@@ -1,0 +1,2 @@
+from .state import ArrayState, ObjectState, State, TpuState  # noqa: F401
+from .run import run, run_fn  # noqa: F401

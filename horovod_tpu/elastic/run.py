@@ -1,0 +1,78 @@
+"""Elastic retry loop (reference ``horovod/common/elastic.py:151``
+``run_fn`` and the per-framework ``hvd.elastic.run`` decorators).
+
+``run(func)`` wraps a training function taking a ``State`` first
+argument.  On ``HorovodInternalError`` (a peer died mid-collective) the
+state is restored from the last commit and the mesh re-initialized; on
+``HostsUpdatedInterrupt`` (membership changed without failure) training
+continues from live state after a re-sync.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+from .. import runtime
+from ..exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from ..utils.logging import get_logger
+from .state import State
+
+
+def run_fn(func: Callable, reset: Callable) -> Callable:
+    @functools.wraps(func)
+    def wrapper(state: State, *args, **kwargs):
+        notification_manager = _get_notification_manager()
+        if notification_manager is not None:
+            notification_manager.init()
+            notification_manager.register_listener(state)
+        skip_sync = False
+        try:
+            while True:
+                if not skip_sync:
+                    state.sync()
+                try:
+                    return func(state, *args, **kwargs)
+                except HorovodInternalError:
+                    get_logger().warning(
+                        "collective failure; restoring committed state"
+                    )
+                    state.restore()
+                    skip_sync = False
+                except HostsUpdatedInterrupt as e:
+                    get_logger().info("hosts updated; re-initializing")
+                    skip_sync = e.skip_sync
+                reset()
+                state.on_reset()
+        finally:
+            if notification_manager is not None:
+                notification_manager.remove_listener(state)
+
+    return wrapper
+
+
+def _default_reset() -> None:
+    """Full re-initialization: tear down the runtime (dropping compiled
+    collectives for the old mesh) and re-init against the (possibly
+    changed) device world — the analog of the reference's
+    ``hvd.shutdown(); hvd.init()`` in ``tensorflow/elastic.py:64``."""
+    runtime.shutdown()
+    runtime.init()
+
+
+def _get_notification_manager():
+    """Worker-side host-update listener, registered by the elastic
+    launcher (reference ``runner/elastic/worker.py``); None outside an
+    elastic job."""
+    try:
+        from ..runner.elastic_worker import get_notification_manager
+
+        return get_notification_manager()
+    except Exception:
+        return None
+
+
+def run(func: Callable) -> Callable:
+    """Decorator: ``@hvd.elastic.run`` (reference per-framework
+    ``elastic.run``)."""
+    return run_fn(func, _default_reset)

@@ -1,0 +1,148 @@
+"""Elastic state: in-memory checkpoint + cross-process sync.
+
+Reference: ``horovod/common/elastic.py:26-148`` (State/ObjectState) and
+the per-framework subclasses (``horovod/torch/elastic/state.py``,
+``tensorflow/elastic.py``).  A State owns everything that must survive a
+membership change: ``commit()`` snapshots to host memory, ``restore()``
+rolls back after a failure, ``sync()`` re-broadcasts from the new rank 0
+after a re-rendezvous.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Callable, Dict, Optional
+
+import jax
+
+from .. import functions, runtime
+from ..exceptions import HostsUpdatedInterrupt
+
+
+class State:
+    """Base elastic state (reference ``common/elastic.py:26``)."""
+
+    def __init__(self, **kwargs):
+        self._host_messages: list = []
+        self._reset_callbacks: list = []
+        self._known_hosts: Optional[frozenset] = None
+
+    def register_reset_callbacks(self, callbacks) -> None:
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self) -> None:
+        self.reset()
+        for cb in self._reset_callbacks:
+            cb()
+
+    def on_hosts_updated(self, timestamp, update_res) -> None:
+        self._host_messages.append((timestamp, update_res))
+
+    def commit(self) -> None:
+        """Snapshot + check for host changes (reference ``elastic.py:60``)."""
+        self.save()
+        self.check_host_updates()
+
+    def check_host_updates(self) -> None:
+        """Raise HostsUpdatedInterrupt when membership changed
+        (reference ``elastic.py:73-96``)."""
+        if self._host_messages:
+            self._host_messages.clear()
+            raise HostsUpdatedInterrupt()
+
+    # Subclass responsibilities (reference elastic.py:99-113):
+    def save(self) -> None:
+        raise NotImplementedError
+
+    def restore(self) -> None:
+        raise NotImplementedError
+
+    def sync(self) -> None:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        """Re-initialize the runtime/mesh after membership change."""
+        from ..ops import eager
+
+        eager.clear_cache()
+
+
+class ObjectState(State):
+    """Checkpoints arbitrary python attributes (reference
+    ``common/elastic.py:116``): attributes passed as kwargs are saved /
+    restored / synced by broadcast from rank 0."""
+
+    def __init__(self, **kwargs):
+        super().__init__()
+        self._saved_state: Dict[str, Any] = dict(kwargs)
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def save(self) -> None:
+        for k in self._saved_state:
+            self._saved_state[k] = copy.deepcopy(getattr(self, k))
+
+    def restore(self) -> None:
+        for k, v in self._saved_state.items():
+            setattr(self, k, copy.deepcopy(v))
+
+    def sync(self) -> None:
+        if self._saved_state:
+            synced = functions.broadcast_object(self._saved_state, root_rank=0)
+            for k, v in synced.items():
+                self._saved_state[k] = v
+                setattr(self, k, v)
+
+
+class ArrayState(ObjectState):
+    """Elastic state for JAX pytrees (params/opt_state): the TPU-native
+    ``TorchState`` (reference ``torch/elastic/state.py:27-140``).
+
+    Pytree attributes are snapshotted to host memory with
+    ``jax.device_get`` (surviving a mesh re-initialization) and restored
+    with ``jax.device_put``; ``sync`` broadcasts from the root process.
+    """
+
+    def __init__(self, **kwargs):
+        self._array_attrs = {
+            k for k, v in kwargs.items() if _is_pytree_of_arrays(v)
+        }
+        super().__init__(**kwargs)
+        self.save()
+
+    def save(self) -> None:
+        for k in list(self._saved_state):
+            v = getattr(self, k)
+            if k in self._array_attrs:
+                self._saved_state[k] = jax.device_get(v)
+            else:
+                self._saved_state[k] = copy.deepcopy(v)
+
+    def restore(self) -> None:
+        for k, v in self._saved_state.items():
+            if k in self._array_attrs:
+                setattr(self, k, jax.device_put(v))
+            else:
+                setattr(self, k, copy.deepcopy(v))
+
+    def sync(self) -> None:
+        if self._saved_state:
+            self.save()
+            synced = functions.broadcast_object(self._saved_state, root_rank=0)
+            for k, v in synced.items():
+                self._saved_state[k] = v
+                setattr(
+                    self, k, jax.device_put(v) if k in self._array_attrs else v
+                )
+
+
+# Framework-flavored alias matching reference naming (TorchState /
+# TensorFlowState -> TpuState).
+TpuState = ArrayState
+
+
+def _is_pytree_of_arrays(v: Any) -> bool:
+    leaves = jax.tree.leaves(v)
+    return bool(leaves) and all(
+        hasattr(l, "shape") and hasattr(l, "dtype") for l in leaves
+    )

@@ -1,0 +1,41 @@
+"""Control-flow exceptions for elastic training.
+
+TPU-native re-design of the reference's ``horovod/common/exceptions.py``:
+the same two exceptions drive the elastic retry loop (reference
+``horovod/common/elastic.py:151``), plus a NotInitialized error for API
+misuse.
+"""
+
+
+class HorovodTpuError(Exception):
+    """Base class for all framework errors."""
+
+
+class NotInitializedError(HorovodTpuError):
+    """Raised when the API is used before ``init()`` was called."""
+
+    def __init__(self, name: str = "horovod_tpu"):
+        super().__init__(
+            f"{name} has not been initialized; call horovod_tpu.init() first."
+        )
+
+
+class HorovodInternalError(HorovodTpuError):
+    """Internal error raised when a collective fails at runtime.
+
+    In elastic mode this unwinds to the ``elastic.run`` retry loop which
+    restores committed state and re-initializes the mesh (reference
+    ``horovod/common/exceptions.py`` + ``elastic.py:151``).
+    """
+
+
+class HostsUpdatedInterrupt(HorovodTpuError):
+    """Raised when cluster membership changed but no worker failed.
+
+    The elastic retry loop re-initializes without restoring state
+    (reference ``horovod/common/elastic.py:73-96``).
+    """
+
+    def __init__(self, skip_sync: bool = False):
+        super().__init__("hosts updated")
+        self.skip_sync = skip_sync

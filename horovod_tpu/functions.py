@@ -1,0 +1,111 @@
+"""Object/parameter broadcast & gather helpers.
+
+Reference: ``horovod/torch/functions.py:29-233`` (broadcast_parameters,
+broadcast_optimizer_state, broadcast_object, allgather_object) and
+``horovod/tensorflow/functions.py`` (broadcast_variables).
+
+Under single-controller JAX there is one logical copy of the parameters,
+so the single-process case is an identity; in multi-process (multi-host
+pod) runs these synchronize host-side values through the device mesh via
+``jax.experimental.multihost_utils`` — the TPU-native replacement for
+the reference's rank-0 MPI/Gloo broadcast.
+"""
+
+from __future__ import annotations
+
+import io
+import pickle
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from . import runtime
+from .process_sets import ProcessSet
+
+
+def broadcast_parameters(params: Any, root_rank: int = 0) -> Any:
+    """Synchronize a parameter pytree from the root (reference
+    ``horovod/torch/functions.py:29`` / ``broadcast_variables``).
+
+    Single-process: params are already the single source of truth —
+    returned as-is (devices receive replicas when the train step shards
+    them).  Multi-process: host values are synchronized from the root
+    process over the mesh.
+    """
+    rt = runtime.get_runtime()
+    if rt.process_count == 1:
+        return params
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.broadcast_one_to_all(
+        params, is_source=rt.process_rank == _root_process(root_rank)
+    )
+
+
+def broadcast_variables(params: Any, root_rank: int = 0) -> Any:
+    """TF-flavored alias (reference ``tensorflow/functions.py``)."""
+    return broadcast_parameters(params, root_rank)
+
+
+def broadcast_optimizer_state(opt_state: Any, root_rank: int = 0) -> Any:
+    """Reference ``horovod/torch/functions.py:116``: optimizer state is a
+    pytree here, so it broadcasts exactly like parameters."""
+    return broadcast_parameters(opt_state, root_rank)
+
+
+def _root_process(root_rank: int) -> int:
+    """Map a device rank to the process that owns it."""
+    rt = runtime.get_runtime()
+    return rt.devices[root_rank].process_index
+
+
+def broadcast_object(
+    obj: Any,
+    root_rank: int = 0,
+    name: Optional[str] = None,
+    process_set: Optional[ProcessSet] = None,
+) -> Any:
+    """Pickle + broadcast an arbitrary Python object (reference
+    ``horovod/torch/functions.py:165``)."""
+    rt = runtime.get_runtime()
+    if rt.process_count == 1:
+        return obj
+    from jax.experimental import multihost_utils
+
+    is_source = rt.process_rank == _root_process(root_rank)
+    if is_source:
+        payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+        length = np.int64(payload.size)
+    else:
+        payload = None
+        length = np.int64(0)
+    length = int(multihost_utils.broadcast_one_to_all(length, is_source=is_source))
+    buf = np.zeros((length,), dtype=np.uint8)
+    if is_source:
+        buf[: payload.size] = payload
+    buf = multihost_utils.broadcast_one_to_all(buf, is_source=is_source)
+    return pickle.loads(np.asarray(buf).tobytes())
+
+
+def allgather_object(
+    obj: Any, name: Optional[str] = None, process_set: Optional[ProcessSet] = None
+) -> list:
+    """Gather arbitrary Python objects from every process (reference
+    ``horovod/torch/functions.py:206``).  Returns a list with one entry
+    per process (single-process: a one-element list)."""
+    rt = runtime.get_runtime()
+    if rt.process_count == 1:
+        return [obj]
+    from jax.experimental import multihost_utils
+
+    payload = np.frombuffer(pickle.dumps(obj), dtype=np.uint8)
+    lengths = multihost_utils.process_allgather(np.int64(payload.size))
+    maxlen = int(np.max(lengths))
+    buf = np.zeros((maxlen,), dtype=np.uint8)
+    buf[: payload.size] = payload
+    gathered = multihost_utils.process_allgather(buf)
+    out = []
+    for i in range(rt.process_count):
+        out.append(pickle.loads(np.asarray(gathered[i, : int(lengths[i])]).tobytes()))
+    return out

@@ -1,0 +1,11 @@
+"""Collective operations.
+
+``horovod_tpu.ops.traced`` — axis-name collectives for use inside
+``shard_map``/pjit (the compute hot path).
+``horovod_tpu.ops.eager``  — Horovod-style eager API on stacked per-rank
+arrays over the global mesh.
+"""
+
+from . import eager, fusion, traced  # noqa: F401
+from .adasum import adasum_allreduce  # noqa: F401
+from .traced import Adasum, Average, Max, Min, Product, ReduceOp, Sum  # noqa: F401

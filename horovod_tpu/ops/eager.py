@@ -1,0 +1,377 @@
+"""Eager collective API on the global mesh (the ``hvd.allreduce`` surface).
+
+The reference's eager ops (``horovod/torch/mpi_ops.py``,
+``tensorflow/mpi_ops.py``) take each rank's local tensor, enqueue it to
+the background service, and return when every rank's contribution is
+reduced.  Under single-controller JAX the "one tensor per rank" model is
+expressed as a **stacked array**: shape ``(size, ...)`` sharded one row
+per device over the world axis — row r is rank r's tensor.  Each
+collective is a jit-compiled ``shard_map`` over the mesh, dispatched
+asynchronously (JAX dispatch is async by default, which already gives the
+reference's handle/synchronize overlap semantics).
+
+The jit cache plays the role of the reference's ResponseCache
+(``response_cache.{h,cc}``): the first call for a given
+(shape, dtype, op, set) traces and compiles; repeats hit the cache with
+no negotiation.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..exceptions import HorovodTpuError
+from ..process_sets import ProcessSet
+from ..runtime import WORLD_AXIS, get_runtime
+from . import traced
+from .traced import Adasum, Average, Max, Min, Product, ReduceOp, Sum  # re-export
+
+
+class Handle:
+    """Async op handle (reference ``HandleManager``,
+    ``torch/handle_manager.{h,cc}``).  JAX arrays are futures already; the
+    handle just carries them plus the op name for the timeline."""
+
+    __slots__ = ("value", "name")
+
+    def __init__(self, value, name: Optional[str] = None):
+        self.value = value
+        self.name = name
+
+    def done(self) -> bool:
+        try:
+            leaves = jax.tree.leaves(self.value)
+            return all(getattr(l, "is_ready", lambda: True)() for l in leaves)
+        except Exception:
+            return True
+
+    def wait(self):
+        jax.block_until_ready(self.value)
+        return self.value
+
+
+def synchronize(handle: Handle):
+    """Block until the collective completed (reference
+    ``torch/mpi_ops.py:865`` ``synchronize``)."""
+    return handle.wait()
+
+
+def poll(handle: Handle) -> bool:
+    """Non-blocking completion check (reference ``torch/mpi_ops.py:849``)."""
+    return handle.done()
+
+
+def _mesh():
+    return get_runtime().mesh
+
+
+def _record(name: Optional[str], op: str, nbytes: int):
+    tl = get_runtime().timeline
+    if tl is not None:
+        tl.record_op(name or op, op, nbytes)
+
+
+def _ps_id(process_set: Optional[ProcessSet]) -> Optional[int]:
+    """Validate a process set is registered (reference rejects collectives
+    on unknown process sets) and return its id for the dispatch cache."""
+    if process_set is None:
+        return None
+    if process_set.process_set_id is None:
+        raise HorovodTpuError(
+            f"process set {list(process_set.ranks)} is not registered; call "
+            "hvd.add_process_set() or pass it to init() first"
+        )
+    table = get_runtime().process_set_table
+    try:
+        registered = table.get(process_set.process_set_id)
+    except KeyError:
+        raise HorovodTpuError(
+            f"process set id {process_set.process_set_id} is not registered"
+        ) from None
+    if registered.ranks != process_set.ranks:
+        raise HorovodTpuError(
+            f"process set id {process_set.process_set_id} is registered with "
+            f"different ranks ({list(registered.ranks)} vs "
+            f"{list(process_set.ranks)})"
+        )
+    return process_set.process_set_id
+
+
+def _stacked(x: jax.Array) -> jax.Array:
+    """Validate/shard a stacked per-rank array: shape (size, ...)."""
+    rt = get_runtime()
+    x = jnp.asarray(x)
+    if x.ndim == 0 or x.shape[0] != rt.size:
+        raise HorovodTpuError(
+            f"eager collectives take stacked per-rank arrays with leading "
+            f"dimension == size ({rt.size}); got shape {x.shape}. Inside "
+            f"jit, use horovod_tpu.ops.traced instead."
+        )
+    return jax.device_put(x, NamedSharding(rt.mesh, P(WORLD_AXIS)))
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted(fn_name: str, static: Tuple) -> callable:
+    """Build + cache the jitted shard_map dispatch for one op config.
+
+    The cache is the TPU analog of the reference ResponseCache: repeat
+    collectives with the same signature skip straight to the compiled
+    executable.  Cleared on shutdown (the mesh is baked in).
+    """
+    mesh = _mesh()
+    kwargs = dict(static)
+    ps_id = kwargs.pop("process_set_id", None)
+    if ps_id is not None:
+        kwargs["process_set"] = get_runtime().process_set_table.get(ps_id)
+    fn = getattr(traced, fn_name)
+    n_in = kwargs.pop("n_tensors", None)
+
+    if n_in is None:
+        def body(v):
+            return jax.tree.map(lambda a: a[None], fn(v[0], **kwargs))
+
+        return jax.jit(
+            jax.shard_map(
+                body, mesh=mesh, in_specs=P(WORLD_AXIS), out_specs=P(WORLD_AXIS)
+            )
+        )
+
+    def body_group(*vs):
+        outs = fn([v[0] for v in vs], **kwargs)
+        return tuple(o[None] for o in outs)
+
+    return jax.jit(
+        jax.shard_map(
+            body_group,
+            mesh=mesh,
+            in_specs=tuple(P(WORLD_AXIS) for _ in range(n_in)),
+            out_specs=tuple(P(WORLD_AXIS) for _ in range(n_in)),
+        )
+    )
+
+
+def clear_cache() -> None:
+    """Drop compiled dispatches (called on shutdown / mesh change)."""
+    _jitted.cache_clear()
+
+
+def allreduce(
+    x: jax.Array,
+    average: Optional[bool] = None,
+    op: Optional[int] = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    process_set: Optional[ProcessSet] = None,
+    name: Optional[str] = None,
+) -> jax.Array:
+    """Stacked allreduce: every output row is the reduction of all rows
+    (rows of ranks outside ``process_set`` pass through unchanged).
+
+    Mirrors ``hvd.allreduce`` (``torch/mpi_ops.py:236``,
+    ``tensorflow/__init__.py:55``): ``average=True`` is the default, and
+    ``op``/``average`` are mutually exclusive like the reference.
+    """
+    if average is not None and op is not None:
+        raise ValueError("specify either average or op, not both")
+    if op is None:
+        op = Average if (average is None or average) else Sum
+    x = _stacked(x)
+    _record(name, "ALLREDUCE", x.nbytes)
+    static = (
+        ("op", op),
+        ("prescale_factor", float(prescale_factor)),
+        ("postscale_factor", float(postscale_factor)),
+        ("process_set_id", _ps_id(process_set)),
+    )
+    return _jitted("allreduce", static)(x)
+
+
+def allreduce_async(*args, name: Optional[str] = None, **kwargs) -> Handle:
+    return Handle(allreduce(*args, name=name, **kwargs), name)
+
+
+def grouped_allreduce(
+    xs: Sequence[jax.Array],
+    average: Optional[bool] = None,
+    op: Optional[int] = None,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    process_set: Optional[ProcessSet] = None,
+    name: Optional[str] = None,
+) -> List[jax.Array]:
+    """Atomic fused allreduce of a tensor group (reference
+    ``grouped_allreduce``, ``torch/mpi_ops.py`` / GroupTable)."""
+    if average is not None and op is not None:
+        raise ValueError("specify either average or op, not both")
+    if op is None:
+        op = Average if (average is None or average) else Sum
+    xs = [_stacked(x) for x in xs]
+    _record(name, "GROUPED_ALLREDUCE", sum(x.nbytes for x in xs))
+    static = (
+        ("op", op),
+        ("prescale_factor", float(prescale_factor)),
+        ("postscale_factor", float(postscale_factor)),
+        ("process_set_id", _ps_id(process_set)),
+        ("n_tensors", len(xs)),
+    )
+    return list(_jitted("grouped_allreduce", static)(*xs))
+
+
+def grouped_allreduce_async(xs, name: Optional[str] = None, **kwargs) -> Handle:
+    return Handle(grouped_allreduce(xs, name=name, **kwargs), name)
+
+
+def allgather(
+    x: jax.Array,
+    process_set: Optional[ProcessSet] = None,
+    name: Optional[str] = None,
+) -> jax.Array:
+    """Stacked allgather: output row r = concat of all rows along dim 0
+    (reference ``hvd.allgather``).  All rows must share a shape; ragged
+    gathers go through ``functions.allgather_object``."""
+    x = _stacked(x)
+    _record(name, "ALLGATHER", x.nbytes)
+    static = (
+        ("process_set_id", _ps_id(process_set)),
+    )
+    return _jitted("allgather", static)(x)
+
+
+def allgather_async(x, name: Optional[str] = None, **kwargs) -> Handle:
+    return Handle(allgather(x, name=name, **kwargs), name)
+
+
+def broadcast(
+    x: jax.Array,
+    root_rank: int,
+    process_set: Optional[ProcessSet] = None,
+    name: Optional[str] = None,
+) -> jax.Array:
+    """Stacked broadcast: every in-set row becomes row[root]."""
+    x = _stacked(x)
+    _record(name, "BROADCAST", x.nbytes)
+    static = (
+        ("root_rank", int(root_rank)),
+        ("process_set_id", _ps_id(process_set)),
+    )
+    return _jitted("broadcast", static)(x)
+
+
+def broadcast_async(x, root_rank, name: Optional[str] = None, **kwargs) -> Handle:
+    return Handle(broadcast(x, root_rank, name=name, **kwargs), name)
+
+
+def reducescatter(
+    x: jax.Array,
+    op: int = Sum,
+    process_set: Optional[ProcessSet] = None,
+    name: Optional[str] = None,
+) -> jax.Array:
+    x = _stacked(x)
+    _record(name, "REDUCESCATTER", x.nbytes)
+    static = (
+        ("op", op),
+        ("process_set_id", _ps_id(process_set)),
+    )
+    return _jitted("reducescatter", static)(x)
+
+
+def alltoall(
+    x: jax.Array,
+    splits: Optional[jax.Array] = None,
+    process_set: Optional[ProcessSet] = None,
+    name: Optional[str] = None,
+) -> jax.Array | Tuple[jax.Array, jax.Array]:
+    """Stacked all-to-all (reference ``hvd.alltoall``,
+    ``operations.cc:1630``).
+
+    With ``splits=None``, row r is split into ``size`` equal chunks and
+    chunk j goes to output row j.  With ``splits`` (shape (size, size):
+    ``splits[r][j]`` = rows rank r sends to rank j), chunks are padded to
+    the max split for the XLA all_to_all and the per-rank receive counts
+    are returned alongside (the reference negotiates recvsplits through
+    the controller, ``collective_operations.h:209-272``).
+    """
+    x = _stacked(x)
+    _record(name, "ALLTOALL", x.nbytes)
+    rt = get_runtime()
+    n = rt.size
+    if splits is None:
+        static = (
+            ("process_set_id", _ps_id(process_set)),
+        )
+        return _jitted("alltoall", static)(x)
+
+    if process_set is not None and _ps_id(process_set) != 0:
+        raise NotImplementedError(
+            "alltoall with explicit splits is currently only supported on "
+            "the global process set (the padded chunk layout is built for "
+            "world ranks); use the equal-split form for subsets"
+        )
+    splits = np.asarray(splits)
+    if splits.shape != (n, n):
+        raise HorovodTpuError(
+            f"splits must have shape (size, size)=({n},{n}); got {splits.shape}"
+        )
+    d0 = x.shape[1]
+    if (splits.sum(axis=1) != d0).any():
+        raise HorovodTpuError("each rank's splits must sum to its row count")
+    max_chunk = int(splits.max())
+    # Pad each (r -> j) chunk to max_chunk host-side via gather indices,
+    # run the equal-split all_to_all, and return recv counts.
+    pad_idx = np.zeros((n, n * max_chunk), dtype=np.int32)
+    valid = np.zeros((n, n * max_chunk), dtype=bool)
+    offs = np.concatenate(
+        [np.zeros((n, 1), dtype=np.int64), np.cumsum(splits, axis=1)], axis=1
+    )
+    for r in range(n):
+        for j in range(n):
+            c = int(splits[r, j])
+            base = j * max_chunk
+            pad_idx[r, base : base + c] = offs[r, j] + np.arange(c)
+            valid[r, base : base + c] = True
+    gathered = jnp.take_along_axis(
+        x, jnp.asarray(pad_idx).reshape(n, n * max_chunk, *([1] * (x.ndim - 2))), axis=1
+    ) if x.ndim > 2 else jnp.take_along_axis(x, jnp.asarray(pad_idx), axis=1)
+    gathered = jnp.where(
+        jnp.asarray(valid).reshape((n, n * max_chunk) + (1,) * (x.ndim - 2)),
+        gathered,
+        jnp.zeros_like(gathered),
+    )
+    static = (
+        ("process_set_id", _ps_id(process_set)),
+    )
+    out = _jitted("alltoall", static)(gathered)
+    recv_splits = jnp.asarray(splits.T)  # recv_splits[r][j] = rows r gets from j
+    return out, recv_splits
+
+
+def alltoall_async(x, splits=None, name: Optional[str] = None, **kwargs) -> Handle:
+    return Handle(alltoall(x, splits, name=name, **kwargs), name)
+
+
+def barrier(process_set: Optional[ProcessSet] = None) -> None:
+    """Blocking barrier over the mesh (reference ``horovod_barrier``)."""
+    static = (
+        ("op", Sum),
+        ("process_set_id", _ps_id(process_set)),
+    )
+    rt = get_runtime()
+    token = jnp.zeros((rt.size, 1), dtype=jnp.int32)
+    jax.block_until_ready(_jitted("allreduce", static)(token))
+
+
+def join() -> int:
+    """Reference ``hvd.join()`` (``operations.cc:1714``): lets a rank with
+    no more data participate in peers' collectives with zero
+    contributions.  Under single-controller SPMD uneven per-rank batches
+    cannot arise inside one process; across processes this is a barrier.
+    Returns the last joined rank like the reference (here: size-1)."""
+    barrier()
+    return get_runtime().size - 1

@@ -1,0 +1,112 @@
+"""Tensor fusion: bucketing small tensors into flat buffers.
+
+TPU-native re-design of the reference's fusion machinery
+(``horovod/common/fusion_buffer_manager.{h,cc}`` + the response fusion in
+``Controller::FuseResponses``, ``controller.cc:793``).  The reference
+copies ready tensors into a persistent 64 MB device buffer, runs one
+NCCL call, and copies back.  Under XLA there is no persistent staging
+buffer to manage: fusion is expressed *functionally* — ravel + concat
+into one flat array per dtype, one collective, then slice back out — and
+XLA fuses the copies into the collective's prologue/epilogue (the analog
+of the reference's BatchedD2DMemcpy CUDA kernel,
+``ops/cuda/cuda_kernels.cu``).
+
+The bucketing *plan* (which tensors share a buffer, respecting the
+fusion-threshold knob and dtype grouping with mixed-precision look-ahead)
+mirrors ``FuseResponses`` and is computed host-side at trace time.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..utils import env
+
+Meta = Tuple[Any, ...]
+
+
+def flatten_group(xs: Sequence[jax.Array]) -> Tuple[List[jax.Array], Meta]:
+    """Concatenate tensors into one flat 1-D buffer per dtype.
+
+    Returns (flat_buffers, meta); order within a dtype follows input
+    order, like the reference fusion buffer layout.
+    """
+    by_dtype: dict = {}
+    entries = []  # (dtype_key, offset, shape, index)
+    for i, x in enumerate(xs):
+        key = jnp.dtype(x.dtype).name
+        bufs = by_dtype.setdefault(key, [])
+        offset = sum(int(np.prod(b.shape)) for b in bufs)
+        bufs.append(x.reshape(-1))
+        entries.append((key, offset, tuple(x.shape), i))
+    flats = []
+    dtype_order = []
+    for key, bufs in by_dtype.items():
+        flats.append(jnp.concatenate(bufs) if len(bufs) > 1 else bufs[0])
+        dtype_order.append(key)
+    return flats, (dtype_order, entries)
+
+
+def unflatten_group(flats: Sequence[jax.Array], meta: Meta) -> List[jax.Array]:
+    dtype_order, entries = meta
+    by_dtype = dict(zip(dtype_order, flats))
+    out: List[jax.Array] = [None] * len(entries)  # type: ignore[list-item]
+    for key, offset, shape, i in entries:
+        size = int(np.prod(shape)) if shape else 1
+        flat = by_dtype[key]
+        out[i] = jax.lax.dynamic_slice_in_dim(flat, offset, size, 0).reshape(shape)
+    return out
+
+
+def bucket_plan(
+    sizes_bytes: Sequence[int],
+    dtypes: Sequence[str],
+    threshold_bytes: int | None = None,
+) -> List[List[int]]:
+    """Greedy in-order bucketing under the fusion threshold.
+
+    Equivalent of ``Controller::FuseResponses`` (``controller.cc:793``):
+    consecutive tensors of the same dtype share a bucket while the total
+    stays <= threshold; a look-ahead lets later same-dtype tensors join an
+    open bucket across interleaved dtypes (the reference's mixed-precision
+    look-ahead).  Returns buckets as lists of tensor indices.  A
+    threshold of 0 disables fusion (one bucket per tensor), matching
+    ``HOROVOD_FUSION_THRESHOLD=0``.
+    """
+    if threshold_bytes is None:
+        threshold_bytes = env.get_int(
+            env.FUSION_THRESHOLD, env.DEFAULT_FUSION_THRESHOLD
+        )
+    if threshold_bytes <= 0:
+        return [[i] for i in range(len(sizes_bytes))]
+    open_buckets: dict = {}  # dtype -> (bucket, bytes)
+    buckets: List[List[int]] = []
+    for i, (sz, dt) in enumerate(zip(sizes_bytes, dtypes)):
+        cur = open_buckets.get(dt)
+        if cur is not None and cur[1] + sz <= threshold_bytes:
+            cur[0].append(i)
+            open_buckets[dt] = (cur[0], cur[1] + sz)
+        else:
+            b = [i]
+            buckets.append(b)
+            open_buckets[dt] = (b, sz)
+    return buckets
+
+
+def pad_to_atomic_unit(flat: jax.Array, unit_bytes: int | None = None) -> Tuple[jax.Array, int]:
+    """Pad a flat buffer so its byte size is a multiple of the atomic unit
+    (reference ``FUSION_BUFFER_ATOMIC_UNIT``, ``common.h:146``; on TPU we
+    align to the lane tile so reduce_scatter shards stay tiled)."""
+    if unit_bytes is None:
+        unit_bytes = env.FUSION_BUFFER_ATOMIC_UNIT
+    itemsize = jnp.dtype(flat.dtype).itemsize
+    unit_elems = max(1, unit_bytes // itemsize)
+    n = flat.shape[0]
+    padded = ((n + unit_elems - 1) // unit_elems) * unit_elems
+    if padded != n:
+        flat = jnp.pad(flat, (0, padded - n))
+    return flat, n

@@ -1,0 +1,345 @@
+"""Traced collectives: axis-name based, usable inside ``shard_map``/pjit.
+
+TPU-native re-design of the reference's collective op layer
+(``horovod/common/ops/collective_operations.{h,cc}``,
+``nccl_operations.cc``): instead of enqueueing requests to a background
+thread that negotiates readiness and dispatches NCCL kernels, every
+collective here is a pure function of its inputs that lowers to a single
+XLA collective (``psum`` / ``all_gather`` / ``reduce_scatter`` /
+``all_to_all``) over the ICI mesh.  Fusion, scheduling, and stream
+management are XLA's job; process-set restriction lowers to XLA
+``replica_groups`` when the set tiles the world evenly, otherwise to a
+masked whole-world collective (correct for arbitrary, even overlapping,
+sets).
+
+Pre/postscale mirror the reference's ``ScaleBuffer``
+(``collective_operations.h:91-127``): scaling is fused into the same XLA
+program, with fp16/bf16 inputs scaled in fp32 like the reference's
+AVX/CUDA paths.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from ..process_sets import ProcessSet
+from ..runtime import WORLD_AXIS, get_runtime
+
+Axis = Union[str, Sequence[str]]
+
+# Reduction op ids — match the reference's ReduceOp values exposed as
+# hvd.Average / hvd.Sum / hvd.Adasum (horovod/torch/mpi_ops.py,
+# operations.cc:1396-1410), extended with Min/Max/Product.
+class ReduceOp:
+    AVERAGE = 0
+    SUM = 1
+    ADASUM = 2
+    MIN = 3
+    MAX = 4
+    PRODUCT = 5
+
+
+Average = ReduceOp.AVERAGE
+Sum = ReduceOp.SUM
+Adasum = ReduceOp.ADASUM
+Min = ReduceOp.MIN
+Max = ReduceOp.MAX
+Product = ReduceOp.PRODUCT
+
+
+def _axis_size(axis: Axis) -> int:
+    """Static size of a (possibly tuple of) mesh axis name(s)."""
+    return lax.axis_size(axis)
+
+
+def _set_info(axis: Axis, process_set: Optional[ProcessSet]):
+    """Resolve (groups, mask, position, set_size) for a process set.
+
+    ``groups`` is an equal-size partition for XLA replica_groups, or None
+    when the masked path must be used.  ``mask``/``position`` are traced
+    per-rank scalars derived from static lookup tables.
+    """
+    if process_set is None or process_set.process_set_id == 0:
+        return None, None, None, _axis_size(axis)
+    table = get_runtime().process_set_table
+    n = _axis_size(axis)
+    idx = lax.axis_index(axis)
+    mask_tab = np.zeros((n,), dtype=np.bool_)
+    pos_tab = np.zeros((n,), dtype=np.int32)
+    for i, r in enumerate(process_set.ranks):
+        mask_tab[r] = True
+        pos_tab[r] = i
+    mask = jnp.asarray(mask_tab)[idx]
+    position = jnp.asarray(pos_tab)[idx]
+    groups = table.partition_groups(process_set)
+    return groups, mask, position, len(process_set.ranks)
+
+
+def _scale(x: jax.Array, factor: float) -> jax.Array:
+    if factor == 1.0:
+        return x
+    if x.dtype in (jnp.float16, jnp.bfloat16):
+        # Scale in fp32 like the reference's fp16 ScaleBuffer path
+        # (collective_operations.h:91-127, cuda ScaleBufferCudaImpl).
+        return (x.astype(jnp.float32) * factor).astype(x.dtype)
+    if jnp.issubdtype(x.dtype, jnp.integer) or jnp.issubdtype(x.dtype, jnp.bool_):
+        # Integer average/fractional scale: compute in fp32 and truncate
+        # back (casting 0.125 to int32 first would zero the result).
+        return (x.astype(jnp.float32) * factor).astype(x.dtype)
+    return x * jnp.asarray(factor, dtype=x.dtype)
+
+
+def _grouped_sum(x: jax.Array, axis: Axis, groups, group_size: int) -> jax.Array:
+    """Within-group sum via reduce_scatter + all_gather with replica
+    groups; flattens and pads so the scatter dimension tiles evenly."""
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    padded = ((n + group_size - 1) // group_size) * group_size
+    if padded != n:
+        flat = jnp.pad(flat, (0, padded - n))
+    shard = lax.psum_scatter(
+        flat, axis, scatter_dimension=0, axis_index_groups=groups, tiled=True
+    )
+    full = lax.all_gather(shard, axis, axis_index_groups=groups, tiled=True)
+    return full[:n].reshape(x.shape)
+
+
+def allreduce(
+    x: jax.Array,
+    axis: Axis = WORLD_AXIS,
+    op: int = Average,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    process_set: Optional[ProcessSet] = None,
+) -> jax.Array:
+    """Allreduce over a mesh axis (reference ``EnqueueTensorAllreduce``,
+    ``operations.cc:1342`` + ``NCCLAllreduce::Execute``).
+
+    Inside the jit program this is a single XLA all-reduce; AVERAGE is
+    SUM with postscale 1/set_size exactly as the reference rewrites it
+    (``operations.cc:1396-1399``).
+    """
+    if op == Adasum:
+        from .adasum import adasum_allreduce
+
+        return adasum_allreduce(
+            _scale(x, prescale_factor), axis=axis, process_set=process_set
+        )
+
+    groups, mask, _, set_size = _set_info(axis, process_set)
+    x = _scale(x, prescale_factor)
+    if op == Average:
+        postscale_factor = postscale_factor / set_size
+        op = Sum
+
+    if op == Sum:
+        if mask is None:
+            y = lax.psum(x, axis)
+        elif groups is not None:
+            # Equal-size partition fast path: reduce_scatter + all_gather
+            # with XLA replica_groups, so each group's reduction rides only
+            # its own ICI links and different process sets reduce
+            # concurrently (shard_map's psum does not take
+            # axis_index_groups; psum_scatter/all_gather do).
+            y = _grouped_sum(x, axis, groups, len(groups[0]))
+        else:
+            y = lax.psum(jnp.where(mask, x, jnp.zeros_like(x)), axis)
+    elif op in (Min, Max):
+        if mask is None:
+            y = lax.pmin(x, axis) if op == Min else lax.pmax(x, axis)
+        else:
+            ident = jnp.array(
+                np.inf if op == Min else -np.inf, dtype=x.dtype
+            )
+            masked = jnp.where(mask, x, jnp.full_like(x, ident))
+            y = lax.pmin(masked, axis) if op == Min else lax.pmax(masked, axis)
+    elif op == Product:
+        # No XLA product collective: gather then reduce locally (rare op).
+        if mask is None:
+            g = lax.all_gather(x, axis)
+            y = jnp.prod(g, axis=0)
+        else:
+            masked = jnp.where(mask, x, jnp.ones_like(x))
+            g = lax.all_gather(masked, axis)
+            y = jnp.prod(g, axis=0)
+    else:
+        raise ValueError(f"unknown reduce op {op}")
+
+    y = _scale(y, postscale_factor)
+    if mask is not None:
+        y = jnp.where(mask, y, x)
+    return y
+
+
+def grouped_allreduce(
+    xs: Sequence[jax.Array],
+    axis: Axis = WORLD_AXIS,
+    op: int = Average,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    process_set: Optional[ProcessSet] = None,
+) -> List[jax.Array]:
+    """Atomically allreduce a group of tensors as one fused collective
+    (reference ``EnqueueTensorAllreduces`` + GroupTable,
+    ``operations.cc:1487-1492``).
+
+    Tensors are flattened and concatenated per dtype into single flat
+    buffers — the explicit analog of the reference's fusion buffer — so
+    the group completes as one XLA collective per dtype.
+    """
+    from .fusion import flatten_group, unflatten_group
+
+    flats, meta = flatten_group(xs)
+    reduced = [
+        allreduce(
+            f,
+            axis=axis,
+            op=op,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+            process_set=process_set,
+        )
+        for f in flats
+    ]
+    return unflatten_group(reduced, meta)
+
+
+def allgather(
+    x: jax.Array,
+    axis: Axis = WORLD_AXIS,
+    process_set: Optional[ProcessSet] = None,
+) -> jax.Array:
+    """Concatenate each rank's tensor along dim 0 (reference
+    ``AllgatherOp``, ``collective_operations.h:129-179``).
+
+    All ranks must pass the same shape here; ragged first dimensions are
+    handled by the eager layer via the size-negotiation helper (the
+    reference computes recvcounts in ``ConstructResponse``).
+    For process sets, members receive the set-gather; non-members receive
+    zeros (they should not rely on the result, mirroring the reference
+    where non-members may not call).
+    """
+    groups, mask, position, set_size = _set_info(axis, process_set)
+    if mask is None:
+        return lax.all_gather(x, axis, tiled=True)
+    if groups is not None:
+        y = lax.all_gather(x, axis, tiled=True, axis_index_groups=groups)
+        return jnp.where(mask, y, jnp.zeros_like(y))
+    # Arbitrary set: scatter into per-member slots and sum-place.
+    slots = jnp.zeros((set_size,) + x.shape, dtype=x.dtype)
+    contrib = jnp.where(mask, x, jnp.zeros_like(x))
+    slots = lax.dynamic_update_index_in_dim(slots, contrib, position, 0)
+    gathered = lax.psum(slots, axis)
+    return gathered.reshape((set_size * x.shape[0],) + x.shape[1:])
+
+
+def broadcast(
+    x: jax.Array,
+    root_rank: int,
+    axis: Axis = WORLD_AXIS,
+    process_set: Optional[ProcessSet] = None,
+) -> jax.Array:
+    """Every rank in the set receives root's value (reference
+    ``BroadcastOp`` / ``EnqueueTensorBroadcast``).
+
+    ``root_rank`` is relative to the process set, like the reference
+    (process_set.h).  Lowered to a masked psum — XLA pattern-matches the
+    one-hot-sum into a broadcast from the source partition.
+    """
+    groups, mask, position, set_size = _set_info(axis, process_set)
+    idx = lax.axis_index(axis)
+    if mask is None:
+        src = jnp.where(idx == root_rank, x, jnp.zeros_like(x))
+        return lax.psum(src, axis)
+    global_root = process_set.ranks[root_rank]
+    src = jnp.where(idx == global_root, x, jnp.zeros_like(x))
+    y = lax.psum(src, axis)
+    return jnp.where(mask, y, x)
+
+
+def reducescatter(
+    x: jax.Array,
+    axis: Axis = WORLD_AXIS,
+    op: int = Sum,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    process_set: Optional[ProcessSet] = None,
+) -> jax.Array:
+    """Reduce + scatter along dim 0; each rank gets its 1/set_size shard.
+
+    The reference exposes reducescatter only as the first phase of
+    hierarchical/Adasum allreduce (``NCCLHierarchicalAllreduce``); here it
+    is first-class because reduce_scatter is the bandwidth-optimal
+    gradient primitive on ICI (ZeRO-style sharded optimizers use it).
+    """
+    groups, mask, _, set_size = _set_info(axis, process_set)
+    if x.shape[0] % set_size != 0:
+        raise ValueError(
+            f"reducescatter dim 0 ({x.shape[0]}) must be divisible by set "
+            f"size {set_size}"
+        )
+    x = _scale(x, prescale_factor)
+    if op == Average:
+        postscale_factor = postscale_factor / set_size
+        op = Sum
+    if op != Sum:
+        raise ValueError("reducescatter supports SUM/AVERAGE")
+    if mask is None:
+        y = lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+    elif groups is not None:
+        y = lax.psum_scatter(
+            x, axis, scatter_dimension=0, tiled=True, axis_index_groups=groups
+        )
+        shard = x.shape[0] // set_size
+        y = jnp.where(mask, y, jnp.zeros((shard,) + x.shape[1:], x.dtype))
+    else:
+        summed = allreduce(x, axis=axis, op=Sum, process_set=process_set)
+        shard = x.shape[0] // set_size
+        _, _, position, _ = _set_info(axis, process_set)
+        y = lax.dynamic_slice_in_dim(summed, position * shard, shard, 0)
+    return _scale(y, postscale_factor)
+
+
+def alltoall(
+    x: jax.Array,
+    axis: Axis = WORLD_AXIS,
+    process_set: Optional[ProcessSet] = None,
+) -> jax.Array:
+    """Equal-split all-to-all along dim 0 (reference ``AlltoallOp``,
+    ``collective_operations.h:209-272``).
+
+    Rank i's j-th chunk goes to rank j's i-th chunk.  Uneven splits are
+    handled by the eager layer via padding to the max split (XLA
+    all_to_all requires equal splits); this traced form is also the
+    Ulysses sequence-parallel primitive (see parallel/ulysses.py).
+    """
+    groups, mask, _, set_size = _set_info(axis, process_set)
+    if x.shape[0] % set_size != 0:
+        raise ValueError(
+            f"alltoall dim 0 ({x.shape[0]}) must be divisible by set size "
+            f"{set_size}"
+        )
+    if mask is None:
+        return lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=True)
+    if groups is not None:
+        y = lax.all_to_all(
+            x, axis, split_axis=0, concat_axis=0, tiled=True,
+            axis_index_groups=groups,
+        )
+        return jnp.where(mask, y, jnp.zeros_like(y))
+    raise NotImplementedError(
+        "alltoall on a process set that does not evenly partition the world "
+        "requires padding; use the eager API or an equal partition."
+    )
+
+
+def barrier(axis: Axis = WORLD_AXIS, process_set: Optional[ProcessSet] = None) -> jax.Array:
+    """Synchronization token (reference ``horovod_barrier``); returns a
+    scalar that depends on every rank in the set."""
+    token = jnp.zeros((), dtype=jnp.int32)
+    return allreduce(token, axis=axis, op=Sum, process_set=process_set)

@@ -1,0 +1,5 @@
+from .distributed_optimizer import (  # noqa: F401
+    DistributedOptimizer,
+    DistributedOptimizerState,
+    distributed_train_step,
+)

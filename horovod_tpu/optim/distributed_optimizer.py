@@ -1,0 +1,327 @@
+"""DistributedOptimizer: gradient reduction fused into an optax transform.
+
+TPU-native re-design of the reference's per-framework optimizers
+(``horovod/torch/optimizer.py:506`` ``DistributedOptimizer``,
+``horovod/tensorflow/__init__.py:627`` + ``DistributedGradientTape``
+``:759``).  The reference hooks each parameter's grad-accumulator,
+fires async allreduces as gradients become ready, and blocks in
+``optimizer.step()``.  Under XLA the whole training step is one compiled
+program, so "overlap" is the compiler's latency-hiding job; what this
+wrapper keeps from the reference is the *semantics and knobs*:
+
+  * op: Average / Sum / Adasum              (optimizer.py:72, :335)
+  * compression (fp16/bf16 wire)            (torch/compression.py)
+  * backward_passes_per_step local gradient
+    aggregation                              (optimizer.py:72,
+                                             tensorflow/gradient_aggregation.py)
+  * gradient_predivide_factor split into
+    pre/postscale                            (optimizer.py:194-205)
+  * tensor fusion bucketing                  (fusion_buffer_manager +
+                                             FuseResponses)
+  * process sets                             (optimizer.py process_set arg)
+
+The returned ``optax.GradientTransformation``'s ``update`` must run in an
+SPMD context (inside ``shard_map`` over the world axis) — use
+``distributed_train_step`` to build the full jitted step, or embed the
+transform in your own shard_map.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ..compression import Compression, Compressor
+from ..ops import fusion, traced
+from ..ops.traced import Adasum, Average, Sum
+from ..process_sets import ProcessSet
+from ..runtime import WORLD_AXIS, get_runtime
+
+
+class DistributedOptimizerState(NamedTuple):
+    """State wrapper; ``acc`` holds per-rank gradient accumulators (local
+    values, varying over the world axis) and is None when
+    backward_passes_per_step == 1."""
+
+    counter: jax.Array
+    acc: Any
+    inner: Any
+
+
+def _reduce_gradients(
+    grads: Any,
+    *,
+    axis,
+    op: int,
+    compression: type[Compressor],
+    prescale_factor: float,
+    postscale_factor: float,
+    process_set: Optional[ProcessSet],
+    fusion_threshold_bytes: Optional[int],
+    groups: Optional[Sequence[Sequence[int]]] = None,
+) -> Any:
+    """Bucket, compress, and allreduce a gradient pytree as few fused
+    collectives (the FuseResponses + fusion-buffer path, compiled)."""
+    leaves, treedef = jax.tree.flatten(grads)
+    if not leaves:
+        return grads
+
+    compressed = [compression.compress(g) for g in leaves]
+    wire = [c[0] for c in compressed]
+    ctxs = [c[1] for c in compressed]
+
+    if groups is not None:
+        # Explicit tensor groups (reference optimizer.py:128-162 `groups`):
+        # each listed group fuses atomically; ungrouped tensors bucket by
+        # threshold.
+        grouped_idx = set(i for g in groups for i in g)
+        buckets = [list(g) for g in groups]
+        rest = [i for i in range(len(wire)) if i not in grouped_idx]
+    else:
+        buckets = []
+        rest = list(range(len(wire)))
+    if rest:
+        sizes = [wire[i].size * wire[i].dtype.itemsize for i in rest]
+        dtypes = [str(wire[i].dtype) for i in rest]
+        for b in fusion.bucket_plan(sizes, dtypes, fusion_threshold_bytes):
+            buckets.append([rest[i] for i in b])
+
+    reduced = list(wire)
+    for bucket in buckets:
+        flats, meta = fusion.flatten_group([wire[i] for i in bucket])
+        out_flats = [
+            traced.allreduce(
+                f,
+                axis=axis,
+                op=op,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+                process_set=process_set,
+            )
+            for f in flats
+        ]
+        for i, t in zip(bucket, fusion.unflatten_group(out_flats, meta)):
+            reduced[i] = t
+
+    out = [compression.decompress(t, c) for t, c in zip(reduced, ctxs)]
+    return jax.tree.unflatten(treedef, out)
+
+
+def DistributedOptimizer(
+    optimizer: optax.GradientTransformation,
+    *,
+    op: int = Average,
+    compression: type[Compressor] = Compression.none,
+    backward_passes_per_step: int = 1,
+    average_aggregated_gradients: bool = True,
+    gradient_predivide_factor: float = 1.0,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    process_set: Optional[ProcessSet] = None,
+    fusion_threshold_bytes: Optional[int] = None,
+    groups: Optional[Sequence[Sequence[int]]] = None,
+    axis=WORLD_AXIS,
+) -> optax.GradientTransformation:
+    """Wrap an optax transform with distributed gradient reduction.
+
+    Mirrors ``hvd.DistributedOptimizer`` keyword-for-keyword where the
+    concept survives on TPU (no ``named_parameters``/``sparse_as_dense``:
+    JAX gradients are a dense pytree by construction).
+    """
+    if gradient_predivide_factor != 1.0:
+        if op != Average:
+            raise ValueError(
+                "gradient_predivide_factor requires op=Average "
+                "(reference torch/optimizer.py:194)"
+            )
+        # Reference split (optimizer.py:194-205): prescale by 1/f before
+        # the sum, postscale by f/size after.
+        prescale_factor = prescale_factor / gradient_predivide_factor
+        postscale_factor = postscale_factor * gradient_predivide_factor
+    k = int(backward_passes_per_step)
+    if k < 1:
+        raise ValueError("backward_passes_per_step must be >= 1")
+
+    def reduce_fn(grads):
+        return _reduce_gradients(
+            grads,
+            axis=axis,
+            op=op,
+            compression=compression,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+            process_set=process_set,
+            fusion_threshold_bytes=fusion_threshold_bytes,
+            groups=groups,
+        )
+
+    def init_fn(params):
+        acc = None
+        if k > 1:
+            acc = jax.tree.map(jnp.zeros_like, params)
+        return DistributedOptimizerState(
+            counter=jnp.zeros((), jnp.int32),
+            acc=acc,
+            inner=optimizer.init(params),
+        )
+
+    def update_fn(grads, state: DistributedOptimizerState, params=None):
+        if k == 1:
+            reduced = reduce_fn(grads)
+            updates, inner = optimizer.update(reduced, state.inner, params)
+            return updates, DistributedOptimizerState(
+                counter=state.counter + 1, acc=None, inner=inner
+            )
+
+        # Local gradient aggregation (reference
+        # LocalGradientAggregationHelper / optimizer.py
+        # backward_passes_per_step): accumulate locally, reduce + step
+        # every k-th call, zero updates in between.
+        acc = jax.tree.map(lambda a, g: a + g, state.acc, grads)
+        counter = state.counter + 1
+        boundary = (counter % k) == 0
+
+        def do_step(operand):
+            acc_, inner_ = operand
+            scale = 1.0 / k if average_aggregated_gradients else 1.0
+            scaled = jax.tree.map(lambda a: a * scale, acc_)
+            reduced = reduce_fn(scaled)
+            updates, new_inner = optimizer.update(reduced, inner_, params)
+            zeroed = jax.tree.map(jnp.zeros_like, acc_)
+            return updates, zeroed, new_inner
+
+        def no_step(operand):
+            acc_, inner_ = operand
+            updates = jax.tree.map(jnp.zeros_like, acc_)
+            return updates, acc_, inner_
+
+        updates, acc, inner = lax.cond(boundary, do_step, no_step, (acc, state.inner))
+        return updates, DistributedOptimizerState(counter=counter, acc=acc, inner=inner)
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+class TrainStep:
+    """Compiled SPMD training step (the DistributedGradientTape-equivalent
+    end-to-end path, reference ``tensorflow/__init__.py:355-455``).
+
+    ``init(params)`` builds properly-sharded optimizer state;
+    ``__call__(params, opt_state, batch)`` runs one fused step: local
+    grads on each chip's batch shard -> fused allreduce -> optimizer
+    update -> loss pmean.
+    """
+
+    def __init__(self, loss_fn, optimizer, *, axis=WORLD_AXIS, has_aux=False):
+        rt = get_runtime()
+        self.mesh = rt.mesh
+        self.axis = axis
+        self.has_aux = has_aux
+        self._optimizer = optimizer
+
+        param_spec = P()  # replicated
+        batch_spec = P(axis)  # sharded along leading dim
+
+        def state_specs(state):
+            # acc leaves vary per rank -> stacked over the axis; the rest
+            # of the state is replicated.
+            if isinstance(state, DistributedOptimizerState) and state.acc is not None:
+                return DistributedOptimizerState(
+                    counter=P(),
+                    acc=jax.tree.map(lambda _: P(axis), state.acc),
+                    inner=jax.tree.map(lambda _: P(), state.inner),
+                )
+            return jax.tree.map(lambda _: P(), state)
+
+        def init_body(params):
+            st = optimizer.init(params)
+            if isinstance(st, DistributedOptimizerState) and st.acc is not None:
+                st = st._replace(acc=jax.tree.map(lambda a: a[None], st.acc))
+            return st
+
+        def step_body(params, opt_state, batch):
+            if isinstance(opt_state, DistributedOptimizerState) and opt_state.acc is not None:
+                opt_state = opt_state._replace(
+                    acc=jax.tree.map(lambda a: a[0], opt_state.acc)
+                )
+            if has_aux:
+                (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                    params, batch
+                )
+            else:
+                loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+                aux = None
+            updates, opt_state = optimizer.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            loss = lax.pmean(loss, axis)
+            if isinstance(opt_state, DistributedOptimizerState) and opt_state.acc is not None:
+                opt_state = opt_state._replace(
+                    acc=jax.tree.map(lambda a: a[None], opt_state.acc)
+                )
+            if has_aux:
+                aux = lax.pmean(aux, axis)
+                return params, opt_state, loss, aux
+            return params, opt_state, loss
+
+        # Build init: trace state structure to derive out specs.
+        def make_init():
+            def init(params):
+                shape = jax.eval_shape(init_body, params)
+                out_specs = state_specs(shape)
+                f = jax.shard_map(
+                    init_body,
+                    mesh=self.mesh,
+                    in_specs=(param_spec,),
+                    out_specs=out_specs,
+                    check_vma=False,
+                )
+                return jax.jit(f)(params)
+
+            return init
+
+        self.init = make_init()
+        self._step_cache = {}
+        self._step_body = step_body
+        self._param_spec = param_spec
+        self._batch_spec = batch_spec
+        self._state_specs = state_specs
+
+    def __call__(self, params, opt_state, batch):
+        specs = self._state_specs(opt_state)
+        key = jax.tree.structure(opt_state)
+        fn = self._step_cache.get(key)
+        if fn is None:
+            out_specs = (self._param_spec, specs, P()) + ((P(),) if self.has_aux else ())
+            fn = jax.jit(
+                jax.shard_map(
+                    self._step_body,
+                    mesh=self.mesh,
+                    in_specs=(self._param_spec, specs, self._batch_spec),
+                    out_specs=out_specs,
+                    check_vma=False,
+                ),
+                donate_argnums=(0, 1),
+            )
+            self._step_cache[key] = fn
+        return fn(params, opt_state, batch)
+
+
+def distributed_train_step(
+    loss_fn,
+    optimizer: optax.GradientTransformation,
+    *,
+    axis=WORLD_AXIS,
+    has_aux: bool = False,
+) -> TrainStep:
+    """Build the compiled SPMD train step; see ``TrainStep``.
+
+    ``loss_fn(params, batch) -> loss`` is written for a *local* batch
+    shard; batches passed to the step carry the global batch with leading
+    dimension divisible by ``size``.
+    """
+    return TrainStep(loss_fn, optimizer, axis=axis, has_aux=has_aux)

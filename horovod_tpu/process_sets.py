@@ -1,0 +1,149 @@
+"""Process sets: concurrent collectives on subsets of ranks.
+
+TPU-native re-design of the reference's headline feature
+(``horovod/common/process_set.{h,cc}``, ``horovod/common/process_sets.py``).
+In the reference a ProcessSet owns a controller + tensor queue + response
+cache per subset of MPI ranks.  On TPU there is no negotiation thread: a
+process set is a *static partition descriptor* over the global 1-D device
+mesh, lowered to XLA ``replica_groups`` (``axis_index_groups``) when the
+sets tile the world evenly, or to masked collectives otherwise.  Either
+way the collective compiles to a single fused XLA op over ICI.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Optional, Sequence
+
+from .exceptions import HorovodTpuError
+from .utils import env
+
+
+class ProcessSet:
+    """An ordered subset of global ranks that collectives can be limited to.
+
+    Mirrors reference ``horovod/common/process_sets.py:18`` semantics:
+    created detached with a list of ranks, given an ``id`` once registered
+    with the runtime.
+    """
+
+    def __init__(self, ranks: Sequence[int]):
+        if len(set(ranks)) != len(ranks):
+            raise ValueError(f"process set ranks must be unique, got {ranks}")
+        self.ranks: tuple[int, ...] = tuple(sorted(int(r) for r in ranks))
+        self.process_set_id: Optional[int] = None
+
+    # -- registry-backed queries ------------------------------------------
+    def _table(self) -> "ProcessSetTable":
+        from . import runtime
+
+        return runtime.get_runtime().process_set_table
+
+    def included(self, rank: Optional[int] = None) -> bool:
+        from . import runtime
+
+        if rank is None:
+            rank = runtime.get_runtime().rank
+        return rank in self.ranks
+
+    def rank(self) -> int:
+        """Rank of the current global rank within this set, or -1."""
+        from . import runtime
+
+        grank = runtime.get_runtime().rank
+        if grank not in self.ranks:
+            return -1
+        return self.ranks.index(grank)
+
+    def size(self) -> int:
+        return len(self.ranks)
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, ProcessSet) and self.ranks == other.ranks
+
+    def __hash__(self) -> int:
+        return hash(self.ranks)
+
+    def __repr__(self) -> str:
+        return f"ProcessSet(id={self.process_set_id}, ranks={list(self.ranks)})"
+
+
+class ProcessSetTable:
+    """Registry of process sets; id 0 is always the global set.
+
+    Mirrors reference ``common/process_set.h:26-80`` ``ProcessSetTable``.
+    Dynamic registration after init is gated by ``HVD_TPU_DYNAMIC_PROCESS_SETS``
+    (reference gates via ``HOROVOD_DYNAMIC_PROCESS_SETS``,
+    ``operations.cc:1194-1260``).
+    """
+
+    def __init__(self, world_size: int):
+        self._lock = threading.Lock()
+        self._next_id = 0
+        self._by_id: Dict[int, ProcessSet] = {}
+        self.world_size = world_size
+        self.global_set = self._register(ProcessSet(range(world_size)))
+
+    def _register(self, ps: ProcessSet) -> ProcessSet:
+        for existing in self._by_id.values():
+            if existing.ranks == ps.ranks:
+                ps.process_set_id = existing.process_set_id
+                return existing
+        if ps.ranks and (ps.ranks[0] < 0 or ps.ranks[-1] >= self.world_size):
+            raise HorovodTpuError(
+                f"process set ranks {ps.ranks} out of range for world size "
+                f"{self.world_size}"
+            )
+        ps.process_set_id = self._next_id
+        self._by_id[ps.process_set_id] = ps
+        self._next_id += 1
+        return ps
+
+    def add(self, ps: ProcessSet, dynamic_ok: bool = False) -> ProcessSet:
+        with self._lock:
+            if ps.ranks in {p.ranks for p in self._by_id.values()}:
+                return self._register(ps)
+            if not dynamic_ok and not env.get_bool(env.DYNAMIC_PROCESS_SETS):
+                raise HorovodTpuError(
+                    "Attempted to add a process set after initialization "
+                    "without dynamic process sets enabled; set "
+                    "HVD_TPU_DYNAMIC_PROCESS_SETS=1 or pass process_sets= to "
+                    "init() (reference horovod/common/operations.cc:1194)."
+                )
+            return self._register(ps)
+
+    def remove(self, ps: ProcessSet) -> None:
+        with self._lock:
+            if ps.process_set_id is None or ps.process_set_id not in self._by_id:
+                raise HorovodTpuError(f"unknown process set {ps}")
+            if ps.process_set_id == 0:
+                raise HorovodTpuError("cannot remove the global process set")
+            del self._by_id[ps.process_set_id]
+            ps.process_set_id = None
+
+    def get(self, process_set_id: int) -> ProcessSet:
+        with self._lock:
+            return self._by_id[process_set_id]
+
+    def ids(self) -> List[int]:
+        with self._lock:
+            return sorted(self._by_id)
+
+    def partition_groups(self, ps: ProcessSet) -> Optional[List[List[int]]]:
+        """Return equal-size replica groups covering all ranks, or None.
+
+        XLA ``replica_groups`` must tile the axis with equal group sizes.
+        If ``ps`` and its complement can't form equal groups, collectives
+        fall back to the masked path (see ops.collective_ops).
+        """
+        n = self.world_size
+        k = len(ps.ranks)
+        if k == n:
+            return None  # global set: use plain collectives
+        rest = [r for r in range(n) if r not in ps.ranks]
+        if k and len(rest) % k == 0:
+            groups = [list(ps.ranks)]
+            for i in range(0, len(rest), k):
+                groups.append(rest[i : i + k])
+            return groups
+        return None

@@ -1,0 +1,203 @@
+"""Global runtime: device mesh, rank topology, process sets.
+
+TPU-native replacement for the reference's C++ ``HorovodGlobalState`` +
+``BackgroundThreadLoop`` (``horovod/common/global_state.h:39``,
+``horovod/common/operations.cc:381``).  The reference spawns a background
+thread per process that negotiates tensor readiness over MPI/Gloo; under
+XLA SPMD every rank compiles the identical program, so op ordering is
+agreed *by construction* and no negotiation service is needed.  What
+remains global is: the 1-D device mesh (the "communicator"), rank
+topology, the process-set table, and observability (timeline/autotune),
+which this module owns.
+
+Rank model (device granularity — one TPU chip == one reference rank):
+  * ``size``        — total chips in the mesh (reference ``horovod_size``)
+  * ``rank``        — global index of this *process's* first chip; with one
+                      chip per process this is exactly the reference rank
+  * ``local_rank``  — index of that chip on this host
+  * ``local_size``  — chips on this host
+  * ``cross_rank``  — host index (reference cross communicator)
+Inside traced code the per-device rank is ``jax.lax.axis_index(axis)``.
+"""
+
+from __future__ import annotations
+
+import atexit
+import threading
+from typing import List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from .exceptions import NotInitializedError
+from .process_sets import ProcessSet, ProcessSetTable
+from .utils import env
+from .utils.logging import get_logger
+
+# Canonical axis name of the global 1-D mesh (the "world communicator").
+WORLD_AXIS = "hvd"
+
+_runtime_lock = threading.Lock()
+_runtime: Optional["Runtime"] = None
+
+
+class Runtime:
+    """Per-process singleton holding the mesh and topology."""
+
+    def __init__(
+        self,
+        process_sets: Optional[Sequence[ProcessSet]] = None,
+        devices: Optional[Sequence[jax.Device]] = None,
+    ):
+        self._init_distributed()
+        if devices is None:
+            devices = jax.devices()
+        # Stable global order: JAX guarantees jax.devices() is identically
+        # ordered on every process (sorted by (process_index, id)).
+        self.devices: List[jax.Device] = list(devices)
+        self.size: int = len(self.devices)
+        self.process_rank: int = jax.process_index()
+        self.process_count: int = jax.process_count()
+        local = [d for d in self.devices if d.process_index == self.process_rank]
+        self.local_devices: List[jax.Device] = local or [self.devices[0]]
+        self._resolve_host_topology()
+        self.mesh: Mesh = Mesh(
+            np.asarray(self.devices, dtype=object).reshape(self.size), (WORLD_AXIS,)
+        )
+        self.process_set_table = ProcessSetTable(self.size)
+        for ps in process_sets or ():
+            self.process_set_table.add(ps, dynamic_ok=True)
+        self.timeline = None
+        timeline_path = env.get_env(env.TIMELINE)
+        if timeline_path:
+            from .utils.timeline import Timeline
+
+            self.timeline = Timeline(timeline_path)
+        get_logger().info(
+            "initialized: %d device(s), %d process(es), platform=%s",
+            self.size,
+            self.process_count,
+            self.devices[0].platform,
+        )
+
+    def _resolve_host_topology(self) -> None:
+        """Compute rank / local_rank / cross_rank at reference semantics
+        (``MPI_Comm_split_type`` SHARED in ``mpi/mpi_context.cc``):
+        processes on the same physical host share a "local" communicator;
+        ``cross_rank`` indexes hosts.  Host identity is agreed by
+        allgathering hostnames over the mesh (the rendezvous analog of the
+        reference's shared-memory split)."""
+        self.rank = self.devices.index(self.local_devices[0])
+        if self.process_count == 1:
+            self.local_rank = 0
+            self.local_size = len(self.local_devices)
+            self.cross_rank = 0
+            self.cross_size = 1
+            return
+        import hashlib
+        import socket
+
+        from jax.experimental import multihost_utils
+
+        digest = hashlib.sha256(socket.gethostname().encode()).digest()[:8]
+        my_host = np.frombuffer(digest, dtype=np.int64)[0]
+        host_ids = np.asarray(
+            multihost_utils.process_allgather(np.int64(my_host))
+        ).reshape(-1)
+        # Hosts ordered by first process appearance; processes within a
+        # host ordered by process index (matches MPI split key semantics).
+        hosts_in_order = list(dict.fromkeys(host_ids.tolist()))
+        self.cross_size = len(hosts_in_order)
+        self.cross_rank = hosts_in_order.index(host_ids[self.process_rank])
+        peers = [p for p in range(self.process_count) if host_ids[p] == my_host]
+        procs_before = peers.index(self.process_rank)
+        per_proc = [
+            sum(1 for d in self.devices if d.process_index == p) for p in peers
+        ]
+        self.local_size = sum(per_proc)
+        self.local_rank = sum(per_proc[:procs_before])
+
+    def _init_distributed(self) -> None:
+        """Multi-host rendezvous: ``jax.distributed.initialize``.
+
+        The TPU-native analog of the reference's Gloo HTTP rendezvous
+        (``horovod/common/gloo/gloo_context.cc:216-230``): the launcher
+        exports coordinator address + process id/count, and the JAX
+        coordination service plays the role of the rendezvous KV store.
+        """
+        self._owns_distributed = False
+        coord = env.get_env(env.COORDINATOR_ADDR)
+        nproc = env.get_int(env.CROSS_SIZE, 1)
+        pid = env.get_int(env.CROSS_RANK, 0)
+        if coord and nproc > 1:
+            # Must run before anything initializes the XLA backend — do
+            # not query jax.process_count() first.  An already-initialized
+            # coordination service (e.g. re-init in elastic mode after the
+            # launcher set it up) is fine.
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coord, num_processes=nproc, process_id=pid
+                )
+                self._owns_distributed = True
+            except RuntimeError as e:
+                # Tolerate re-init when the coordination service is already
+                # up (elastic restart in the same process); anything else
+                # is a genuine rendezvous failure.
+                if jax.process_count() != nproc:
+                    raise
+                get_logger().info("jax.distributed already initialized: %s", e)
+
+    def shutdown(self) -> None:
+        from .ops import eager
+
+        eager.clear_cache()
+        if self.timeline is not None:
+            self.timeline.close()
+            self.timeline = None
+        if self._owns_distributed:
+            jax.distributed.shutdown()
+            self._owns_distributed = False
+
+
+def init(
+    process_sets: Optional[Sequence[ProcessSet]] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> None:
+    """Initialize the runtime (reference ``horovod_init``,
+    ``operations.cc:869`` / ``InitializeHorovodOnce`` ``:791``).
+
+    Idempotent like the reference.  ``process_sets`` registers rank
+    subsets up front (reference ``horovod_init_multi_comm``,
+    ``operations.cc:881``).
+    """
+    global _runtime
+    with _runtime_lock:
+        if _runtime is None:
+            _runtime = Runtime(process_sets=process_sets, devices=devices)
+
+
+def shutdown() -> None:
+    global _runtime
+    with _runtime_lock:
+        if _runtime is not None:
+            _runtime.shutdown()
+            _runtime = None
+
+
+def is_initialized() -> bool:
+    return _runtime is not None
+
+
+def get_runtime() -> Runtime:
+    rt = _runtime
+    if rt is None:
+        raise NotInitializedError()
+    return rt
+
+
+def get_runtime_or_none() -> Optional[Runtime]:
+    return _runtime
+
+
+atexit.register(shutdown)

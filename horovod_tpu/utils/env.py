@@ -1,0 +1,93 @@
+"""Environment-variable config layer.
+
+The reference centralizes ~40 ``HOROVOD_*`` env knobs in
+``horovod/common/common.h:107-139`` and parses them in
+``BackgroundThreadLoop`` (``operations.cc:459-588``).  We keep the same
+three-layer config model (env vars < CLI flags < per-call kwargs) with the
+``HVD_TPU_*`` prefix, accepting the legacy ``HOROVOD_*`` spelling as a
+fallback so reference users can switch without editing their job scripts.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+# Knob names (HVD_TPU_ prefix; HOROVOD_ prefix accepted as fallback).
+FUSION_THRESHOLD = "FUSION_THRESHOLD"  # bytes; reference default 64MB
+CYCLE_TIME = "CYCLE_TIME"  # ms; kept for API parity (no bg thread on TPU)
+CACHE_CAPACITY = "CACHE_CAPACITY"
+TIMELINE = "TIMELINE"
+TIMELINE_MARK_CYCLES = "TIMELINE_MARK_CYCLES"
+AUTOTUNE = "AUTOTUNE"
+AUTOTUNE_LOG = "AUTOTUNE_LOG"
+LOG_LEVEL = "LOG_LEVEL"
+STALL_CHECK_DISABLE = "STALL_CHECK_DISABLE"
+STALL_CHECK_TIME_SECONDS = "STALL_CHECK_TIME_SECONDS"
+STALL_SHUTDOWN_TIME_SECONDS = "STALL_SHUTDOWN_TIME_SECONDS"
+ELASTIC_ENABLED = "ELASTIC"
+DYNAMIC_PROCESS_SETS = "DYNAMIC_PROCESS_SETS"
+PROCESS_SETS = "PROCESS_SETS"
+BATCH_D2D_MEMCOPIES = "BATCH_D2D_MEMCOPIES"
+NUM_STREAMS = "NUM_STREAMS"
+
+# Launcher-provided rendezvous env (analog of reference gloo_run.py:65-103).
+RANK = "RANK"
+SIZE = "SIZE"
+LOCAL_RANK = "LOCAL_RANK"
+LOCAL_SIZE = "LOCAL_SIZE"
+CROSS_RANK = "CROSS_RANK"
+CROSS_SIZE = "CROSS_SIZE"
+HOSTNAME = "HOSTNAME"
+RENDEZVOUS_ADDR = "RENDEZVOUS_ADDR"
+RENDEZVOUS_PORT = "RENDEZVOUS_PORT"
+COORDINATOR_ADDR = "COORDINATOR_ADDR"  # jax.distributed coordinator
+
+DEFAULT_FUSION_THRESHOLD = 64 * 1024 * 1024
+# Fusion buffers are padded to this many bytes (reference common.h:146
+# FUSION_BUFFER_ATOMIC_UNIT = 64); on TPU we align to the fp32 lane tile.
+FUSION_BUFFER_ATOMIC_UNIT = 512
+
+
+def _names(name: str) -> tuple[str, str]:
+    return "HVD_TPU_" + name, "HOROVOD_" + name
+
+
+def get_env(name: str, default: Optional[str] = None) -> Optional[str]:
+    """Read a knob, preferring HVD_TPU_<name>, falling back to HOROVOD_<name>."""
+    new, legacy = _names(name)
+    val = os.environ.get(new)
+    if val is None:
+        val = os.environ.get(legacy)
+    return default if val is None else val
+
+
+def get_int(name: str, default: int) -> int:
+    val = get_env(name)
+    if val is None or val == "":
+        return default
+    try:
+        return int(val)
+    except ValueError:
+        return default
+
+
+def get_float(name: str, default: float) -> float:
+    val = get_env(name)
+    if val is None or val == "":
+        return default
+    try:
+        return float(val)
+    except ValueError:
+        return default
+
+
+def get_bool(name: str, default: bool = False) -> bool:
+    val = get_env(name)
+    if val is None or val == "":
+        return default
+    return val.strip().lower() in ("1", "true", "yes", "on")
+
+
+def set_env(name: str, value: str) -> None:
+    os.environ["HVD_TPU_" + name] = value

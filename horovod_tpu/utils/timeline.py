@@ -1,0 +1,123 @@
+"""Chrome-tracing timeline (reference ``horovod/common/timeline.{h,cc}``).
+
+The reference feeds a lock-free SPSC queue drained by a dedicated writer
+thread producing chrome://tracing JSON with per-tensor NEGOTIATE/QUEUE/op
+phases.  On TPU there is no negotiation phase; we record the eager
+dispatch lifecycle (ENQUEUE -> compiled-op) per named collective, with
+the same JSON format so the file opens in chrome://tracing / Perfetto.
+Deep device-level profiling is delegated to ``jax.profiler`` (the
+``start_profile``/``stop_profile`` helpers), the TPU-native analog of the
+reference's NVTX ranges (``nvtx_op_range.h``).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+from typing import Optional
+
+
+class Timeline:
+    """Background-thread JSON writer, mirroring ``TimelineWriter``."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._queue: "queue.Queue" = queue.Queue(maxsize=1_000_000)
+        self._start = time.perf_counter()
+        self._closed = threading.Event()
+        self._fh = open(path, "w")
+        self._fh.write("[\n")
+        self._first = True
+        self._thread = threading.Thread(
+            target=self._drain, name="hvd_tpu_timeline", daemon=True
+        )
+        self._thread.start()
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._start) * 1e6
+
+    def record_op(self, name: str, activity: str, nbytes: int) -> None:
+        """One complete event per collective dispatch."""
+        self._put(
+            {
+                "name": name,
+                "cat": activity,
+                "ph": "X",
+                "ts": self._now_us(),
+                "dur": 1,
+                "pid": os.getpid(),
+                "tid": 0,
+                "args": {"bytes": int(nbytes), "activity": activity},
+            }
+        )
+
+    def begin(self, name: str, activity: str) -> None:
+        self._put(
+            {"name": name, "cat": activity, "ph": "B", "ts": self._now_us(),
+             "pid": os.getpid(), "tid": 0}
+        )
+
+    def end(self, name: str, activity: str) -> None:
+        self._put(
+            {"name": name, "cat": activity, "ph": "E", "ts": self._now_us(),
+             "pid": os.getpid(), "tid": 0}
+        )
+
+    def mark_cycle(self) -> None:
+        """Reference ``HOROVOD_TIMELINE_MARK_CYCLES`` instant events."""
+        self._put(
+            {"name": "CYCLE", "ph": "i", "ts": self._now_us(), "s": "g",
+             "pid": os.getpid(), "tid": 0}
+        )
+
+    def _put(self, event: dict) -> None:
+        if self._closed.is_set():
+            return
+        try:
+            self._queue.put_nowait(event)
+        except queue.Full:
+            pass  # drop like the reference's bounded lockfree queue
+
+    def _drain(self) -> None:
+        # The writer thread owns the file handle end to end: it drains the
+        # backlog after close() signals, writes the epilogue, and closes —
+        # so no event can land after the closing bracket.
+        while not (self._closed.is_set() and self._queue.empty()):
+            try:
+                ev = self._queue.get(timeout=0.1)
+            except queue.Empty:
+                continue
+            if not self._first:
+                self._fh.write(",\n")
+            self._first = False
+            self._fh.write(json.dumps(ev))
+        self._fh.write("\n]\n")
+        self._fh.close()
+
+    def close(self) -> None:
+        self._closed.set()
+        self._thread.join()
+
+
+# jax.profiler passthroughs (NVTX-range analog).
+_profiler_active = False
+
+
+def start_profile(logdir: str) -> None:
+    global _profiler_active
+    import jax
+
+    jax.profiler.start_trace(logdir)
+    _profiler_active = True
+
+
+def stop_profile() -> None:
+    global _profiler_active
+    import jax
+
+    if _profiler_active:
+        jax.profiler.stop_trace()
+        _profiler_active = False

@@ -1,0 +1,42 @@
+"""Test fixtures: run everything on a virtual 8-device CPU mesh.
+
+Mirrors the reference's test strategy (SURVEY.md §4): parallel-tier
+tests exercise real collectives — here on 8 XLA host devices
+(``--xla_force_host_platform_device_count=8``), the CPU stand-in for a
+TPU slice.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    "--xla_force_host_platform_device_count=8 "
+    + os.environ.get("XLA_FLAGS", "")
+)
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest
+
+import horovod_tpu as hvd
+
+
+@pytest.fixture(scope="session", autouse=True)
+def _devices():
+    assert len(jax.devices()) == 8, "expected 8 virtual CPU devices"
+    yield
+
+
+@pytest.fixture()
+def hvd_init():
+    hvd.init()
+    yield hvd
+    hvd.shutdown()
+
+
+@pytest.fixture(scope="module")
+def hvd_module():
+    hvd.init()
+    yield hvd
+    hvd.shutdown()

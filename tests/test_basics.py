@@ -1,0 +1,75 @@
+"""Basics API tests (reference analog: the rank/size assertions woven
+through ``test/parallel/test_torch.py`` and ``test_tensorflow.py``)."""
+
+import jax
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.exceptions import HorovodTpuError, NotInitializedError
+
+
+def test_not_initialized_raises():
+    if hvd.is_initialized():
+        hvd.shutdown()
+    with pytest.raises(NotInitializedError):
+        hvd.size()
+
+
+def test_init_topology(hvd_init):
+    assert hvd.size() == 8
+    assert hvd.rank() == 0
+    assert hvd.local_size() == 8
+    assert hvd.cross_size() == 1
+    assert hvd.process_count() == 1
+    assert hvd.is_homogeneous()
+    assert hvd.xla_built()
+    assert not hvd.mpi_enabled()
+
+
+def test_init_idempotent(hvd_init):
+    hvd.init()
+    assert hvd.size() == 8
+
+
+def test_mesh_shape(hvd_init):
+    mesh = hvd.mesh()
+    assert mesh.axis_names == (hvd.WORLD_AXIS,)
+    assert mesh.devices.shape == (8,)
+
+
+def test_process_set_registration(hvd_init):
+    ps = hvd.ProcessSet([0, 1, 2, 3])
+    with pytest.raises(HorovodTpuError):
+        hvd.add_process_set(ps)  # dynamic not enabled
+
+
+def test_process_set_dynamic(hvd_init, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_DYNAMIC_PROCESS_SETS", "1")
+    ps = hvd.add_process_set(hvd.ProcessSet([0, 1, 2, 3]))
+    assert ps.process_set_id == 1
+    assert ps.size() == 4
+    assert ps.included(2)
+    assert not ps.included(5)
+    assert ps.rank() == 0  # global rank 0 is position 0
+    # duplicate registration returns the same set
+    ps2 = hvd.add_process_set(hvd.ProcessSet([3, 2, 1, 0]))
+    assert ps2.process_set_id == 1
+    hvd.remove_process_set(ps)
+    assert hvd.get_process_set_ids() == [0]
+
+
+def test_global_process_set(hvd_init):
+    gps = hvd.global_process_set()
+    assert gps.process_set_id == 0
+    assert gps.size() == 8
+    with pytest.raises(HorovodTpuError):
+        hvd.remove_process_set(gps)
+
+
+def test_init_with_process_sets():
+    hvd.init(process_sets=[hvd.ProcessSet([0, 1]), hvd.ProcessSet([2, 3, 4])])
+    try:
+        assert hvd.get_process_set_ids() == [0, 1, 2]
+    finally:
+        hvd.shutdown()

@@ -1,0 +1,289 @@
+"""Eager collective tests — the analog of the collective × dtype × op
+enumeration in reference ``test/parallel/test_torch.py`` (2448 LoC) and
+``test_tensorflow.py``, against numpy references."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.exceptions import HorovodTpuError
+
+N = 8
+import ml_dtypes
+DTYPES = [np.float32, np.float16, np.int32, ml_dtypes.bfloat16]
+
+
+def stacked(shape=(4, 3), dtype=np.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    if np.issubdtype(dtype, np.integer):
+        return rng.randint(-10, 10, size=(N,) + shape).astype(dtype)
+    return rng.randn(N, *shape).astype(dtype)
+
+
+@pytest.mark.parametrize("dtype", DTYPES)
+def test_allreduce_average(hvd_module, dtype):
+    x = stacked(dtype=dtype)
+    y = np.asarray(hvd.allreduce(x))
+    expected = np.broadcast_to(x.mean(axis=0), x.shape)
+    tol = 5e-2 if dtype in (np.float16, ml_dtypes.bfloat16) else 1e-5
+    if np.issubdtype(dtype, np.integer):
+        # average of ints stays int, truncated toward zero
+        assert y.dtype == x.dtype
+        np.testing.assert_array_equal(
+            y,
+            np.broadcast_to(
+                np.trunc(x.sum(axis=0).astype(np.float32) / N).astype(dtype),
+                x.shape,
+            ),
+        )
+    else:
+        np.testing.assert_allclose(y, expected.astype(dtype), rtol=tol, atol=tol)
+
+
+@pytest.mark.parametrize("dtype", [np.float32, np.int32])
+def test_allreduce_sum(hvd_module, dtype):
+    x = stacked(dtype=dtype)
+    y = np.asarray(hvd.allreduce(x, op=hvd.Sum))
+    expected = np.broadcast_to(x.sum(axis=0), x.shape)
+    np.testing.assert_allclose(y, expected, rtol=1e-5, atol=1e-5)
+
+
+def test_allreduce_min_max(hvd_module):
+    x = stacked()
+    np.testing.assert_allclose(
+        np.asarray(hvd.allreduce(x, op=hvd.Min)),
+        np.broadcast_to(x.min(axis=0), x.shape),
+        rtol=1e-6,
+    )
+    np.testing.assert_allclose(
+        np.asarray(hvd.allreduce(x, op=hvd.Max)),
+        np.broadcast_to(x.max(axis=0), x.shape),
+        rtol=1e-6,
+    )
+
+
+def test_allreduce_product(hvd_module):
+    x = stacked(shape=(2, 2))
+    np.testing.assert_allclose(
+        np.asarray(hvd.allreduce(x, op=hvd.Product)),
+        np.broadcast_to(np.prod(x, axis=0), x.shape),
+        rtol=1e-4,
+    )
+
+
+def test_allreduce_prescale_postscale(hvd_module):
+    x = stacked()
+    y = np.asarray(
+        hvd.allreduce(x, op=hvd.Sum, prescale_factor=0.5, postscale_factor=2.0)
+    )
+    expected = np.broadcast_to((x * 0.5).sum(axis=0) * 2.0, x.shape)
+    np.testing.assert_allclose(y, expected, rtol=1e-5)
+
+
+def test_allreduce_average_and_op_conflict(hvd_module):
+    with pytest.raises(ValueError):
+        hvd.allreduce(stacked(), average=True, op=hvd.Sum)
+
+
+def test_allreduce_bad_shape(hvd_module):
+    with pytest.raises(HorovodTpuError):
+        hvd.allreduce(np.zeros((3, 2), np.float32))
+
+
+def test_allreduce_process_set_partition(hvd_module, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_DYNAMIC_PROCESS_SETS", "1")
+    ps = hvd.add_process_set([0, 1, 2, 3])
+    x = stacked()
+    y = np.asarray(hvd.allreduce(x, op=hvd.Sum, process_set=ps))
+    expected = x.copy()
+    expected[:4] = x[:4].sum(axis=0)
+    np.testing.assert_allclose(y, expected, rtol=1e-5)
+    hvd.remove_process_set(ps)
+
+
+def test_allreduce_process_set_arbitrary(hvd_module, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_DYNAMIC_PROCESS_SETS", "1")
+    ps = hvd.add_process_set([1, 4, 6])  # does not partition evenly
+    x = stacked()
+    y = np.asarray(hvd.allreduce(x, op=hvd.Sum, process_set=ps))
+    expected = x.copy()
+    s = x[[1, 4, 6]].sum(axis=0)
+    for r in (1, 4, 6):
+        expected[r] = s
+    np.testing.assert_allclose(y, expected, rtol=1e-5)
+    hvd.remove_process_set(ps)
+
+
+def test_allreduce_unregistered_process_set_rejected(hvd_module):
+    ps = hvd.ProcessSet([0, 1])  # never registered
+    with pytest.raises(HorovodTpuError, match="not registered"):
+        hvd.allreduce(stacked(), process_set=ps)
+
+
+def test_allreduce_removed_process_set_rejected(hvd_module, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_DYNAMIC_PROCESS_SETS", "1")
+    ps = hvd.add_process_set([0, 1])
+    hvd.remove_process_set(ps)
+    with pytest.raises(HorovodTpuError, match="not registered"):
+        hvd.allreduce(stacked(), process_set=ps)
+
+
+def test_alltoall_splits_with_subset_rejected(hvd_module, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_DYNAMIC_PROCESS_SETS", "1")
+    ps = hvd.add_process_set([0, 1, 2, 3])
+    splits = np.full((N, N), 2)
+    with pytest.raises(NotImplementedError):
+        hvd.alltoall(stacked(shape=(16, 2)), splits=splits, process_set=ps)
+    hvd.remove_process_set(ps)
+
+
+def test_grouped_allreduce(hvd_module):
+    xs = [stacked(shape=(3,), seed=i) for i in range(4)]
+    ys = hvd.grouped_allreduce(xs, op=hvd.Sum)
+    for x, y in zip(xs, ys):
+        np.testing.assert_allclose(
+            np.asarray(y), np.broadcast_to(x.sum(axis=0), x.shape), rtol=1e-5
+        )
+
+
+def test_grouped_allreduce_mixed_dtypes(hvd_module):
+    xs = [
+        stacked(shape=(3,), dtype=np.float32, seed=1),
+        stacked(shape=(5,), dtype=np.float16, seed=2),
+        stacked(shape=(2, 2), dtype=np.float32, seed=3),
+    ]
+    ys = hvd.grouped_allreduce(xs, op=hvd.Sum)
+    for x, y in zip(xs, ys):
+        assert np.asarray(y).dtype == x.dtype
+        np.testing.assert_allclose(
+            np.asarray(y), np.broadcast_to(x.sum(axis=0), x.shape), rtol=1e-5
+        )
+
+
+def test_allgather(hvd_module):
+    x = stacked(shape=(2, 3))
+    y = np.asarray(hvd.allgather(x))
+    # every output row is the concatenation over ranks
+    assert y.shape == (N, N * 2, 3)
+    expected = x.reshape(N * 2, 3)
+    for r in range(N):
+        np.testing.assert_allclose(y[r], expected, rtol=1e-6)
+
+
+def test_allgather_process_set(hvd_module, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_DYNAMIC_PROCESS_SETS", "1")
+    ps = hvd.add_process_set([0, 1, 2, 3])
+    x = stacked(shape=(2,))
+    y = np.asarray(hvd.allgather(x, process_set=ps))
+    assert y.shape == (N, 8)
+    expected = x[:4].reshape(8)
+    for r in range(4):
+        np.testing.assert_allclose(y[r], expected, rtol=1e-6)
+    hvd.remove_process_set(ps)
+
+
+def test_broadcast(hvd_module):
+    x = stacked()
+    for root in (0, 3, 7):
+        y = np.asarray(hvd.broadcast(x, root_rank=root))
+        np.testing.assert_allclose(
+            y, np.broadcast_to(x[root], x.shape), rtol=1e-6
+        )
+
+
+def test_broadcast_process_set(hvd_module, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_DYNAMIC_PROCESS_SETS", "1")
+    ps = hvd.add_process_set([2, 5, 7])
+    x = stacked()
+    y = np.asarray(hvd.broadcast(x, root_rank=1, process_set=ps))  # root = rank 5
+    expected = x.copy()
+    for r in (2, 5, 7):
+        expected[r] = x[5]
+    np.testing.assert_allclose(y, expected, rtol=1e-6)
+    hvd.remove_process_set(ps)
+
+
+def test_reducescatter(hvd_module):
+    x = stacked(shape=(16, 3))
+    y = np.asarray(hvd.reducescatter(x, op=hvd.Sum))
+    total = x.sum(axis=0)  # (16, 3)
+    assert y.shape == (N, 2, 3)
+    for r in range(N):
+        np.testing.assert_allclose(y[r], total[r * 2 : (r + 1) * 2], rtol=1e-5)
+
+
+def test_alltoall_equal(hvd_module):
+    x = stacked(shape=(16, 2))
+    y = np.asarray(hvd.alltoall(x))
+    assert y.shape == x.shape
+    # rank r chunk j -> rank j chunk r
+    for r in range(N):
+        for j in range(N):
+            np.testing.assert_allclose(
+                y[j, r * 2 : (r + 1) * 2], x[r, j * 2 : (j + 1) * 2], rtol=1e-6
+            )
+
+
+def test_alltoall_uneven(hvd_module):
+    rng = np.random.RandomState(0)
+    splits = rng.randint(0, 3, size=(N, N))
+    d0 = int(splits.sum(axis=1).max())
+    splits[:, 0] += d0 - splits.sum(axis=1)  # make rows equal length d0
+    x = rng.randn(N, d0, 2).astype(np.float32)
+    out, recv = hvd.alltoall(x, splits=splits)
+    out, recv = np.asarray(out), np.asarray(recv)
+    max_chunk = splits.max()
+    for r in range(N):
+        for j in range(N):
+            c = splits[j, r]  # rank j sends c rows to rank r
+            assert recv[r, j] == c
+            sent = x[j, splits[j, :r].sum() : splits[j, :r].sum() + c]
+            got = out[r, j * max_chunk : j * max_chunk + c]
+            np.testing.assert_allclose(got, sent, rtol=1e-6)
+
+
+def test_async_handles(hvd_module):
+    x = stacked()
+    h = hvd.allreduce_async(x, op=hvd.Sum, name="grad_0")
+    y = hvd.synchronize(h)
+    assert hvd.poll(h)
+    np.testing.assert_allclose(
+        np.asarray(y), np.broadcast_to(x.sum(axis=0), x.shape), rtol=1e-5
+    )
+
+
+def test_barrier_and_join(hvd_module):
+    hvd.barrier()
+    assert hvd.join() == N - 1
+
+
+def test_traced_inside_shard_map(hvd_module):
+    """Traced collectives compose inside a user shard_map (the hot path)."""
+    from jax.sharding import PartitionSpec as P
+
+    mesh = hvd.mesh()
+
+    def step(x):
+        g = hvd.traced.allreduce(x, op=hvd.Sum)
+        return g
+
+    f = jax.jit(
+        jax.shard_map(step, mesh=mesh, in_specs=P(hvd.WORLD_AXIS), out_specs=P(hvd.WORLD_AXIS))
+    )
+    x = stacked(shape=(5,))
+    y = np.asarray(f(x))
+    np.testing.assert_allclose(y, np.broadcast_to(x.sum(0), x.shape), rtol=1e-5)
+
+
+def test_compile_cache_reuse(hvd_module):
+    """Second identical call must hit the compiled cache (ResponseCache
+    analog)."""
+    from horovod_tpu.ops.eager import _jitted
+
+    before = _jitted.cache_info().hits
+    x = stacked()
+    hvd.allreduce(x)
+    hvd.allreduce(x + 1)
+    assert _jitted.cache_info().hits > before
