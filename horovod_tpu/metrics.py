@@ -1,0 +1,44 @@
+"""Metric averaging across ranks.
+
+Reference: ``MetricAverageCallback`` (``horovod/_keras/callbacks.py:49``)
+allreduce-averages epoch metrics so every rank logs the same numbers.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import numpy as np
+
+from . import runtime
+from .process_sets import ProcessSet
+
+
+def metric_average(value: Any, process_set: Optional[ProcessSet] = None) -> Any:
+    """Average a host-side scalar (or pytree of scalars) across processes.
+
+    Single-process worlds return the value unchanged (each metric is
+    already global).  With ``process_set``, only processes owning a rank
+    in the set participate; processes outside it get their value back
+    unchanged (mirroring the reference's process_set-scoped collectives).
+    """
+    rt = runtime.get_runtime()
+    if rt.process_count == 1:
+        return value
+
+    from jax.experimental import multihost_utils
+
+    if process_set is None:
+        member_procs = list(range(rt.process_count))
+    else:
+        member_procs = sorted(
+            {rt.devices[r].process_index for r in process_set.ranks}
+        )
+    leaves, treedef = jax.tree.flatten(value)
+    arr = np.asarray([float(l) for l in leaves], dtype=np.float64)
+    gathered = np.asarray(multihost_utils.process_allgather(arr))
+    if rt.process_rank not in member_procs:
+        return value
+    mean = gathered[member_procs].mean(axis=0)
+    return jax.tree.unflatten(treedef, [float(m) for m in mean])

@@ -1,0 +1,11 @@
+"""Model zoo used by the examples, benchmarks, and tests.
+
+The reference ships models inside its example scripts
+(``examples/pytorch/pytorch_mnist.py`` Net, the tf_cnn_benchmarks
+ResNet/VGG/Inception configs cited by ``docs/benchmarks.rst``); here they
+are first-class flax modules designed for TPU: NHWC layouts, bf16
+compute with fp32 params, shapes padded to MXU tiles.
+"""
+
+from .mnist import MnistCNN, MnistMLP  # noqa: F401
+from .resnet import ResNet, ResNet50, ResNet101, ResNet152  # noqa: F401

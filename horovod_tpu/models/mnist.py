@@ -1,0 +1,41 @@
+"""MNIST models (reference ``examples/pytorch/pytorch_mnist.py:30-50``
+``Net``: conv5x5(10) -> pool -> conv5x5(20) -> pool -> fc50 -> fc10)."""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MnistCNN(nn.Module):
+    """The reference example's LeNet-style net, NHWC for TPU."""
+
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        # x: (B, 28, 28, 1)
+        x = x.astype(self.dtype)
+        x = nn.Conv(10, (5, 5), padding="VALID", dtype=self.dtype)(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        x = nn.Conv(20, (5, 5), padding="VALID", dtype=self.dtype)(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(50, dtype=self.dtype)(x))
+        x = nn.Dense(10, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+class MnistMLP(nn.Module):
+    """Small MLP used by unit tests (fast to init/compile)."""
+
+    hidden: int = 128
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        x = nn.relu(nn.Dense(self.hidden, dtype=self.dtype)(x))
+        return nn.Dense(10, dtype=self.dtype)(x).astype(jnp.float32)

@@ -1,0 +1,91 @@
+"""ResNet v1.5 for TPU (the north-star benchmark model).
+
+Reference workload: ``examples/pytorch/pytorch_imagenet_resnet50.py``
+(torchvision resnet50) and the tf_cnn_benchmarks ResNet-101 numbers in
+``docs/benchmarks.rst:32-43``.  TPU-first design choices:
+
+* NHWC layout (XLA:TPU's native conv layout — no transposes).
+* bf16 activations/weights compute with fp32 master params and fp32
+  batch-norm statistics (the numerically load-bearing part).
+* v1.5 stride placement (stride on the 3x3, like the benchmark configs).
+* Channel counts are multiples of 128 in the trunk, aligning to the MXU.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(
+                self.filters * 4, (1, 1), self.strides, name="conv_proj"
+            )(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: jnp.dtype = jnp.bfloat16
+    act: Callable = nn.relu
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=jnp.float32,  # bn statistics in fp32
+        )
+        x = x.astype(self.dtype)
+        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                 name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = self.act(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = BottleneckBlock(
+                    filters=self.num_filters * 2**i,
+                    strides=strides,
+                    conv=conv,
+                    norm=norm,
+                    act=self.act,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
+ResNet50 = partial(ResNet, stage_sizes=[3, 4, 6, 3])
+ResNet101 = partial(ResNet, stage_sizes=[3, 4, 23, 3])
+ResNet152 = partial(ResNet, stage_sizes=[3, 8, 36, 3])

@@ -1,3 +1,4 @@
+from .adasum_optimizer import DistributedAdasumOptimizer  # noqa: F401
 from .distributed_optimizer import (  # noqa: F401
     DistributedOptimizer,
     DistributedOptimizerState,

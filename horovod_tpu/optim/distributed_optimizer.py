@@ -215,13 +215,34 @@ class TrainStep:
     ``__call__(params, opt_state, batch)`` runs one fused step: local
     grads on each chip's batch shard -> fused allreduce -> optimizer
     update -> loss pmean.
+
+    Stateful models (flax mutable collections like BatchNorm
+    ``batch_stats``): pass ``stateful=True`` with
+    ``loss_fn(params, model_state, batch) -> (loss, new_model_state)``;
+    the step becomes ``(params, model_state, opt_state, batch) ->
+    (params, model_state, opt_state, loss)``.  The returned model state
+    is cross-replica averaged so running statistics stay identical on
+    every rank — note this is *running-stats* averaging only:
+    normalization inside the step still uses each replica's local batch
+    moments.  For true synchronized BatchNorm (moments allreduced before
+    normalizing, reference ``torch/sync_batch_norm.py``) build the model
+    with ``horovod_tpu.SyncBatchNorm``.
     """
 
-    def __init__(self, loss_fn, optimizer, *, axis=WORLD_AXIS, has_aux=False):
+    def __init__(
+        self, loss_fn, optimizer, *, axis=WORLD_AXIS, has_aux=False, stateful=False
+    ):
+        if stateful and has_aux:
+            raise ValueError(
+                "stateful=True and has_aux=True are mutually exclusive: a "
+                "stateful loss_fn's aux slot carries the new model state "
+                "(return extra metrics inside the model state pytree)"
+            )
         rt = get_runtime()
         self.mesh = rt.mesh
         self.axis = axis
         self.has_aux = has_aux
+        self.stateful = stateful
         self._optimizer = optimizer
 
         param_spec = P()  # replicated
@@ -244,18 +265,28 @@ class TrainStep:
                 st = st._replace(acc=jax.tree.map(lambda a: a[None], st.acc))
             return st
 
-        def step_body(params, opt_state, batch):
-            if isinstance(opt_state, DistributedOptimizerState) and opt_state.acc is not None:
-                opt_state = opt_state._replace(
-                    acc=jax.tree.map(lambda a: a[0], opt_state.acc)
+        def compute_grads(params, model_state, batch):
+            if stateful:
+                (loss, out_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                    params, model_state, batch
                 )
+                # Cross-replica average of model state (SyncBN semantics).
+                out_state = lax.pmean(out_state, axis)
+                return loss, out_state, None, grads
             if has_aux:
                 (loss, aux), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                     params, batch
                 )
-            else:
-                loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-                aux = None
+                return loss, None, lax.pmean(aux, axis), grads
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            return loss, None, None, grads
+
+        def step_body(params, model_state, opt_state, batch):
+            if isinstance(opt_state, DistributedOptimizerState) and opt_state.acc is not None:
+                opt_state = opt_state._replace(
+                    acc=jax.tree.map(lambda a: a[0], opt_state.acc)
+                )
+            loss, model_state, aux, grads = compute_grads(params, model_state, batch)
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             loss = lax.pmean(loss, axis)
@@ -263,10 +294,13 @@ class TrainStep:
                 opt_state = opt_state._replace(
                     acc=jax.tree.map(lambda a: a[None], opt_state.acc)
                 )
-            if has_aux:
-                aux = lax.pmean(aux, axis)
-                return params, opt_state, loss, aux
-            return params, opt_state, loss
+            out = (params,)
+            if stateful:
+                out += (model_state,)
+            out += (opt_state, loss)
+            if aux is not None:
+                out += (aux,)
+            return out
 
         # Build init: trace state structure to derive out specs.
         def make_init():
@@ -291,24 +325,35 @@ class TrainStep:
         self._batch_spec = batch_spec
         self._state_specs = state_specs
 
-    def __call__(self, params, opt_state, batch):
+    def __call__(self, params, *args):
+        if self.stateful:
+            model_state, opt_state, batch = args
+        else:
+            opt_state, batch = args
+            model_state = None
         specs = self._state_specs(opt_state)
-        key = jax.tree.structure(opt_state)
+        key = (jax.tree.structure(opt_state), jax.tree.structure(model_state))
         fn = self._step_cache.get(key)
         if fn is None:
-            out_specs = (self._param_spec, specs, P()) + ((P(),) if self.has_aux else ())
+            in_specs = (self._param_spec, P(), specs, self._batch_spec)
+            out_specs = (self._param_spec,)
+            if self.stateful:
+                out_specs += (P(),)
+            out_specs += (specs, P())
+            if self.has_aux and not self.stateful:
+                out_specs += (P(),)
             fn = jax.jit(
                 jax.shard_map(
                     self._step_body,
                     mesh=self.mesh,
-                    in_specs=(self._param_spec, specs, self._batch_spec),
+                    in_specs=in_specs,
                     out_specs=out_specs,
                     check_vma=False,
                 ),
-                donate_argnums=(0, 1),
+                donate_argnums=(0, 1, 2),
             )
             self._step_cache[key] = fn
-        return fn(params, opt_state, batch)
+        return fn(params, model_state, opt_state, batch)
 
 
 def distributed_train_step(
@@ -317,11 +362,15 @@ def distributed_train_step(
     *,
     axis=WORLD_AXIS,
     has_aux: bool = False,
+    stateful: bool = False,
 ) -> TrainStep:
     """Build the compiled SPMD train step; see ``TrainStep``.
 
-    ``loss_fn(params, batch) -> loss`` is written for a *local* batch
-    shard; batches passed to the step carry the global batch with leading
-    dimension divisible by ``size``.
+    ``loss_fn(params, batch) -> loss`` (or with ``stateful=True``,
+    ``loss_fn(params, model_state, batch) -> (loss, new_model_state)``)
+    is written for a *local* batch shard; batches passed to the step
+    carry the global batch with leading dimension divisible by ``size``.
     """
-    return TrainStep(loss_fn, optimizer, axis=axis, has_aux=has_aux)
+    return TrainStep(
+        loss_fn, optimizer, axis=axis, has_aux=has_aux, stateful=stateful
+    )

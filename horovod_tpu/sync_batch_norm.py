@@ -1,0 +1,51 @@
+"""Cross-replica synchronized BatchNorm.
+
+Reference: ``horovod/torch/sync_batch_norm.py`` (218 LoC) and
+``horovod/tensorflow/sync_batch_norm.py`` — both allreduce the batch
+moments across ranks before normalizing.
+
+On TPU this is a first-class XLA pattern: flax's ``nn.BatchNorm``
+already takes ``axis_name``/``axis_index_groups`` and computes moments
+with a fused cross-replica mean over the mesh axis.  ``SyncBatchNorm``
+is a configured constructor pinning that axis to the world axis (or a
+process-set partition), so reference users get the same drop-in name
+with the collective compiled into the training step instead of a
+hand-written allreduce of sum/sum-of-squares.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import flax.linen as nn
+
+from .process_sets import ProcessSet
+from .runtime import WORLD_AXIS, get_runtime
+
+
+def SyncBatchNorm(
+    *,
+    axis_name: Optional[str] = WORLD_AXIS,
+    process_set: Optional[ProcessSet] = None,
+    **kwargs,
+) -> nn.BatchNorm:
+    """Build a BatchNorm whose moments are averaged across the mesh.
+
+    Must run inside a ``shard_map``/``distributed_train_step`` context
+    (the moments collective needs the mesh axis) — initialize the model
+    with ``use_running_average=True`` (eval mode) outside it.
+    ``process_set``
+    restricts the sync group like the reference's ``process_set``
+    argument, lowering to XLA replica groups; it must evenly partition
+    the world.
+    """
+    groups = None
+    if process_set is not None and process_set.process_set_id != 0:
+        table = get_runtime().process_set_table
+        groups = table.partition_groups(process_set)
+        if groups is None:
+            raise ValueError(
+                "SyncBatchNorm process_set must evenly partition the world "
+                f"(XLA replica groups); got {list(process_set.ranks)}"
+            )
+    return nn.BatchNorm(axis_name=axis_name, axis_index_groups=groups, **kwargs)

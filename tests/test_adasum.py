@@ -1,0 +1,109 @@
+"""Adasum math tests against a NumPy reference implementation
+(the analog of reference ``test/parallel/test_adasum_pytorch.py``, which
+checks the C++ Adasum against a NumPy recursion)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvd
+
+N = 8
+
+
+def adasum_pair_np(a, b):
+    """Reference math, adasum.h:397-409."""
+    dot = float(np.dot(a.ravel(), b.ravel()))
+    na = float(np.dot(a.ravel(), a.ravel()))
+    nb = float(np.dot(b.ravel(), b.ravel()))
+    ca = 1.0 - dot / (2 * na) if na > 0 else 1.0
+    cb = 1.0 - dot / (2 * nb) if nb > 0 else 1.0
+    return ca * a + cb * b
+
+
+def adasum_np(tensors):
+    """Recursive-doubling reference over a power-of-two list."""
+    n = len(tensors)
+    vals = [t.astype(np.float64) for t in tensors]
+    level = 1
+    while level < n:
+        new = list(vals)
+        for r in range(n):
+            partner = r ^ level
+            new[r] = adasum_pair_np(vals[r], vals[partner])
+        vals = new
+        level <<= 1
+    return vals
+
+
+def test_adasum_matches_numpy_reference(hvd_module):
+    x = np.random.RandomState(0).randn(N, 16).astype(np.float32)
+    y = np.asarray(hvd.allreduce(x, op=hvd.Adasum))
+    expected = adasum_np([x[r] for r in range(N)])
+    for r in range(N):
+        np.testing.assert_allclose(y[r], expected[r], rtol=1e-4, atol=1e-5)
+
+
+def test_adasum_orthogonal_adds(hvd_module):
+    """Orthogonal gradients must add (scale-invariance property)."""
+    x = np.zeros((N, N), np.float32)
+    for r in range(N):
+        x[r, r] = 3.0  # mutually orthogonal
+    y = np.asarray(hvd.allreduce(x, op=hvd.Adasum))
+    np.testing.assert_allclose(y[0], np.full(N, 3.0) * np.eye(N).sum(0), rtol=1e-5)
+
+
+def test_adasum_parallel_averages(hvd_module):
+    """Identical gradients must average (parallel case)."""
+    v = np.random.RandomState(1).randn(12).astype(np.float32)
+    x = np.tile(v, (N, 1))
+    y = np.asarray(hvd.allreduce(x, op=hvd.Adasum))
+    np.testing.assert_allclose(y[0], v, rtol=1e-4)
+
+
+def test_adasum_process_set(hvd_module, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_DYNAMIC_PROCESS_SETS", "1")
+    ps = hvd.add_process_set([0, 1, 2, 3])
+    x = np.random.RandomState(2).randn(N, 8).astype(np.float32)
+    y = np.asarray(hvd.allreduce(x, op=hvd.Adasum, process_set=ps))
+    expected = adasum_np([x[r] for r in range(4)])
+    for r in range(4):
+        np.testing.assert_allclose(y[r], expected[r], rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(y[4:], x[4:], rtol=1e-6)  # non-members
+    hvd.remove_process_set(ps)
+
+
+def test_adasum_non_power_of_two_rejected(hvd_module, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_DYNAMIC_PROCESS_SETS", "1")
+    ps = hvd.add_process_set([0, 1, 2])
+    with pytest.raises(Exception, match="power-of-two"):
+        hvd.allreduce(np.zeros((N, 4), np.float32), op=hvd.Adasum, process_set=ps)
+    hvd.remove_process_set(ps)
+
+
+def test_delta_adasum_optimizer(hvd_module):
+    """DistributedAdasumOptimizer applies inner update locally then
+    adasums deltas; with identical data everywhere it must equal the
+    plain local update (parallel deltas average to themselves)."""
+    X = np.random.RandomState(1).randn(16, 4).astype(np.float32)
+    Y = (X @ np.full((4, 1), 0.7)).astype(np.float32)
+    # replicate the same batch on every rank so deltas are identical
+    Xr = np.tile(X[:2], (N, 1))
+    Yr = np.tile(Y[:2], (N, 1))
+
+    def loss_fn(p, b):
+        x, y = b
+        return jnp.mean((x @ p["w"] - y) ** 2)
+
+    params = {"w": jnp.full((4, 1), 0.3)}
+    tx = hvd.DistributedAdasumOptimizer(optax.sgd(0.1))
+    step = hvd.distributed_train_step(loss_fn, tx)
+    st = step.init(params)
+    p, _, _ = step(
+        jax.tree.map(jnp.array, params), st, (jnp.asarray(Xr), jnp.asarray(Yr))
+    )
+    g = jax.grad(loss_fn)(params, (jnp.asarray(X[:2]), jnp.asarray(Y[:2])))
+    ref = params["w"] - 0.1 * g["w"]
+    np.testing.assert_allclose(np.asarray(p["w"]), np.asarray(ref), rtol=1e-4)

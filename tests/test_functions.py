@@ -1,0 +1,152 @@
+"""broadcast/allgather helpers, SyncBatchNorm, metric averaging, elastic
+state (reference analogs: torch/functions tests in test_torch.py,
+sync batch norm tests, test_torch_elastic.py)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.elastic import ArrayState, ObjectState
+
+
+def test_broadcast_parameters_single_process(hvd_module):
+    params = {"w": jnp.ones((3, 3)), "b": jnp.zeros((3,))}
+    out = hvd.broadcast_parameters(params, root_rank=0)
+    assert out is params  # single controller: identity
+
+
+def test_broadcast_object_and_allgather_object(hvd_module):
+    obj = {"epoch": 3, "name": "abc"}
+    assert hvd.broadcast_object(obj) == obj
+    assert hvd.allgather_object(obj) == [obj]
+
+
+def test_metric_average_single_process(hvd_module):
+    assert hvd.metric_average(0.5) == 0.5
+
+
+def test_sync_batch_norm_module(hvd_module):
+    """SyncBatchNorm inside the distributed step: moments averaged over
+    the world axis -> identical to BN over the global batch."""
+    import flax.linen as nn
+    from jax.sharding import PartitionSpec as P
+
+    class Net(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            x = nn.Dense(4)(x)
+            x = hvd.SyncBatchNorm(use_running_average=not train)(x)
+            return x
+
+    model = Net()
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(16, 4), jnp.float32)
+    # init in eval mode: the moments collective needs the mesh axis,
+    # which only exists inside shard_map
+    variables = model.init(jax.random.PRNGKey(0), x[:2], train=False)
+    params, stats = variables["params"], variables["batch_stats"]
+
+    mesh = hvd.mesh()
+
+    def fwd(p, s, xb):
+        out, updated = model.apply(
+            {"params": p, "batch_stats": s}, xb, train=True,
+            mutable=["batch_stats"],
+        )
+        return out, updated["batch_stats"]
+
+    f = jax.jit(
+        jax.shard_map(
+            fwd, mesh=mesh,
+            in_specs=(P(), P(), P(hvd.WORLD_AXIS)),
+            out_specs=(P(hvd.WORLD_AXIS), P()),
+            check_vma=False,
+        )
+    )
+    out_sharded, stats_sharded = f(params, stats, x)
+
+    # single-device reference: identical net with a plain (unsynced)
+    # BatchNorm over the full global batch — same param tree (the
+    # SyncBatchNorm factory returns an nn.BatchNorm named BatchNorm_0)
+    class NetRef(nn.Module):
+        @nn.compact
+        def __call__(self, x, train=True):
+            x = nn.Dense(4)(x)
+            x = nn.BatchNorm(use_running_average=not train)(x)
+            return x
+
+    out_ref, updated_ref = NetRef().apply(
+        {"params": params, "batch_stats": stats}, x, train=True,
+        mutable=["batch_stats"],
+    )
+    np.testing.assert_allclose(
+        np.asarray(out_sharded), np.asarray(out_ref), rtol=1e-4, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        np.asarray(stats_sharded["BatchNorm_0"]["mean"]),
+        np.asarray(updated_ref["batch_stats"]["BatchNorm_0"]["mean"]),
+        rtol=1e-4, atol=1e-6,
+    )
+
+
+def test_object_state_commit_restore(hvd_module):
+    state = ObjectState(epoch=0, batch=0)
+    state.epoch = 5
+    state.commit()
+    state.epoch = 9
+    state.restore()
+    assert state.epoch == 5
+
+
+def test_array_state_save_restore(hvd_module):
+    params = {"w": jnp.ones((2, 2))}
+    state = ArrayState(params=params, epoch=1)
+    state.params = jax.tree.map(lambda a: a * 3, state.params)
+    state.commit()
+    state.params = jax.tree.map(lambda a: a * 7, state.params)
+    state.restore()
+    np.testing.assert_allclose(np.asarray(state.params["w"]), 3.0)
+    assert state.epoch == 1
+
+
+def test_elastic_run_retry_loop(hvd_module):
+    """HorovodInternalError restores committed state and retries
+    (reference elastic.py:151 run_fn)."""
+    from horovod_tpu.elastic.run import run_fn
+
+    calls = {"n": 0}
+    state = ObjectState(step=0)
+
+    def train(st):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            st.step = 99  # uncommitted progress, lost on failure
+            raise hvd.HorovodInternalError("simulated peer failure")
+        return st.step
+
+    resets = {"n": 0}
+    wrapped = run_fn(train, lambda: resets.__setitem__("n", resets["n"] + 1))
+    result = wrapped(state)
+    assert result == 0  # restored to committed value
+    assert calls["n"] == 2 and resets["n"] == 1
+
+
+def test_elastic_hosts_updated_continues(hvd_module):
+    from horovod_tpu.elastic.run import run_fn
+
+    calls = {"n": 0}
+    state = ObjectState(step=0)
+
+    def train(st):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            st.step = 42  # live progress survives a host update
+            raise hvd.HostsUpdatedInterrupt()
+        return st.step
+
+    wrapped = run_fn(train, lambda: None)
+    assert wrapped(state) == 42
+    assert calls["n"] == 2
