@@ -1,0 +1,101 @@
+"""Host discovery for elastic training.
+
+Reference: ``horovod/runner/elastic/discovery.py`` — ``HostManager``
+runs a user-supplied discovery script emitting ``host[:slots]`` lines,
+tracks current hosts, and blacklists hosts that failed.
+"""
+
+from __future__ import annotations
+
+import subprocess
+import threading
+from typing import Dict, List, Optional, Set
+
+from ..runner import hosts as hosts_mod
+from ..utils.logging import get_logger
+
+
+class HostDiscovery:
+    """Base interface (reference ``HostDiscovery``)."""
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        raise NotImplementedError
+
+
+class HostDiscoveryScript(HostDiscovery):
+    """Runs the user script; each stdout line is ``host[:slots]``
+    (reference ``HostDiscoveryScript``)."""
+
+    def __init__(self, discovery_script: str, default_slots: int = 1):
+        self.script = discovery_script
+        self.default_slots = default_slots
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        out = subprocess.run(
+            self.script, shell=True, capture_output=True, text=True, timeout=60
+        )
+        if out.returncode != 0:
+            raise RuntimeError(
+                f"discovery script failed ({out.returncode}): {out.stderr}"
+            )
+        hosts: Dict[str, int] = {}
+        for line in out.stdout.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            info = hosts_mod.HostInfo.from_string(line)
+            hosts[info.hostname] = (
+                info.slots if ":" in line else self.default_slots
+            )
+        return hosts
+
+
+class FixedHosts(HostDiscovery):
+    """Static host set (used when elastic runs with -H but no script)."""
+
+    def __init__(self, hosts: Dict[str, int]):
+        self._hosts = dict(hosts)
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        return dict(self._hosts)
+
+
+class HostManager:
+    """Current + blacklisted hosts (reference ``HostManager``)."""
+
+    def __init__(self, discovery: HostDiscovery):
+        self._discovery = discovery
+        self._lock = threading.Lock()
+        self._current: Dict[str, int] = {}
+        self._blacklist: Set[str] = set()
+
+    def update_available_hosts(self) -> bool:
+        """Polls discovery; returns True when the usable set changed."""
+        found = self._discovery.find_available_hosts_and_slots()
+        with self._lock:
+            usable = {
+                h: s for h, s in found.items() if h not in self._blacklist
+            }
+            changed = usable != self._current
+            self._current = usable
+            return changed
+
+    def blacklist(self, hostname: str) -> None:
+        with self._lock:
+            if hostname not in self._blacklist:
+                get_logger().warning("blacklisting host %s", hostname)
+            self._blacklist.add(hostname)
+            self._current.pop(hostname, None)
+
+    def is_blacklisted(self, hostname: str) -> bool:
+        with self._lock:
+            return hostname in self._blacklist
+
+    @property
+    def current_hosts(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._current)
+
+    def available_slots(self) -> int:
+        with self._lock:
+            return sum(self._current.values())

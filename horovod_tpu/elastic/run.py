@@ -23,7 +23,8 @@ def run_fn(func: Callable, reset: Callable) -> Callable:
     @functools.wraps(func)
     def wrapper(state: State, *args, **kwargs):
         notification_manager = _get_notification_manager()
-        if notification_manager is not None:
+        elastic_job = notification_manager is not None
+        if elastic_job:
             notification_manager.init()
             notification_manager.register_listener(state)
         skip_sync = False
@@ -37,18 +38,42 @@ def run_fn(func: Callable, reset: Callable) -> Callable:
                     get_logger().warning(
                         "collective failure; restoring committed state"
                     )
+                    if elastic_job:
+                        # TPU elastic restarts the process: the committed
+                        # state is already persisted in the launcher KV
+                        # store.  Exit with RESTART_CODE — this worker is
+                        # a *survivor* observing a peer failure, and must
+                        # not be blacklisted as the faulty host (the dead
+                        # worker's own non-zero exit marks its host).
+                        _exit_for_restart(_RESTART_CODE)
                     state.restore()
                     skip_sync = False
                 except HostsUpdatedInterrupt as e:
                     get_logger().info("hosts updated; re-initializing")
+                    if elastic_job:
+                        # commit() persisted the snapshot just before
+                        # raising; nothing further to save here.
+                        _exit_for_restart(_RESTART_CODE)
                     skip_sync = e.skip_sync
                 reset()
                 state.on_reset()
         finally:
-            if notification_manager is not None:
+            if elastic_job:
                 notification_manager.remove_listener(state)
 
     return wrapper
+
+
+_RESTART_CODE = 73  # runner/elastic_driver.py RESTART_CODE
+
+
+def _exit_for_restart(code: int) -> None:
+    import os
+    import sys
+
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(code)  # skip atexit: the mesh may be wedged on a dead peer
 
 
 def _default_reset() -> None:

@@ -39,9 +39,63 @@ class State:
         self._host_messages.append((timestamp, update_res))
 
     def commit(self) -> None:
-        """Snapshot + check for host changes (reference ``elastic.py:60``)."""
+        """Snapshot + check for host changes (reference ``elastic.py:60``).
+
+        In an elastic job the snapshot is also persisted to the launcher
+        KV store (rank 0): workers restart across membership rounds on
+        TPU (see runner/elastic_driver.py), so host memory alone cannot
+        carry state between rounds the way the reference's surviving
+        processes do.
+        """
         self.save()
+        self._persist()
         self.check_host_updates()
+
+    def _persist(self) -> None:
+        from ..runner import elastic_worker
+
+        mgr = elastic_worker.get_notification_manager()
+        if mgr is not None:
+            mgr.init()
+            blob = self._serialize()
+            if blob is not None:
+                mgr.save_state_blob(blob)
+            elif not getattr(self, "_warned_no_serialize", False):
+                self._warned_no_serialize = True
+                from ..utils.logging import get_logger
+
+                get_logger().warning(
+                    "elastic job with a State that does not serialize: "
+                    "progress cannot survive worker restarts — use "
+                    "ObjectState/ArrayState or override _serialize()"
+                )
+
+    def _load_persisted(self) -> bool:
+        """Adopt the previous round's snapshot — only on the FIRST sync
+        after process start (later syncs must not roll live progress back
+        to the last commit) and only on rank 0 (the subsequent broadcast
+        overwrites every other rank anyway)."""
+        if getattr(self, "_restore_attempted", False):
+            return False
+        self._restore_attempted = True
+        from ..runner import elastic_worker
+
+        mgr = elastic_worker.get_notification_manager()
+        if mgr is None or mgr.rank != 0:
+            return False
+        mgr.init()
+        blob = mgr.load_state_blob()
+        if blob is None:
+            return False
+        return self._deserialize(blob)
+
+    # Serialization hooks for cross-round persistence (subclasses with
+    # array state override to host-ify leaves).
+    def _serialize(self):
+        return None
+
+    def _deserialize(self, blob) -> bool:
+        return False
 
     def check_host_updates(self) -> None:
         """Raise HostsUpdatedInterrupt when membership changed
@@ -94,11 +148,33 @@ class ObjectState(State):
         # equivalent there and additionally avoids rolling back progress
         # when sync() is reached outside a commit boundary.
         if self._saved_state:
+            # Fresh elastic round: adopt the persisted snapshot from the
+            # previous round, if any, before broadcasting.
+            self._load_persisted()
             self.save()
             synced = functions.broadcast_object(self._saved_state, root_rank=0)
             for k, v in synced.items():
                 self._saved_state[k] = v
                 setattr(self, k, v)
+
+    def _serialize(self):
+        import pickle
+
+        return pickle.dumps(self._saved_state)
+
+    def _deserialize(self, blob) -> bool:
+        import pickle
+
+        try:
+            saved = pickle.loads(blob)
+        except Exception:
+            return False
+        if set(saved) != set(self._saved_state):
+            return False
+        self._saved_state.update(saved)
+        for k, v in saved.items():
+            setattr(self, k, v)
+        return True
 
 
 class ArrayState(ObjectState):
@@ -134,6 +210,7 @@ class ArrayState(ObjectState):
 
     def sync(self) -> None:
         if self._saved_state:
+            self._load_persisted()
             self.save()
             synced = functions.broadcast_object(self._saved_state, root_rank=0)
             for k, v in synced.items():
@@ -141,6 +218,14 @@ class ArrayState(ObjectState):
                 setattr(
                     self, k, jax.device_put(v) if k in self._array_attrs else v
                 )
+
+    def _deserialize(self, blob) -> bool:
+        if not super()._deserialize(blob):
+            return False
+        # re-device the array attributes (the blob holds host arrays)
+        for k in self._array_attrs:
+            setattr(self, k, jax.device_put(self._saved_state[k]))
+        return True
 
 
 # Framework-flavored alias matching reference naming (TorchState /

@@ -103,17 +103,53 @@ def _ps_id(process_set: Optional[ProcessSet]) -> Optional[int]:
     return process_set.process_set_id
 
 
-def _stacked(x: jax.Array) -> jax.Array:
-    """Validate/shard a stacked per-rank array: shape (size, ...)."""
+def _stacked(x: jax.Array) -> Tuple[jax.Array, bool]:
+    """Shard a per-rank array over the world axis.
+
+    Two layouts (both reference-faithful):
+      * global stacked: shape (size, ...) — single-controller form; row r
+        is rank r's tensor.
+      * local rows (multi-process only): shape (local_size, ...) — each
+        process passes only its own ranks' tensors, exactly the
+        reference's per-process ``hvd.allreduce(local_tensor)`` call
+        shape.  Results are returned in the same local layout.
+    Returns (global_array, was_local).
+    """
     rt = get_runtime()
     x = jnp.asarray(x)
-    if x.ndim == 0 or x.shape[0] != rt.size:
-        raise HorovodTpuError(
-            f"eager collectives take stacked per-rank arrays with leading "
-            f"dimension == size ({rt.size}); got shape {x.shape}. Inside "
-            f"jit, use horovod_tpu.ops.traced instead."
+    if x.ndim > 0 and x.shape[0] == rt.size:
+        return jax.device_put(x, NamedSharding(rt.mesh, P(WORLD_AXIS))), False
+    if (
+        rt.process_count > 1
+        and x.ndim > 0
+        and x.shape[0] == len(rt.local_devices)
+    ):
+        from jax.experimental import multihost_utils
+
+        g = multihost_utils.host_local_array_to_global_array(
+            np.asarray(x), rt.mesh, P(WORLD_AXIS)
         )
-    return jax.device_put(x, NamedSharding(rt.mesh, P(WORLD_AXIS)))
+        return g, True
+    expect = f"({rt.size}, ...)"
+    if rt.process_count > 1:
+        expect += f" global or ({len(rt.local_devices)}, ...) process-local"
+    raise HorovodTpuError(
+        f"eager collectives take stacked per-rank arrays with leading "
+        f"dimension {expect}; got shape {x.shape}. Inside jit, use "
+        f"horovod_tpu.ops.traced instead."
+    )
+
+
+def _delocalize(y: jax.Array, was_local: bool) -> jax.Array:
+    """Return the caller's layout: local rows when input was local."""
+    if not was_local:
+        return y
+    rt = get_runtime()
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.global_array_to_host_local_array(
+        y, rt.mesh, P(WORLD_AXIS)
+    )
 
 
 @functools.lru_cache(maxsize=None)
@@ -181,7 +217,7 @@ def allreduce(
         raise ValueError("specify either average or op, not both")
     if op is None:
         op = Average if (average is None or average) else Sum
-    x = _stacked(x)
+    x, was_local = _stacked(x)
     _record(name, "ALLREDUCE", x.nbytes)
     static = (
         ("op", op),
@@ -189,7 +225,7 @@ def allreduce(
         ("postscale_factor", float(postscale_factor)),
         ("process_set_id", _ps_id(process_set)),
     )
-    return _jitted("allreduce", static)(x)
+    return _delocalize(_jitted("allreduce", static)(x), was_local)
 
 
 def allreduce_async(*args, name: Optional[str] = None, **kwargs) -> Handle:
@@ -211,7 +247,8 @@ def grouped_allreduce(
         raise ValueError("specify either average or op, not both")
     if op is None:
         op = Average if (average is None or average) else Sum
-    xs = [_stacked(x) for x in xs]
+    pairs = [_stacked(x) for x in xs]
+    xs = [p[0] for p in pairs]
     _record(name, "GROUPED_ALLREDUCE", sum(x.nbytes for x in xs))
     static = (
         ("op", op),
@@ -220,7 +257,8 @@ def grouped_allreduce(
         ("process_set_id", _ps_id(process_set)),
         ("n_tensors", len(xs)),
     )
-    return list(_jitted("grouped_allreduce", static)(*xs))
+    outs = _jitted("grouped_allreduce", static)(*xs)
+    return [_delocalize(o, p[1]) for o, p in zip(outs, pairs)]
 
 
 def grouped_allreduce_async(xs, name: Optional[str] = None, **kwargs) -> Handle:
@@ -235,12 +273,12 @@ def allgather(
     """Stacked allgather: output row r = concat of all rows along dim 0
     (reference ``hvd.allgather``).  All rows must share a shape; ragged
     gathers go through ``functions.allgather_object``."""
-    x = _stacked(x)
+    x, was_local = _stacked(x)
     _record(name, "ALLGATHER", x.nbytes)
     static = (
         ("process_set_id", _ps_id(process_set)),
     )
-    return _jitted("allgather", static)(x)
+    return _delocalize(_jitted("allgather", static)(x), was_local)
 
 
 def allgather_async(x, name: Optional[str] = None, **kwargs) -> Handle:
@@ -254,13 +292,13 @@ def broadcast(
     name: Optional[str] = None,
 ) -> jax.Array:
     """Stacked broadcast: every in-set row becomes row[root]."""
-    x = _stacked(x)
+    x, was_local = _stacked(x)
     _record(name, "BROADCAST", x.nbytes)
     static = (
         ("root_rank", int(root_rank)),
         ("process_set_id", _ps_id(process_set)),
     )
-    return _jitted("broadcast", static)(x)
+    return _delocalize(_jitted("broadcast", static)(x), was_local)
 
 
 def broadcast_async(x, root_rank, name: Optional[str] = None, **kwargs) -> Handle:
@@ -273,13 +311,13 @@ def reducescatter(
     process_set: Optional[ProcessSet] = None,
     name: Optional[str] = None,
 ) -> jax.Array:
-    x = _stacked(x)
+    x, was_local = _stacked(x)
     _record(name, "REDUCESCATTER", x.nbytes)
     static = (
         ("op", op),
         ("process_set_id", _ps_id(process_set)),
     )
-    return _jitted("reducescatter", static)(x)
+    return _delocalize(_jitted("reducescatter", static)(x), was_local)
 
 
 def alltoall(
@@ -298,7 +336,7 @@ def alltoall(
     are returned alongside (the reference negotiates recvsplits through
     the controller, ``collective_operations.h:209-272``).
     """
-    x = _stacked(x)
+    x, was_local = _stacked(x)
     _record(name, "ALLTOALL", x.nbytes)
     rt = get_runtime()
     n = rt.size
@@ -306,7 +344,7 @@ def alltoall(
         static = (
             ("process_set_id", _ps_id(process_set)),
         )
-        return _jitted("alltoall", static)(x)
+        return _delocalize(_jitted("alltoall", static)(x), was_local)
 
     if process_set is not None and _ps_id(process_set) != 0:
         raise NotImplementedError(
@@ -347,9 +385,13 @@ def alltoall(
     static = (
         ("process_set_id", _ps_id(process_set)),
     )
-    out = _jitted("alltoall", static)(gathered)
-    recv_splits = jnp.asarray(splits.T)  # recv_splits[r][j] = rows r gets from j
-    return out, recv_splits
+    out = _delocalize(_jitted("alltoall", static)(gathered), was_local)
+    recv_splits = splits.T  # recv_splits[r][j] = rows r gets from j
+    if was_local:
+        # match the local-rows layout of `out`: only this process's ranks
+        first = rt.rank
+        recv_splits = recv_splits[first : first + len(rt.local_devices)]
+    return out, jnp.asarray(recv_splits)
 
 
 def alltoall_async(x, splits=None, name: Optional[str] = None, **kwargs) -> Handle:

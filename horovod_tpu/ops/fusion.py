@@ -83,6 +83,15 @@ def bucket_plan(
         )
     if threshold_bytes <= 0:
         return [[i] for i in range(len(sizes_bytes))]
+    # Prefer the native planner (cpp/src/fusion.cc) when built.
+    from .. import native
+
+    dtype_ids = {d: i for i, d in enumerate(dict.fromkeys(dtypes))}
+    planned = native.fusion_plan(
+        list(sizes_bytes), [dtype_ids[d] for d in dtypes], threshold_bytes
+    )
+    if planned is not None:
+        return planned
     open_buckets: dict = {}  # dtype -> (bucket, bytes)
     buckets: List[List[int]] = []
     for i, (sz, dt) in enumerate(zip(sizes_bytes, dtypes)):

@@ -1,0 +1,242 @@
+"""Pure-Python controller speaking the native controller's protocol.
+
+Fallback for environments without a C++ toolchain: wire-compatible with
+``cpp/src/controller.cc`` (frame = 'HVDC' | opcode | len | payload |
+HMAC-SHA256), so a Python server can serve native clients and vice
+versa.  Reference analog: the HTTP KV store
+(``horovod/runner/http/http_server.py``) + HMAC'd RPC
+(``runner/common/util/secret.py``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import hmac
+import socket
+import socketserver
+import struct
+import threading
+import time
+from typing import Dict, Optional
+
+OP_PUT, OP_GET, OP_COUNT, OP_DELSCOPE, OP_PING = 1, 2, 3, 4, 5
+ST_OK, ST_NOTFOUND, ST_AUTH, ST_BAD = 0, 1, 2, 3
+MAX_PAYLOAD = 64 << 20
+
+
+def _mac(secret: bytes, data: bytes) -> bytes:
+    return hmac.new(secret, data, hashlib.sha256).digest()
+
+
+def _recv_all(sock: socket.socket, n: int) -> Optional[bytes]:
+    buf = b""
+    while len(buf) < n:
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            return None
+        buf += chunk
+    return buf
+
+
+def _put_str(parts: list, s: str) -> None:
+    b = s.encode()
+    parts.append(struct.pack(">I", len(b)))
+    parts.append(b)
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        server: PyControllerServer = self.server.controller  # type: ignore[attr-defined]
+        sock = self.request
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        while True:
+            hdr = _recv_all(sock, 9)
+            if hdr is None or hdr[:4] != b"HVDC":
+                return
+            op = hdr[4]
+            (length,) = struct.unpack(">I", hdr[5:9])
+            if length > MAX_PAYLOAD:
+                return
+            payload = _recv_all(sock, length) if length else b""
+            mac = _recv_all(sock, 32)
+            if payload is None or mac is None:
+                return
+            authed = bytes([op]) + struct.pack(">I", length) + payload
+            status, out = ST_OK, b""
+            if not hmac.compare_digest(_mac(server.secret, authed), mac):
+                status = ST_AUTH
+            else:
+                status, out = server.dispatch(op, payload)
+            reply = bytes([status]) + struct.pack(">I", len(out)) + out
+            sock.sendall(reply + _mac(server.secret, reply))
+
+
+class _TCPServer(socketserver.ThreadingTCPServer):
+    allow_reuse_address = True
+    daemon_threads = True
+
+
+class PyControllerServer:
+    """Protocol-compatible with native ``hvd_ctrl_server_*``."""
+
+    def __init__(self, secret: str, world: int, bind_host: str = "0.0.0.0",
+                 port: int = 0):
+        self.secret = secret.encode()
+        self.world = world
+        self._lock = threading.Lock()
+        self._store: Dict[str, Dict[str, bytes]] = {}
+        self._server = _TCPServer((bind_host, port), _Handler)
+        self._server.controller = self  # type: ignore[attr-defined]
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def dispatch(self, op: int, payload: bytes):
+        pos = 0
+
+        def get_str():
+            nonlocal pos
+            (n,) = struct.unpack_from(">I", payload, pos)
+            pos += 4
+            s = payload[pos : pos + n]
+            pos += n
+            return s.decode()
+
+        try:
+            if op == OP_PUT:
+                scope, key = get_str(), get_str()
+                (n,) = struct.unpack_from(">I", payload, pos)
+                pos += 4
+                val = payload[pos : pos + n]
+                with self._lock:
+                    self._store.setdefault(scope, {})[key] = val
+                return ST_OK, b""
+            if op == OP_GET:
+                scope, key = get_str(), get_str()
+                with self._lock:
+                    val = self._store.get(scope, {}).get(key)
+                return (ST_OK, val) if val is not None else (ST_NOTFOUND, b"")
+            if op == OP_COUNT:
+                scope = get_str()
+                with self._lock:
+                    n = len(self._store.get(scope, {}))
+                return ST_OK, struct.pack(">I", n)
+            if op == OP_DELSCOPE:
+                scope = get_str()
+                with self._lock:
+                    self._store.pop(scope, None)
+                return ST_OK, b""
+            if op == OP_PING:
+                return ST_OK, b"pong"
+        except Exception:
+            pass
+        return ST_BAD, b""
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+
+class PyControllerClient:
+    """Protocol-compatible with native ``hvd_ctrl_client_*``."""
+
+    def __init__(self, host: str, port: int, secret: str, rank: int):
+        self.secret = secret.encode()
+        self.rank = rank
+        self._lock = threading.Lock()
+        self._sock = socket.create_connection((host, port), timeout=60)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+
+    def _request(self, op: int, payload: bytes):
+        with self._lock:
+            frame = b"HVDC" + bytes([op]) + struct.pack(">I", len(payload)) + payload
+            authed = bytes([op]) + struct.pack(">I", len(payload)) + payload
+            self._sock.sendall(frame + _mac(self.secret, authed))
+            hdr = _recv_all(self._sock, 5)
+            if hdr is None:
+                raise OSError("controller connection lost")
+            status = hdr[0]
+            (length,) = struct.unpack(">I", hdr[1:5])
+            body = _recv_all(self._sock, length) if length else b""
+            mac = _recv_all(self._sock, 32)
+            reply = bytes([status]) + struct.pack(">I", length) + (body or b"")
+            if mac is None or not hmac.compare_digest(
+                _mac(self.secret, reply), mac
+            ):
+                raise OSError("controller reply auth failed")
+            return status, body or b""
+
+    def put(self, scope: str, key: str, value: bytes) -> None:
+        parts: list = []
+        _put_str(parts, scope)
+        _put_str(parts, key)
+        parts.append(struct.pack(">I", len(value)))
+        parts.append(value)
+        status, _ = self._request(OP_PUT, b"".join(parts))
+        if status != ST_OK:
+            raise OSError("controller put failed")
+
+    def get(self, scope: str, key: str, timeout_ms: int = -1) -> Optional[bytes]:
+        parts: list = []
+        _put_str(parts, scope)
+        _put_str(parts, key)
+        payload = b"".join(parts)
+        deadline = time.monotonic() + timeout_ms / 1000 if timeout_ms >= 0 else None
+        while True:
+            status, body = self._request(OP_GET, payload)
+            if status == ST_OK:
+                return body
+            if status != ST_NOTFOUND:
+                raise OSError("controller get failed")
+            if deadline is not None and time.monotonic() >= deadline:
+                return None
+            time.sleep(0.05)
+
+    def delete_scope(self, scope: str) -> None:
+        parts: list = []
+        _put_str(parts, scope)
+        self._request(OP_DELSCOPE, b"".join(parts))
+
+    def barrier(self, name: str, count: int, timeout_ms: int = -1) -> bool:
+        scope = f"__barrier__/{name}"
+        self.put(scope, str(self.rank), b"1")
+        parts: list = []
+        _put_str(parts, scope)
+        payload = b"".join(parts)
+        deadline = time.monotonic() + timeout_ms / 1000 if timeout_ms >= 0 else None
+        while True:
+            status, body = self._request(OP_COUNT, payload)
+            if status != ST_OK or len(body) != 4:
+                return False
+            (n,) = struct.unpack(">I", body)
+            if n >= count:
+                return True
+            if deadline is not None and time.monotonic() >= deadline:
+                return False
+            time.sleep(0.02)
+
+    def close(self) -> None:
+        self._sock.close()
+
+
+def make_server(secret: str, world: int, bind_host: str = "0.0.0.0",
+                port: int = 0, prefer_native: bool = True):
+    """Native server when built, Python otherwise (same protocol)."""
+    if prefer_native:
+        from .. import native
+
+        if native.available():
+            return native.ControllerServer(secret, world, bind_host, port)
+    return PyControllerServer(secret, world, bind_host, port)
+
+
+def make_client(host: str, port: int, secret: str, rank: int,
+                prefer_native: bool = True):
+    if prefer_native:
+        from .. import native
+
+        if native.available():
+            return native.ControllerClient(host, port, secret, rank)
+    return PyControllerClient(host, port, secret, rank)

@@ -1,0 +1,238 @@
+"""Elastic driver: membership rounds with full worker respawn.
+
+Reference: ``horovod/runner/elastic/driver.py`` — background discovery
+loop, rank reassignment preserving surviving workers, worker respawn on
+new slots, blacklist on failure, ``reset_limit`` bound on membership
+changes.
+
+TPU redesign rationale: XLA compiles for a fixed mesh and
+``jax.distributed`` cannot re-initialize in-process (verified: the
+backend pins the first world), so a membership change restarts *all*
+worker processes for the new round instead of re-bootstrapping
+communicators inside survivors.  Training state survives rounds through
+the launcher KV store / checkpoints (``elastic/state.py`` persists
+commits when elastic env is present), which also covers the
+all-workers-lost case the reference cannot (its in-memory state dies
+with the last survivor).
+
+Worker exit-code contract (read by this driver):
+  0                    job finished -> round succeeds, driver exits
+  73 (RESTART_CODE)    host update acknowledged -> respawn a new round
+  anything else        failure -> blacklist the worker's host, new round
+"""
+
+from __future__ import annotations
+
+import secrets as pysecrets
+import socket
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ..elastic.discovery import HostManager
+from ..utils.logging import get_logger
+from . import controller_py, exec_utils
+from . import hosts as hosts_mod
+from .launch import free_port, make_worker_env
+
+RESTART_CODE = 73
+
+DISCOVERY_PERIOD_S = 1.0  # reference driver.py:30
+
+
+class ElasticDriver:
+    def __init__(
+        self,
+        host_manager: HostManager,
+        min_np: int,
+        max_np: Optional[int] = None,
+        reset_limit: Optional[int] = None,
+        cooldown_s: float = 0.5,
+    ):
+        self.host_manager = host_manager
+        self.min_np = min_np
+        self.max_np = max_np
+        self.reset_limit = reset_limit
+        self.cooldown_s = cooldown_s
+        self.rounds = 0
+        self._shutdown = threading.Event()
+        self._membership_changed = threading.Event()
+        self._discovery_thread: Optional[threading.Thread] = None
+
+    # -- discovery loop (reference driver.py:181) ------------------------
+    def start_discovery(self) -> None:
+        def loop():
+            while not self._shutdown.is_set():
+                try:
+                    if self.host_manager.update_available_hosts():
+                        self._membership_changed.set()
+                except Exception as e:  # discovery script hiccup
+                    get_logger().warning("host discovery failed: %s", e)
+                self._shutdown.wait(DISCOVERY_PERIOD_S)
+
+        self.host_manager.update_available_hosts()
+        self._membership_changed.clear()
+        self._discovery_thread = threading.Thread(target=loop, daemon=True)
+        self._discovery_thread.start()
+
+    def stop(self) -> None:
+        self._shutdown.set()
+        if self._discovery_thread:
+            self._discovery_thread.join(timeout=5)
+
+    def wait_for_available_slots(self, min_np: int, timeout_s: float = 600) -> bool:
+        """Block until the discovered world can host min_np workers
+        (reference ``wait_for_available_slots``)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.host_manager.available_slots() >= min_np:
+                return True
+            if self._shutdown.is_set():
+                return False
+            time.sleep(DISCOVERY_PERIOD_S)
+        return False
+
+    def current_assignments(self) -> List[hosts_mod.SlotInfo]:
+        hosts = [
+            hosts_mod.HostInfo(h, s)
+            for h, s in sorted(self.host_manager.current_hosts.items())
+        ]
+        total = sum(h.slots for h in hosts)
+        np_ = min(total, self.max_np) if self.max_np else total
+        if np_ < self.min_np:
+            raise RuntimeError(
+                f"only {total} slot(s) available, need min_np={self.min_np}"
+            )
+        return hosts_mod.get_host_assignments(hosts, np_, max_np=np_)
+
+    # -- main loop -------------------------------------------------------
+    def run_rounds(
+        self,
+        command: List[str],
+        *,
+        extra_env: Optional[Dict[str, str]] = None,
+        ssh_port: Optional[int] = None,
+        ssh_identity_file: Optional[str] = None,
+    ) -> int:
+        """Spawn worker rounds until success, failure beyond limits, or
+        reset_limit exhausted.  Returns the job exit code."""
+        secret = pysecrets.token_hex(16)
+        server = controller_py.make_server(secret, self.min_np)
+        control = controller_py.make_client(
+            "127.0.0.1", server.port, secret, rank=-1
+        )
+        rendezvous_addr = "127.0.0.1"
+        try:
+            while True:
+                if not self.wait_for_available_slots(self.min_np):
+                    return 1
+                try:
+                    assignments = self.current_assignments()
+                except RuntimeError as e:
+                    get_logger().warning("%s", e)
+                    time.sleep(DISCOVERY_PERIOD_S)
+                    continue
+                self.rounds += 1
+                round_id = self.rounds
+                self._membership_changed.clear()
+                control.put("__elastic__", "round", str(round_id).encode())
+                control.put("__elastic__", f"round_{round_id}_np",
+                            str(len(assignments)).encode())
+                get_logger().warning(
+                    "elastic round %d: %d worker(s) on %d host(s)",
+                    round_id, len(assignments), assignments[-1].cross_size,
+                )
+                coordinator_host = (
+                    "127.0.0.1"
+                    if exec_utils.is_local(assignments[0].hostname)
+                    else assignments[0].hostname
+                )
+                coordinator_addr = f"{coordinator_host}:{free_port()}"
+                workers = []
+                for slot in assignments:
+                    env = make_worker_env(
+                        slot, coordinator_addr, rendezvous_addr, server.port,
+                        secret, extra_env,
+                    )
+                    env["HVD_TPU_ELASTIC"] = "1"
+                    env["HVD_TPU_ELASTIC_ROUND"] = str(round_id)
+                    workers.append(
+                        exec_utils.WorkerProcess(
+                            slot.rank, slot.hostname, command, env,
+                            ssh_port=ssh_port,
+                            ssh_identity_file=ssh_identity_file,
+                        )
+                    )
+                rc = self._watch_round(workers, assignments, control, round_id)
+                if rc == 0:
+                    return 0
+                if rc == RESTART_CODE:
+                    if (
+                        self.reset_limit is not None
+                        and self.rounds > self.reset_limit
+                    ):
+                        get_logger().error(
+                            "reset_limit %d exceeded", self.reset_limit
+                        )
+                        return 1
+                    time.sleep(self.cooldown_s)
+                    continue
+                # real failure: can we keep going?
+                if self.host_manager.available_slots() >= self.min_np:
+                    time.sleep(self.cooldown_s)
+                    continue
+                return rc
+        finally:
+            control.close()
+            server.stop()
+            self.stop()
+
+    def _watch_round(
+        self,
+        workers: List[exec_utils.WorkerProcess],
+        assignments: List[hosts_mod.SlotInfo],
+        control,
+        round_id: int,
+    ) -> int:
+        """Wait for the round to end.  Membership change -> signal workers
+        (they exit RESTART_CODE at the next commit); failure -> blacklist
+        and terminate; success of all -> 0."""
+        pending = set(range(len(workers)))
+        saw_failure = 0
+        while pending:
+            if self._membership_changed.is_set():
+                control.put(
+                    "__elastic__", f"hosts_updated_{round_id}", b"1"
+                )
+                self._membership_changed.clear()
+            for i in sorted(pending):
+                rc = workers[i].returncode
+                if rc is None:
+                    continue
+                pending.discard(i)
+                if rc == 0:
+                    continue
+                if rc == RESTART_CODE:
+                    # graceful restart request: drain the others too
+                    control.put(
+                        "__elastic__", f"hosts_updated_{round_id}", b"1"
+                    )
+                    saw_failure = saw_failure or RESTART_CODE
+                    continue
+                saw_failure = rc
+                self.host_manager.blacklist(assignments[i].hostname)
+                # a dead peer wedges collectives: end the round
+                for j in pending:
+                    workers[j].terminate()
+                for j in pending:
+                    workers[j].wait()
+                pending = set()
+                break
+            time.sleep(0.1)
+        for w in workers:
+            w.wait()
+        if saw_failure == RESTART_CODE:
+            return RESTART_CODE
+        if saw_failure:
+            return RESTART_CODE if self.host_manager.available_slots() >= self.min_np else saw_failure
+        return 0

@@ -1,0 +1,31 @@
+"""Elastic launch glue for the CLI (reference ``_run_elastic`` +
+``launch_gloo_elastic``, ``horovod/runner/launch.py:621`` /
+``gloo_run.py:287``)."""
+
+from __future__ import annotations
+
+import argparse
+
+from ..elastic.discovery import FixedHosts, HostDiscoveryScript, HostManager
+from . import hosts as hosts_mod
+from .elastic_driver import ElasticDriver
+from .launch import env_from_args
+
+
+def launch_elastic(args: argparse.Namespace) -> int:
+    if args.discovery_script:
+        discovery = HostDiscoveryScript(args.discovery_script)
+    elif args.hosts:
+        discovery = FixedHosts(
+            {h.hostname: h.slots for h in hosts_mod.parse_hosts(args.hosts)}
+        )
+    else:
+        raise SystemExit(
+            "elastic mode needs --host-discovery-script or -H hosts"
+        )
+    min_np = args.min_np or args.np
+    driver = ElasticDriver(
+        HostManager(discovery), min_np=min_np, max_np=args.max_np
+    )
+    driver.start_discovery()
+    return driver.run_rounds(args.command, extra_env=env_from_args(args))

@@ -1,0 +1,136 @@
+"""Worker-side elastic plumbing.
+
+Reference: ``horovod/runner/elastic/worker.py`` — a
+``WorkerNotificationManager`` listens for driver host-update
+notifications and flags registered ``State`` objects, whose next
+``commit()``/``check_host_updates()`` raises ``HostsUpdatedInterrupt``.
+
+Here the notification channel is the launcher KV store: the driver sets
+``__elastic__/hosts_updated_<round>``; a poller thread flags states.
+State persistence across worker restarts also lives here (the driver
+respawns processes on membership change — see elastic_driver.py).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import threading
+import time
+from typing import Any, List, Optional
+
+from . import controller_py
+
+RESTART_CODE = 73
+_POLL_PERIOD_S = 0.5
+
+_manager: Optional["WorkerNotificationManager"] = None
+_manager_lock = threading.Lock()
+
+
+def in_elastic_job() -> bool:
+    return os.environ.get("HVD_TPU_ELASTIC") == "1"
+
+
+def get_notification_manager() -> Optional["WorkerNotificationManager"]:
+    global _manager
+    if not in_elastic_job():
+        return None
+    with _manager_lock:
+        if _manager is None:
+            _manager = WorkerNotificationManager()
+        return _manager
+
+
+class WorkerNotificationManager:
+    def __init__(self):
+        self._listeners: List[Any] = []
+        self._lock = threading.Lock()
+        self._client = None
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self.round = int(os.environ.get("HVD_TPU_ELASTIC_ROUND", "0"))
+        self.rank = int(os.environ.get("HVD_TPU_CROSS_RANK", "0"))
+
+    def init(self) -> None:
+        if self._client is not None:
+            return
+        self._client = controller_py.make_client(
+            os.environ["HVD_TPU_RENDEZVOUS_ADDR"],
+            int(os.environ["HVD_TPU_RENDEZVOUS_PORT"]),
+            os.environ["HVD_TPU_SECRET"],
+            self.rank,
+        )
+        self._thread = threading.Thread(target=self._poll, daemon=True)
+        self._thread.start()
+
+    def _poll(self) -> None:
+        key = f"hosts_updated_{self.round}"
+        while not self._stop.is_set():
+            try:
+                val = self._client.get("__elastic__", key, timeout_ms=0)
+            except Exception:
+                val = None
+            if val is not None:
+                with self._lock:
+                    for state in self._listeners:
+                        state.on_hosts_updated(time.time(), "updated")
+                return  # one notification per round
+            self._stop.wait(_POLL_PERIOD_S)
+
+    def register_listener(self, state) -> None:
+        with self._lock:
+            self._listeners.append(state)
+
+    def remove_listener(self, state) -> None:
+        with self._lock:
+            if state in self._listeners:
+                self._listeners.remove(state)
+
+    # -- state persistence across rounds (rank 0 writes) ----------------
+    # Blobs are chunked: the controller protocol caps one frame at 64MB
+    # (native hvd_ctrl_get also truncates reads at its buffer cap), so a
+    # model+optimizer snapshot ships as <=16MB pieces with a manifest.
+    _CHUNK = 16 << 20
+
+    def save_state_blob(self, blob: bytes) -> None:
+        if self.rank != 0 or self._client is None:
+            return
+        import hashlib
+
+        n = max(1, (len(blob) + self._CHUNK - 1) // self._CHUNK)
+        for i in range(n):
+            self._client.put(
+                "__elastic_state__", f"chunk_{i}",
+                blob[i * self._CHUNK : (i + 1) * self._CHUNK],
+            )
+        manifest = f"{n}:{len(blob)}:{hashlib.sha256(blob).hexdigest()}"
+        self._client.put("__elastic_state__", "manifest", manifest.encode())
+
+    def load_state_blob(self) -> Optional[bytes]:
+        if self._client is None:
+            return None
+        import hashlib
+
+        manifest = self._client.get("__elastic_state__", "manifest", timeout_ms=0)
+        if manifest is None:
+            return None
+        n, total, digest = manifest.decode().split(":")
+        parts = []
+        for i in range(int(n)):
+            chunk = self._client.get(
+                "__elastic_state__", f"chunk_{i}", timeout_ms=5000
+            )
+            if chunk is None:
+                return None
+            parts.append(chunk)
+        blob = b"".join(parts)[: int(total)]
+        if hashlib.sha256(blob).hexdigest() != digest:
+            return None  # torn write (a newer commit is in flight)
+        return blob
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._client is not None:
+            self._client.close()
+            self._client = None

@@ -1,0 +1,105 @@
+"""Process execution: local subprocess or ssh, with rank-prefixed output
+streaming (reference ``horovod/runner/gloo_run.py:187-211`` execs
+per-slot commands over ssh and threads stream stdout/stderr with a rank
+prefix; ``safe_shell_exec`` handles termination).
+"""
+
+from __future__ import annotations
+
+import os
+import shlex
+import subprocess
+import sys
+import threading
+from typing import Dict, List, Optional
+
+LOCAL_HOSTNAMES = {"localhost", "127.0.0.1", "::1"}
+
+
+def is_local(hostname: str) -> bool:
+    import socket
+
+    return hostname in LOCAL_HOSTNAMES or hostname == socket.gethostname()
+
+
+def build_command(
+    hostname: str,
+    command: List[str],
+    env: Dict[str, str],
+    ssh_port: Optional[int] = None,
+    ssh_identity_file: Optional[str] = None,
+) -> List[str]:
+    """Local commands run directly with env; remote wrap in ssh with
+    inline exports (reference ``get_remote_command``)."""
+    if is_local(hostname):
+        return command
+    exports = " ".join(
+        f"{k}={shlex.quote(v)}" for k, v in sorted(env.items())
+    )
+    ssh = ["ssh", "-o", "StrictHostKeyChecking=no"]
+    if ssh_port:
+        ssh += ["-p", str(ssh_port)]
+    if ssh_identity_file:
+        ssh += ["-i", ssh_identity_file]
+    remote = f"cd {shlex.quote(os.getcwd())} && env {exports} " + " ".join(
+        shlex.quote(c) for c in command
+    )
+    return ssh + [hostname, remote]
+
+
+class WorkerProcess:
+    """One launched worker with output streaming."""
+
+    def __init__(
+        self,
+        rank: int,
+        hostname: str,
+        command: List[str],
+        env: Dict[str, str],
+        ssh_port: Optional[int] = None,
+        ssh_identity_file: Optional[str] = None,
+        prefix_output: bool = True,
+    ):
+        self.rank = rank
+        self.hostname = hostname
+        full_env = dict(os.environ)
+        full_env.update(env)
+        argv = build_command(hostname, command, env, ssh_port, ssh_identity_file)
+        self.proc = subprocess.Popen(
+            argv,
+            env=full_env if is_local(hostname) else None,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            bufsize=1,
+        )
+        self._streamer = threading.Thread(
+            target=self._stream, args=(prefix_output,), daemon=True
+        )
+        self._streamer.start()
+
+    def _stream(self, prefix: bool) -> None:
+        assert self.proc.stdout is not None
+        for line in self.proc.stdout:
+            if prefix:
+                sys.stdout.write(f"[{self.rank}]<stdout>: {line}")
+            else:
+                sys.stdout.write(line)
+            sys.stdout.flush()
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        rc = self.proc.wait(timeout)
+        self._streamer.join(timeout=5)
+        return rc
+
+    def terminate(self) -> None:
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(timeout=5)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+    @property
+    def returncode(self) -> Optional[int]:
+        return self.proc.poll()

@@ -1,0 +1,107 @@
+"""Host parsing and slot assignment.
+
+Reference: ``horovod/runner/common/util/hosts.py`` — parses
+``host:slots`` lists / hostfiles and computes per-slot rank assignments
+(``get_host_assignments``, ``hosts.py:100``) producing ``SlotInfo``
+records {rank, local_rank, cross_rank, sizes}.
+
+On TPU a "slot" is a worker process (normally one per host, owning all
+of that host's chips), so slots default to 1 instead of the reference's
+GPU count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import List, Optional
+
+
+@dataclasses.dataclass
+class HostInfo:
+    hostname: str
+    slots: int = 1
+
+    @staticmethod
+    def from_string(spec: str) -> "HostInfo":
+        spec = spec.strip()
+        if ":" in spec:
+            host, slots = spec.rsplit(":", 1)
+            return HostInfo(host, int(slots))
+        return HostInfo(spec, 1)
+
+
+@dataclasses.dataclass
+class SlotInfo:
+    hostname: str
+    rank: int
+    local_rank: int
+    cross_rank: int
+    size: int
+    local_size: int
+    cross_size: int
+
+
+def parse_hosts(hosts_string: str) -> List[HostInfo]:
+    """Parse ``host1:slots,host2:slots`` (reference ``parse_hosts``)."""
+    return [HostInfo.from_string(h) for h in hosts_string.split(",") if h.strip()]
+
+
+def parse_host_files(filename: str) -> List[HostInfo]:
+    """Parse a hostfile with ``host slots=N`` lines (reference
+    ``parse_host_files``)."""
+    hosts = []
+    with open(filename) as fh:
+        for line in fh:
+            line = line.split("#")[0].strip()
+            if not line:
+                continue
+            m = re.match(r"^(\S+)\s+slots\s*=\s*(\d+)$", line)
+            if m:
+                hosts.append(HostInfo(m.group(1), int(m.group(2))))
+            else:
+                hosts.append(HostInfo.from_string(line))
+    return hosts
+
+
+def get_host_assignments(
+    hosts: List[HostInfo], min_np: int, max_np: Optional[int] = None
+) -> List[SlotInfo]:
+    """Assign ranks to host slots (reference ``hosts.py:100``).
+
+    Fills hosts in order; ranks are contiguous per host so local_rank
+    matches position on the host and cross_rank indexes hosts.  Raises
+    when fewer than ``min_np`` slots are available.
+    """
+    total = sum(h.slots for h in hosts)
+    if total < min_np:
+        raise ValueError(
+            f"requested {min_np} processes but hosts provide only {total} "
+            f"slot(s); add hosts or raise slots (host:slots)"
+        )
+    np_ = min(total, max_np) if max_np else min_np
+    assignments: List[SlotInfo] = []
+    rank = 0
+    used_hosts = []
+    for cross_rank, h in enumerate(hosts):
+        if rank >= np_:
+            break
+        local = min(h.slots, np_ - rank)
+        used_hosts.append((h, local))
+        for local_rank in range(local):
+            assignments.append(
+                SlotInfo(
+                    hostname=h.hostname,
+                    rank=rank,
+                    local_rank=local_rank,
+                    cross_rank=cross_rank,
+                    size=np_,
+                    local_size=local,
+                    cross_size=0,  # fixed up below
+                )
+            )
+            rank += 1
+    cross_size = len(used_hosts)
+    for a in assignments:
+        a.cross_size = cross_size
+    return assignments

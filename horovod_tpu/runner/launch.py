@@ -1,0 +1,215 @@
+"""``hvdrun`` — the launcher CLI.
+
+Reference: ``horovodrun`` (``horovod/runner/launch.py``, 774 LoC): parses
+np/hosts/elastic flags plus every HOROVOD_* knob, starts the rendezvous
+server, computes host assignments, and execs workers over ssh with
+per-slot env.  The TPU launcher keeps that surface but drops the
+MPI/gloo controller choice (the data plane is XLA) and the NIC-discovery
+driver (the JAX coordination service exchanges addresses itself).
+
+Worker env contract (read by ``runtime._init_distributed`` /
+``Runtime``):
+  HVD_TPU_COORDINATOR_ADDR  host:port of the jax.distributed coordinator
+                            (runs inside worker 0)
+  HVD_TPU_CROSS_RANK/SIZE   process id / process count
+  HVD_TPU_RENDEZVOUS_ADDR/PORT/SECRET  the controller KV store
+"""
+
+from __future__ import annotations
+
+import argparse
+import secrets as pysecrets
+import socket
+import sys
+import time
+from typing import Dict, List, Optional
+
+from ..utils.logging import get_logger
+from ..version import __version__
+from . import controller_py, exec_utils, hosts as hosts_mod
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def make_worker_env(
+    slot: hosts_mod.SlotInfo,
+    coordinator_addr: str,
+    rendezvous_addr: str,
+    rendezvous_port: int,
+    secret: str,
+    extra_env: Optional[Dict[str, str]] = None,
+) -> Dict[str, str]:
+    env = {
+        "HVD_TPU_COORDINATOR_ADDR": coordinator_addr,
+        "HVD_TPU_CROSS_RANK": str(slot.rank),
+        "HVD_TPU_CROSS_SIZE": str(slot.size),
+        "HVD_TPU_LOCAL_RANK": str(slot.local_rank),
+        "HVD_TPU_LOCAL_SIZE": str(slot.local_size),
+        "HVD_TPU_HOSTNAME": slot.hostname,
+        "HVD_TPU_RENDEZVOUS_ADDR": rendezvous_addr,
+        "HVD_TPU_RENDEZVOUS_PORT": str(rendezvous_port),
+        "HVD_TPU_SECRET": secret,
+    }
+    if extra_env:
+        env.update(extra_env)
+    return env
+
+
+def launch_static(
+    np_: int,
+    host_list: List[hosts_mod.HostInfo],
+    command: List[str],
+    *,
+    ssh_port: Optional[int] = None,
+    ssh_identity_file: Optional[str] = None,
+    extra_env: Optional[Dict[str, str]] = None,
+    verbose: bool = False,
+) -> int:
+    """Static (fixed world) launch (reference ``launch_gloo``,
+    ``runner/gloo_run.py:226``).  Returns the first non-zero exit code,
+    terminating the remaining workers on failure like the reference.
+    """
+    assignments = hosts_mod.get_host_assignments(host_list, np_)
+    secret = pysecrets.token_hex(16)
+    server = controller_py.make_server(secret, np_)
+    rendezvous_addr = socket.gethostbyname(socket.gethostname())
+    if all(exec_utils.is_local(a.hostname) for a in assignments):
+        rendezvous_addr = "127.0.0.1"
+    coordinator_host = (
+        "127.0.0.1"
+        if exec_utils.is_local(assignments[0].hostname)
+        else assignments[0].hostname
+    )
+    coordinator_addr = f"{coordinator_host}:{free_port()}"
+    if verbose:
+        get_logger().warning(
+            "launching %d process(es) on %d host(s); rendezvous %s:%d",
+            np_, assignments[-1].cross_size, rendezvous_addr, server.port,
+        )
+    workers = []
+    try:
+        for slot in assignments:
+            env = make_worker_env(
+                slot, coordinator_addr, rendezvous_addr, server.port, secret,
+                extra_env,
+            )
+            workers.append(
+                exec_utils.WorkerProcess(
+                    slot.rank, slot.hostname, command, env,
+                    ssh_port=ssh_port, ssh_identity_file=ssh_identity_file,
+                )
+            )
+        exit_code = 0
+        pending = set(range(len(workers)))
+        while pending:
+            for i in sorted(pending):
+                rc = workers[i].returncode
+                if rc is not None:
+                    pending.discard(i)
+                    if rc != 0:
+                        exit_code = exit_code or rc
+                        # fail fast: a dead peer wedges collectives
+                        for j in pending:
+                            workers[j].terminate()
+                        pending = set()
+                        break
+            time.sleep(0.1)
+        for w in workers:
+            w.wait()
+        return exit_code
+    finally:
+        for w in workers:
+            w.terminate()
+        server.stop()
+
+
+def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
+    parser = argparse.ArgumentParser(
+        prog="hvdrun",
+        description="Launch a horovod_tpu distributed job "
+        "(the horovodrun equivalent).",
+    )
+    parser.add_argument("--version", action="version", version=__version__)
+    parser.add_argument("-np", "--num-proc", type=int, dest="np",
+                        help="total number of worker processes")
+    parser.add_argument("-H", "--hosts",
+                        help="comma list of host:slots (default localhost:np)")
+    parser.add_argument("--hostfile",
+                        help="hostfile with 'host slots=N' lines")
+    parser.add_argument("-p", "--ssh-port", type=int, dest="ssh_port")
+    parser.add_argument("-i", "--ssh-identity-file", dest="ssh_identity_file")
+    parser.add_argument("--verbose", action="store_true")
+    # elastic flags (reference --min-np/--max-np/--host-discovery-script)
+    parser.add_argument("--min-np", type=int, dest="min_np")
+    parser.add_argument("--max-np", type=int, dest="max_np")
+    parser.add_argument("--host-discovery-script", dest="discovery_script")
+    # knob flags -> env (reference config_parser.py maps flags to env)
+    parser.add_argument("--fusion-threshold-mb", type=int)
+    parser.add_argument("--timeline-filename")
+    parser.add_argument("--autotune", action="store_true")
+    parser.add_argument("--autotune-log-file")
+    parser.add_argument("--log-level")
+    parser.add_argument("command", nargs=argparse.REMAINDER,
+                        help="worker command, e.g. python train.py")
+    args = parser.parse_args(argv)
+    if not args.command:
+        parser.error("no worker command given")
+    if args.command[0] == "--":
+        args.command = args.command[1:]
+    if args.np is None and args.min_np is None:
+        parser.error("-np (or --min-np for elastic) is required")
+    return args
+
+
+def env_from_args(args: argparse.Namespace) -> Dict[str, str]:
+    """Map CLI knob flags onto HVD_TPU_* env (reference
+    ``runner/common/util/config_parser.py``)."""
+    env: Dict[str, str] = {}
+    if args.fusion_threshold_mb is not None:
+        env["HVD_TPU_FUSION_THRESHOLD"] = str(args.fusion_threshold_mb << 20)
+    if args.timeline_filename:
+        env["HVD_TPU_TIMELINE"] = args.timeline_filename
+    if args.autotune:
+        env["HVD_TPU_AUTOTUNE"] = "1"
+    if args.autotune_log_file:
+        env["HVD_TPU_AUTOTUNE_LOG"] = args.autotune_log_file
+    if args.log_level:
+        env["HVD_TPU_LOG_LEVEL"] = args.log_level
+    return env
+
+
+def run_commandline(argv: Optional[List[str]] = None) -> int:
+    args = parse_args(argv)
+    if args.discovery_script or args.min_np is not None:
+        from .elastic_launch import launch_elastic
+
+        return launch_elastic(args)
+    if args.hostfile:
+        host_list = hosts_mod.parse_host_files(args.hostfile)
+    elif args.hosts:
+        host_list = hosts_mod.parse_hosts(args.hosts)
+    else:
+        host_list = [hosts_mod.HostInfo("localhost", args.np)]
+    return launch_static(
+        args.np,
+        host_list,
+        args.command,
+        ssh_port=args.ssh_port,
+        ssh_identity_file=args.ssh_identity_file,
+        extra_env=env_from_args(args),
+        verbose=args.verbose,
+    )
+
+
+def main() -> None:
+    sys.exit(run_commandline())
+
+
+if __name__ == "__main__":
+    main()

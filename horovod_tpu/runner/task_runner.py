@@ -1,0 +1,57 @@
+"""Worker-side entry for ``horovod_tpu.runner.run()``.
+
+Reference analog: ``horovod/runner/task_fn.py`` + the run-func wrapper —
+each worker fetches the pickled function from the launcher's KV store,
+executes it with the runtime initialized, and publishes its result.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import sys
+import traceback
+
+
+def main() -> int:
+    # Env set before any jax import: CPU forcing for integration tests.
+    if os.environ.get("HVD_TPU_FORCE_CPU") == "1":
+        os.environ.setdefault(
+            "XLA_FLAGS", "--xla_force_host_platform_device_count=1"
+        )
+    rank = int(os.environ["HVD_TPU_CROSS_RANK"])
+    addr = os.environ["HVD_TPU_RENDEZVOUS_ADDR"]
+    port = int(os.environ["HVD_TPU_RENDEZVOUS_PORT"])
+    secret = os.environ["HVD_TPU_SECRET"]
+
+    from . import controller_py
+
+    client = controller_py.make_client(addr, port, secret, rank)
+    try:
+        blob = client.get("__run__", "func", timeout_ms=30_000)
+        if blob is None:
+            raise RuntimeError("no function published by launcher")
+        import cloudpickle
+
+        func, args, kwargs = cloudpickle.loads(blob)
+        if os.environ.get("HVD_TPU_FORCE_CPU") == "1":
+            import jax
+
+            jax.config.update("jax_platforms", "cpu")
+        result = func(*args, **kwargs)
+        client.put("__results__", str(rank), pickle.dumps(("ok", result)))
+        return 0
+    except Exception:
+        err = traceback.format_exc()
+        try:
+            client.put("__results__", str(rank), pickle.dumps(("error", err)))
+        except Exception:
+            pass
+        sys.stderr.write(err)
+        return 1
+    finally:
+        client.close()
+
+
+if __name__ == "__main__":
+    sys.exit(main())

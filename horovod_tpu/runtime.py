@@ -71,9 +71,14 @@ class Runtime:
         self.timeline = None
         timeline_path = env.get_env(env.TIMELINE)
         if timeline_path:
-            from .utils.timeline import Timeline
+            from . import native
 
-            self.timeline = Timeline(timeline_path)
+            if native.available():
+                self.timeline = native.NativeTimeline(timeline_path)
+            else:
+                from .utils.timeline import Timeline
+
+                self.timeline = Timeline(timeline_path)
         get_logger().info(
             "initialized: %d device(s), %d process(es), platform=%s",
             self.size,
@@ -100,14 +105,19 @@ class Runtime:
 
         from jax.experimental import multihost_utils
 
-        digest = hashlib.sha256(socket.gethostname().encode()).digest()[:8]
-        my_host = np.frombuffer(digest, dtype=np.int64)[0]
-        host_ids = np.asarray(
-            multihost_utils.process_allgather(np.int64(my_host))
-        ).reshape(-1)
+        # 31-bit hash: jax's default x64-disabled mode truncates gathered
+        # integers to int32, so the id must fit in int32 exactly
+        digest = hashlib.sha256(socket.gethostname().encode()).digest()[:4]
+        my_host = int.from_bytes(digest, "big") & 0x7FFFFFFF
+        host_ids = [
+            int(h)
+            for h in np.asarray(
+                multihost_utils.process_allgather(np.int32(my_host))
+            ).reshape(-1)
+        ]
         # Hosts ordered by first process appearance; processes within a
         # host ordered by process index (matches MPI split key semantics).
-        hosts_in_order = list(dict.fromkeys(host_ids.tolist()))
+        hosts_in_order = list(dict.fromkeys(host_ids))
         self.cross_size = len(hosts_in_order)
         self.cross_rank = hosts_in_order.index(host_ids[self.process_rank])
         peers = [p for p in range(self.process_count) if host_ids[p] == my_host]

@@ -1,0 +1,99 @@
+"""Online autotuning of the fusion threshold.
+
+Reference: ``ParameterManager`` (``horovod/common/parameter_manager.{h,cc}``)
+scores each tuning window by observed bytes/sec and drives a Bayesian
+optimizer (``optim/bayesian_optimization.cc``) over knobs like the
+fusion threshold and cycle time, then broadcasts the winner.
+
+On TPU the fusion threshold is a trace-time constant, so a "window" is a
+compiled step function: the tuner suggests a threshold, the caller
+rebuilds/recompiles its step with it, reports the measured score, and
+after ``warmup_windows`` the tuner freezes the best value (the reference
+also freezes after convergence).  The GP/EI search runs in the native
+core (cpp/src/autotune.cc); a hill-climbing fallback covers builds
+without the native library.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from . import env
+from .logging import get_logger
+
+
+class FusionAutotuner:
+    """Suggest/observe loop for the fusion threshold knob.
+
+    Usage::
+
+        tuner = FusionAutotuner()
+        while training:
+            thr = tuner.threshold_bytes()
+            step = build_step(fusion_threshold_bytes=thr)   # recompiles
+            score = run_window(step)                        # bytes/sec
+            tuner.observe(score)
+    """
+
+    def __init__(
+        self,
+        low_bytes: int = 1 << 16,
+        high_bytes: int = 1 << 28,
+        warmup_windows: int = 10,
+        log_path: Optional[str] = None,
+    ):
+        self.low = math.log2(low_bytes)
+        self.high = math.log2(high_bytes)
+        self.warmup_windows = warmup_windows
+        self._windows = 0
+        self._frozen: Optional[int] = None
+        self._current: Optional[float] = None
+        self._log_path = log_path or env.get_env(env.AUTOTUNE_LOG)
+        self._native = None
+        self._history: list[tuple[float, float]] = []
+        from .. import native
+
+        if native.available():
+            self._native = native.Autotune(self.low, self.high)
+
+    def threshold_bytes(self) -> int:
+        if self._frozen is not None:
+            return self._frozen
+        if self._native is not None:
+            self._current = self._native.suggest()
+        else:
+            # fallback: coarse grid sweep
+            grid = [self.low + (self.high - self.low) * i / max(1, self.warmup_windows - 1)
+                    for i in range(self.warmup_windows)]
+            self._current = grid[min(self._windows, len(grid) - 1)]
+        return int(2 ** self._current)
+
+    def observe(self, score: float) -> None:
+        """Report the window score (bytes/sec or images/sec)."""
+        if self._frozen is not None or self._current is None:
+            return
+        self._history.append((self._current, score))
+        if self._native is not None:
+            self._native.observe(self._current, score)
+        self._windows += 1
+        if self._log_path:
+            with open(self._log_path, "a") as fh:
+                fh.write(f"{self._windows},{2**self._current:.0f},{score}\n")
+        if self._windows >= self.warmup_windows:
+            self._freeze()
+
+    def _freeze(self) -> None:
+        if self._native is not None:
+            best_x, best_score = self._native.best()
+        else:
+            best_x, best_score = max(self._history, key=lambda p: p[1])
+        self._frozen = int(2 ** best_x)
+        get_logger().info(
+            "autotune converged: fusion threshold %d bytes (score %.3g)",
+            self._frozen, best_score,
+        )
+
+    @property
+    def converged(self) -> bool:
+        return self._frozen is not None

@@ -1,0 +1,162 @@
+"""Elastic integration: real worker processes, membership change
+mid-training, state carried across rounds.
+
+Reference analog: ``test/integration/elastic_common.py`` +
+``test_elastic_torch.py`` — scripted discovery emitting different host
+lists over time, real elastic jobs, asserting world sizes and state
+continuity per round.
+"""
+
+import os
+import sys
+import textwrap
+import threading
+import time
+
+import pytest
+
+from horovod_tpu.elastic.discovery import HostDiscovery, HostManager
+from horovod_tpu.runner.elastic_driver import ElasticDriver
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+WORKER_ENV = {
+    "PYTHONPATH": REPO_ROOT + os.pathsep + os.environ.get("PYTHONPATH", ""),
+}
+
+pytestmark = pytest.mark.integration
+
+WORKER_SCRIPT = textwrap.dedent(
+    """
+    import os, sys
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import horovod_tpu as hvd
+    from horovod_tpu.elastic import ObjectState
+
+    hvd.init()
+    out = open(os.environ["RESULTS_FILE"] + f".{os.environ['HVD_TPU_CROSS_RANK']}", "a")
+
+    state = ObjectState(epoch=0)
+
+    @hvd.elastic.run
+    def train(state):
+        while state.epoch < 6:
+            time.sleep(0.8)  # one "epoch" of work
+            state.epoch += 1
+            print(f"epoch {state.epoch} world {hvd.size()}", flush=True)
+            out.write(f"round={os.environ['HVD_TPU_ELASTIC_ROUND']} "
+                      f"epoch={state.epoch} size={hvd.size()}\\n")
+            out.flush()
+            state.commit()
+        return state.epoch
+
+    import time
+    final = train(state)
+    out.write(f"done epoch={final}\\n")
+    out.close()
+    """
+)
+
+
+class ScriptedDiscovery(HostDiscovery):
+    """Host set changes after a delay (the scripted-discovery fake)."""
+
+    def __init__(self, phases):
+        # phases: list of (duration_s, {host: slots}); last phase persists
+        self._phases = phases
+        self._t0 = time.monotonic()
+
+    def find_available_hosts_and_slots(self):
+        t = time.monotonic() - self._t0
+        acc = 0.0
+        for duration, hosts in self._phases:
+            acc += duration
+            if t < acc:
+                return dict(hosts)
+        return dict(self._phases[-1][1])
+
+
+def test_elastic_membership_change(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(WORKER_SCRIPT)
+    results_file = str(tmp_path / "results")
+
+    discovery = ScriptedDiscovery([
+        (3.0, {"localhost": 2}),
+        (1e9, {"localhost": 3}),  # scale up after 3s
+    ])
+    driver = ElasticDriver(HostManager(discovery), min_np=2, max_np=4)
+    driver.start_discovery()
+    rc = driver.run_rounds(
+        [sys.executable, str(script)],
+        extra_env={"RESULTS_FILE": results_file, **WORKER_ENV},
+    )
+    assert rc == 0
+    assert driver.rounds >= 2, "membership change should have forced a new round"
+
+    # parse per-rank logs: epochs must be monotonic across rounds (state
+    # survived the restart) and the final round must run at size 3
+    lines = []
+    for fn in os.listdir(tmp_path):
+        if fn.startswith("results."):
+            lines += (tmp_path / fn).read_text().splitlines()
+    assert any(l.startswith("done epoch=6") for l in lines)
+    by_round = {}
+    for l in lines:
+        if l.startswith("round="):
+            parts = dict(kv.split("=") for kv in l.split())
+            by_round.setdefault(int(parts["round"]), []).append(
+                (int(parts["epoch"]), int(parts["size"]))
+            )
+    first_round = min(by_round)
+    last_round = max(by_round)
+    assert first_round != last_round
+    assert all(s == 2 for _, s in by_round[first_round])
+    assert all(s == 3 for _, s in by_round[last_round])
+    max_epoch_first = max(e for e, _ in by_round[first_round])
+    min_epoch_last = min(e for e, _ in by_round[last_round])
+    assert min_epoch_last >= max_epoch_first, (
+        f"state lost across rounds: round {first_round} reached "
+        f"{max_epoch_first}, round {last_round} restarted at {min_epoch_last}"
+    )
+
+
+def test_elastic_worker_failure_blacklists_and_continues(tmp_path):
+    """A worker that dies is handled: the driver starts a new round
+    (reference fault-tolerance-without-scaling case)."""
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(
+        """
+        import os, sys, time
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+        os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+        import horovod_tpu as hvd
+        hvd.init()
+        round_id = int(os.environ["HVD_TPU_ELASTIC_ROUND"])
+        rank = int(os.environ["HVD_TPU_CROSS_RANK"])
+        host = os.environ["HVD_TPU_HOSTNAME"]
+        marker = os.environ["RESULTS_FILE"] + f".round{round_id}.rank{rank}"
+        open(marker, "w").write(f"size={hvd.size()} host={host}\\n")
+        if round_id == 1 and host == "127.0.0.1":
+            os._exit(7)  # simulated crash of the 127.0.0.1 "host"
+        time.sleep(1.0)
+        """
+    ))
+    results_file = str(tmp_path / "marks")
+    discovery = ScriptedDiscovery([(1e9, {"localhost": 1, "127.0.0.1": 1})])
+    driver = ElasticDriver(HostManager(discovery), min_np=1, max_np=2)
+    driver.start_discovery()
+    rc = driver.run_rounds(
+        [sys.executable, str(script)],
+        extra_env={"RESULTS_FILE": results_file, **WORKER_ENV},
+    )
+    assert rc == 0
+    assert driver.rounds == 2
+    marks = sorted(os.listdir(tmp_path))
+    assert any("round2" in m for m in marks)

@@ -1,0 +1,34 @@
+"""FusionAutotuner tests (reference analog: parameter_manager logic)."""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.utils.autotune import FusionAutotuner
+
+
+def _synthetic_score(threshold_bytes: float) -> float:
+    # bell curve peaking at 4MB
+    x = np.log2(threshold_bytes)
+    return float(np.exp(-0.5 * ((x - 22.0) / 2.0) ** 2))
+
+
+def test_autotuner_converges_near_peak():
+    tuner = FusionAutotuner(low_bytes=1 << 16, high_bytes=1 << 28,
+                            warmup_windows=12)
+    while not tuner.converged:
+        thr = tuner.threshold_bytes()
+        tuner.observe(_synthetic_score(thr))
+    best = tuner.threshold_bytes()
+    assert tuner.converged
+    # frozen threshold stable
+    assert tuner.threshold_bytes() == best
+    assert abs(np.log2(best) - 22.0) < 3.0
+
+
+def test_autotuner_log(tmp_path):
+    log = tmp_path / "autotune.csv"
+    tuner = FusionAutotuner(warmup_windows=3, log_path=str(log))
+    while not tuner.converged:
+        tuner.observe(_synthetic_score(tuner.threshold_bytes()))
+    lines = log.read_text().strip().splitlines()
+    assert len(lines) == 3

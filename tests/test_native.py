@@ -1,0 +1,193 @@
+"""Native core tests (the analog of the reference's C++-logic unit tier:
+controller/fusion/cache logic driven in-process, SURVEY.md §4)."""
+
+import json
+import os
+import secrets as pysecrets
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native core not built"
+)
+
+
+def test_version():
+    lib = native.load()
+    assert lib.hvd_version().decode() == "0.1.0"
+
+
+def test_fusion_plan_basic():
+    sizes = [10, 20, 30, 1000, 5]
+    dtypes = [0, 0, 0, 0, 0]
+    buckets = native.fusion_plan(sizes, dtypes, 100)
+    # 10+20+30 fits; 1000 overflows into its own; 5 joins the open 1000?
+    # no: 1000+5 > 100 -> 5 opens a new bucket
+    assert buckets == [[0, 1, 2], [3], [4]]
+
+
+def test_fusion_plan_mixed_dtype_lookahead():
+    sizes = [10, 10, 10, 10]
+    dtypes = [0, 1, 0, 1]
+    buckets = native.fusion_plan(sizes, dtypes, 100)
+    # interleaved dtypes fuse per-dtype with look-ahead
+    assert buckets == [[0, 2], [1, 3]]
+
+
+def test_fusion_plan_matches_python():
+    from horovod_tpu.ops import fusion
+
+    rng = np.random.RandomState(0)
+    sizes = [int(s) for s in rng.randint(1, 10_000, 200)]
+    dtypes = [str(d) for d in rng.randint(0, 3, 200)]
+    ids = {d: i for i, d in enumerate(dict.fromkeys(dtypes))}
+    nat = native.fusion_plan(sizes, [ids[d] for d in dtypes], 16_384)
+    # python reference implementation (the fallback path)
+    open_b = {}
+    py = []
+    for i, (sz, dt) in enumerate(zip(sizes, dtypes)):
+        cur = open_b.get(dt)
+        if cur is not None and cur[1] + sz <= 16_384:
+            cur[0].append(i)
+            open_b[dt] = (cur[0], cur[1] + sz)
+        else:
+            b = [i]
+            py.append(b)
+            open_b[dt] = (b, sz)
+    assert nat == py
+
+
+def test_response_cache_lru():
+    cache = native.ResponseCache(capacity=2)
+    assert not cache.lookup("a", 1)   # miss, insert
+    assert cache.lookup("a", 1)       # hit
+    assert not cache.lookup("a", 2)   # signature change -> miss
+    assert cache.lookup("a", 2)
+    cache.lookup("b", 1)
+    cache.lookup("c", 1)              # evicts LRU ("a")
+    assert len(cache) == 2
+    assert not cache.lookup("a", 2)   # was evicted
+    cache.close()
+
+
+def test_native_timeline_valid_json(tmp_path):
+    path = str(tmp_path / "tl.json")
+    tl = native.NativeTimeline(path)
+    for i in range(100):
+        tl.record_op(f"tensor_{i}", "ALLREDUCE", 1024 * i)
+    tl.begin("neg", "NEGOTIATE_ALLREDUCE")
+    tl.end("neg", "NEGOTIATE_ALLREDUCE")
+    tl.mark_cycle()
+    assert tl.dropped() == 0
+    tl.close()
+    events = json.load(open(path))
+    assert len(events) == 103
+    assert events[0]["name"] == "tensor_0"
+    assert events[0]["args"]["bytes"] == 0
+    assert events[-1]["ph"] == "i"
+
+
+def test_stall_inspector():
+    si = native.StallInspector(warn_seconds=0.05, shutdown_seconds=0.0)
+    si.begin("grad_1")
+    si.begin("grad_2")
+    si.end("grad_2")
+    names, shutdown = si.report()
+    assert names == []  # not yet stalled
+    time.sleep(0.1)
+    names, shutdown = si.report()
+    assert names == ["grad_1"]
+    assert not shutdown
+    si.end("grad_1")
+    names, _ = si.report()
+    assert names == []
+    si.close()
+
+
+def test_wire_roundtrip():
+    buf = native.encode_request(
+        rank=3, rtype=native.REQUEST_ALLREDUCE, dtype=7, root=-1,
+        dims=[64, 128, 3], name="layer1/conv/kernel",
+    )
+    msg = native.decode_request(buf)
+    assert msg["rank"] == 3
+    assert msg["type"] == native.REQUEST_ALLREDUCE
+    assert msg["dtype"] == 7
+    assert msg["dims"] == [64, 128, 3]
+    assert msg["name"] == "layer1/conv/kernel"
+    assert msg["consumed"] == len(buf)
+
+
+def test_controller_kv_and_barrier():
+    secret = pysecrets.token_hex(16)
+    srv = native.ControllerServer(secret=secret, world=4)
+    try:
+        port = srv.port
+        assert port > 0
+        clients = [
+            native.ControllerClient("127.0.0.1", port, secret, rank=r)
+            for r in range(4)
+        ]
+        clients[0].put("scope", "hello", b"world")
+        assert clients[1].get("scope", "hello", timeout_ms=1000) == b"world"
+        # blocking get: value published later by another client
+        def publisher():
+            time.sleep(0.1)
+            clients[2].put("scope", "late", b"\x00\x01binary\xff")
+
+        t = threading.Thread(target=publisher)
+        t.start()
+        assert clients[3].get("scope", "late", timeout_ms=5000) == b"\x00\x01binary\xff"
+        t.join()
+        # get timeout on missing key
+        assert clients[0].get("scope", "missing", timeout_ms=100) is None
+        # barrier across 4 participants
+        results = [None] * 4
+
+        def do_barrier(r):
+            results[r] = clients[r].barrier("round0", 4, timeout_ms=5000)
+
+        threads = [threading.Thread(target=do_barrier, args=(r,)) for r in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert all(results)
+        # scope cleanup
+        clients[0].delete_scope("scope")
+        assert clients[1].get("scope", "hello", timeout_ms=50) is None
+        for c in clients:
+            c.close()
+    finally:
+        srv.stop()
+
+
+def test_controller_rejects_bad_secret():
+    secret = pysecrets.token_hex(16)
+    srv = native.ControllerServer(secret=secret, world=1)
+    try:
+        evil = native.ControllerClient("127.0.0.1", srv.port, "wrong", rank=0)
+        with pytest.raises(OSError):
+            evil.put("s", "k", b"v")
+        evil.close()
+    finally:
+        srv.stop()
+
+
+def test_autotune_finds_peak():
+    at = native.Autotune(low_log2_bytes=16, high_log2_bytes=28)
+
+    def objective(x):
+        return -((x - 23.0) ** 2) + 100.0  # peak at 2^23 bytes
+
+    for _ in range(12):
+        x = at.suggest()
+        at.observe(x, objective(x))
+    best_x, best_y = at.best()
+    assert abs(best_x - 23.0) < 1.5, f"best {best_x} too far from 23"
+    at.close()

@@ -1,0 +1,140 @@
+"""Launcher unit tests (reference analog: ``test/single/test_run.py`` —
+host parsing, assignment math, CLI parsing with mocked exec)."""
+
+import os
+import textwrap
+
+import pytest
+
+from horovod_tpu.runner import hosts as hosts_mod
+from horovod_tpu.runner import launch as launch_mod
+
+
+def test_parse_hosts():
+    hs = hosts_mod.parse_hosts("a:4,b,c:2")
+    assert [(h.hostname, h.slots) for h in hs] == [("a", 4), ("b", 1), ("c", 2)]
+
+
+def test_parse_host_files(tmp_path):
+    f = tmp_path / "hostfile"
+    f.write_text(textwrap.dedent("""\
+        # comment
+        node1 slots=4
+        node2 slots=2
+        node3
+    """))
+    hs = hosts_mod.parse_host_files(str(f))
+    assert [(h.hostname, h.slots) for h in hs] == [
+        ("node1", 4), ("node2", 2), ("node3", 1)
+    ]
+
+
+def test_get_host_assignments():
+    hs = hosts_mod.parse_hosts("a:2,b:2")
+    slots = hosts_mod.get_host_assignments(hs, 4)
+    assert [(s.hostname, s.rank, s.local_rank, s.cross_rank) for s in slots] == [
+        ("a", 0, 0, 0), ("a", 1, 1, 0), ("b", 2, 0, 1), ("b", 3, 1, 1)
+    ]
+    assert all(s.size == 4 and s.cross_size == 2 for s in slots)
+    assert slots[0].local_size == 2
+
+
+def test_get_host_assignments_partial_last_host():
+    hs = hosts_mod.parse_hosts("a:2,b:4")
+    slots = hosts_mod.get_host_assignments(hs, 3)
+    assert len(slots) == 3
+    assert slots[2].hostname == "b" and slots[2].local_size == 1
+
+
+def test_get_host_assignments_insufficient():
+    with pytest.raises(ValueError, match="only 2 slot"):
+        hosts_mod.get_host_assignments(hosts_mod.parse_hosts("a:2"), 4)
+
+
+def test_parse_args_basic():
+    args = launch_mod.parse_args(["-np", "4", "python", "train.py", "--lr", "1"])
+    assert args.np == 4
+    assert args.command == ["python", "train.py", "--lr", "1"]
+
+
+def test_parse_args_knobs_to_env():
+    args = launch_mod.parse_args([
+        "-np", "2", "--fusion-threshold-mb", "32", "--timeline-filename",
+        "/tmp/tl.json", "--autotune", "--log-level", "debug", "python", "x.py",
+    ])
+    env = launch_mod.env_from_args(args)
+    assert env["HVD_TPU_FUSION_THRESHOLD"] == str(32 << 20)
+    assert env["HVD_TPU_TIMELINE"] == "/tmp/tl.json"
+    assert env["HVD_TPU_AUTOTUNE"] == "1"
+    assert env["HVD_TPU_LOG_LEVEL"] == "debug"
+
+
+def test_parse_args_requires_np_and_command():
+    with pytest.raises(SystemExit):
+        launch_mod.parse_args(["python", "x.py"])
+    with pytest.raises(SystemExit):
+        launch_mod.parse_args(["-np", "2"])
+
+
+def test_py_controller_roundtrip():
+    from horovod_tpu.runner import controller_py as cp
+
+    srv = cp.PyControllerServer(secret="s3cret", world=2)
+    try:
+        c1 = cp.PyControllerClient("127.0.0.1", srv.port, "s3cret", 0)
+        c2 = cp.PyControllerClient("127.0.0.1", srv.port, "s3cret", 1)
+        c1.put("sc", "k", b"\x00binary\xff")
+        assert c2.get("sc", "k", timeout_ms=1000) == b"\x00binary\xff"
+        assert c2.get("sc", "nope", timeout_ms=50) is None
+        import threading
+
+        ok = [False, False]
+        ts = [
+            threading.Thread(
+                target=lambda i=i, c=c: ok.__setitem__(
+                    i, c.barrier("b0", 2, timeout_ms=3000)
+                ),
+            )
+            for i, c in enumerate((c1, c2))
+        ]
+        [t.start() for t in ts]
+        [t.join() for t in ts]
+        assert all(ok)
+        # auth failure
+        evil = cp.PyControllerClient("127.0.0.1", srv.port, "wrong", 2)
+        with pytest.raises(OSError):
+            evil.put("sc", "k2", b"x")
+        evil.close()
+        c1.close()
+        c2.close()
+    finally:
+        srv.stop()
+
+
+def test_native_python_controller_interop():
+    """The Python client must speak the native server's protocol and
+    vice versa (same wire format + HMAC)."""
+    from horovod_tpu import native
+    from horovod_tpu.runner import controller_py as cp
+
+    if not native.available():
+        pytest.skip("native core not built")
+    # native server <- python client
+    srv = native.ControllerServer(secret="tok", world=1)
+    try:
+        pyc = cp.PyControllerClient("127.0.0.1", srv.port, "tok", 0)
+        pyc.put("s", "k", b"value1")
+        assert pyc.get("s", "k", timeout_ms=1000) == b"value1"
+        pyc.close()
+    finally:
+        srv.stop()
+    # python server <- native client
+    pysrv = cp.PyControllerServer(secret="tok2", world=1)
+    try:
+        nc = native.ControllerClient("127.0.0.1", pysrv.port, "tok2", 0)
+        nc.put("s", "k", b"value2")
+        assert nc.get("s", "k", timeout_ms=1000) == b"value2"
+        assert nc.barrier("bb", 1, timeout_ms=1000)
+        nc.close()
+    finally:
+        pysrv.stop()
