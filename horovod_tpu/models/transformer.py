@@ -1,0 +1,221 @@
+"""GPT-style decoder-only transformer, wired for hybrid parallelism.
+
+The reference has no model code (Horovod sits below the model; its
+model zoo is the example scripts, SURVEY.md §2 L8) — but the TPU build
+must demonstrate long-context and model parallelism as first-class
+(SURVEY.md §5, §7 step 9), and that requires a transformer to hang them
+on.  TPU-first choices:
+
+* bf16 activations with fp32 LayerNorm/softmax/params (MXU-friendly).
+* attention impl selectable per config: "full" (single device),
+  "ring" (context parallel over the sp axis — parallel/ring_attention),
+  "ulysses" (all_to_all sequence parallel — parallel/ulysses).
+* QKV/out projections are column/row tensor-parallel over the tp axis
+  (one psum per attention + one per MLP, the Megatron pairing).
+* optional MoE FFN sharded over the ep axis (parallel/moe).
+
+All modules degrade gracefully outside shard_map: tp/sp/ep axes absent
+⇒ plain dense single-device transformer (the test and entry() path).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..parallel.mesh import EP_AXIS, SP_AXIS, TP_AXIS
+from ..parallel.moe import MoELayer
+from ..parallel.ring_attention import full_attention, ring_attention
+from ..parallel.tensor import (
+    ColumnParallelDense,
+    RowParallelDense,
+    TensorParallelMLP,
+    _axis_present,
+)
+from ..parallel.ulysses import ulysses_attention
+
+Dtype = Any
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    num_layers: int = 12
+    model_dim: int = 768
+    num_heads: int = 12          # GLOBAL head count
+    head_dim: int = 64
+    ff_dim: int = 3072           # GLOBAL feed-forward width
+    max_len: int = 2048
+    dtype: Any = jnp.bfloat16
+    causal: bool = True
+    # Parallelism:
+    attn_impl: str = "full"      # "full" | "ring" | "ulysses"
+    sp_axis: str = SP_AXIS
+    tp_axis: str = TP_AXIS
+    # MoE (0 ⇒ dense FFN everywhere):
+    moe_every: int = 0           # use MoE FFN in every k-th block
+    num_experts_local: int = 1
+    moe_k: int = 2
+    moe_capacity_factor: float = 1.25
+    ep_axis: str = EP_AXIS
+
+
+def _tp_degree(axis: str) -> int:
+    return lax.axis_size(axis) if _axis_present(axis) else 1
+
+
+class Attention(nn.Module):
+    """Multi-head attention: tp-sharded projections + sp-sharded
+    sequence (ring or Ulysses)."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, x: jax.Array) -> jax.Array:
+        cfg = self.cfg
+        if cfg.attn_impl not in ("full", "ring", "ulysses"):
+            raise ValueError(
+                f"unknown attn_impl {cfg.attn_impl!r}; expected "
+                "'full', 'ring', or 'ulysses'"
+            )
+        tp = _tp_degree(cfg.tp_axis)
+        if cfg.num_heads % tp != 0:
+            raise ValueError(
+                f"num_heads {cfg.num_heads} not divisible by tp degree {tp}"
+            )
+        h_local = cfg.num_heads // tp
+        b, t, _ = x.shape
+
+        qkv = ColumnParallelDense(
+            3 * cfg.num_heads * cfg.head_dim, axis=cfg.tp_axis,
+            dtype=cfg.dtype, name="qkv",
+        )(x)
+        qkv = qkv.reshape(b, t, 3, h_local, cfg.head_dim)
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+
+        # With the sp axis absent the sequence is unsharded, so plain
+        # full attention is the correct lowering for every impl.
+        if cfg.attn_impl == "ring" and _axis_present(cfg.sp_axis):
+            out = ring_attention(q, k, v, axis=cfg.sp_axis, causal=cfg.causal)
+        elif cfg.attn_impl == "ulysses" and _axis_present(cfg.sp_axis):
+            out = ulysses_attention(
+                q, k, v, axis=cfg.sp_axis, causal=cfg.causal
+            )
+        else:
+            out = full_attention(q, k, v, causal=cfg.causal)
+
+        out = out.reshape(b, t, h_local * cfg.head_dim)
+        return RowParallelDense(
+            cfg.model_dim, axis=cfg.tp_axis, dtype=cfg.dtype, name="proj"
+        )(out)
+
+
+class Block(nn.Module):
+    """Pre-LN transformer block; FFN is dense-TP or MoE."""
+
+    cfg: TransformerConfig
+    use_moe: bool = False
+
+    @nn.compact
+    def __call__(
+        self, x: jax.Array
+    ) -> Tuple[jax.Array, jax.Array]:
+        cfg = self.cfg
+        # LayerNorm in fp32 — the numerically load-bearing reductions.
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
+        x = x + Attention(cfg, name="attn")(h.astype(cfg.dtype))
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
+        h = h.astype(cfg.dtype)
+        aux = jnp.zeros((), jnp.float32)
+        if self.use_moe:
+            y, aux = MoELayer(
+                num_experts_local=cfg.num_experts_local,
+                hidden=cfg.ff_dim // max(1, cfg.num_experts_local),
+                k=cfg.moe_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                axis=cfg.ep_axis,
+                dtype=cfg.dtype,
+                name="moe",
+            )(h)
+        else:
+            y = TensorParallelMLP(
+                hidden=cfg.ff_dim,
+                features=cfg.model_dim,
+                axis=cfg.tp_axis,
+                dtype=cfg.dtype,
+                name="mlp",
+            )(h)
+        return x + y.astype(x.dtype), aux
+
+
+class Transformer(nn.Module):
+    """Decoder-only LM.  Input: int32 token ids [B, T_local] (T_local =
+    T_global / sp when the sequence is sharded).  Returns (logits
+    [B, T_local, vocab], moe_aux_loss scalar)."""
+
+    cfg: TransformerConfig
+
+    @nn.compact
+    def __call__(self, tokens: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        cfg = self.cfg
+        b, t = tokens.shape
+        emb = nn.Embed(
+            cfg.vocab_size, cfg.model_dim,
+            embedding_init=nn.initializers.normal(0.02), name="wte",
+        )
+        x = emb(tokens)
+        # Positional embedding at GLOBAL positions: offset by this
+        # device's sequence-block index when sharded over sp.
+        pos = jnp.arange(t)
+        t_global = t
+        if _axis_present(cfg.sp_axis):
+            t_global = t * lax.axis_size(cfg.sp_axis)
+            pos = pos + lax.axis_index(cfg.sp_axis) * t
+        if t_global > cfg.max_len:
+            raise ValueError(
+                f"sequence length {t_global} exceeds max_len {cfg.max_len}"
+            )
+        wpe = self.param(
+            "wpe", nn.initializers.normal(0.02),
+            (cfg.max_len, cfg.model_dim), jnp.float32,
+        )
+        x = (x + jnp.take(wpe, pos, axis=0)[None]).astype(cfg.dtype)
+
+        aux_total = jnp.zeros((), jnp.float32)
+        for i in range(cfg.num_layers):
+            use_moe = (
+                cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
+            )
+            x, aux = Block(cfg, use_moe=use_moe, name=f"block_{i}")(x)
+            aux_total = aux_total + aux
+
+        x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
+        # Tied output head (GPT-2 style): logits via embed transpose.
+        logits = emb.attend(x.astype(jnp.float32))
+        return logits, aux_total
+
+
+def gpt_small(**overrides) -> Transformer:
+    """124M-class config (GPT-2 small) — the flagship LM benchmark."""
+    cfg = TransformerConfig(
+        vocab_size=50304,  # GPT-2 vocab padded to a multiple of 128 (MXU)
+        num_layers=12, model_dim=768, num_heads=12, head_dim=64,
+        ff_dim=3072, max_len=1024,
+    )
+    cfg = dataclasses.replace(cfg, **overrides)
+    return Transformer(cfg)
+
+
+def gpt_tiny(**overrides) -> Transformer:
+    """Tiny config for tests and the multi-chip dryrun."""
+    cfg = TransformerConfig(
+        vocab_size=256, num_layers=2, model_dim=64, num_heads=4,
+        head_dim=16, ff_dim=128, max_len=256, dtype=jnp.float32,
+    )
+    cfg = dataclasses.replace(cfg, **overrides)
+    return Transformer(cfg)

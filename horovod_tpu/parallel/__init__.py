@@ -1,0 +1,35 @@
+"""Multi-dimensional parallelism over the TPU device mesh.
+
+The reference is a data-parallel engine: its only model-parallel
+building blocks are process sets (rank subsets running concurrent
+collectives, ``horovod/common/process_set.{h,cc}``) and the ``alltoall``
+collective (``horovod/common/operations.cc:1630``).  SURVEY.md §2.5/§5
+inventories TP / PP / SP / CP / ring attention as capabilities the
+TPU-native build must cover idiomatically.  This package is that cover:
+first-class mesh axes (dp / tp / pp / sp / ep) instead of hand-rolled
+process sets, with each strategy lowered to XLA collectives over ICI:
+
+* ``mesh``           — named multi-axis ``jax.sharding.Mesh`` construction
+* ``tensor``         — Megatron-style column/row parallel layers (psum)
+* ``ring_attention`` — context parallelism: blockwise attention with
+                       K/V blocks streamed around an ICI ring (ppermute)
+* ``ulysses``        — sequence parallelism via head<->sequence all_to_all
+* ``pipeline``       — GPipe-style microbatch pipeline over the pp axis
+* ``moe``            — expert parallelism: top-k routing + all_to_all
+                       dispatch/combine over the ep axis
+"""
+
+from .mesh import (  # noqa: F401
+    DP_AXIS,
+    EP_AXIS,
+    PP_AXIS,
+    SP_AXIS,
+    TP_AXIS,
+    ParallelConfig,
+    make_mesh,
+)
+from .ring_attention import ring_attention  # noqa: F401
+from .ulysses import ulysses_attention  # noqa: F401
+from .tensor import ColumnParallelDense, RowParallelDense  # noqa: F401
+from .pipeline import pipeline_apply  # noqa: F401
+from .moe import MoELayer, moe_alltoall_dispatch  # noqa: F401

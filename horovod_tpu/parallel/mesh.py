@@ -1,0 +1,106 @@
+"""Named multi-axis device meshes for hybrid parallelism.
+
+The reference composes parallelism out of process sets — explicit rank
+lists with their own controller/queue (``horovod/common/process_set.h:26-80``,
+``test/parallel/test_process_sets_static.py``).  On TPU the idiomatic
+equivalent is a multi-dimensional ``jax.sharding.Mesh`` whose named axes
+*are* the process sets: a collective over axis "dp" is a concurrent
+per-group collective exactly like a Horovod process-set allreduce, but
+the grouping is declared once in the mesh geometry and XLA lays the
+collectives onto the matching ICI dimensions.
+
+Axis order (outer→inner) follows bandwidth needs: tp (highest traffic,
+innermost → shortest ICI hops), then sp/ep, then pp, then dp (lowest
+traffic, outermost → may cross DCN).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+# Canonical axis names. dp = data, pp = pipeline stages, ep = experts,
+# sp = sequence/context blocks, tp = tensor (operator) sharding.
+DP_AXIS = "dp"
+PP_AXIS = "pp"
+EP_AXIS = "ep"
+SP_AXIS = "sp"
+TP_AXIS = "tp"
+
+# Outer-to-inner mesh order: innermost axes get the physically closest
+# devices, so the hottest collectives ride the shortest ICI links.
+AXIS_ORDER: Tuple[str, ...] = (DP_AXIS, PP_AXIS, EP_AXIS, SP_AXIS, TP_AXIS)
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelConfig:
+    """Degrees of each parallelism dimension; product must equal the
+    number of devices (unset axes default to 1 and are dropped from the
+    mesh unless ``keep_unit_axes``)."""
+
+    dp: int = 1
+    pp: int = 1
+    ep: int = 1
+    sp: int = 1
+    tp: int = 1
+
+    def degree(self, axis: str) -> int:
+        return getattr(self, axis)
+
+    @property
+    def total(self) -> int:
+        return self.dp * self.pp * self.ep * self.sp * self.tp
+
+    def axes(self, keep_unit_axes: bool = False) -> List[str]:
+        return [
+            a for a in AXIS_ORDER if keep_unit_axes or self.degree(a) > 1
+        ] or [DP_AXIS]
+
+
+def make_mesh(
+    config: Optional[ParallelConfig] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    keep_unit_axes: bool = False,
+    **degrees: int,
+) -> Mesh:
+    """Build a named mesh for hybrid parallelism.
+
+    ``make_mesh(dp=2, tp=4)`` on 8 chips → Mesh {'dp': 2, 'tp': 4}.
+    One axis may be -1 (inferred from the device count, like a reshape).
+    """
+    if config is None:
+        config = ParallelConfig(**degrees)
+    elif degrees:
+        raise ValueError("pass either a ParallelConfig or keyword degrees")
+    if devices is None:
+        from ..runtime import get_runtime_or_none
+
+        rt = get_runtime_or_none()
+        devices = rt.devices if rt is not None else jax.devices()
+    devices = list(devices)
+
+    vals = {a: config.degree(a) for a in AXIS_ORDER}
+    unknown = [a for a, v in vals.items() if v == -1]
+    if len(unknown) > 1:
+        raise ValueError("at most one axis degree may be -1")
+    if unknown:
+        known = int(np.prod([v for v in vals.values() if v != -1]))
+        if len(devices) % known != 0:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by fixed degrees {known}"
+            )
+        vals[unknown[0]] = len(devices) // known
+        config = ParallelConfig(**vals)
+    if config.total != len(devices):
+        raise ValueError(
+            f"mesh degrees {vals} multiply to {config.total}, but "
+            f"{len(devices)} devices are available"
+        )
+    axes = config.axes(keep_unit_axes)
+    shape = tuple(config.degree(a) for a in axes)
+    arr = np.asarray(devices, dtype=object).reshape(shape)
+    return Mesh(arr, tuple(axes))
