@@ -200,6 +200,41 @@ class Transformer(nn.Module):
         return logits, aux_total
 
 
+def param_shard_axes(params, cfg: TransformerConfig):
+    """Pytree (matching ``params``) of space-separated mesh-axis names
+    each parameter is sharded over, for ``parallel.sync_gradients``.
+
+    Rules mirror the module structure: attention qkv/proj kernels and
+    MLP wi/wo kernels are tp-sharded (column/row); MoE expert weights
+    are ep-sharded; embeddings / LayerNorms / psum-side biases / router
+    are replicated.
+    """
+
+    def classify(path) -> str:
+        keys = [getattr(k, "key", str(k)) for k in path]
+        joined = "/".join(str(k) for k in keys)
+        leaf = keys[-1] if keys else ""
+        if "/moe/" in f"/{joined}/":
+            return cfg.ep_axis if leaf in ("wi", "wo") else ""
+        if "/attn/" in f"/{joined}/":
+            if "/qkv/" in f"/{joined}/":
+                return cfg.tp_axis  # column shard: kernel and bias
+            if "/proj/" in f"/{joined}/" and leaf == "kernel":
+                return cfg.tp_axis  # row shard; proj bias is replicated
+            return ""
+        if "/mlp/" in f"/{joined}/":
+            if "/wi/" in f"/{joined}/":
+                return cfg.tp_axis
+            if "/wo/" in f"/{joined}/" and leaf == "kernel":
+                return cfg.tp_axis
+            return ""
+        return ""
+
+    return jax.tree_util.tree_map_with_path(
+        lambda path, _: classify(path), params
+    )
+
+
 def gpt_small(**overrides) -> Transformer:
     """124M-class config (GPT-2 small) — the flagship LM benchmark."""
     cfg = TransformerConfig(

@@ -33,3 +33,4 @@ from .ulysses import ulysses_attention  # noqa: F401
 from .tensor import ColumnParallelDense, RowParallelDense  # noqa: F401
 from .pipeline import pipeline_apply  # noqa: F401
 from .moe import MoELayer, moe_alltoall_dispatch  # noqa: F401
+from .grad_sync import sync_gradients  # noqa: F401

@@ -55,9 +55,11 @@ class ParallelConfig:
     def total(self) -> int:
         return self.dp * self.pp * self.ep * self.sp * self.tp
 
-    def axes(self, keep_unit_axes: bool = False) -> List[str]:
+    def axes(self, keep: Sequence[str] = ()) -> List[str]:
+        """Axes of the mesh: degree->1 axes plus any in ``keep`` (axes
+        the caller explicitly asked for, even at degree 1)."""
         return [
-            a for a in AXIS_ORDER if keep_unit_axes or self.degree(a) > 1
+            a for a in AXIS_ORDER if self.degree(a) > 1 or a in keep
         ] or [DP_AXIS]
 
 
@@ -71,7 +73,11 @@ def make_mesh(
 
     ``make_mesh(dp=2, tp=4)`` on 8 chips → Mesh {'dp': 2, 'tp': 4}.
     One axis may be -1 (inferred from the device count, like a reshape).
+    Degree-1 axes are dropped unless explicitly passed as keywords (so
+    ``make_mesh(pp=1)`` still has a 'pp' axis to shard over) or
+    ``keep_unit_axes`` is set (keeps all five).
     """
+    explicit = tuple(AXIS_ORDER) if keep_unit_axes else tuple(degrees)
     if config is None:
         config = ParallelConfig(**degrees)
     elif degrees:
@@ -100,7 +106,7 @@ def make_mesh(
             f"mesh degrees {vals} multiply to {config.total}, but "
             f"{len(devices)} devices are available"
         )
-    axes = config.axes(keep_unit_axes)
+    axes = config.axes(explicit)
     shape = tuple(config.degree(a) for a in axes)
     arr = np.asarray(devices, dtype=object).reshape(shape)
     return Mesh(arr, tuple(axes))
