@@ -38,6 +38,7 @@ from ..parallel.tensor import (
     _axis_present,
 )
 from ..parallel.ulysses import ulysses_attention
+from ..ops.pallas_kernels import flash_attention
 
 Dtype = Any
 
@@ -54,7 +55,7 @@ class TransformerConfig:
     dtype: Any = jnp.bfloat16
     causal: bool = True
     # Parallelism:
-    attn_impl: str = "full"      # "full" | "ring" | "ulysses"
+    attn_impl: str = "flash"     # "flash" | "full" | "ring" | "ulysses"
     sp_axis: str = SP_AXIS
     tp_axis: str = TP_AXIS
     # MoE (0 ⇒ dense FFN everywhere):
@@ -78,10 +79,10 @@ class Attention(nn.Module):
     @nn.compact
     def __call__(self, x: jax.Array) -> jax.Array:
         cfg = self.cfg
-        if cfg.attn_impl not in ("full", "ring", "ulysses"):
+        if cfg.attn_impl not in ("flash", "full", "ring", "ulysses"):
             raise ValueError(
                 f"unknown attn_impl {cfg.attn_impl!r}; expected "
-                "'full', 'ring', or 'ulysses'"
+                "'flash', 'full', 'ring', or 'ulysses'"
             )
         tp = _tp_degree(cfg.tp_axis)
         if cfg.num_heads % tp != 0:
@@ -103,9 +104,23 @@ class Attention(nn.Module):
         if cfg.attn_impl == "ring" and _axis_present(cfg.sp_axis):
             out = ring_attention(q, k, v, axis=cfg.sp_axis, causal=cfg.causal)
         elif cfg.attn_impl == "ulysses" and _axis_present(cfg.sp_axis):
+            # The post-exchange [B, T_global, H/n, D] attention is the
+            # fused Pallas kernel — full sequence, fraction of the heads.
             out = ulysses_attention(
-                q, k, v, axis=cfg.sp_axis, causal=cfg.causal
+                q, k, v, axis=cfg.sp_axis, causal=cfg.causal,
+                attn_fn=flash_attention,
             )
+        elif _axis_present(cfg.sp_axis) and lax.axis_size(cfg.sp_axis) > 1:
+            # flash/full attend only within the local shard: on a
+            # sequence-sharded mesh that silently drops cross-shard
+            # attention, so refuse rather than return wrong logits.
+            raise ValueError(
+                f"attn_impl={cfg.attn_impl!r} is shard-local but the "
+                f"sequence axis {cfg.sp_axis!r} is present in the mesh; "
+                "use attn_impl='ring' or 'ulysses' for sequence parallelism"
+            )
+        elif cfg.attn_impl == "flash":
+            out = flash_attention(q, k, v, cfg.causal)
         else:
             out = full_attention(q, k, v, causal=cfg.causal)
 

@@ -53,7 +53,9 @@ def test_sequence_parallel_matches_single_device(impl, heads, head_dim):
     with the same weights: sequence parallelism is numerically
     transparent."""
     toks = _tokens(b=2, t=32)
-    ref_model = gpt_tiny(num_heads=heads, head_dim=head_dim)
+    # attn_impl="full": the reference must be *exact* attention, not the
+    # flash kernel, so shared flash numerics can't cancel out.
+    ref_model = gpt_tiny(num_heads=heads, head_dim=head_dim, attn_impl="full")
     params = ref_model.init(jax.random.PRNGKey(2), toks)
     ref_logits, _ = jax.jit(ref_model.apply)(params, toks)
 
@@ -147,3 +149,18 @@ def test_tp_transformer_runs_sharded():
     logits = jax.jit(f)(toks)
     assert logits.shape == (2, 16, 256)
     assert np.isfinite(np.asarray(logits)).all()
+
+
+def test_shard_local_attention_on_sp_mesh_raises():
+    """flash/full on a sequence-sharded mesh must refuse (they would
+    silently drop cross-shard attention)."""
+    toks = _tokens(b=2, t=32)
+    model = gpt_tiny(attn_impl="flash")
+    params = model.init(jax.random.PRNGKey(0), toks)
+    mesh = make_mesh(sp=8)
+    f = shard_map(
+        lambda p, tk: model.apply(p, tk)[0],
+        mesh=mesh, in_specs=(P(), P(None, "sp")), out_specs=P(None, "sp"),
+    )
+    with pytest.raises(ValueError, match="shard-local"):
+        jax.jit(f)(params, toks)
