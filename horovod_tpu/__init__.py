@@ -178,3 +178,5 @@ from .compression import Compression  # noqa: F401,E402
 from . import elastic  # noqa: F401,E402
 from .sync_batch_norm import SyncBatchNorm  # noqa: F401,E402
 from .metrics import metric_average  # noqa: F401,E402
+from . import callbacks  # noqa: F401,E402
+from . import data  # noqa: F401,E402

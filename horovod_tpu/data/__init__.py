@@ -1,0 +1,15 @@
+"""Data loading utilities.
+
+Reference: ``horovod/data/data_loader_base.py`` (BaseDataLoader +
+AsyncDataLoaderMixin) and ``horovod/torch/elastic/sampler.py``
+(ElasticSampler).  TPU-native additions: :func:`shard_batch` for
+host-local → global-batch device placement.
+"""
+
+from .data_loader_base import (  # noqa: F401
+    AsyncDataLoaderMixin,
+    BaseDataLoader,
+    ArrayDataLoader,
+    AsyncArrayDataLoader,
+)
+from .sampler import ElasticSampler  # noqa: F401

@@ -1,0 +1,189 @@
+"""Base data loader + async prefetch mixin.
+
+Reference: ``horovod/data/data_loader_base.py:1-132`` — the Spark
+estimators feed training through a ``BaseDataLoader`` and can overlap
+host-side batch preparation with device compute via
+``AsyncDataLoaderMixin`` (a background thread filling a bounded queue).
+On TPU the overlap matters even more: the queue hides host preprocessing
+behind device steps, and batches can be placed onto devices ahead of
+time.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Any, Iterator, Optional, Sequence
+
+import numpy as np
+
+from ..utils.logging import get_logger
+
+log = get_logger()
+
+
+class BaseDataLoader:
+    """Iterable over batches for one epoch.
+
+    Subclasses implement :meth:`_iterate`; users iterate the loader
+    itself (reference ``BaseDataLoader.__iter__``).
+    """
+
+    def __len__(self) -> int:
+        raise NotImplementedError()
+
+    def _iterate(self) -> Iterator[Any]:
+        raise NotImplementedError()
+
+    def __iter__(self) -> Iterator[Any]:
+        self._pre_epoch()
+        return self._iterate()
+
+    def _pre_epoch(self) -> None:
+        """Hook run before each epoch's iteration starts."""
+
+
+class AsyncDataLoaderMixin:
+    """Prefetch batches on a background thread through a bounded queue.
+
+    Mix in *before* the loader class (reference
+    ``data_loader_base.py:61``)::
+
+        class AsyncArrayDataLoader(AsyncDataLoaderMixin, ArrayDataLoader):
+            ...
+
+    ``async_loading=False`` degrades to synchronous iteration.  The
+    worker thread is started lazily per epoch and drained/joined on
+    close or when the epoch ends (``None`` sentinel).
+    """
+
+    def __init__(self, *args, async_loading: bool = True,
+                 queue_size: int = 5, **kwargs):
+        self.async_loading = async_loading
+        self._queue_size = queue_size
+        self._queue: Optional[queue.Queue] = None
+        self._worker: Optional[threading.Thread] = None
+        self._shutdown = threading.Event()
+        super().__init__(*args, **kwargs)
+
+    def close_async_loader(self) -> None:
+        """Stop the worker thread (reference ``close_async_loader``)."""
+        if self._worker is None:
+            return
+        self._shutdown.set()
+        # Drain so a blocked put() can observe the shutdown flag.
+        try:
+            while True:
+                self._queue.get_nowait()
+        except queue.Empty:
+            pass
+        self._worker.join()
+        self._worker = None
+        self._shutdown.clear()
+
+    def _fill(self) -> None:
+        try:
+            for batch in super()._iterate():
+                while not self._shutdown.is_set():
+                    try:
+                        self._queue.put(batch, timeout=0.1)
+                        break
+                    except queue.Full:
+                        continue
+                if self._shutdown.is_set():
+                    return
+            self._queue.put(None)  # epoch-end sentinel
+        except Exception as e:  # surface worker errors to the consumer
+            log.error("async data loader worker failed: %s", e)
+            self._queue.put(e)
+
+    def _iterate(self) -> Iterator[Any]:
+        if not self.async_loading:
+            yield from super()._iterate()
+            return
+        self.close_async_loader()
+        self._queue = queue.Queue(maxsize=self._queue_size)
+        self._worker = threading.Thread(target=self._fill, daemon=True)
+        self._worker.start()
+        while True:
+            item = self._queue.get()
+            if item is None:
+                break
+            if isinstance(item, Exception):
+                raise item
+            yield item
+        self._worker.join()
+        self._worker = None
+
+
+class ArrayDataLoader(BaseDataLoader):
+    """Batch iterator over in-memory arrays, optionally rank-sharded.
+
+    TPU-native convenience with reference-equivalent semantics to
+    feeding a framework DataLoader with a DistributedSampler: each rank
+    sees a disjoint 1/size shard, reshuffled per epoch from ``seed`` +
+    epoch so all ranks agree on the permutation.
+    """
+
+    def __init__(
+        self,
+        arrays: Sequence[np.ndarray],
+        batch_size: int,
+        shuffle: bool = True,
+        seed: int = 0,
+        shard: bool = True,
+        drop_last: bool = True,
+    ):
+        if not arrays:
+            raise ValueError("need at least one array")
+        n = len(arrays[0])
+        for a in arrays:
+            if len(a) != n:
+                raise ValueError("arrays must share leading dimension")
+        self.arrays = arrays
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+
+        if shard:
+            # Shard per controller process: each process feeds its local
+            # chips the process-local slice of the global batch (JAX
+            # multi-controller convention), so the shard unit is the
+            # process, not the chip.
+            from .. import runtime
+
+            rt = runtime.get_runtime_or_none()
+            self._rank = rt.process_rank if rt else 0
+            self._num_shards = rt.process_count if rt else 1
+        else:
+            self._rank, self._num_shards = 0, 1
+        self._shard_len = n // self._num_shards if shard else n
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        if self.drop_last:
+            return self._shard_len // self.batch_size
+        return (self._shard_len + self.batch_size - 1) // self.batch_size
+
+    def _iterate(self) -> Iterator[Any]:
+        n = len(self.arrays[0])
+        if self.shuffle:
+            order = np.random.RandomState(self.seed + self.epoch).permutation(n)
+        else:
+            order = np.arange(n)
+        # Strided shard: identical to DistributedSampler's rank::size split.
+        mine = order[self._rank::self._num_shards][: self._shard_len]
+        nb = len(self)
+        for b in range(nb):
+            idx = mine[b * self.batch_size:(b + 1) * self.batch_size]
+            if len(idx) == 0:
+                return
+            yield tuple(a[idx] for a in self.arrays)
+
+
+class AsyncArrayDataLoader(AsyncDataLoaderMixin, ArrayDataLoader):
+    """ArrayDataLoader with background prefetch."""

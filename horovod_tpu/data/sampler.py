@@ -1,0 +1,127 @@
+"""Elastic sampler: shard indices across a changing world.
+
+Reference: ``horovod/torch/elastic/sampler.py`` — a DistributedSampler
+that additionally (a) records processed indices so a restarted epoch
+resumes where it left off, and (b) re-shards the remaining indices when
+the world size changes mid-epoch.  State round-trips through the elastic
+``State`` object (``state_dict``/``load_state_dict``).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Iterator, List, Optional
+
+import numpy as np
+
+
+class ElasticSampler:
+    """Deterministically shards ``dataset_size`` indices over ranks.
+
+    All ranks derive the same permutation from (seed, epoch), then take
+    a strided shard padded to equal length (so collective step counts
+    match across ranks — the reference pads by wrapping, we repeat the
+    leading remainder the same way).
+    """
+
+    def __init__(
+        self,
+        dataset_size: int,
+        shuffle: bool = True,
+        seed: int = 0,
+        rank: Optional[int] = None,
+        num_replicas: Optional[int] = None,
+    ):
+        self.dataset_size = dataset_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.epoch = 0
+        self.processed_indices: List[int] = []
+        if rank is None or num_replicas is None:
+            from .. import runtime
+
+            rt = runtime.get_runtime_or_none()
+            rank = rank if rank is not None else (rt.rank if rt else 0)
+            num_replicas = num_replicas if num_replicas is not None else (
+                rt.size if rt else 1
+            )
+        self.rank = rank
+        self.num_replicas = num_replicas
+        self._reset()
+
+    # -- reference API ----------------------------------------------------
+
+    def set_epoch(self, epoch: int) -> None:
+        """Start a new epoch: clear processed set, reshuffle."""
+        self.epoch = epoch
+        self.processed_indices = []
+        self._reset()
+
+    def record_batch(self, batch_idx: int, batch_size: int) -> None:
+        """Mark one batch of this rank's shard as processed."""
+        start = batch_idx * batch_size
+        self.processed_indices.extend(
+            self.indices[start:start + batch_size]
+        )
+
+    def load_state_dict(self, state: Dict) -> None:
+        self.epoch = state["epoch"]
+        self.processed_indices = list(state["processed_indices"])
+        self._reset()
+
+    def state_dict(self) -> Dict:
+        return {
+            "epoch": self.epoch,
+            "processed_indices": list(self.processed_indices),
+        }
+
+    def reset(self, rank: Optional[int] = None,
+              num_replicas: Optional[int] = None) -> None:
+        """Re-shard after a world-size change (called from State.on_reset).
+
+        Remaining (unprocessed) indices are redistributed over the new
+        world; processed ones are not replayed.
+        """
+        if rank is not None:
+            self.rank = rank
+        if num_replicas is not None:
+            self.num_replicas = num_replicas
+        else:
+            from .. import runtime
+
+            rt = runtime.get_runtime_or_none()
+            if rt is not None:
+                self.rank, self.num_replicas = rt.rank, rt.size
+        self._reset()
+
+    # -- iteration --------------------------------------------------------
+
+    def __len__(self) -> int:
+        return self.num_samples
+
+    def __iter__(self) -> Iterator[int]:
+        return iter(self.indices)
+
+    # -- internals --------------------------------------------------------
+
+    def _reset(self) -> None:
+        if self.shuffle:
+            order = np.random.RandomState(self.seed + self.epoch).permutation(
+                self.dataset_size
+            )
+        else:
+            order = np.arange(self.dataset_size)
+        processed = set(self.processed_indices)
+        remaining = [int(i) for i in order if int(i) not in processed]
+        self.num_samples = int(
+            math.ceil(len(remaining) / float(self.num_replicas))
+        )
+        total = self.num_samples * self.num_replicas
+        # Pad by wrapping so every rank has an equal shard (reference
+        # sampler.py padding).  Repeat as many times as needed: with
+        # fewer remaining indices than replicas a single wrap would
+        # leave some ranks short, desynchronizing collective step counts.
+        if remaining:
+            reps = -(-total // len(remaining))  # ceil
+            remaining = (remaining * reps)[:total]
+        self.indices = remaining[self.rank:total:self.num_replicas]

@@ -59,12 +59,20 @@ def scale_buffer(
     """``out = (x * scale).astype(dtype)`` as one VMEM-tiled kernel.
 
     Parity with the reference's pre/post-scale device kernels
-    (``cuda_kernels.cu`` ``ScaleBufferCudaImpl``); used by the fusion
-    path so scale+cast happens in a single pass over the buffer instead
-    of two HBM round-trips.  Accepts any shape; flattens and re-tiles to
-    (rows, 128) lanes internally.
+    (``cuda_kernels.cu`` ``ScaleBufferCudaImpl``).  Inside jit/shard_map
+    XLA already fuses scale+cast into neighboring ops, so the traced
+    collective path uses plain arithmetic (``ops/traced.py:_scale``);
+    this kernel is the single-pass alternative for eager/op-by-op use
+    where there is no fusion context.  Differentiable (custom VJP:
+    ``dx = g*scale``, ``dscale = Σ g·x``).  Accepts any shape; flattens
+    and re-tiles to (rows, 128) lanes internally.
     """
-    out_dtype = jnp.dtype(dtype or x.dtype)
+    return _scale_buffer_vjp(x, jnp.asarray(scale, jnp.float32),
+                             jnp.dtype(dtype or x.dtype).name)
+
+
+def _scale_buffer_impl(x: jax.Array, scale, out_dtype_name: str) -> jax.Array:
+    out_dtype = jnp.dtype(out_dtype_name)
     shape = x.shape
     n = int(np.prod(shape)) if shape else 1
     tile = _SCALE_BLOCK_ROWS * _LANES
@@ -87,6 +95,25 @@ def scale_buffer(
         interpret=_interpret(),
     )(flat, scale_arr)
     return out.reshape(-1)[:n].reshape(shape)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
+def _scale_buffer_vjp(x, scale, out_dtype_name):
+    return _scale_buffer_impl(x, scale, out_dtype_name)
+
+
+def _scale_buffer_fwd(x, scale, out_dtype_name):
+    return _scale_buffer_impl(x, scale, out_dtype_name), (x, scale)
+
+
+def _scale_buffer_bwd(out_dtype_name, res, g):
+    x, scale = res
+    dx = _scale_buffer_impl(g, scale, jnp.dtype(x.dtype).name)
+    dscale = jnp.sum(g.astype(jnp.float32) * x.astype(jnp.float32))
+    return dx, dscale
+
+
+_scale_buffer_vjp.defvjp(_scale_buffer_fwd, _scale_buffer_bwd)
 
 
 # ---------------------------------------------------------------------------
@@ -347,7 +374,18 @@ def flash_attention(
     Backward recomputes blockwise from the saved logsumexp (flash
     identities), so memory stays O(T·chunk).  Numerics match
     ``parallel.ring_attention.full_attention`` to fp tolerance.
+
+    Requires ``q`` and ``k``/``v`` to share sequence length: the kernel's
+    padding mask and causal diagonal are derived from ``q.shape[1]``.
+    For cross-attention with differing lengths use ``full_attention``
+    (which offsets the diagonal by ``tk - tq``).
     """
+    if k.shape[1] != q.shape[1] or v.shape[1] != q.shape[1]:
+        raise ValueError(
+            f"flash_attention requires equal q/k/v sequence lengths, got "
+            f"q T={q.shape[1]}, k T={k.shape[1]}, v T={v.shape[1]}; use "
+            "full_attention for unequal lengths"
+        )
     out, _ = _flash_forward(
         q, k, v, causal, scale if scale is not None else q.shape[-1] ** -0.5,
         block_q, block_k,

@@ -1,0 +1,18 @@
+"""Ray cluster integration.
+
+Reference: ``horovod/ray/`` — ``RayExecutor`` (``ray/runner.py:128``)
+spawns one Ray actor per slot, a ``Coordinator`` (``ray/runner.py:41``)
+collects hostnames, assigns ranks and builds the rendezvous env, and
+placement-group strategies (``ray/strategy.py``) pack or spread slots
+over nodes.  ``ElasticRayExecutor`` (``ray/elastic.py``) adds Ray-based
+host discovery.
+
+The rank-assignment / env-construction / placement logic here is pure
+Python (unit-testable without a Ray cluster); only
+:class:`RayExecutor`'s ``start``/``run`` require ``ray`` to be
+importable.
+"""
+
+from .runner import Coordinator, RayExecutor  # noqa: F401
+from .strategy import ColocatedStrategy, PackStrategy, SpreadStrategy  # noqa: F401
+from .elastic import ElasticRayExecutor, RayHostDiscovery  # noqa: F401

@@ -1,0 +1,118 @@
+"""Elastic training on Ray.
+
+Reference: ``horovod/ray/elastic.py`` — ``ElasticRayExecutor`` drives the
+elastic driver with a Ray-native ``RayHostDiscovery`` (queries the Ray
+GCS for alive nodes instead of running a user discovery script).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+from ..elastic.discovery import HostDiscovery
+from ..utils.logging import get_logger
+
+log = get_logger()
+
+
+class RayHostDiscovery(HostDiscovery):
+    """Discover available hosts/slots from Ray's cluster state.
+
+    Reference: ``ray/elastic.py:34-76``.  ``use_gpu``/``cpus_per_slot``
+    translate node resources into slot counts.
+    """
+
+    def __init__(self, use_gpu: bool = False, cpus_per_slot: int = 1,
+                 gpus_per_slot: int = 1):
+        self.use_gpu = use_gpu
+        self.cpus_per_slot = cpus_per_slot
+        self.gpus_per_slot = gpus_per_slot
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        import ray
+
+        out: Dict[str, int] = {}
+        for node in ray.nodes():
+            if not node.get("Alive", False):
+                continue
+            resources = node.get("Resources", {})
+            hostname = node.get("NodeManagerHostname")
+            if self.use_gpu:
+                slots = int(resources.get("GPU", 0) // self.gpus_per_slot)
+            else:
+                slots = int(resources.get("CPU", 0) // self.cpus_per_slot)
+            if hostname and slots > 0:
+                out[hostname] = slots
+        return out
+
+
+class ElasticRayExecutor:
+    """Elastic executor: Ray actors join/leave as nodes come and go.
+
+    Reference: ``ray/elastic.py:120-465``.  Wraps our elastic driver
+    (``horovod_tpu/runner/elastic_driver.py``) with RayHostDiscovery and
+    runs ``fn`` under the elastic retry loop on each worker.
+    """
+
+    def __init__(
+        self,
+        settings: Optional[Dict[str, Any]] = None,
+        min_workers: int = 1,
+        max_workers: Optional[int] = None,
+        use_gpu: bool = False,
+        cpus_per_slot: int = 1,
+        override_discovery: Optional[HostDiscovery] = None,
+    ):
+        self.settings = settings or {}
+        self.min_workers = min_workers
+        self.max_workers = max_workers
+        self.discovery = override_discovery or RayHostDiscovery(
+            use_gpu=use_gpu, cpus_per_slot=cpus_per_slot
+        )
+        self.driver = None
+
+    def start(self) -> None:
+        from ..elastic.discovery import HostManager
+        from ..runner.elastic_driver import ElasticDriver
+
+        self.driver = ElasticDriver(
+            host_manager=HostManager(self.discovery),
+            min_np=self.min_workers,
+            max_np=self.max_workers,
+        )
+        self.driver.start_discovery()
+
+    def run(self, fn_or_command, args: Optional[list] = None,
+            kwargs: Optional[dict] = None) -> int:
+        """Run an elastic job; returns the job exit code.
+
+        Accepts either a worker command (``List[str]``, executed as-is on
+        each slot like ``run_rounds``) or a callable, which is shipped to
+        workers via cloudpickle the way ``horovod_tpu.runner.run`` ships
+        functions.
+        """
+        import ray  # noqa: F401 — fail fast if Ray is unavailable
+
+        if self.driver is None:
+            self.start()
+        if callable(fn_or_command):
+            import sys
+            import tempfile
+
+            import cloudpickle
+
+            with tempfile.NamedTemporaryFile(
+                suffix=".pkl", delete=False
+            ) as fh:
+                cloudpickle.dump(
+                    (fn_or_command, args or [], kwargs or {}), fh
+                )
+                path = fh.name
+            command = [
+                sys.executable, "-c",
+                "import cloudpickle,sys;"
+                f"fn,a,k=cloudpickle.load(open({path!r},'rb'));fn(*a,**k)",
+            ]
+        else:
+            command = list(fn_or_command)
+        return self.driver.run_rounds(command)

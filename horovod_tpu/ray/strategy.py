@@ -1,0 +1,64 @@
+"""Placement-group strategies.
+
+Reference: ``horovod/ray/strategy.py`` — ``PGStrategy`` variants decide
+how worker slots map onto Ray placement-group bundles: *pack* fills
+whole hosts first (fewest hosts, best for ICI locality on TPU pods);
+*spread* one slot per host (most hosts, best host-memory headroom);
+*colocated* pins a fixed per-host slot count.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+
+class PlacementStrategy:
+    def __init__(self, num_workers: int, num_workers_per_host: int = 1,
+                 cpus_per_worker: int = 1, gpus_per_worker: int = 0):
+        self.num_workers = num_workers
+        self.num_workers_per_host = num_workers_per_host
+        self.cpus_per_worker = cpus_per_worker
+        self.gpus_per_worker = gpus_per_worker
+
+    def _bundle(self, slots: int) -> Dict[str, int]:
+        b = {"CPU": self.cpus_per_worker * slots}
+        if self.gpus_per_worker:
+            b["GPU"] = self.gpus_per_worker * slots
+        return b
+
+    def bundles(self) -> List[Dict[str, int]]:
+        raise NotImplementedError()
+
+
+class PackStrategy(PlacementStrategy):
+    """Fill hosts to ``num_workers_per_host`` before opening new ones."""
+
+    def bundles(self) -> List[Dict[str, int]]:
+        out = []
+        remaining = self.num_workers
+        while remaining > 0:
+            slots = min(remaining, self.num_workers_per_host)
+            out.append(self._bundle(slots))
+            remaining -= slots
+        return out
+
+
+class SpreadStrategy(PlacementStrategy):
+    """One slot per bundle — maximally distributed."""
+
+    def bundles(self) -> List[Dict[str, int]]:
+        return [self._bundle(1) for _ in range(self.num_workers)]
+
+
+class ColocatedStrategy(PlacementStrategy):
+    """Exactly ``num_workers_per_host`` slots on each of N hosts; requires
+    the worker count to divide evenly (reference colocated strategy)."""
+
+    def bundles(self) -> List[Dict[str, int]]:
+        if self.num_workers % self.num_workers_per_host != 0:
+            raise ValueError(
+                f"num_workers={self.num_workers} not divisible by "
+                f"num_workers_per_host={self.num_workers_per_host}"
+            )
+        hosts = self.num_workers // self.num_workers_per_host
+        return [self._bundle(self.num_workers_per_host) for _ in range(hosts)]

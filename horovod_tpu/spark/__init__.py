@@ -1,0 +1,18 @@
+"""Spark cluster integration.
+
+Reference: ``horovod/spark/`` — ``horovod.spark.run()``
+(``spark/runner.py:197``) runs a function on every Spark task with the
+Horovod env set up, and the Estimator API
+(``spark/common/estimator.py:25``) trains a model against a DataFrame
+persisted through a ``Store``.
+
+TPU re-design: Spark tasks are host-controllers for TPU slices; the
+rank/rendezvous layout is computed exactly as in the Ray coordinator
+(``horovod_tpu/ray/runner.py``).  The ``Store`` abstraction and
+estimator parameter handling are pure Python (testable without a Spark
+cluster); ``run()`` and ``TpuEstimator.fit`` require ``pyspark``.
+"""
+
+from .store import FilesystemStore, LocalStore, Store  # noqa: F401
+from .estimator import TpuEstimator  # noqa: F401
+from .runner import run  # noqa: F401

@@ -1,0 +1,218 @@
+"""Estimator API: train a JAX/flax model against a DataFrame.
+
+Reference: ``horovod/spark/common/estimator.py:25`` (HorovodEstimator) +
+``spark/keras/estimator.py`` / ``spark/torch/estimator.py`` — fit()
+materializes the DataFrame to the Store, launches distributed training
+via ``horovod.spark.run``, checkpoints through the Store, and returns a
+model wrapper usable for inference.
+
+TPU re-design: the model is a flax ``nn.Module`` + optax optimizer; the
+training loop is our ``distributed_train_step``; data reaches workers as
+numpy shards written by ``_prepare_data`` (the petastorm-parquet
+equivalent — columnar npz shards, one per partition).
+"""
+
+from __future__ import annotations
+
+import os
+import cloudpickle as pickle
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as np
+
+from .store import LocalStore, Store
+from ..utils.logging import get_logger
+
+log = get_logger()
+
+
+class TpuEstimator:
+    """Sklearn-style fit/predict over distributed TPU training.
+
+    Parameters mirror the reference estimator's
+    (``spark/common/params.py``): model, optimizer (an optax
+    GradientTransformation factory), loss, feature/label columns,
+    batch_size, epochs, store, backend options.
+    """
+
+    def __init__(
+        self,
+        model=None,
+        optimizer=None,
+        loss: Optional[Callable] = None,
+        feature_cols: Sequence[str] = ("features",),
+        label_cols: Sequence[str] = ("label",),
+        batch_size: int = 32,
+        epochs: int = 1,
+        num_proc: Optional[int] = None,
+        store: Optional[Store] = None,
+        run_id: Optional[str] = None,
+        verbose: int = 1,
+        extra_env: Optional[dict] = None,
+    ):
+        if model is None:
+            raise ValueError("model is required")
+        if optimizer is None:
+            raise ValueError("optimizer is required")
+        if loss is None:
+            raise ValueError("loss is required")
+        self.model = model
+        self.optimizer = optimizer
+        self.loss = loss
+        self.feature_cols = list(feature_cols)
+        self.label_cols = list(label_cols)
+        self.batch_size = batch_size
+        self.epochs = epochs
+        self.num_proc = num_proc
+        self.store = store or LocalStore()
+        self.run_id = run_id or "run_default"
+        self.verbose = verbose
+        self.extra_env = extra_env
+
+    # -- checkpoint-resume (reference estimator.py:91 _has_checkpoint) ----
+
+    def _has_checkpoint(self) -> bool:
+        return self.store.load_checkpoint(self.run_id) is not None
+
+    # -- data materialization (petastorm-parquet equivalent) --------------
+
+    def _prepare_data(self, df) -> str:
+        """Write the DataFrame to the store as columnar npz and return the
+        path (reference ``util.prepare_data``, parquet via petastorm)."""
+        cols = self.feature_cols + self.label_cols
+        rows = df.select(*cols).collect()
+        arrays = {
+            c: np.asarray([row[c] for row in rows]) for c in cols
+        }
+        path = self.store.get_train_data_path()
+        os.makedirs(path, exist_ok=True)
+        np.savez(os.path.join(path, "part-0.npz"), **arrays)
+        return path
+
+    def fit(self, df) -> "TpuModel":
+        """Distributed-train on a Spark DataFrame; returns a TpuModel."""
+        data_path = self._prepare_data(df)
+        from . import runner as spark_runner
+
+        params = spark_runner.run(
+            _train_worker,
+            args=(
+                pickle.dumps(self.model),
+                pickle.dumps(self.optimizer),
+                pickle.dumps(self.loss),
+                data_path,
+                self.feature_cols,
+                self.label_cols,
+                self.batch_size,
+                self.epochs,
+                self.store.prefix_path,
+                self.run_id,
+            ),
+            num_proc=self.num_proc,
+            extra_env=self.extra_env,
+            verbose=self.verbose,
+        )
+        return TpuModel(model=self.model, params=params[0],
+                        feature_cols=self.feature_cols)
+
+    def fit_on_arrays(self, **named_arrays) -> "TpuModel":
+        """Spark-free fit over in-memory arrays (single-controller path;
+        used by tests and by notebook users without a cluster)."""
+        path = self.store.get_train_data_path()
+        os.makedirs(path, exist_ok=True)
+        np.savez(os.path.join(path, "part-0.npz"), **named_arrays)
+        params = _train_worker(
+            pickle.dumps(self.model), pickle.dumps(self.optimizer),
+            pickle.dumps(self.loss), path, self.feature_cols,
+            self.label_cols, self.batch_size, self.epochs,
+            self.store.prefix_path, self.run_id,
+        )
+        return TpuModel(model=self.model, params=params,
+                        feature_cols=self.feature_cols)
+
+
+def _train_worker(model_blob, opt_blob, loss_blob, data_path, feature_cols,
+                  label_cols, batch_size, epochs, store_prefix, run_id):
+    """Per-rank training body (reference ``_torch_fn``/``_keras_fn``)."""
+    import jax
+    import jax.numpy as jnp
+
+    import horovod_tpu as hvd
+    from .store import FilesystemStore
+
+    model = pickle.loads(model_blob)
+    optimizer = pickle.loads(opt_blob)
+    loss = pickle.loads(loss_blob)
+    store = FilesystemStore(store_prefix)
+
+    hvd.init()
+    data = np.load(os.path.join(data_path, "part-0.npz"))
+    features = [data[c] for c in feature_cols]
+    labels = [data[c] for c in label_cols]
+
+    x0 = jnp.asarray(features[0][:1], jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), x0)
+    # resume from a prior run's checkpoint if present
+    ckpt = store.load_checkpoint(run_id)
+    if ckpt is not None:
+        params = jax.tree.map(jnp.asarray, ckpt)
+    params = hvd.broadcast_parameters(params, root_rank=0)
+
+    tx = hvd.DistributedOptimizer(optimizer)
+
+    def loss_fn(p, batch):
+        x, y = batch
+        pred = model.apply(p, x)
+        return loss(pred, y)
+
+    step = hvd.distributed_train_step(loss_fn, tx)
+    opt_state = step.init(params)
+
+    from ..data import ArrayDataLoader
+
+    loader = ArrayDataLoader(
+        [np.asarray(features[0]), np.asarray(labels[0])],
+        batch_size=batch_size, shard=True,
+    )
+    for epoch in range(epochs):
+        loader.set_epoch(epoch)
+        for xb, yb in loader:
+            params, opt_state, _ = step(
+                params, opt_state,
+                (jnp.asarray(xb, jnp.float32), jnp.asarray(yb)),
+            )
+    params = jax.tree.map(np.asarray, params)
+    if hvd.rank() == 0:
+        store.save_checkpoint(run_id, params)
+    return params
+
+
+class TpuModel:
+    """Trained-model wrapper (reference returns a Spark Transformer;
+    here ``transform`` accepts a DataFrame when pyspark is present, and
+    ``predict`` always works on arrays)."""
+
+    def __init__(self, model, params, feature_cols):
+        self.model = model
+        self.params = params
+        self.feature_cols = feature_cols
+
+    def predict(self, x) -> np.ndarray:
+        import jax.numpy as jnp
+
+        return np.asarray(self.model.apply(
+            self.params, jnp.asarray(np.asarray(x), jnp.float32)
+        ))
+
+    def transform(self, df):
+        import pyspark.sql.functions as F
+        from pyspark.sql.types import ArrayType, FloatType
+
+        col = self.feature_cols[0]
+        predict = self.predict
+
+        @F.udf(ArrayType(FloatType()))
+        def _udf(v):
+            return [float(p) for p in predict(np.asarray(v)[None, ...])[0]]
+
+        return df.withColumn("prediction", _udf(df[col]))

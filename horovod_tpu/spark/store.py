@@ -1,0 +1,112 @@
+"""Artifact stores for estimator training.
+
+Reference: ``horovod/spark/common/store.py`` — a ``Store`` provides
+train-data, checkpoint and logs locations (LocalStore / HDFSStore /
+DBFSLocalStore).  Here checkpointing is orbax/npz against a filesystem
+path; remote filesystems mount through the same interface.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import shutil
+import tempfile
+from typing import Any, Optional
+
+
+class Store:
+    """Base interface (reference ``store.py:40-130``)."""
+
+    def get_train_data_path(self, idx: Optional[int] = None) -> str:
+        raise NotImplementedError()
+
+    def get_val_data_path(self, idx: Optional[int] = None) -> str:
+        raise NotImplementedError()
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        raise NotImplementedError()
+
+    def get_logs_path(self, run_id: str) -> str:
+        raise NotImplementedError()
+
+    def exists(self, path: str) -> bool:
+        raise NotImplementedError()
+
+    def read(self, path: str) -> bytes:
+        raise NotImplementedError()
+
+    def write(self, path: str, data: bytes) -> None:
+        raise NotImplementedError()
+
+    @staticmethod
+    def create(prefix_path: str, *args, **kwargs) -> "Store":
+        """Pick a store for a path (reference ``store.py:132-147``)."""
+        if prefix_path.startswith(("hdfs://", "s3://", "gs://")):
+            raise NotImplementedError(
+                f"remote store for {prefix_path!r} requires the matching "
+                "filesystem package; mount it locally and use LocalStore"
+            )
+        return LocalStore(prefix_path, *args, **kwargs)
+
+
+class FilesystemStore(Store):
+    """Store over a mounted filesystem prefix."""
+
+    def __init__(self, prefix_path: str,
+                 train_path: Optional[str] = None,
+                 val_path: Optional[str] = None,
+                 checkpoint_path: Optional[str] = None,
+                 logs_path: Optional[str] = None):
+        self.prefix_path = prefix_path
+        self._train = train_path or os.path.join(prefix_path, "intermediate_train_data")
+        self._val = val_path or os.path.join(prefix_path, "intermediate_val_data")
+        self._ckpt = checkpoint_path or os.path.join(prefix_path, "checkpoints")
+        self._logs = logs_path or os.path.join(prefix_path, "logs")
+        os.makedirs(prefix_path, exist_ok=True)
+
+    def get_train_data_path(self, idx: Optional[int] = None) -> str:
+        return self._train if idx is None else f"{self._train}.{idx}"
+
+    def get_val_data_path(self, idx: Optional[int] = None) -> str:
+        return self._val if idx is None else f"{self._val}.{idx}"
+
+    def get_checkpoint_path(self, run_id: str) -> str:
+        return os.path.join(self._ckpt, run_id)
+
+    def get_logs_path(self, run_id: str) -> str:
+        return os.path.join(self._logs, run_id)
+
+    def exists(self, path: str) -> bool:
+        return os.path.exists(path)
+
+    def read(self, path: str) -> bytes:
+        with open(path, "rb") as fh:
+            return fh.read()
+
+    def write(self, path: str, data: bytes) -> None:
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        with open(path, "wb") as fh:
+            fh.write(data)
+
+    # -- checkpoint helpers used by the estimator -------------------------
+
+    def save_checkpoint(self, run_id: str, obj: Any) -> str:
+        path = os.path.join(self.get_checkpoint_path(run_id), "checkpoint.pkl")
+        self.write(path, pickle.dumps(obj))
+        return path
+
+    def load_checkpoint(self, run_id: str) -> Optional[Any]:
+        path = os.path.join(self.get_checkpoint_path(run_id), "checkpoint.pkl")
+        if not self.exists(path):
+            return None
+        return pickle.loads(self.read(path))
+
+
+class LocalStore(FilesystemStore):
+    """Local-disk store (reference ``LocalStore``, ``store.py:223``)."""
+
+    def __init__(self, prefix_path: Optional[str] = None, **kwargs):
+        if prefix_path is None:
+            prefix_path = os.path.join(tempfile.gettempdir(), "hvd_tpu_store")
+        super().__init__(prefix_path, **kwargs)

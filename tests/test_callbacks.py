@@ -1,0 +1,101 @@
+"""Callbacks (reference ``_keras/callbacks.py`` tests in test_keras.py):
+LR schedule/warmup math, metric averaging, broadcast-at-start, and the
+optax-native warmup schedule."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.callbacks import (
+    BroadcastGlobalVariablesCallback,
+    Callback,
+    CallbackList,
+    LearningRateScheduleCallback,
+    LearningRateWarmupCallback,
+    MetricAverageCallback,
+    TrainingLoop,
+    warmup_schedule,
+)
+
+
+def test_schedule_staircase(hvd_module):
+    cb = LearningRateScheduleCallback(
+        multiplier=lambda epoch: 0.1 ** epoch, start_epoch=1, end_epoch=3
+    )
+    loop = TrainingLoop()
+    for epoch, expected in [(0, 1.0), (1, 0.1), (2, 0.01), (3, 0.01)]:
+        loop.epoch = epoch
+        cb.on_epoch_begin(loop)
+        assert loop.lr_multiplier == pytest.approx(expected)
+
+
+def test_schedule_smooth_requires_steps_per_epoch(hvd_module):
+    cb = LearningRateScheduleCallback(multiplier=lambda e: e, staircase=False)
+    loop = TrainingLoop()
+    with pytest.raises(ValueError):
+        cb.on_batch_begin(loop)
+
+
+def test_warmup_ramp(hvd_module):
+    size = hvd.size()
+    cb = LearningRateWarmupCallback(warmup_epochs=2, steps_per_epoch=10)
+    loop = TrainingLoop()
+    loop.epoch, loop.batch = 0, 0
+    cb.on_batch_begin(loop)
+    assert loop.lr_multiplier == pytest.approx(1.0 / size)
+    loop.epoch, loop.batch = 1, 9
+    cb.on_batch_begin(loop)
+    mid = loop.lr_multiplier
+    assert 1.0 / size < mid < 1.0
+    loop.epoch, loop.batch = 2, 0
+    cb.on_batch_begin(loop)
+    assert loop.lr_multiplier == pytest.approx(1.0)
+
+
+def test_broadcast_and_metric_callbacks(hvd_module):
+    loop = TrainingLoop(params={"w": jnp.ones((2,))})
+    cbs = CallbackList([
+        BroadcastGlobalVariablesCallback(0), MetricAverageCallback(),
+    ])
+    cbs.on_train_begin(loop)
+    np.testing.assert_allclose(np.asarray(loop.params["w"]), 1.0)
+    loop.logs = {"loss": 0.25}
+    cbs.on_epoch_end(loop)
+    assert loop.logs["loss"] == pytest.approx(0.25)
+
+
+def test_warmup_schedule_traced(hvd_module):
+    size = hvd.size()
+    sched = warmup_schedule(
+        base_lr=0.1, warmup_epochs=2, steps_per_epoch=5
+    )
+    assert float(sched(0)) == pytest.approx(0.1)
+    assert float(sched(10)) == pytest.approx(0.1 * size)
+    assert float(sched(100)) == pytest.approx(0.1 * size)
+    assert 0.1 < float(sched(5)) < 0.1 * size or size == 1
+
+
+def test_callback_hook_order(hvd_module):
+    calls = []
+
+    class Recorder(Callback):
+        def on_train_begin(self, loop):
+            calls.append("train_begin")
+
+        def on_epoch_begin(self, loop):
+            calls.append("epoch_begin")
+
+        def on_epoch_end(self, loop):
+            calls.append("epoch_end")
+
+        def on_train_end(self, loop):
+            calls.append("train_end")
+
+    loop = TrainingLoop()
+    cbs = CallbackList([Recorder()])
+    cbs.on_train_begin(loop)
+    cbs.on_epoch_begin(loop)
+    cbs.on_epoch_end(loop)
+    cbs.on_train_end(loop)
+    assert calls == ["train_begin", "epoch_begin", "epoch_end", "train_end"]

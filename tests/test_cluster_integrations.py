@@ -1,0 +1,148 @@
+"""Ray/Spark integration logic (reference ``test/single/test_ray.py``
+layout assertions + ``test/integration/test_spark.py`` store/estimator
+pieces) — the pure-Python parts run without ray/pyspark installed."""
+
+import os
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.ray import (
+    ColocatedStrategy,
+    Coordinator,
+    PackStrategy,
+    RayExecutor,
+    SpreadStrategy,
+)
+from horovod_tpu.spark import FilesystemStore, LocalStore, Store, TpuEstimator
+
+
+# ---- Ray coordinator (reference ray/runner.py:41-126) ------------------
+
+def test_coordinator_rank_layout():
+    c = Coordinator()
+    # two hosts, 2 + 1 slots, registration order defines cross_rank
+    c.register("hostA", 0)
+    c.register("hostA", 1)
+    c.register("hostB", 2)
+    envs = c.finalize_registration()
+    assert c.world_size == 3
+    assert envs[0]["HVD_TPU_LOCAL_RANK"] == "0"
+    assert envs[1]["HVD_TPU_LOCAL_RANK"] == "1"
+    assert envs[2]["HVD_TPU_LOCAL_RANK"] == "0"
+    assert envs[0]["HVD_TPU_CROSS_RANK"] == "0"
+    assert envs[2]["HVD_TPU_CROSS_RANK"] == "1"
+    assert all(e["HVD_TPU_SIZE"] == "3" for e in envs.values())
+    assert envs[0]["HVD_TPU_LOCAL_SIZE"] == "2"
+    assert envs[2]["HVD_TPU_LOCAL_SIZE"] == "1"
+
+
+def test_coordinator_slot_infos():
+    c = Coordinator()
+    c.register("h1", 0)
+    c.register("h2", 1)
+    slots = c.slot_infos()
+    assert [s.rank for s in slots] == [0, 1]
+    assert slots[0].cross_size == 2
+    assert slots[0].size == 2
+
+
+def test_coordinator_node_id_by_rank():
+    c = Coordinator()
+    c.register("h1", 0)
+    c.register("h1", 1)
+    assert c.node_id_by_rank == {0: "h1", 1: "h1"}
+
+
+# ---- placement strategies (reference ray/strategy.py) ------------------
+
+def test_pack_strategy_bundles():
+    s = PackStrategy(num_workers=5, num_workers_per_host=2, cpus_per_worker=3)
+    assert s.bundles() == [{"CPU": 6}, {"CPU": 6}, {"CPU": 3}]
+
+
+def test_spread_strategy_bundles():
+    s = SpreadStrategy(num_workers=3, cpus_per_worker=2)
+    assert s.bundles() == [{"CPU": 2}] * 3
+
+
+def test_colocated_strategy_divisibility():
+    s = ColocatedStrategy(num_workers=4, num_workers_per_host=2)
+    assert len(s.bundles()) == 2
+    with pytest.raises(ValueError):
+        ColocatedStrategy(num_workers=5, num_workers_per_host=2).bundles()
+
+
+def test_ray_executor_requires_ray():
+    ex = RayExecutor(num_workers=2)
+    assert ex.placement_bundles() == [{"CPU": 1}, {"CPU": 1}]
+    with pytest.raises(ImportError, match="ray"):
+        ex.start()
+
+
+# ---- Spark store (reference spark/common/store.py) ---------------------
+
+def test_local_store_paths(tmp_path):
+    store = LocalStore(str(tmp_path / "store"))
+    assert store.get_checkpoint_path("run1").endswith("checkpoints/run1")
+    assert store.get_logs_path("run1").endswith("logs/run1")
+    assert store.get_train_data_path(3).endswith("intermediate_train_data.3")
+
+
+def test_store_checkpoint_roundtrip(tmp_path):
+    store = LocalStore(str(tmp_path / "store"))
+    assert store.load_checkpoint("r") is None
+    store.save_checkpoint("r", {"w": [1, 2, 3]})
+    assert store.load_checkpoint("r") == {"w": [1, 2, 3]}
+
+
+def test_store_create_dispatch(tmp_path):
+    s = Store.create(str(tmp_path / "x"))
+    assert isinstance(s, LocalStore)
+    with pytest.raises(NotImplementedError):
+        Store.create("hdfs://namenode/path")
+
+
+# ---- Estimator (pure parts + array fit path) ---------------------------
+
+def test_estimator_validates_params():
+    with pytest.raises(ValueError, match="model"):
+        TpuEstimator()
+
+
+def test_estimator_fit_on_arrays(hvd_module, tmp_path):
+    import flax.linen as nn
+    import jax.numpy as jnp
+    import optax
+
+    class Linear(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(2)(x)
+
+    def loss(pred, y):
+        return optax.softmax_cross_entropy_with_integer_labels(pred, y).mean()
+
+    rng = np.random.RandomState(0)
+    x = rng.randn(64, 4).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int32)
+
+    est = TpuEstimator(
+        model=Linear(), optimizer=optax.adam(1e-2), loss=loss,
+        feature_cols=["features"], label_cols=["label"],
+        batch_size=8, epochs=2, store=LocalStore(str(tmp_path / "store")),
+        run_id="test_run",
+    )
+    model = est.fit_on_arrays(features=x, label=y)
+    preds = model.predict(x[:4])
+    assert preds.shape == (4, 2)
+    # checkpoint persisted for resume
+    assert est._has_checkpoint()
+
+
+def test_spark_run_requires_pyspark():
+    from horovod_tpu import spark as hvd_spark
+
+    with pytest.raises(ImportError, match="pyspark"):
+        hvd_spark.run(lambda: None)

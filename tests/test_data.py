@@ -1,0 +1,144 @@
+"""Data loaders + elastic sampler (reference
+``horovod/data/data_loader_base.py`` and torch ElasticSampler tests)."""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.data import (
+    ArrayDataLoader,
+    AsyncArrayDataLoader,
+    ElasticSampler,
+)
+
+
+def _arrays(n=64, d=4):
+    rng = np.random.RandomState(0)
+    return [rng.randn(n, d).astype(np.float32), rng.randint(0, 3, size=n)]
+
+
+def test_array_loader_batches(hvd_module):
+    x, y = _arrays()
+    loader = ArrayDataLoader([x, y], batch_size=8, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == len(loader)
+    xb, yb = batches[0]
+    assert xb.shape == (8, 4) and yb.shape == (8,)
+    # full epoch covers the shard exactly once
+    seen = np.concatenate([b[1] for b in batches])
+    assert len(seen) == len(loader) * 8
+
+
+def test_array_loader_epoch_shuffle(hvd_module):
+    x, y = _arrays()
+    loader = ArrayDataLoader([x, y], batch_size=8, shuffle=True, seed=3)
+    loader.set_epoch(0)
+    e0 = np.concatenate([b[1] for b in loader])
+    loader.set_epoch(1)
+    e1 = np.concatenate([b[1] for b in loader])
+    assert not np.array_equal(e0, e1)
+    loader.set_epoch(0)
+    again = np.concatenate([b[1] for b in loader])
+    np.testing.assert_array_equal(e0, again)
+
+
+def test_async_loader_matches_sync(hvd_module):
+    x, y = _arrays()
+    sync = ArrayDataLoader([x, y], batch_size=8, shuffle=False)
+    async_ = AsyncArrayDataLoader([x, y], batch_size=8, shuffle=False)
+    sb = [b[1] for b in sync]
+    ab = [b[1] for b in async_]
+    assert len(sb) == len(ab)
+    for s, a in zip(sb, ab):
+        np.testing.assert_array_equal(s, a)
+    async_.close_async_loader()
+
+
+def test_async_loader_close_midway(hvd_module):
+    x, y = _arrays(n=128)
+    loader = AsyncArrayDataLoader([x, y], batch_size=4, queue_size=2)
+    it = iter(loader)
+    next(it)
+    loader.close_async_loader()  # must not hang
+
+
+def test_async_loader_propagates_errors(hvd_module):
+    from horovod_tpu.data import AsyncDataLoaderMixin
+
+    x, y = _arrays(n=8)
+
+    class Bad(ArrayDataLoader):
+        def _iterate(self):
+            yield (x[:2], y[:2])
+            raise RuntimeError("boom")
+
+    class AsyncBad(AsyncDataLoaderMixin, Bad):
+        pass
+
+    loader = AsyncBad([x, y], batch_size=2)
+    with pytest.raises(RuntimeError, match="boom"):
+        list(loader)
+
+
+# ---- ElasticSampler ----------------------------------------------------
+
+def test_elastic_sampler_full_coverage():
+    s = ElasticSampler(dataset_size=20, shuffle=False, rank=0, num_replicas=2)
+    s2 = ElasticSampler(dataset_size=20, shuffle=False, rank=1, num_replicas=2)
+    assert sorted(list(s) + list(s2)) == list(range(20))
+    assert len(s) == 10
+
+
+def test_elastic_sampler_resume_skips_processed():
+    s = ElasticSampler(dataset_size=16, shuffle=False, rank=0, num_replicas=2)
+    first_two_batches = s.indices[:4]
+    s.record_batch(0, 2)
+    s.record_batch(1, 2)
+    state = s.state_dict()
+
+    s2 = ElasticSampler(dataset_size=16, shuffle=False, rank=0, num_replicas=2)
+    s2.load_state_dict(state)
+    remaining = set(s2) | set(
+        ElasticSampler(dataset_size=16, shuffle=False, rank=1, num_replicas=2)
+        .indices
+    )
+    for idx in first_two_batches:
+        assert idx not in set(s2.indices)
+
+
+def test_elastic_sampler_reshard_on_world_change():
+    s = ElasticSampler(dataset_size=24, shuffle=True, seed=7, rank=0,
+                       num_replicas=3)
+    s.record_batch(0, 4)
+    processed = set(s.processed_indices)
+    # world shrinks 3 -> 2; remaining work redistributed
+    s.reset(rank=0, num_replicas=2)
+    other = ElasticSampler(dataset_size=24, shuffle=True, seed=7, rank=1,
+                           num_replicas=2)
+    other.load_state_dict({"epoch": 0,
+                           "processed_indices": list(processed)})
+    combined = set(s.indices) | set(other.indices)
+    assert combined.isdisjoint(processed)
+    # everything unprocessed is covered
+    assert combined == set(range(24)) - processed
+
+
+def test_elastic_sampler_pads_when_fewer_remaining_than_replicas():
+    # 1 unprocessed index, 4 replicas: every rank must still get exactly
+    # num_samples indices or collective step counts desynchronize.
+    s0 = ElasticSampler(dataset_size=5, shuffle=False, rank=0, num_replicas=4)
+    s0.load_state_dict({"epoch": 0, "processed_indices": [0, 1, 2, 3]})
+    for r in range(4):
+        s = ElasticSampler(dataset_size=5, shuffle=False, rank=r,
+                           num_replicas=4)
+        s.load_state_dict({"epoch": 0, "processed_indices": [0, 1, 2, 3]})
+        assert list(s) == [4], (r, list(s))
+
+
+def test_elastic_sampler_epoch_reset():
+    s = ElasticSampler(dataset_size=10, shuffle=True, rank=0, num_replicas=1)
+    s.record_batch(0, 5)
+    assert len(s.processed_indices) == 5
+    s.set_epoch(1)
+    assert s.processed_indices == []
+    assert len(s) == 10

@@ -37,6 +37,20 @@ def test_scale_buffer_jit_and_grad():
     x = jnp.ones((256,), jnp.float32)
     y = jax.jit(lambda a: scale_buffer(a, 2.0))(x)
     np.testing.assert_allclose(np.asarray(y), 2.0 * np.ones(256))
+    # custom VJP: d/dx (x*2).sum() == 2, d/dscale == Σx
+    dx = jax.grad(lambda a: scale_buffer(a, 2.0).sum())(x)
+    np.testing.assert_allclose(np.asarray(dx), 2.0 * np.ones(256))
+    dscale = jax.grad(lambda s: scale_buffer(x, s).sum())(jnp.float32(2.0))
+    np.testing.assert_allclose(float(dscale), 256.0)
+
+
+def test_flash_attention_rejects_unequal_seq_lens():
+    from horovod_tpu.ops.pallas_kernels import flash_attention
+
+    q = jnp.zeros((1, 64, 2, 32))
+    kv = jnp.zeros((1, 128, 2, 32))
+    with pytest.raises(ValueError, match="equal q/k/v sequence lengths"):
+        flash_attention(q, kv, kv)
 
 
 @pytest.mark.parametrize(
