@@ -1,0 +1,102 @@
+"""Elastic MNIST training — survive worker joins/leaves mid-run.
+
+TPU-native equivalent of reference
+``examples/elastic/pytorch/pytorch_mnist_elastic.py``: wrap training in
+``@hvd.elastic.run`` with an ``ArrayState``; on membership change the
+state re-syncs from rank 0 and training continues from the last commit;
+the ``ElasticSampler`` reshards remaining work over the new world.
+
+Launch elastically::
+
+    python -m horovod_tpu.runner --min-np 1 --max-np 4 \
+        --host-discovery-script ./discover_hosts.sh \
+        python examples/elastic_mnist.py
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.data import ElasticSampler
+from horovod_tpu.elastic import ArrayState
+from horovod_tpu.models import MnistCNN
+
+
+def synthetic_mnist(n=4096, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 28, 28, 1).astype(np.float32)
+    y = (x.mean(axis=(1, 2, 3)) * 1000).astype(np.int32) % 10
+    return x, y
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=3)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--lr", type=float, default=0.01)
+    parser.add_argument("--batches-per-commit", type=int, default=10)
+    args = parser.parse_args()
+
+    hvd.init()
+    x, y = synthetic_mnist()
+
+    model = MnistCNN()
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28, 1)))
+    params = hvd.broadcast_parameters(params, root_rank=0)
+
+    tx = hvd.DistributedOptimizer(
+        optax.sgd(args.lr * hvd.size(), momentum=0.5)
+    )
+
+    def loss_fn(p, batch):
+        xb, yb = batch
+        logits = model.apply(p, xb)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, yb
+        ).mean()
+
+    step = hvd.distributed_train_step(loss_fn, tx)
+    opt_state = step.init(params)
+
+    sampler = ElasticSampler(dataset_size=len(x), seed=7)
+    state = ArrayState(
+        params=params, opt_state=opt_state, epoch=0, batch_idx=0,
+        sampler_state=sampler.state_dict(),
+    )
+
+    @hvd.elastic.run
+    def train(state):
+        sampler.load_state_dict(state.sampler_state)
+        sampler.reset()  # pick up the (possibly new) world size
+        while state.epoch < args.epochs:
+            indices = list(sampler)
+            nb = len(indices) // args.batch_size
+            for b in range(state.batch_idx, nb):
+                idx = indices[b * args.batch_size:(b + 1) * args.batch_size]
+                state.params, state.opt_state, loss = step(
+                    state.params, state.opt_state,
+                    (jnp.asarray(x[idx]), jnp.asarray(y[idx])),
+                )
+                sampler.record_batch(b, args.batch_size)
+                if (b + 1) % args.batches_per_commit == 0:
+                    state.batch_idx = b + 1
+                    state.sampler_state = sampler.state_dict()
+                    state.commit()  # checkpoint + host-update check
+            if hvd.rank() == 0:
+                print(f"epoch {state.epoch}: loss {float(loss):.4f} "
+                      f"(world size {hvd.size()})")
+            state.epoch += 1
+            state.batch_idx = 0
+            sampler.set_epoch(state.epoch)
+            state.sampler_state = sampler.state_dict()
+            state.commit()
+
+    train(state)
+
+
+if __name__ == "__main__":
+    main()

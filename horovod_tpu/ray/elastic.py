@@ -96,22 +96,22 @@ class ElasticRayExecutor:
         if self.driver is None:
             self.start()
         if callable(fn_or_command):
+            # Embed the payload in the command line itself: workers may
+            # run on other hosts (ssh), so a driver-local temp file
+            # would not be visible there.
+            import base64
             import sys
-            import tempfile
 
             import cloudpickle
 
-            with tempfile.NamedTemporaryFile(
-                suffix=".pkl", delete=False
-            ) as fh:
-                cloudpickle.dump(
-                    (fn_or_command, args or [], kwargs or {}), fh
-                )
-                path = fh.name
+            payload = base64.b64encode(
+                cloudpickle.dumps((fn_or_command, args or [], kwargs or {}))
+            ).decode("ascii")
             command = [
                 sys.executable, "-c",
-                "import cloudpickle,sys;"
-                f"fn,a,k=cloudpickle.load(open({path!r},'rb'));fn(*a,**k)",
+                "import base64,cloudpickle;"
+                f"fn,a,k=cloudpickle.loads(base64.b64decode({payload!r}));"
+                "fn(*a,**k)",
             ]
         else:
             command = list(fn_or_command)

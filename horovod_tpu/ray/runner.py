@@ -59,10 +59,18 @@ class Coordinator:
         self.world_size += 1
 
     def finalize_registration(self) -> Dict[int, Dict[str, str]]:
-        """Return per-worker env maps (reference ``runner.py:84-126``)."""
+        """Return per-worker env maps (reference ``runner.py:84-126``).
+
+        Follows the launcher env contract (``runner/launch.py``
+        ``make_worker_env``): ``HVD_TPU_CROSS_RANK``/``CROSS_SIZE`` are
+        the *process id / process count* consumed by
+        ``runtime._init_distributed`` as ``jax.distributed`` identity —
+        NOT the reference's host-index semantics, which live in
+        ``HVD_TPU_HOST_RANK``/``HOST_SIZE`` here.
+        """
         rank_to_info: Dict[int, Dict[str, Any]] = {}
-        cross_size = len(self.hostnames_by_rank)
-        for cross_rank, (hostname, ranks) in enumerate(
+        host_size = len(self.hostnames_by_rank)
+        for host_rank, (hostname, ranks) in enumerate(
             self.hostnames_by_rank.items()
         ):
             local_size = len(ranks)
@@ -72,20 +80,20 @@ class Coordinator:
                     rank=world_rank,
                     local_rank=local_rank,
                     local_size=local_size,
-                    cross_rank=cross_rank,
-                    cross_size=cross_size,
+                    host_rank=host_rank,
+                    host_size=host_size,
                 )
         size = self.world_size
         envs: Dict[int, Dict[str, str]] = {}
         for world_rank, info in rank_to_info.items():
             envs[world_rank] = {
                 "HVD_TPU_HOSTNAME": info["hostname"],
-                "HVD_TPU_RANK": str(info["rank"]),
-                "HVD_TPU_SIZE": str(size),
+                "HVD_TPU_CROSS_RANK": str(info["rank"]),
+                "HVD_TPU_CROSS_SIZE": str(size),
                 "HVD_TPU_LOCAL_RANK": str(info["local_rank"]),
                 "HVD_TPU_LOCAL_SIZE": str(info["local_size"]),
-                "HVD_TPU_CROSS_RANK": str(info["cross_rank"]),
-                "HVD_TPU_CROSS_SIZE": str(info["cross_size"]),
+                "HVD_TPU_HOST_RANK": str(info["host_rank"]),
+                "HVD_TPU_HOST_SIZE": str(info["host_size"]),
             }
         return envs
 
@@ -94,12 +102,12 @@ class Coordinator:
         return [
             SlotInfo(
                 hostname=e["HVD_TPU_HOSTNAME"],
-                rank=int(e["HVD_TPU_RANK"]),
+                rank=int(e["HVD_TPU_CROSS_RANK"]),
                 local_rank=int(e["HVD_TPU_LOCAL_RANK"]),
-                cross_rank=int(e["HVD_TPU_CROSS_RANK"]),
-                size=int(e["HVD_TPU_SIZE"]),
+                cross_rank=int(e["HVD_TPU_HOST_RANK"]),
+                size=int(e["HVD_TPU_CROSS_SIZE"]),
                 local_size=int(e["HVD_TPU_LOCAL_SIZE"]),
-                cross_size=int(e["HVD_TPU_CROSS_SIZE"]),
+                cross_size=int(e["HVD_TPU_HOST_SIZE"]),
             )
             for _, e in sorted(envs.items())
         ]
@@ -165,7 +173,18 @@ class RayExecutor:
                 self.hostname = socket.gethostname()
 
             def info(self):
-                return self.hostname
+                import ray.util
+
+                return self.hostname, ray.util.get_node_ip_address()
+
+            def free_port(self):
+                import socket
+
+                s = socket.socket()
+                s.bind(("", 0))
+                port = s.getsockname()[1]
+                s.close()
+                return port
 
             def set_env(self, env):
                 import os
@@ -179,10 +198,16 @@ class RayExecutor:
             Worker.options(placement_group=self._pg).remote()
             for _ in range(self.num_workers)
         ]
-        hostnames = ray.get([w.info.remote() for w in self.workers])
-        for world_rank, hostname in enumerate(hostnames):
+        infos = ray.get([w.info.remote() for w in self.workers])
+        for world_rank, (hostname, _ip) in enumerate(infos):
             self.coordinator.register(hostname, world_rank)
         envs = self.coordinator.finalize_registration()
+        # Worker 0 hosts the jax.distributed coordination service; every
+        # actor gets its address (runtime._init_distributed contract).
+        coord_ip = infos[0][1]
+        coord_port = ray.get(self.workers[0].free_port.remote())
+        for e in envs.values():
+            e["HVD_TPU_COORDINATOR_ADDR"] = f"{coord_ip}:{coord_port}"
         ray.get([
             w.set_env.remote(envs[i]) for i, w in enumerate(self.workers)
         ])

@@ -1,0 +1,100 @@
+"""Config-file → CLI-arg mapping for ``hvdrun``.
+
+Reference: ``horovod/runner/common/util/config_parser.py`` — horovodrun
+accepts ``--config-file`` (YAML) whose sections set the same knobs as
+the CLI flags, with CLI flags winning on conflict.  PyYAML is not baked
+into this image, so the parser accepts JSON or a two-level YAML subset
+(``section:`` headers + indented ``key: value`` pairs — exactly the
+shape the reference's config files use).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict
+
+
+def parse_config_file(path: str) -> Dict[str, Any]:
+    """Load a JSON or simple-YAML config into a nested dict."""
+    with open(path) as fh:
+        text = fh.read()
+    stripped = text.lstrip()
+    if stripped.startswith("{"):
+        return json.loads(text)
+    return _parse_simple_yaml(text)
+
+
+def _parse_scalar(s: str) -> Any:
+    s = s.strip()
+    if s.lower() in ("true", "yes", "on"):
+        return True
+    if s.lower() in ("false", "no", "off"):
+        return False
+    if s.lower() in ("null", "none", "~", ""):
+        return None
+    for cast in (int, float):
+        try:
+            return cast(s)
+        except ValueError:
+            pass
+    return s.strip("'\"")
+
+
+def _parse_simple_yaml(text: str) -> Dict[str, Any]:
+    """Two-level ``section:`` / ``  key: value`` parser (no lists,
+    anchors, or multi-line scalars — enough for hvdrun config files)."""
+    root: Dict[str, Any] = {}
+    section: Dict[str, Any] | None = None
+    for lineno, raw in enumerate(text.splitlines(), 1):
+        line = raw.split("#", 1)[0].rstrip()
+        if not line.strip():
+            continue
+        indented = line[0] in (" ", "\t")
+        if ":" not in line:
+            raise ValueError(f"line {lineno}: expected 'key: value' in {raw!r}")
+        key, _, value = line.partition(":")
+        key = key.strip()
+        if not indented:
+            if value.strip() == "":
+                section = {}
+                root[key] = section
+            else:
+                root[key] = _parse_scalar(value)
+                section = None
+        else:
+            if section is None:
+                raise ValueError(
+                    f"line {lineno}: indented key {key!r} outside a section"
+                )
+            section[key] = _parse_scalar(value)
+    return root
+
+
+# config key → (argparse dest, transform). Mirrors the reference's
+# sections: params / timeline / autotune / logging / elastic.
+_MAPPING = {
+    ("params", "fusion_threshold_mb"): "fusion_threshold_mb",
+    ("timeline", "filename"): "timeline_filename",
+    ("autotune", "enabled"): "autotune",
+    ("autotune", "log_file"): "autotune_log_file",
+    ("logging", "level"): "log_level",
+    ("elastic", "min_np"): "min_np",
+    ("elastic", "max_np"): "max_np",
+    ("elastic", "discovery_script"): "discovery_script",
+    ("ssh", "port"): "ssh_port",
+    ("ssh", "identity_file"): "ssh_identity_file",
+}
+
+
+def apply_config_to_args(args, config: Dict[str, Any]) -> None:
+    """Fill unset argparse fields from the config (CLI wins on conflict,
+    matching the reference's override order)."""
+    for (section, key), dest in _MAPPING.items():
+        value = config.get(section, {})
+        if not isinstance(value, dict):
+            continue
+        # Identity check, not ==: an explicit CLI 0 must not read as
+        # "unset" (0 == False in Python).
+        current = getattr(args, dest, None)
+        if key in value and (current is None or current is False):
+            setattr(args, dest, value[key])

@@ -129,6 +129,54 @@ def launch_static(
         server.stop()
 
 
+def check_build(out=None) -> None:
+    """Print the capability report (reference ``check_build``,
+    ``runner/launch.py:110`` — 'Available Frameworks/Controllers/Tensor
+    Operations' box)."""
+    def flag(ok: bool) -> str:
+        return "[X]" if ok else "[ ]"
+
+    lines = [f"horovod_tpu v{__version__}:", "", "Available Frameworks:"]
+    for mod, name in [("jax", "JAX"), ("flax", "Flax"), ("optax", "Optax"),
+                      ("orbax.checkpoint", "Orbax")]:
+        try:
+            __import__(mod)
+            ok = True
+        except ImportError:
+            ok = False
+        lines.append(f"    {flag(ok)} {name}")
+    # Like the reference, report configured capabilities without
+    # initializing backends (jax.devices() would block on TPU runtime
+    # bring-up, which can take minutes over a cold tunnel).
+    import os
+
+    lines += ["", "Configured Device Backends:"]
+    platforms = os.environ.get("JAX_PLATFORMS", "")
+    tpu_configured = bool(
+        os.environ.get("PALLAS_AXON_POOL_IPS")
+        or os.environ.get("TPU_NAME")
+        or "tpu" in platforms
+    ) and platforms != "cpu"
+    lines.append(f"    {flag(tpu_configured)} TPU")
+    lines.append(f"    {flag(True)} CPU (XLA host)")
+    lines += ["", "Available Components:"]
+    from .. import native
+
+    lines.append(f"    {flag(native.available())} native core (C++)")
+    try:
+        from jax.experimental import pallas  # noqa: F401
+
+        has_pallas = True
+    except ImportError:
+        has_pallas = False
+    lines.append(f"    {flag(has_pallas)} Pallas kernels")
+    for ok, name in [(True, "process sets"), (True, "elastic"),
+                     (True, "timeline"), (True, "autotune"),
+                     (True, "Adasum")]:
+        lines.append(f"    {flag(ok)} {name}")
+    print("\n".join(lines), file=out)
+
+
 def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     parser = argparse.ArgumentParser(
         prog="hvdrun",
@@ -155,9 +203,20 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     parser.add_argument("--autotune", action="store_true")
     parser.add_argument("--autotune-log-file")
     parser.add_argument("--log-level")
+    parser.add_argument("--config-file",
+                        help="JSON/YAML config with the same knobs "
+                        "(CLI flags win on conflict)")
+    parser.add_argument("--check-build", action="store_true",
+                        help="print the capability report and exit")
     parser.add_argument("command", nargs=argparse.REMAINDER,
                         help="worker command, e.g. python train.py")
     args = parser.parse_args(argv)
+    if args.check_build:
+        return args
+    if args.config_file:
+        from .config_parser import apply_config_to_args, parse_config_file
+
+        apply_config_to_args(args, parse_config_file(args.config_file))
     if not args.command:
         parser.error("no worker command given")
     if args.command[0] == "--":
@@ -186,6 +245,9 @@ def env_from_args(args: argparse.Namespace) -> Dict[str, str]:
 
 def run_commandline(argv: Optional[List[str]] = None) -> int:
     args = parse_args(argv)
+    if args.check_build:
+        check_build()
+        return 0
     if args.discovery_script or args.min_np is not None:
         from .elastic_launch import launch_elastic
 

@@ -77,16 +77,25 @@ class TpuEstimator:
     # -- data materialization (petastorm-parquet equivalent) --------------
 
     def _prepare_data(self, df) -> str:
-        """Write the DataFrame to the store as columnar npz and return the
-        path (reference ``util.prepare_data``, parquet via petastorm)."""
+        """Materialize the DataFrame to the store as columnar npz shards,
+        one per Spark partition, written by the executors (reference
+        ``util.prepare_data``, parquet via petastorm).  The store prefix
+        must be a shared filesystem (the reference requires the same of
+        its HDFS/DBFS stores)."""
         cols = self.feature_cols + self.label_cols
-        rows = df.select(*cols).collect()
-        arrays = {
-            c: np.asarray([row[c] for row in rows]) for c in cols
-        }
         path = self.store.get_train_data_path()
         os.makedirs(path, exist_ok=True)
-        np.savez(os.path.join(path, "part-0.npz"), **arrays)
+
+        def write_partition(idx, rows_iter):
+            rows = list(rows_iter)
+            if rows:
+                arrays = {
+                    c: np.asarray([row[c] for row in rows]) for c in cols
+                }
+                np.savez(os.path.join(path, f"part-{idx}.npz"), **arrays)
+            yield idx
+
+        df.select(*cols).rdd.mapPartitionsWithIndex(write_partition).count()
         return path
 
     def fit(self, df) -> "TpuModel":
@@ -146,9 +155,30 @@ def _train_worker(model_blob, opt_blob, loss_blob, data_path, feature_cols,
     store = FilesystemStore(store_prefix)
 
     hvd.init()
-    data = np.load(os.path.join(data_path, "part-0.npz"))
-    features = [data[c] for c in feature_cols]
-    labels = [data[c] for c in label_cols]
+    # Load all partition shards (written by _prepare_data) and stitch
+    # columns back together; the ArrayDataLoader then takes this rank's
+    # 1/size index shard.
+    import glob
+
+    parts = sorted(glob.glob(os.path.join(data_path, "part-*.npz")))
+    if not parts:
+        raise FileNotFoundError(f"no data shards under {data_path}")
+    blobs = [np.load(p) for p in parts]
+
+    def column(c):
+        return np.concatenate([b[c] for b in blobs], axis=0)
+
+    if len(label_cols) != 1:
+        raise ValueError("exactly one label column is supported")
+    # Multiple feature columns are joined along the last axis (the
+    # dense-assembler convention the reference's estimators use).
+    if len(feature_cols) == 1:
+        features = [column(feature_cols[0])]
+    else:
+        feats = [np.atleast_2d(column(c).T).T.astype(np.float32)
+                 for c in feature_cols]
+        features = [np.concatenate(feats, axis=-1)]
+    labels = [column(label_cols[0])]
 
     x0 = jnp.asarray(features[0][:1], jnp.float32)
     params = model.init(jax.random.PRNGKey(0), x0)

@@ -22,7 +22,7 @@ from horovod_tpu.spark import FilesystemStore, LocalStore, Store, TpuEstimator
 
 def test_coordinator_rank_layout():
     c = Coordinator()
-    # two hosts, 2 + 1 slots, registration order defines cross_rank
+    # two hosts, 2 + 1 slots, registration order defines host_rank
     c.register("hostA", 0)
     c.register("hostA", 1)
     c.register("hostB", 2)
@@ -31,9 +31,14 @@ def test_coordinator_rank_layout():
     assert envs[0]["HVD_TPU_LOCAL_RANK"] == "0"
     assert envs[1]["HVD_TPU_LOCAL_RANK"] == "1"
     assert envs[2]["HVD_TPU_LOCAL_RANK"] == "0"
-    assert envs[0]["HVD_TPU_CROSS_RANK"] == "0"
-    assert envs[2]["HVD_TPU_CROSS_RANK"] == "1"
-    assert all(e["HVD_TPU_SIZE"] == "3" for e in envs.values())
+    # launcher contract: CROSS_RANK/SIZE = process id / process count
+    # (what runtime._init_distributed feeds jax.distributed.initialize)
+    assert [envs[r]["HVD_TPU_CROSS_RANK"] for r in range(3)] == ["0", "1", "2"]
+    assert all(e["HVD_TPU_CROSS_SIZE"] == "3" for e in envs.values())
+    # host-index semantics live in HOST_RANK/HOST_SIZE
+    assert envs[0]["HVD_TPU_HOST_RANK"] == "0"
+    assert envs[2]["HVD_TPU_HOST_RANK"] == "1"
+    assert all(e["HVD_TPU_HOST_SIZE"] == "2" for e in envs.values())
     assert envs[0]["HVD_TPU_LOCAL_SIZE"] == "2"
     assert envs[2]["HVD_TPU_LOCAL_SIZE"] == "1"
 
@@ -139,6 +144,34 @@ def test_estimator_fit_on_arrays(hvd_module, tmp_path):
     assert preds.shape == (4, 2)
     # checkpoint persisted for resume
     assert est._has_checkpoint()
+
+
+def test_estimator_multi_feature_columns(hvd_module, tmp_path):
+    import flax.linen as nn
+    import optax
+
+    class Linear(nn.Module):
+        @nn.compact
+        def __call__(self, x):
+            return nn.Dense(2)(x)
+
+    rng = np.random.RandomState(1)
+    f1 = rng.randn(64, 3).astype(np.float32)
+    f2 = rng.randn(64).astype(np.float32)  # 1-D column joins as width 1
+    y = ((f1.sum(axis=1) + f2) > 0).astype(np.int32)
+
+    est = TpuEstimator(
+        model=Linear(), optimizer=optax.adam(1e-2),
+        loss=lambda p, t: optax.softmax_cross_entropy_with_integer_labels(
+            p, t).mean(),
+        feature_cols=["f1", "f2"], label_cols=["label"],
+        batch_size=16, epochs=2, store=LocalStore(str(tmp_path / "s")),
+        run_id="mc",
+    )
+    model = est.fit_on_arrays(f1=f1, f2=f2, label=y)
+    # trained on the 4-wide concatenation, not silently on f1 alone
+    assert model.predict(np.concatenate(
+        [f1[:4], f2[:4, None]], axis=-1)).shape == (4, 2)
 
 
 def test_spark_run_requires_pyspark():

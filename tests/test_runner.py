@@ -138,3 +138,62 @@ def test_native_python_controller_interop():
         nc.close()
     finally:
         pysrv.stop()
+
+
+# ---- config file + check-build (reference launch.py:110, config_parser) ----
+
+def test_config_parser_simple_yaml(tmp_path):
+    from horovod_tpu.runner.config_parser import parse_config_file
+
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(
+        "params:\n"
+        "  fusion_threshold_mb: 128   # comment\n"
+        "timeline:\n"
+        "  filename: /tmp/tl.json\n"
+        "autotune:\n"
+        "  enabled: true\n"
+        "elastic:\n"
+        "  min_np: 2\n"
+    )
+    parsed = parse_config_file(str(cfg))
+    assert parsed["params"]["fusion_threshold_mb"] == 128
+    assert parsed["timeline"]["filename"] == "/tmp/tl.json"
+    assert parsed["autotune"]["enabled"] is True
+    assert parsed["elastic"]["min_np"] == 2
+
+
+def test_config_parser_json(tmp_path):
+    from horovod_tpu.runner.config_parser import parse_config_file
+
+    cfg = tmp_path / "cfg.json"
+    cfg.write_text('{"params": {"fusion_threshold_mb": 64}}')
+    assert parse_config_file(str(cfg))["params"]["fusion_threshold_mb"] == 64
+
+
+def test_config_file_feeds_args_cli_wins(tmp_path):
+    from horovod_tpu.runner.launch import env_from_args, parse_args
+
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(
+        "params:\n  fusion_threshold_mb: 128\nlogging:\n  level: debug\n"
+    )
+    args = parse_args([
+        "-np", "2", "--config-file", str(cfg),
+        "--fusion-threshold-mb", "32",  # CLI beats config
+        "python", "train.py",
+    ])
+    env = env_from_args(args)
+    assert env["HVD_TPU_FUSION_THRESHOLD"] == str(32 << 20)
+    assert env["HVD_TPU_LOG_LEVEL"] == "debug"
+
+
+def test_check_build_reports(capsys):
+    from horovod_tpu.runner.launch import check_build
+
+    check_build()
+    out = capsys.readouterr().out
+    assert "Available Frameworks" in out
+    assert "[X] JAX" in out
+    assert "native core" in out
+    assert "Adasum" in out
