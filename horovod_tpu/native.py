@@ -439,3 +439,13 @@ def decode_request(buf: bytes):
         "name": name.value.decode(),
         "consumed": consumed,
     }
+
+
+if __name__ == "__main__":
+    import sys
+
+    if "--build" in sys.argv:
+        lib = load(build=True)
+        print("built:", _LIB_PATH if lib is not None else "FAILED")
+        sys.exit(0 if lib is not None else 1)
+    print("usage: python -m horovod_tpu.native --build")

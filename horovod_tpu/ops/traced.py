@@ -28,6 +28,7 @@ import numpy as np
 from jax import lax
 
 from ..process_sets import ProcessSet
+from ..utils import env
 from ..runtime import WORLD_AXIS, get_runtime
 
 Axis = Union[str, Sequence[str]]
@@ -109,6 +110,59 @@ def _grouped_sum(x: jax.Array, axis: Axis, groups, group_size: int) -> jax.Array
     return full[:n].reshape(x.shape)
 
 
+def _hierarchical_sum(x: jax.Array, axis: Axis) -> jax.Array:
+    """Two-stage sum: reduce-scatter within each host (ICI), cross-host
+    sum of the scattered shards (DCN), all-gather within host.
+
+    Reference: ``NCCLHierarchicalAllreduce`` (``nccl_operations.cc:234``)
+    — intra-node reduce-scatter → cross-node allreduce → intra-node
+    allgather.  XLA often stages DCN collectives itself, but the
+    explicit form guarantees each DCN link carries only 1/local_size of
+    the payload (the reference's homogeneous-split rationale,
+    ``nccl_operations.cc:297-335``).
+    """
+    from .. import runtime as _rt
+
+    rt = _rt.get_runtime()
+    L, H = rt.local_size, rt.cross_size
+    # Only valid over the full world axis with a homogeneous host grid;
+    # anything else (hybrid sub-axes, ragged hosts) falls back to the
+    # flat psum, which is always correct.
+    if (
+        L <= 1 or H <= 1 or L * H != rt.size
+        or _axis_size(axis) != rt.size
+    ):
+        return lax.psum(x, axis)
+    # Group ranks by their owning controller process (the host), not by
+    # assumed contiguity — process indices need not be rank-contiguous.
+    by_host: dict = {}
+    for r, d in enumerate(rt.devices):
+        by_host.setdefault(d.process_index, []).append(r)
+    if len(by_host) == 1:
+        # Single controller (tests / one-host worlds): hosts are a
+        # logical overlay; contiguous blocks are the only sensible map.
+        local_groups = [[h * L + i for i in range(L)] for h in range(H)]
+    else:
+        local_groups = [sorted(v) for _, v in sorted(by_host.items())]
+        if len(local_groups) != H or any(len(g) != L for g in local_groups):
+            return lax.psum(x, axis)
+    cross_groups = [
+        [g[i] for g in local_groups] for i in range(L)
+    ]
+    shape, n = x.shape, x.size
+    pad = (-n) % L
+    flat = jnp.pad(x.reshape(-1), (0, pad))
+    s = lax.psum_scatter(
+        flat, axis, scatter_dimension=0,
+        axis_index_groups=local_groups, tiled=True,
+    )
+    s = _grouped_sum(s, axis, cross_groups, H)
+    out = lax.all_gather(
+        s, axis, axis_index_groups=local_groups, tiled=True
+    )
+    return out[:n].reshape(shape)
+
+
 def allreduce(
     x: jax.Array,
     axis: Axis = WORLD_AXIS,
@@ -116,13 +170,17 @@ def allreduce(
     prescale_factor: float = 1.0,
     postscale_factor: float = 1.0,
     process_set: Optional[ProcessSet] = None,
+    hierarchical: Optional[bool] = None,
 ) -> jax.Array:
     """Allreduce over a mesh axis (reference ``EnqueueTensorAllreduce``,
     ``operations.cc:1342`` + ``NCCLAllreduce::Execute``).
 
     Inside the jit program this is a single XLA all-reduce; AVERAGE is
     SUM with postscale 1/set_size exactly as the reference rewrites it
-    (``operations.cc:1396-1399``).
+    (``operations.cc:1396-1399``).  ``hierarchical`` (default: the
+    ``HVD_TPU_HIERARCHICAL_ALLREDUCE`` env knob, reference
+    ``HOROVOD_HIERARCHICAL_ALLREDUCE``) stages sum/average as
+    intra-host reduce-scatter → cross-host sum → intra-host allgather.
     """
     if op == Adasum:
         from .adasum import adasum_allreduce
@@ -137,9 +195,12 @@ def allreduce(
         postscale_factor = postscale_factor / set_size
         op = Sum
 
+    if hierarchical is None:
+        hierarchical = env.get_bool(env.HIERARCHICAL_ALLREDUCE, False)
+
     if op == Sum:
         if mask is None:
-            y = lax.psum(x, axis)
+            y = _hierarchical_sum(x, axis) if hierarchical else lax.psum(x, axis)
         elif groups is not None:
             # Equal-size partition fast path: reduce_scatter + all_gather
             # with XLA replica_groups, so each group's reduction rides only

@@ -95,24 +95,24 @@ class ElasticRayExecutor:
 
         if self.driver is None:
             self.start()
+        publish = None
         if callable(fn_or_command):
-            # Embed the payload in the command line itself: workers may
-            # run on other hosts (ssh), so a driver-local temp file
-            # would not be visible there.
-            import base64
+            # Ship the payload through the rendezvous KV store (the
+            # ``horovod.run`` func-delivery path): works for remote ssh
+            # workers (no driver-local temp file) and has no argv size
+            # cap (cloudpickled closures can be arbitrarily large).
             import sys
 
             import cloudpickle
 
-            payload = base64.b64encode(
-                cloudpickle.dumps((fn_or_command, args or [], kwargs or {}))
-            ).decode("ascii")
+            publish = {
+                ("__run__", "func"): cloudpickle.dumps(
+                    (fn_or_command, args or [], kwargs or {})
+                ),
+            }
             command = [
-                sys.executable, "-c",
-                "import base64,cloudpickle;"
-                f"fn,a,k=cloudpickle.loads(base64.b64decode({payload!r}));"
-                "fn(*a,**k)",
+                sys.executable, "-m", "horovod_tpu.runner.task_runner",
             ]
         else:
             command = list(fn_or_command)
-        return self.driver.run_rounds(command)
+        return self.driver.run_rounds(command, publish=publish)

@@ -178,13 +178,9 @@ class RayExecutor:
                 return self.hostname, ray.util.get_node_ip_address()
 
             def free_port(self):
-                import socket
+                from horovod_tpu.runner.launch import free_port
 
-                s = socket.socket()
-                s.bind(("", 0))
-                port = s.getsockname()[1]
-                s.close()
-                return port
+                return free_port()
 
             def set_env(self, env):
                 import os

@@ -40,13 +40,29 @@ def _parse_scalar(s: str) -> Any:
     return s.strip("'\"")
 
 
+def _strip_comment(line: str) -> str:
+    """Drop a trailing ``# comment`` without corrupting values that
+    contain '#': the hash must be outside quotes and either start the
+    line or follow whitespace (YAML's rule)."""
+    in_quote = None
+    for i, ch in enumerate(line):
+        if in_quote:
+            if ch == in_quote:
+                in_quote = None
+        elif ch in ("'", '"'):
+            in_quote = ch
+        elif ch == "#" and (i == 0 or line[i - 1] in " \t"):
+            return line[:i]
+    return line
+
+
 def _parse_simple_yaml(text: str) -> Dict[str, Any]:
     """Two-level ``section:`` / ``  key: value`` parser (no lists,
     anchors, or multi-line scalars — enough for hvdrun config files)."""
     root: Dict[str, Any] = {}
     section: Dict[str, Any] | None = None
     for lineno, raw in enumerate(text.splitlines(), 1):
-        line = raw.split("#", 1)[0].rstrip()
+        line = _strip_comment(raw).rstrip()
         if not line.strip():
             continue
         indented = line[0] in (" ", "\t")

@@ -113,14 +113,23 @@ class ElasticDriver:
         extra_env: Optional[Dict[str, str]] = None,
         ssh_port: Optional[int] = None,
         ssh_identity_file: Optional[str] = None,
+        publish: Optional[Dict[tuple, bytes]] = None,
     ) -> int:
         """Spawn worker rounds until success, failure beyond limits, or
-        reset_limit exhausted.  Returns the job exit code."""
+        reset_limit exhausted.  Returns the job exit code.
+
+        ``publish`` entries ({(scope, key): blob}) are put into the
+        rendezvous KV before the first round — how function payloads
+        reach workers (e.g. ``task_runner`` fetches ``__run__/func``),
+        mirroring ``horovod.run``'s KV-store func delivery.
+        """
         secret = pysecrets.token_hex(16)
         server = controller_py.make_server(secret, self.min_np)
         control = controller_py.make_client(
             "127.0.0.1", server.port, secret, rank=-1
         )
+        for (scope, key), blob in (publish or {}).items():
+            control.put(scope, key, blob)
         rendezvous_addr = "127.0.0.1"
         try:
             while True:

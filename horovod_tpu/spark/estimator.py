@@ -85,6 +85,13 @@ class TpuEstimator:
         cols = self.feature_cols + self.label_cols
         path = self.store.get_train_data_path()
         os.makedirs(path, exist_ok=True)
+        # Clear shards from a previous fit: a smaller partition count
+        # would otherwise leave stale part files that _train_worker's
+        # glob would mix into this run's data.
+        import glob as _glob
+
+        for stale in _glob.glob(os.path.join(path, "part-*.npz")):
+            os.remove(stale)
 
         def write_partition(idx, rows_iter):
             rows = list(rows_iter)
@@ -129,6 +136,10 @@ class TpuEstimator:
         used by tests and by notebook users without a cluster)."""
         path = self.store.get_train_data_path()
         os.makedirs(path, exist_ok=True)
+        import glob as _glob
+
+        for stale in _glob.glob(os.path.join(path, "part-*.npz")):
+            os.remove(stale)
         np.savez(os.path.join(path, "part-0.npz"), **named_arrays)
         params = _train_worker(
             pickle.dumps(self.model), pickle.dumps(self.optimizer),

@@ -27,6 +27,7 @@ STALL_CHECK_TIME_SECONDS = "STALL_CHECK_TIME_SECONDS"
 STALL_SHUTDOWN_TIME_SECONDS = "STALL_SHUTDOWN_TIME_SECONDS"
 ELASTIC_ENABLED = "ELASTIC"
 DYNAMIC_PROCESS_SETS = "DYNAMIC_PROCESS_SETS"
+HIERARCHICAL_ALLREDUCE = "HIERARCHICAL_ALLREDUCE"  # reference HOROVOD_HIERARCHICAL_ALLREDUCE
 PROCESS_SETS = "PROCESS_SETS"
 BATCH_D2D_MEMCOPIES = "BATCH_D2D_MEMCOPIES"
 NUM_STREAMS = "NUM_STREAMS"

@@ -287,3 +287,29 @@ def test_compile_cache_reuse(hvd_module):
     hvd.allreduce(x)
     hvd.allreduce(x + 1)
     assert _jitted.cache_info().hits > before
+
+
+def test_hierarchical_allreduce_matches_flat(hvd_module):
+    """reference NCCLHierarchicalAllreduce semantics: two-stage staging
+    must produce the same sum as the flat psum (4 'local' x 2 'hosts')."""
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu import runtime as rtm
+    from horovod_tpu.ops import traced
+
+    rt = rtm.get_runtime()
+    old = rt.local_size, rt.cross_size
+    rt.local_size, rt.cross_size = 4, 2
+    try:
+        x = np.arange(8 * 7, dtype=np.float32).reshape(8, 7)
+        f = jax.jit(shard_map(
+            lambda a: traced.allreduce(a, op=hvd.Sum, hierarchical=True),
+            mesh=rt.mesh, in_specs=(P(hvd.WORLD_AXIS),),
+            out_specs=P(hvd.WORLD_AXIS), check_vma=False,
+        ))
+        y = np.asarray(f(jnp.asarray(x)))
+        np.testing.assert_allclose(y, np.tile(x.sum(axis=0), (8, 1)))
+    finally:
+        rt.local_size, rt.cross_size = old

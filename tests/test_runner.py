@@ -197,3 +197,18 @@ def test_check_build_reports(capsys):
     assert "[X] JAX" in out
     assert "native core" in out
     assert "Adasum" in out
+
+
+def test_config_parser_hash_in_value(tmp_path):
+    from horovod_tpu.runner.config_parser import parse_config_file
+
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(
+        "timeline:\n"
+        "  filename: /data/run#3/tl.json\n"
+        "params:\n"
+        "  fusion_threshold_mb: 16  # trailing comment\n"
+    )
+    parsed = parse_config_file(str(cfg))
+    assert parsed["timeline"]["filename"] == "/data/run#3/tl.json"
+    assert parsed["params"]["fusion_threshold_mb"] == 16
