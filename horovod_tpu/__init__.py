@@ -180,3 +180,9 @@ from .sync_batch_norm import SyncBatchNorm  # noqa: F401,E402
 from .metrics import metric_average  # noqa: F401,E402
 from . import callbacks  # noqa: F401,E402
 from . import data  # noqa: F401,E402
+from . import checkpoint  # noqa: F401,E402
+from .checkpoint import (  # noqa: F401,E402
+    load_checkpoint,
+    restore_or_init,
+    save_checkpoint,
+)

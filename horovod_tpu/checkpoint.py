@@ -1,0 +1,140 @@
+"""Checkpoint save/restore for params + optimizer state.
+
+Reference checkpoint/resume mechanisms (SURVEY.md §5): (1) elastic
+``State`` in-memory commits (``horovod_tpu/elastic/state.py``), (2)
+Spark store checkpoints (``horovod_tpu/spark/store.py``), and (3) Keras
+``load_model`` with hvd-wrapped optimizers (``keras/__init__.py:167``)
+— a durable on-disk format that round-trips the full training state.
+This module is mechanism (3) for the TPU build: orbax when available
+(async, sharded, multi-host), msgpack-free npz/pickle fallback
+otherwise.
+
+Rank-0-writes / all-read, with a ``broadcast`` on restore so every rank
+starts from identical bytes (the reference's
+``BroadcastGlobalVariablesCallback``-after-load pattern).
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+from . import functions, runtime
+from .utils.logging import get_logger
+
+log = get_logger()
+
+_CKPT_FILE = "checkpoint.pkl"
+
+
+def _has_orbax() -> bool:
+    try:
+        import orbax.checkpoint  # noqa: F401
+
+        return True
+    except ImportError:
+        return False
+
+
+def save_checkpoint(
+    path: str,
+    state: Dict[str, Any],
+    step: Optional[int] = None,
+    use_orbax: Optional[bool] = None,
+) -> str:
+    """Write ``state`` (a dict of pytrees: params, opt_state, ...) under
+    ``path``; only rank 0 writes (reference: checkpoints saved on rank 0,
+    e.g. ``examples/pytorch/pytorch_imagenet_resnet50.py``'s
+    ``save_checkpoint``).  Returns the checkpoint directory."""
+    target = path if step is None else os.path.join(path, f"step_{step}")
+    rt = runtime.get_runtime_or_none()
+    if rt is not None and rt.process_rank != 0:
+        return target
+    os.makedirs(target, exist_ok=True)
+    if use_orbax is None:
+        use_orbax = _has_orbax()
+    host_state = jax.device_get(state)
+    if use_orbax:
+        import orbax.checkpoint as ocp
+
+        ckptr = ocp.PyTreeCheckpointer()
+        ckptr.save(
+            os.path.join(target, "orbax"), host_state,
+            force=True,
+        )
+    else:
+        with open(os.path.join(target, _CKPT_FILE), "wb") as fh:
+            pickle.dump(host_state, fh)
+    log.info("checkpoint saved to %s", target)
+    return target
+
+
+def load_checkpoint(
+    path: str,
+    step: Optional[int] = None,
+    broadcast: bool = True,
+) -> Optional[Dict[str, Any]]:
+    """Load a checkpoint; returns None if absent.  With ``broadcast``
+    (default), only rank 0 touches the filesystem and its bytes are
+    broadcast, so all ranks restore identically even when local files
+    are divergent, partially written, or missing on non-root ranks."""
+    target = path if step is None else os.path.join(path, f"step_{step}")
+    rt = runtime.get_runtime_or_none()
+    multi = rt is not None and rt.process_count > 1
+    state = None
+    if not (broadcast and multi and rt.process_rank != 0):
+        orbax_dir = os.path.join(target, "orbax")
+        pkl = os.path.join(target, _CKPT_FILE)
+        if os.path.isdir(orbax_dir) and _has_orbax():
+            import orbax.checkpoint as ocp
+
+            state = ocp.PyTreeCheckpointer().restore(orbax_dir)
+        elif os.path.exists(pkl):
+            with open(pkl, "rb") as fh:
+                state = pickle.load(fh)
+    if broadcast and multi:
+        state = functions.broadcast_object(state, root_rank=0)
+    return state
+
+
+def latest_step(path: str) -> Optional[int]:
+    """Highest ``step_N`` subdirectory under ``path`` (resume point)."""
+    if not os.path.isdir(path):
+        return None
+    steps = []
+    for name in os.listdir(path):
+        if name.startswith("step_"):
+            try:
+                steps.append(int(name[5:]))
+            except ValueError:
+                pass
+    return max(steps) if steps else None
+
+
+def restore_or_init(
+    path: str,
+    init_state: Dict[str, Any],
+) -> tuple:
+    """Resume from the newest checkpoint under ``path`` or fall back to
+    ``init_state`` broadcast from rank 0.  Returns (state, step) with
+    step == 0 for a fresh start (the reference's resume_from_epoch
+    pattern, ``pytorch_imagenet_resnet50.py``).
+
+    The resume-vs-init decision is rank 0's, broadcast to all — ranks
+    must take the same branch or their collective sequences diverge
+    (checkpoints are written by rank 0, so other ranks' filesystems may
+    legitimately not have them).
+    """
+    rt = runtime.get_runtime_or_none()
+    step = latest_step(path)
+    if rt is not None and rt.process_count > 1:
+        step = functions.broadcast_object(step, root_rank=0)
+    if step is not None:
+        state = load_checkpoint(path, step=step)
+        if state is not None:
+            return state, step
+    return functions.broadcast_parameters(init_state, root_rank=0), 0

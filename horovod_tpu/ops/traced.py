@@ -404,3 +404,37 @@ def barrier(axis: Axis = WORLD_AXIS, process_set: Optional[ProcessSet] = None) -
     scalar that depends on every rank in the set."""
     token = jnp.zeros((), dtype=jnp.int32)
     return allreduce(token, axis=axis, op=Sum, process_set=process_set)
+
+
+def join_average(
+    x: jax.Array,
+    active,
+    axis: Axis = WORLD_AXIS,
+) -> jax.Array:
+    """Average ``x`` over only the *active* ranks — the SPMD form of the
+    reference's Join semantics (``operations.cc:1714``, JoinOp: joined
+    ranks contribute zero tensors and the readiness count shrinks,
+    ``controller.cc:262-317``).
+
+    Under SPMD every rank must execute every collective, so a rank that
+    has run out of data cannot simply stop: instead it keeps stepping
+    with a padding batch and ``active=False``, and its contribution is
+    masked out here.  ``active`` is a per-rank traced bool (or 0/1
+    scalar).  When no rank is active the result is zero (matching a
+    fully-joined world where the collective never fires).
+
+    Typical uneven-batch loop::
+
+        steps = allreduce-max of per-rank batch counts   # static or eager
+        for i in range(steps):
+            batch, is_real = loader.next_or_pad()
+            grads = jax.grad(loss)(params, batch)
+            grads = tree.map(lambda g: join_average(g, is_real), grads)
+    """
+    active_f = jnp.asarray(active, jnp.float32)
+    n_active = lax.psum(active_f, axis)
+    contrib = lax.psum(
+        jnp.where(active_f > 0, x, jnp.zeros_like(x)), axis
+    )
+    denom = jnp.maximum(n_active, 1.0).astype(contrib.dtype)
+    return contrib / denom

@@ -43,13 +43,22 @@ def _parse_scalar(s: str) -> Any:
 def _strip_comment(line: str) -> str:
     """Drop a trailing ``# comment`` without corrupting values that
     contain '#': the hash must be outside quotes and either start the
-    line or follow whitespace (YAML's rule)."""
+    line or follow whitespace (YAML's rule).  A quote only opens a
+    quoted scalar when it is the first character of the value — a lone
+    apostrophe mid-word (``user's``) is plain text, per YAML."""
+    colon = line.find(":")
+    value_start = None
+    if colon != -1:
+        rest = line[colon + 1:]
+        offset = len(rest) - len(rest.lstrip())
+        if colon + 1 + offset < len(line):
+            value_start = colon + 1 + offset
     in_quote = None
     for i, ch in enumerate(line):
         if in_quote:
             if ch == in_quote:
                 in_quote = None
-        elif ch in ("'", '"'):
+        elif ch in ("'", '"') and i == value_start:
             in_quote = ch
         elif ch == "#" and (i == 0 or line[i - 1] in " \t"):
             return line[:i]

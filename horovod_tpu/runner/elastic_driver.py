@@ -157,6 +157,17 @@ class ElasticDriver:
                     else assignments[0].hostname
                 )
                 coordinator_addr = f"{coordinator_host}:{free_port()}"
+                # The rendezvous KV runs in this driver process: remote
+                # workers must dial our routable address, not loopback
+                # (same rule as launch_static, launch.py:81-83).
+                if all(
+                    exec_utils.is_local(a.hostname) for a in assignments
+                ):
+                    rendezvous_addr = "127.0.0.1"
+                else:
+                    rendezvous_addr = socket.gethostbyname(
+                        socket.gethostname()
+                    )
                 workers = []
                 for slot in assignments:
                     env = make_worker_env(

@@ -26,6 +26,17 @@ from ..utils.logging import get_logger
 log = get_logger()
 
 
+def _fresh_data_dir(path: str) -> None:
+    """Create ``path`` and drop shards from any previous fit: a smaller
+    partition count would otherwise leave stale part files that
+    ``_train_worker``'s glob would mix into this run's data."""
+    import glob
+
+    os.makedirs(path, exist_ok=True)
+    for stale in glob.glob(os.path.join(path, "part-*.npz")):
+        os.remove(stale)
+
+
 class TpuEstimator:
     """Sklearn-style fit/predict over distributed TPU training.
 
@@ -84,14 +95,7 @@ class TpuEstimator:
         its HDFS/DBFS stores)."""
         cols = self.feature_cols + self.label_cols
         path = self.store.get_train_data_path()
-        os.makedirs(path, exist_ok=True)
-        # Clear shards from a previous fit: a smaller partition count
-        # would otherwise leave stale part files that _train_worker's
-        # glob would mix into this run's data.
-        import glob as _glob
-
-        for stale in _glob.glob(os.path.join(path, "part-*.npz")):
-            os.remove(stale)
+        _fresh_data_dir(path)
 
         def write_partition(idx, rows_iter):
             rows = list(rows_iter)
@@ -135,11 +139,7 @@ class TpuEstimator:
         """Spark-free fit over in-memory arrays (single-controller path;
         used by tests and by notebook users without a cluster)."""
         path = self.store.get_train_data_path()
-        os.makedirs(path, exist_ok=True)
-        import glob as _glob
-
-        for stale in _glob.glob(os.path.join(path, "part-*.npz")):
-            os.remove(stale)
+        _fresh_data_dir(path)
         np.savez(os.path.join(path, "part-0.npz"), **named_arrays)
         params = _train_worker(
             pickle.dumps(self.model), pickle.dumps(self.optimizer),

@@ -313,3 +313,43 @@ def test_hierarchical_allreduce_matches_flat(hvd_module):
         np.testing.assert_allclose(y, np.tile(x.sum(axis=0), (8, 1)))
     finally:
         rt.local_size, rt.cross_size = old
+
+
+def test_join_average_uneven_ranks(hvd_module):
+    """SPMD Join semantics (reference JoinOp): ranks 5..7 are 'joined'
+    (out of data); the average covers only the 5 active ranks."""
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.ops import traced
+
+    rt_mesh = hvd.mesh()
+    x = np.arange(8 * 3, dtype=np.float32).reshape(8, 3)
+    active = np.asarray([1, 1, 1, 1, 1, 0, 0, 0], np.float32).reshape(8, 1)
+
+    f = jax.jit(shard_map(
+        lambda a, m: traced.join_average(a, m[0] > 0),
+        mesh=rt_mesh, in_specs=(P(hvd.WORLD_AXIS), P(hvd.WORLD_AXIS)),
+        out_specs=P(hvd.WORLD_AXIS), check_vma=False,
+    ))
+    y = np.asarray(f(jnp.asarray(x), jnp.asarray(active)))
+    want = np.tile(x[:5].mean(axis=0), (8, 1))
+    np.testing.assert_allclose(y, want, rtol=1e-6)
+
+
+def test_join_average_none_active(hvd_module):
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    from horovod_tpu.ops import traced
+
+    x = np.ones((8, 2), np.float32)
+    zero = np.zeros((8, 1), np.float32)
+    f = jax.jit(shard_map(
+        lambda a, m: traced.join_average(a, m[0] > 0),
+        mesh=hvd.mesh(), in_specs=(P(hvd.WORLD_AXIS), P(hvd.WORLD_AXIS)),
+        out_specs=P(hvd.WORLD_AXIS), check_vma=False,
+    ))
+    np.testing.assert_allclose(np.asarray(f(jnp.asarray(x), jnp.asarray(zero))), 0.0)

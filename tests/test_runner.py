@@ -212,3 +212,17 @@ def test_config_parser_hash_in_value(tmp_path):
     parsed = parse_config_file(str(cfg))
     assert parsed["timeline"]["filename"] == "/data/run#3/tl.json"
     assert parsed["params"]["fusion_threshold_mb"] == 16
+
+
+def test_config_parser_apostrophe_in_value(tmp_path):
+    from horovod_tpu.runner.config_parser import parse_config_file
+
+    cfg = tmp_path / "cfg.yaml"
+    cfg.write_text(
+        "timeline:\n"
+        "  filename: user's tl.json  # note\n"
+        "  quoted: '#literal'\n"
+    )
+    parsed = parse_config_file(str(cfg))
+    assert parsed["timeline"]["filename"] == "user's tl.json"
+    assert parsed["timeline"]["quoted"] == "#literal"
