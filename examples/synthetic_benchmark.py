@@ -1,9 +1,11 @@
-"""Synthetic ResNet-50 throughput benchmark (TPU-native equivalent of
-reference ``examples/pytorch/pytorch_synthetic_benchmark.py``).
+"""Synthetic CNN throughput benchmark (TPU-native equivalent of
+reference ``examples/pytorch/pytorch_synthetic_benchmark.py`` and the
+tf_cnn_benchmarks methodology cited by ``docs/benchmarks.rst``).
 
 Measures images/sec for forward+backward+allreduce+update on synthetic
-ImageNet-shaped data, the metric the reference publishes in
-``docs/benchmarks.rst``.  Run: ``python examples/synthetic_benchmark.py``.
+ImageNet-shaped data across the reference's headline models
+(``--model resnet50|resnet101|vgg16|inception3``).
+Run: ``python examples/synthetic_benchmark.py [--model resnet50]``.
 """
 
 import argparse
@@ -15,16 +17,24 @@ import numpy as np
 import optax
 
 import horovod_tpu as hvd
-from horovod_tpu.models import ResNet50
+from horovod_tpu.models import InceptionV3, ResNet50, ResNet101, VGG16
+
+MODELS = {
+    "resnet50": ResNet50,
+    "resnet101": ResNet101,
+    "vgg16": VGG16,
+    "inception3": InceptionV3,
+}
 
 
 def build_benchmark(args):
-    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    model = MODELS[args.model](num_classes=1000, dtype=jnp.bfloat16)
     variables = model.init(
         jax.random.PRNGKey(0), jnp.zeros((1, args.image_size, args.image_size, 3)),
         train=True,
     )
-    params, batch_stats = variables["params"], variables["batch_stats"]
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats")  # VGG has no BatchNorm
     params = hvd.broadcast_parameters(params, root_rank=0)
 
     tx = hvd.DistributedOptimizer(
@@ -32,21 +42,31 @@ def build_benchmark(args):
         compression=hvd.Compression.fp16 if args.fp16_allreduce else hvd.Compression.none,
     )
 
-    def loss_fn(p, stats, batch):
-        x, y = batch
-        logits, updated = model.apply(
-            {"params": p, "batch_stats": stats}, x, train=True,
-            mutable=["batch_stats"],
-        )
-        loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
-        return loss, updated["batch_stats"]
+    if batch_stats is not None:
+        def loss_fn(p, stats, batch):
+            x, y = batch
+            logits, updated = model.apply(
+                {"params": p, "batch_stats": stats}, x, train=True,
+                mutable=["batch_stats"],
+            )
+            loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+            return loss, updated["batch_stats"]
 
-    step = hvd.distributed_train_step(loss_fn, tx, stateful=True)
+        step = hvd.distributed_train_step(loss_fn, tx, stateful=True)
+    else:
+        def loss_fn(p, batch):
+            x, y = batch
+            logits = model.apply({"params": p}, x, train=True)
+            return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+        step = hvd.distributed_train_step(loss_fn, tx)
     return model, params, batch_stats, step
 
 
 def main():
     parser = argparse.ArgumentParser()
+    parser.add_argument("--model", default="resnet50",
+                        choices=sorted(MODELS))
     parser.add_argument("--batch-size", type=int, default=32,
                         help="per-chip batch (reference default 32)")
     parser.add_argument("--image-size", type=int, default=224)
@@ -69,16 +89,21 @@ def main():
 
     def run_one():
         nonlocal params, batch_stats, opt_state
-        params, batch_stats, opt_state, loss = step(
-            params, batch_stats, opt_state, (data, target)
-        )
+        if batch_stats is not None:
+            params, batch_stats, opt_state, loss = step(
+                params, batch_stats, opt_state, (data, target)
+            )
+        else:
+            params, opt_state, loss = step(params, opt_state, (data, target))
         return loss
 
     if hvd.rank() == 0:
-        print(f"Model: ResNet50, batch {args.batch_size}/chip x {hvd.size()} chips")
+        print(f"Model: {args.model}, batch {args.batch_size}/chip x {hvd.size()} chips")
+    loss = None
     for _ in range(args.num_warmup_batches):
         loss = run_one()
-    float(loss)  # scalar host read: a real completion fence on every transport
+    if loss is not None:
+        float(loss)  # scalar host read: a real completion fence on every transport
 
     img_secs = []
     for i in range(args.num_iters):

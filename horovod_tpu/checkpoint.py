@@ -89,7 +89,14 @@ def load_checkpoint(
     if not (broadcast and multi and rt.process_rank != 0):
         orbax_dir = os.path.join(target, "orbax")
         pkl = os.path.join(target, _CKPT_FILE)
-        if os.path.isdir(orbax_dir) and _has_orbax():
+        if os.path.isdir(orbax_dir):
+            if not _has_orbax():
+                raise RuntimeError(
+                    f"checkpoint at {orbax_dir} was written with orbax, "
+                    "which is not importable here — install "
+                    "orbax-checkpoint to restore it (refusing to "
+                    "silently restart from scratch)"
+                )
             import orbax.checkpoint as ocp
 
             state = ocp.PyTreeCheckpointer().restore(orbax_dir)
@@ -137,4 +144,7 @@ def restore_or_init(
         state = load_checkpoint(path, step=step)
         if state is not None:
             return state, step
+    if rt is None:
+        # usable before hvd.init() like the rest of this module
+        return init_state, 0
     return functions.broadcast_parameters(init_state, root_rank=0), 0

@@ -9,6 +9,8 @@ compute with fp32 params, shapes padded to MXU tiles.
 
 from .mnist import MnistCNN, MnistMLP  # noqa: F401
 from .resnet import ResNet, ResNet50, ResNet101, ResNet152  # noqa: F401
+from .vgg import VGG, VGG16, VGG19  # noqa: F401
+from .inception import InceptionV3  # noqa: F401
 from .transformer import (  # noqa: F401
     Transformer,
     TransformerConfig,

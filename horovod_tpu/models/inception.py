@@ -1,0 +1,160 @@
+"""Inception V3 for TPU (headline benchmark model: 90% scaling
+efficiency at 512 GPUs, ``docs/benchmarks.rst:13-14``; the
+mixed-branch-width design exercises XLA's conv fusion very differently
+from ResNet's uniform bottlenecks).
+
+Faithful V3 topology (stem → 3×InceptionA → grid reduction →
+4×InceptionB → grid reduction → 2×InceptionC → global pool); branches
+use NHWC, bf16 compute, BatchNorm with fp32 stats.  The auxiliary
+classifier is omitted (training-signal trick, not part of the serving
+graph the benchmarks time).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvBN(nn.Module):
+    features: int
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: str = "SAME"
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = nn.Conv(
+            self.features, self.kernel, strides=self.strides,
+            padding=self.padding, use_bias=False, dtype=self.dtype,
+        )(x)
+        x = nn.BatchNorm(
+            use_running_average=not train, momentum=0.9, epsilon=1e-3,
+            dtype=jnp.float32,
+        )(x)
+        return nn.relu(x).astype(self.dtype)
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        d = self.dtype
+        b1 = ConvBN(64, (1, 1), dtype=d)(x, train)
+        b2 = ConvBN(48, (1, 1), dtype=d)(x, train)
+        b2 = ConvBN(64, (5, 5), dtype=d)(b2, train)
+        b3 = ConvBN(64, (1, 1), dtype=d)(x, train)
+        b3 = ConvBN(96, (3, 3), dtype=d)(b3, train)
+        b3 = ConvBN(96, (3, 3), dtype=d)(b3, train)
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = ConvBN(self.pool_features, (1, 1), dtype=d)(b4, train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class ReductionA(nn.Module):
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        d = self.dtype
+        b1 = ConvBN(384, (3, 3), strides=(2, 2), padding="VALID", dtype=d)(x, train)
+        b2 = ConvBN(64, (1, 1), dtype=d)(x, train)
+        b2 = ConvBN(96, (3, 3), dtype=d)(b2, train)
+        b2 = ConvBN(96, (3, 3), strides=(2, 2), padding="VALID", dtype=d)(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionB(nn.Module):
+    channels_7x7: int
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        d, c = self.dtype, self.channels_7x7
+        b1 = ConvBN(192, (1, 1), dtype=d)(x, train)
+        b2 = ConvBN(c, (1, 1), dtype=d)(x, train)
+        b2 = ConvBN(c, (1, 7), dtype=d)(b2, train)
+        b2 = ConvBN(192, (7, 1), dtype=d)(b2, train)
+        b3 = ConvBN(c, (1, 1), dtype=d)(x, train)
+        b3 = ConvBN(c, (7, 1), dtype=d)(b3, train)
+        b3 = ConvBN(c, (1, 7), dtype=d)(b3, train)
+        b3 = ConvBN(c, (7, 1), dtype=d)(b3, train)
+        b3 = ConvBN(192, (1, 7), dtype=d)(b3, train)
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = ConvBN(192, (1, 1), dtype=d)(b4, train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class ReductionB(nn.Module):
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        d = self.dtype
+        b1 = ConvBN(192, (1, 1), dtype=d)(x, train)
+        b1 = ConvBN(320, (3, 3), strides=(2, 2), padding="VALID", dtype=d)(b1, train)
+        b2 = ConvBN(192, (1, 1), dtype=d)(x, train)
+        b2 = ConvBN(192, (1, 7), dtype=d)(b2, train)
+        b2 = ConvBN(192, (7, 1), dtype=d)(b2, train)
+        b2 = ConvBN(192, (3, 3), strides=(2, 2), padding="VALID", dtype=d)(b2, train)
+        b3 = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b1, b2, b3], axis=-1)
+
+
+class InceptionC(nn.Module):
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        d = self.dtype
+        b1 = ConvBN(320, (1, 1), dtype=d)(x, train)
+        b2 = ConvBN(384, (1, 1), dtype=d)(x, train)
+        b2a = ConvBN(384, (1, 3), dtype=d)(b2, train)
+        b2b = ConvBN(384, (3, 1), dtype=d)(b2, train)
+        b2 = jnp.concatenate([b2a, b2b], axis=-1)
+        b3 = ConvBN(448, (1, 1), dtype=d)(x, train)
+        b3 = ConvBN(384, (3, 3), dtype=d)(b3, train)
+        b3a = ConvBN(384, (1, 3), dtype=d)(b3, train)
+        b3b = ConvBN(384, (3, 1), dtype=d)(b3, train)
+        b3 = jnp.concatenate([b3a, b3b], axis=-1)
+        b4 = nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        b4 = ConvBN(192, (1, 1), dtype=d)(b4, train)
+        return jnp.concatenate([b1, b2, b3, b4], axis=-1)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        d = self.dtype
+        x = x.astype(d)
+        # stem (299x299 canonical; any size >= ~75 works, pooling is global)
+        x = ConvBN(32, (3, 3), strides=(2, 2), padding="VALID", dtype=d)(x, train)
+        x = ConvBN(32, (3, 3), padding="VALID", dtype=d)(x, train)
+        x = ConvBN(64, (3, 3), dtype=d)(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = ConvBN(80, (1, 1), padding="VALID", dtype=d)(x, train)
+        x = ConvBN(192, (3, 3), padding="VALID", dtype=d)(x, train)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = InceptionA(32, dtype=d)(x, train)
+        x = InceptionA(64, dtype=d)(x, train)
+        x = InceptionA(64, dtype=d)(x, train)
+        x = ReductionA(dtype=d)(x, train)
+        x = InceptionB(128, dtype=d)(x, train)
+        x = InceptionB(160, dtype=d)(x, train)
+        x = InceptionB(160, dtype=d)(x, train)
+        x = InceptionB(192, dtype=d)(x, train)
+        x = ReductionB(dtype=d)(x, train)
+        x = InceptionC(dtype=d)(x, train)
+        x = InceptionC(dtype=d)(x, train)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)

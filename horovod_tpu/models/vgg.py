@@ -1,0 +1,58 @@
+"""VGG for TPU (one of the reference's three headline benchmark models:
+``docs/benchmarks.rst:13-14`` reports 68% scaling efficiency for VGG-16
+at 512 GPUs — the hardest of the trio because of its 138M mostly-dense
+parameters; it stresses the gradient-allreduce path more than compute).
+
+NHWC, bf16 compute/fp32 params.  The default head is the original two
+4096-wide FC layers (``classifier_mlp=True``) — those FCs are what made
+VGG the allreduce stress test, so parameter-count parity is the
+benchmark-faithful default; pass ``classifier_mlp=False`` for a modern
+global-average-pool + single-dense head (much smaller, and
+image-size-independent).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+_CFG_16 = (2, 2, 3, 3, 3)
+_CFG_19 = (2, 2, 4, 4, 4)
+_WIDTHS = (64, 128, 256, 512, 512)
+
+
+class VGG(nn.Module):
+    stage_convs: Sequence[int] = _CFG_16
+    num_classes: int = 1000
+    classifier_mlp: bool = True
+    dtype: jnp.dtype = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        x = x.astype(self.dtype)
+        for stage, (n_convs, width) in enumerate(
+            zip(self.stage_convs, _WIDTHS)
+        ):
+            for i in range(n_convs):
+                x = nn.Conv(
+                    width, (3, 3), padding="SAME", dtype=self.dtype,
+                    name=f"conv{stage}_{i}",
+                )(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        if self.classifier_mlp:
+            x = x.reshape((x.shape[0], -1))
+            x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc1")(x))
+            x = nn.relu(nn.Dense(4096, dtype=self.dtype, name="fc2")(x))
+        else:
+            x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+VGG16 = partial(VGG, stage_convs=_CFG_16)
+VGG19 = partial(VGG, stage_convs=_CFG_19)

@@ -130,7 +130,6 @@ class ElasticDriver:
         )
         for (scope, key), blob in (publish or {}).items():
             control.put(scope, key, blob)
-        rendezvous_addr = "127.0.0.1"
         try:
             while True:
                 if not self.wait_for_available_slots(self.min_np):
@@ -158,16 +157,8 @@ class ElasticDriver:
                 )
                 coordinator_addr = f"{coordinator_host}:{free_port()}"
                 # The rendezvous KV runs in this driver process: remote
-                # workers must dial our routable address, not loopback
-                # (same rule as launch_static, launch.py:81-83).
-                if all(
-                    exec_utils.is_local(a.hostname) for a in assignments
-                ):
-                    rendezvous_addr = "127.0.0.1"
-                else:
-                    rendezvous_addr = socket.gethostbyname(
-                        socket.gethostname()
-                    )
+                # workers must dial our routable address, not loopback.
+                rendezvous_addr = exec_utils.routable_addr(assignments)
                 workers = []
                 for slot in assignments:
                     env = make_worker_env(

@@ -22,6 +22,18 @@ def is_local(hostname: str) -> bool:
     return hostname in LOCAL_HOSTNAMES or hostname == socket.gethostname()
 
 
+def routable_addr(assignments) -> str:
+    """Address remote workers should dial to reach a service running in
+    this (driver) process: loopback when every slot is local, else this
+    host's resolvable address.  Shared by the static and elastic launch
+    paths so the two cannot diverge."""
+    import socket
+
+    if all(is_local(a.hostname) for a in assignments):
+        return "127.0.0.1"
+    return socket.gethostbyname(socket.gethostname())
+
+
 def build_command(
     hostname: str,
     command: List[str],

@@ -78,9 +78,7 @@ def launch_static(
     assignments = hosts_mod.get_host_assignments(host_list, np_)
     secret = pysecrets.token_hex(16)
     server = controller_py.make_server(secret, np_)
-    rendezvous_addr = socket.gethostbyname(socket.gethostname())
-    if all(exec_utils.is_local(a.hostname) for a in assignments):
-        rendezvous_addr = "127.0.0.1"
+    rendezvous_addr = exec_utils.routable_addr(assignments)
     coordinator_host = (
         "127.0.0.1"
         if exec_utils.is_local(assignments[0].hostname)

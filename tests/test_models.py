@@ -75,3 +75,26 @@ def test_tiny_resnet_stateful_training(hvd_module):
         lambda a, b: not np.allclose(np.asarray(a), b), stats, stats0
     )
     assert any(jax.tree.leaves(changed))
+
+
+def test_vgg16_forward_and_param_count(hvd_module):
+    from horovod_tpu.models import VGG16
+
+    model = VGG16(num_classes=10, dtype=jnp.float32)
+    x = jnp.zeros((2, 32, 32, 3))
+    params = model.init(jax.random.PRNGKey(0), x, train=False)
+    logits = model.apply(params, x, train=False)
+    assert logits.shape == (2, 10)
+    n_conv_stages = len({k for k in params["params"] if k.startswith("conv")})
+    assert n_conv_stages == 13  # VGG-16 = 13 convs + 3 FC
+
+
+def test_inception_v3_forward(hvd_module):
+    from horovod_tpu.models import InceptionV3
+
+    model = InceptionV3(num_classes=10, dtype=jnp.float32)
+    x = jnp.zeros((1, 96, 96, 3))
+    variables = model.init(jax.random.PRNGKey(0), x, train=False)
+    logits = model.apply(variables, x, train=False)
+    assert logits.shape == (1, 10)
+    assert "batch_stats" in variables
