@@ -31,6 +31,14 @@ log = get_logger()
 _CKPT_FILE = "checkpoint.pkl"
 
 
+class _LoadError:
+    """Picklable error sentinel broadcast to all ranks so load failures
+    raise everywhere instead of deadlocking non-root ranks."""
+
+    def __init__(self, message: str):
+        self.message = message
+
+
 def _has_orbax() -> bool:
     try:
         import orbax.checkpoint  # noqa: F401
@@ -91,20 +99,26 @@ def load_checkpoint(
         pkl = os.path.join(target, _CKPT_FILE)
         if os.path.isdir(orbax_dir):
             if not _has_orbax():
-                raise RuntimeError(
+                # Refuse to silently restart from scratch — but in a
+                # multi-process world the error must reach every rank
+                # through the broadcast below, or non-root ranks hang in
+                # the collective waiting for rank 0's payload.
+                state = _LoadError(
                     f"checkpoint at {orbax_dir} was written with orbax, "
                     "which is not importable here — install "
-                    "orbax-checkpoint to restore it (refusing to "
-                    "silently restart from scratch)"
+                    "orbax-checkpoint to restore it"
                 )
-            import orbax.checkpoint as ocp
+            else:
+                import orbax.checkpoint as ocp
 
-            state = ocp.PyTreeCheckpointer().restore(orbax_dir)
+                state = ocp.PyTreeCheckpointer().restore(orbax_dir)
         elif os.path.exists(pkl):
             with open(pkl, "rb") as fh:
                 state = pickle.load(fh)
     if broadcast and multi:
         state = functions.broadcast_object(state, root_rank=0)
+    if isinstance(state, _LoadError):
+        raise RuntimeError(state.message)
     return state
 
 

@@ -4,3 +4,7 @@ from .distributed_optimizer import (  # noqa: F401
     DistributedOptimizerState,
     distributed_train_step,
 )
+from .zero import (  # noqa: F401
+    sharded_gradient_transformation,
+    zero_train_step,
+)

@@ -1,0 +1,171 @@
+"""ZeRO-1 sharded optimizer states (capability add beyond the reference).
+
+The reference replicates optimizer state on every rank (its
+DistributedOptimizer wraps a local optimizer; only gradients cross the
+wire).  On TPU the bandwidth-optimal gradient primitive is
+``reduce_scatter`` (each chip receives only 1/N of the reduced
+gradient), which makes optimizer-state sharding free to bolt on:
+
+    grads --psum_scatter--> grad shard          (same bytes as allreduce's
+    shard update with optax on the 1/N slice     reduce-scatter half)
+    params <--all_gather-- updated param shards (the other half)
+
+Total comms equal one allreduce (reduce-scatter + all-gather), but
+optimizer state (e.g. Adam's two moments) shrinks N-fold per chip, and
+the optimizer update itself runs on 1/N of the elements.
+
+Sharding is over the *flattened* parameter vector, so it is exact for
+elementwise transforms (sgd, momentum, adam(w), rmsprop, lamb's
+elementwise core...).  Transforms that need global-across-parameters
+reductions (e.g. ``optax.clip_by_global_norm``) would see only their
+shard; compose those *outside* via ``pre_update`` hooks or avoid them.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.flatten_util import ravel_pytree
+
+from ..runtime import WORLD_AXIS
+
+
+class ZeroState(NamedTuple):
+    inner: optax.OptState  # shard-shaped leaves
+    shard_size: jnp.ndarray  # static-shaped scalar for pytree stability
+
+
+def sharded_gradient_transformation(
+    tx: optax.GradientTransformation,
+    axis=WORLD_AXIS,
+) -> optax.GradientTransformation:
+    """Wrap ``tx`` so init/update act on this rank's flat param shard.
+
+    For use inside ``shard_map`` with replicated params: ``init`` builds
+    state for the local 1/N slice; ``update`` takes *unreduced local
+    grads*, reduce-scatters them (average), updates the slice, and
+    returns full-size updates assembled by all-gather.
+    """
+
+    def _shard_meta(params):
+        flat, unravel = ravel_pytree(params)
+        n = flat.shape[0]
+        world = lax.axis_size(axis)
+        padded = -(-n // world) * world
+        return flat, unravel, n, world, padded
+
+    def init_fn(params):
+        flat, _, n, world, padded = _shard_meta(params)
+        idx = lax.axis_index(axis)
+        shard_len = padded // world
+        flat = jnp.pad(flat, (0, padded - n))
+        my = lax.dynamic_slice(flat, (idx * shard_len,), (shard_len,))
+        return ZeroState(
+            inner=tx.init(my), shard_size=jnp.asarray(shard_len)
+        )
+
+    def update_fn(grads, state: ZeroState, params=None):
+        if params is None:
+            raise ValueError("sharded optimizer requires params")
+        gflat, _, n, world, padded = _shard_meta(grads)
+        pflat, unravel, _, _, _ = _shard_meta(params)
+        shard_len = padded // world
+        idx = lax.axis_index(axis)
+
+        gflat = jnp.pad(gflat, (0, padded - n))
+        # Average-reduce-scatter: each rank gets its 1/N of the mean grad.
+        gshard = lax.psum_scatter(
+            gflat, axis, scatter_dimension=0, tiled=True
+        ) / world
+        pshard = lax.dynamic_slice(
+            jnp.pad(pflat, (0, padded - n)), (idx * shard_len,), (shard_len,)
+        )
+        ushard, inner = tx.update(gshard, state.inner, pshard)
+        # Assemble the full update vector; params stay replicated.
+        uflat = lax.all_gather(ushard, axis, tiled=True)[:n]
+        return unravel(uflat), ZeroState(
+            inner=inner, shard_size=state.shard_size
+        )
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def zero_train_step(
+    loss_fn,
+    tx: optax.GradientTransformation,
+    *,
+    axis=WORLD_AXIS,
+):
+    """Compiled SPMD step with ZeRO-1 sharded optimizer state.
+
+    Same call convention as ``distributed_train_step``'s stateless form:
+    ``step.init(params)`` then ``step(params, opt_state, batch) ->
+    (params, opt_state, loss)``.  Params are replicated; optimizer state
+    leaves live sharded (leading dim padded_n/N per chip).
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from .. import runtime as _rt
+
+    stx = sharded_gradient_transformation(tx, axis=axis)
+    rt = _rt.get_runtime()
+    mesh = rt.mesh
+    param_spec = P()
+
+    def init_body(params):
+        return stx.init(params)
+
+    def step_body(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        updates, opt_state = stx.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, opt_state, lax.pmean(loss, axis)
+
+    def state_spec_for(params):
+        # Opt-state leaves are device-varying shards -> P(axis); the
+        # structure comes from an axis-free emulation of init.
+        def abstract_init(p):
+            flat, _ = ravel_pytree(p)
+            world = rt.size
+            shard_len = -(-flat.shape[0] // world)
+            my = jnp.zeros((shard_len,), flat.dtype)
+            return ZeroState(
+                inner=tx.init(my), shard_size=jnp.asarray(shard_len)
+            )
+
+        shape = jax.eval_shape(abstract_init, params)
+        return jax.tree.map(
+            lambda leaf: P(axis) if leaf.ndim > 0 else P(), shape
+        )
+
+    class _Step:
+        def __init__(self):
+            self._fn = None
+
+        def init(self, params):
+            f = jax.shard_map(
+                init_body, mesh=mesh, in_specs=(param_spec,),
+                out_specs=state_spec_for(params), check_vma=False,
+            )
+            return jax.jit(f)(params)
+
+        def __call__(self, params, opt_state, batch):
+            if self._fn is None:
+                state_spec = jax.tree.map(
+                    lambda leaf: P(axis) if getattr(leaf, "ndim", 0) > 0 else P(),
+                    opt_state,
+                )
+                batch_spec = jax.tree.map(lambda _: P(axis), batch)
+                self._fn = jax.jit(jax.shard_map(
+                    step_body, mesh=mesh,
+                    in_specs=(param_spec, state_spec, batch_spec),
+                    out_specs=(param_spec, state_spec, P()),
+                    check_vma=False,
+                ), donate_argnums=(0, 1))
+            return self._fn(params, opt_state, batch)
+
+    return _Step()

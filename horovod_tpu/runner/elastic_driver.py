@@ -24,7 +24,6 @@ Worker exit-code contract (read by this driver):
 from __future__ import annotations
 
 import secrets as pysecrets
-import socket
 import threading
 import time
 from typing import Callable, Dict, List, Optional

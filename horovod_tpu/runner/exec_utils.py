@@ -8,6 +8,7 @@ from __future__ import annotations
 
 import os
 import shlex
+import socket
 import subprocess
 import sys
 import threading
@@ -17,8 +18,6 @@ LOCAL_HOSTNAMES = {"localhost", "127.0.0.1", "::1"}
 
 
 def is_local(hostname: str) -> bool:
-    import socket
-
     return hostname in LOCAL_HOSTNAMES or hostname == socket.gethostname()
 
 
@@ -27,8 +26,6 @@ def routable_addr(assignments) -> str:
     this (driver) process: loopback when every slot is local, else this
     host's resolvable address.  Shared by the static and elastic launch
     paths so the two cannot diverge."""
-    import socket
-
     if all(is_local(a.hostname) for a in assignments):
         return "127.0.0.1"
     return socket.gethostbyname(socket.gethostname())
