@@ -1,0 +1,9 @@
+"""Framework interop bindings.
+
+The reference binds TF/PyTorch/MXNet through per-framework C++ glue
+(SURVEY.md §2.3).  Here JAX *is* the native surface; these adapters let
+code holding other frameworks' tensors use the same collectives —
+zero-copy where DLPack allows.
+"""
+
+from . import torch as torch  # noqa: F401

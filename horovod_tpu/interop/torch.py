@@ -1,0 +1,191 @@
+"""PyTorch binding: Horovod's ``horovod.torch`` surface over the TPU
+runtime.
+
+Reference: ``horovod/torch/mpi_ops.py`` + ``mpi_ops_v2.cc`` — sync and
+async collectives on ``torch.Tensor``s with a handle/synchronize model.
+Here tensors cross into JAX via DLPack (zero-copy on CPU), run the same
+eager collectives, and come back as torch tensors.  Gradients do not
+flow through these ops (use the JAX surface for training); they serve
+torch-side data/metric plumbing — ``broadcast_parameters`` of a torch
+``state_dict``, metric averaging, allgather of eval outputs — exactly
+the roles the reference's torch functions play around a training loop.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from .. import functions as _functions
+from ..ops import eager as _eager
+
+
+def _torch():
+    try:
+        import torch  # noqa: F811
+
+        return torch
+    except ImportError as e:  # pragma: no cover
+        raise ImportError(
+            "horovod_tpu.interop.torch requires the `torch` package"
+        ) from e
+
+
+def _to_jax(t):
+    torch = _torch()
+    if not torch.is_tensor(t):
+        raise TypeError(f"expected a torch.Tensor, got {type(t)!r}")
+    import jax.numpy as jnp
+
+    t = t.detach()
+    if t.device.type != "cpu":
+        t = t.cpu()
+    if t.dtype == torch.bfloat16:
+        # numpy has no native bf16; bit-cast through uint16 so the wire
+        # dtype stays bf16 end to end (no precision round-trip).
+        import ml_dtypes
+
+        return jnp.asarray(
+            t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+        )
+    if t.dtype in (torch.int64, torch.float64):
+        # JAX's default x64-disabled mode would silently truncate to
+        # 32 bits and _to_torch would mask it by casting back — refuse.
+        raise TypeError(
+            f"{t.dtype} tensors would be silently truncated to 32 bits "
+            "by JAX (x64 disabled); cast to a 32-bit dtype first"
+        )
+    # numpy view is zero-copy from torch; jnp.asarray copies onto the
+    # accelerator once (unavoidable: the collective runs there).
+    return jnp.asarray(t.numpy())
+
+
+def _to_torch(x, like):
+    torch = _torch()
+    import ml_dtypes
+
+    arr = np.asarray(x)
+    if arr.dtype == ml_dtypes.bfloat16:
+        out = torch.from_numpy(
+            arr.view(np.uint16).copy()
+        ).view(torch.bfloat16)
+    else:
+        # copy: jax buffers surface as read-only numpy views, and torch
+        # tensors must own writable memory
+        out = torch.from_numpy(arr.copy())
+    if like is not None:
+        out = out.to(device=like.device, dtype=like.dtype)
+    return out
+
+
+# ---- collectives (reference torch/mpi_ops.py surface) -------------------
+
+def allreduce(tensor, op: int = _eager.Average, name: Optional[str] = None,
+              process_set=None, prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0):
+    """Reference ``hvd.allreduce(tensor)`` for torch tensors (stacked
+    (size, ...) convention like the JAX eager API)."""
+    y = _eager.allreduce(
+        _to_jax(tensor), op=op, name=name, process_set=process_set,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+    )
+    return _to_torch(y, tensor)
+
+
+def allgather(tensor, name: Optional[str] = None, process_set=None):
+    return _to_torch(
+        _eager.allgather(_to_jax(tensor), name=name, process_set=process_set),
+        tensor,
+    )
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None,
+              process_set=None):
+    return _to_torch(
+        _eager.broadcast(_to_jax(tensor), root_rank, name=name,
+                         process_set=process_set),
+        tensor,
+    )
+
+
+def alltoall(tensor, splits=None, name: Optional[str] = None,
+             process_set=None):
+    return _to_torch(
+        _eager.alltoall(_to_jax(tensor), splits, name=name,
+                        process_set=process_set),
+        tensor,
+    )
+
+
+# ---- parameter/object plumbing (reference torch/functions.py) -----------
+
+def _tensor_to_numpy(torch, v):
+    v = v.detach().cpu()
+    if v.dtype == torch.bfloat16:
+        import ml_dtypes
+
+        return v.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+    return v.numpy()
+
+
+def broadcast_parameters(state_dict: Dict[str, Any], root_rank: int = 0):
+    """Broadcast a torch ``state_dict`` in place from ``root_rank``
+    (reference ``horovod/torch/functions.py:29`` — called on
+    ``model.state_dict()`` before training).
+
+    The whole dict ships as ONE broadcast (the reference batches its
+    parameter broadcasts the same way) rather than one collective per
+    tensor."""
+    torch = _torch()
+    payload = {
+        k: _tensor_to_numpy(torch, v) if torch.is_tensor(v) else v
+        for k, v in state_dict.items()
+    }
+    synced = _functions.broadcast_object(payload, root_rank=root_rank)
+    for k, v in state_dict.items():
+        if torch.is_tensor(v):
+            with torch.no_grad():
+                v.copy_(_to_torch(synced[k], v))
+        else:
+            state_dict[k] = synced[k]
+    return state_dict
+
+
+def broadcast_optimizer_state(optimizer, root_rank: int = 0):
+    """Broadcast a ``torch.optim`` state dict from ``root_rank`` as one
+    batched collective (reference ``functions.py:118``)."""
+    torch = _torch()
+
+    def to_wire(v):
+        if torch.is_tensor(v):
+            return ("__tensor__", _tensor_to_numpy(torch, v), str(v.dtype))
+        if isinstance(v, dict):
+            return {k: to_wire(x) for k, x in v.items()}
+        if isinstance(v, list):
+            return [to_wire(x) for x in v]
+        return v
+
+    def from_wire(v):
+        if isinstance(v, tuple) and len(v) == 3 and v[0] == "__tensor__":
+            dtype = getattr(torch, v[2].replace("torch.", ""))
+            ref = torch.empty(0, dtype=dtype)
+            return _to_torch(v[1], ref)
+        if isinstance(v, dict):
+            return {k: from_wire(x) for k, x in v.items()}
+        if isinstance(v, list):
+            return [from_wire(x) for x in v]
+        return v
+
+    synced = _functions.broadcast_object(
+        to_wire(optimizer.state_dict()), root_rank=root_rank
+    )
+    optimizer.load_state_dict(from_wire(synced))
+
+
+def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None):
+    return _functions.broadcast_object(obj, root_rank=root_rank)
+
+
+def allgather_object(obj, name: Optional[str] = None):
+    return _functions.allgather_object(obj)

@@ -23,8 +23,6 @@ shard; compose those *outside* via ``pre_update`` hooks or avoid them.
 
 from __future__ import annotations
 
-from typing import NamedTuple
-
 import jax
 import jax.numpy as jnp
 import optax
@@ -32,11 +30,6 @@ from jax import lax
 from jax.flatten_util import ravel_pytree
 
 from ..runtime import WORLD_AXIS
-
-
-class ZeroState(NamedTuple):
-    inner: optax.OptState  # shard-shaped leaves
-    shard_size: jnp.ndarray  # static-shaped scalar for pytree stability
 
 
 def sharded_gradient_transformation(
@@ -64,11 +57,9 @@ def sharded_gradient_transformation(
         shard_len = padded // world
         flat = jnp.pad(flat, (0, padded - n))
         my = lax.dynamic_slice(flat, (idx * shard_len,), (shard_len,))
-        return ZeroState(
-            inner=tx.init(my), shard_size=jnp.asarray(shard_len)
-        )
+        return tx.init(my)
 
-    def update_fn(grads, state: ZeroState, params=None):
+    def update_fn(grads, state, params=None):
         if params is None:
             raise ValueError("sharded optimizer requires params")
         gflat, _, n, world, padded = _shard_meta(grads)
@@ -84,12 +75,10 @@ def sharded_gradient_transformation(
         pshard = lax.dynamic_slice(
             jnp.pad(pflat, (0, padded - n)), (idx * shard_len,), (shard_len,)
         )
-        ushard, inner = tx.update(gshard, state.inner, pshard)
+        ushard, state = tx.update(gshard, state, pshard)
         # Assemble the full update vector; params stay replicated.
         uflat = lax.all_gather(ushard, axis, tiled=True)[:n]
-        return unravel(uflat), ZeroState(
-            inner=inner, shard_size=state.shard_size
-        )
+        return unravel(uflat), state
 
     return optax.GradientTransformation(init_fn, update_fn)
 
@@ -132,10 +121,7 @@ def zero_train_step(
             flat, _ = ravel_pytree(p)
             world = rt.size
             shard_len = -(-flat.shape[0] // world)
-            my = jnp.zeros((shard_len,), flat.dtype)
-            return ZeroState(
-                inner=tx.init(my), shard_size=jnp.asarray(shard_len)
-            )
+            return tx.init(jnp.zeros((shard_len,), flat.dtype))
 
         shape = jax.eval_shape(abstract_init, params)
         return jax.tree.map(

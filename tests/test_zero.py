@@ -64,7 +64,7 @@ def test_zero_state_is_sharded(hvd_module):
     # each adam moment leaf is a global array of padded_n elements,
     # sharded across the 8 devices — not replicated N copies
     n_params = 16 * 16 + 16
-    mu = st.inner[0].mu
+    mu = st[0].mu
     assert mu.shape[0] >= n_params and mu.shape[0] % N == 0
     shardings = mu.sharding.device_set
     assert len(shardings) == N
