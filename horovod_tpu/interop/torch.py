@@ -38,27 +38,22 @@ def _to_jax(t):
         raise TypeError(f"expected a torch.Tensor, got {type(t)!r}")
     import jax.numpy as jnp
 
-    t = t.detach()
-    if t.device.type != "cpu":
-        t = t.cpu()
-    if t.dtype == torch.bfloat16:
-        # numpy has no native bf16; bit-cast through uint16 so the wire
-        # dtype stays bf16 end to end (no precision round-trip).
-        import ml_dtypes
+    import jax as _jax
 
-        return jnp.asarray(
-            t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
-        )
-    if t.dtype in (torch.int64, torch.float64):
+    if (
+        t.dtype in (torch.int64, torch.float64)
+        and not _jax.config.jax_enable_x64
+    ):
         # JAX's default x64-disabled mode would silently truncate to
         # 32 bits and _to_torch would mask it by casting back — refuse.
         raise TypeError(
             f"{t.dtype} tensors would be silently truncated to 32 bits "
-            "by JAX (x64 disabled); cast to a 32-bit dtype first"
+            "by JAX (x64 disabled); cast to a 32-bit dtype first or "
+            "enable jax_enable_x64"
         )
     # numpy view is zero-copy from torch; jnp.asarray copies onto the
     # accelerator once (unavoidable: the collective runs there).
-    return jnp.asarray(t.numpy())
+    return jnp.asarray(_tensor_to_numpy(torch, t))
 
 
 def _to_torch(x, like):
@@ -123,10 +118,21 @@ def alltoall(tensor, splits=None, name: Optional[str] = None,
 def _tensor_to_numpy(torch, v):
     v = v.detach().cpu()
     if v.dtype == torch.bfloat16:
+        # numpy has no native bf16; bit-cast through uint16 so the wire
+        # dtype stays bf16 end to end (no precision round-trip).
         import ml_dtypes
 
-        return v.view(torch.uint16).numpy().view(ml_dtypes.bfloat16)
+        return v.contiguous().view(torch.uint16).numpy().view(
+            ml_dtypes.bfloat16
+        )
     return v.numpy()
+
+
+def _is_single_process() -> bool:
+    from .. import runtime
+
+    rt = runtime.get_runtime_or_none()
+    return rt is None or rt.process_count == 1
 
 
 def broadcast_parameters(state_dict: Dict[str, Any], root_rank: int = 0):
@@ -137,6 +143,8 @@ def broadcast_parameters(state_dict: Dict[str, Any], root_rank: int = 0):
     The whole dict ships as ONE broadcast (the reference batches its
     parameter broadcasts the same way) rather than one collective per
     tensor."""
+    if _is_single_process():
+        return state_dict  # nothing to sync; skip the encode/copy pass
     torch = _torch()
     payload = {
         k: _tensor_to_numpy(torch, v) if torch.is_tensor(v) else v
@@ -155,6 +163,8 @@ def broadcast_parameters(state_dict: Dict[str, Any], root_rank: int = 0):
 def broadcast_optimizer_state(optimizer, root_rank: int = 0):
     """Broadcast a ``torch.optim`` state dict from ``root_rank`` as one
     batched collective (reference ``functions.py:118``)."""
+    if _is_single_process():
+        return
     torch = _torch()
 
     def to_wire(v):

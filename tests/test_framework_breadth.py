@@ -1,0 +1,75 @@
+"""Framework-binding breadth: the reference binds TF/torch/MXNet/Keras
+(SURVEY.md §2.3); our surface is pytree-native, so any JAX framework
+plugs in unchanged.  These tests pin that claim for dm-haiku and
+HuggingFace transformers-flax (both common in TPU shops), alongside the
+flax models used everywhere else and the torch adapter in
+test_interop.py."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvd
+
+
+def test_haiku_model_trains(hvd_module):
+    haiku = pytest.importorskip("haiku")
+
+    def net_fn(x):
+        return haiku.nets.MLP([16, 4])(x)
+
+    net = haiku.without_apply_rng(haiku.transform(net_fn))
+    rng = np.random.RandomState(0)
+    x = rng.randn(32, 8).astype(np.float32)
+    y = (x.sum(axis=1) > 0).astype(np.int32) % 4
+
+    params = net.init(jax.random.PRNGKey(0), jnp.zeros((1, 8)))
+    params = hvd.broadcast_parameters(params, root_rank=0)
+
+    def loss_fn(p, batch):
+        xb, yb = batch
+        return optax.softmax_cross_entropy_with_integer_labels(
+            net.apply(p, xb), yb
+        ).mean()
+
+    tx = hvd.DistributedOptimizer(optax.adam(1e-2))
+    step = hvd.distributed_train_step(loss_fn, tx)
+    st = step.init(params)
+    losses = []
+    for _ in range(10):
+        params, st, loss = step(params, st, (jnp.asarray(x), jnp.asarray(y)))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0]
+
+
+def test_transformers_flax_gpt2_trains(hvd_module):
+    transformers = pytest.importorskip("transformers")
+    from transformers import FlaxGPT2LMHeadModel, GPT2Config
+
+    config = GPT2Config(
+        vocab_size=128, n_positions=32, n_embd=32, n_layer=2, n_head=2,
+    )
+    model = FlaxGPT2LMHeadModel(config, seed=0)  # random init, no download
+    params = hvd.broadcast_parameters(model.params, root_rank=0)
+
+    rng = np.random.RandomState(0)
+    toks = rng.randint(0, 128, (8, 16)).astype(np.int32)
+
+    def loss_fn(p, batch):
+        input_ids = batch[0]
+        logits = model(input_ids=input_ids, params=p).logits
+        onehot = jax.nn.one_hot(input_ids[:, 1:], 128)
+        return -jnp.mean(
+            jnp.sum(jax.nn.log_softmax(logits[:, :-1]) * onehot, -1)
+        )
+
+    tx = hvd.DistributedOptimizer(optax.adamw(5e-3))
+    step = hvd.distributed_train_step(loss_fn, tx)
+    st = step.init(params)
+    losses = []
+    for _ in range(8):
+        params, st, loss = step(params, st, (jnp.asarray(toks),))
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
