@@ -36,6 +36,8 @@ def main():
     parser.add_argument("--momentum", type=float, default=0.5)
     parser.add_argument("--use-adasum", action="store_true",
                         help="use Adasum gradient combining")
+    parser.add_argument("--num-samples", type=int, default=8192,
+                        help="synthetic dataset size (shrink for smoke tests)")
     args = parser.parse_args()
 
     hvd.init()  # reference: hvd.init()
@@ -61,8 +63,13 @@ def main():
     step = hvd.distributed_train_step(loss_fn, tx)
     opt_state = step.init(params)
 
-    X, Y = synthetic_mnist()
+    X, Y = synthetic_mnist(n=args.num_samples)
     steps_per_epoch = len(X) // global_batch
+    if steps_per_epoch < 1:
+        raise SystemExit(
+            f"--num-samples {args.num_samples} < global batch "
+            f"{global_batch}; nothing to train"
+        )
     for epoch in range(args.epochs):
         perm = np.random.RandomState(epoch).permutation(len(X))
         for i in range(steps_per_epoch):

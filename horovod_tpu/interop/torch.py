@@ -131,8 +131,9 @@ def _tensor_to_numpy(torch, v):
 def _is_single_process() -> bool:
     from .. import runtime
 
-    rt = runtime.get_runtime_or_none()
-    return rt is None or rt.process_count == 1
+    # get_runtime (not _or_none): an uninitialized runtime must raise,
+    # not silently no-op a broadcast the caller is counting on.
+    return runtime.get_runtime().process_count == 1
 
 
 def broadcast_parameters(state_dict: Dict[str, Any], root_rank: int = 0):

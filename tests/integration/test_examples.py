@@ -1,0 +1,63 @@
+"""End-to-end example runs (reference ``test/integration`` tier: real
+subprocess jobs).  Each example executes with tiny settings on the
+8-device virtual CPU mesh."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def _run_example(script, *args, timeout=420):
+    env = dict(os.environ)
+    env.update({
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+        "JAX_PLATFORMS": "cpu",
+        # PYTHONPATH is the repo ONLY — an inherited accelerator-plugin
+        # site dir (e.g. the axon TPU relay) would register a PJRT
+        # backend whose init dials remote hardware and can hang for
+        # minutes, even with JAX_PLATFORMS=cpu in the env.
+        "PYTHONPATH": REPO,
+    })
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "examples", script), *args],
+        env=env, capture_output=True, text=True, timeout=timeout,
+    )
+    assert proc.returncode == 0, (
+        f"{script} failed:\n{proc.stdout[-2000:]}\n{proc.stderr[-2000:]}"
+    )
+    return proc.stdout
+
+
+def test_mnist_example():
+    out = _run_example("mnist.py", "--epochs", "1", "--batch-size", "8",
+                       "--num-samples", "256")
+    assert "loss" in out.lower()
+
+
+def test_process_sets_example():
+    out = _run_example("process_sets.py")
+    assert "even-team avg: 3.0" in out
+    assert "odd-team avg: 4.0" in out
+
+
+def test_synthetic_benchmark_example():
+    out = _run_example(
+        "synthetic_benchmark.py", "--model", "resnet50",
+        "--image-size", "32", "--batch-size", "2",
+        "--num-warmup-batches", "1", "--num-batches-per-iter", "1",
+        "--num-iters", "1",
+    )
+    assert "Img/sec per chip" in out
+
+
+def test_gpt_pretrain_example():
+    out = _run_example(
+        "gpt_pretrain.py", "--dp", "2", "--sp", "2", "--tp", "2",
+        "--steps", "3", "--seq-per-sp", "32",
+    )
+    assert "mesh dp2/sp2/tp2" in out

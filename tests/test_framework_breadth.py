@@ -23,7 +23,7 @@ def test_haiku_model_trains(hvd_module):
     net = haiku.without_apply_rng(haiku.transform(net_fn))
     rng = np.random.RandomState(0)
     x = rng.randn(32, 8).astype(np.float32)
-    y = (x.sum(axis=1) > 0).astype(np.int32) % 4
+    y = (np.abs(x.sum(axis=1)) * 10).astype(np.int32) % 4
 
     params = net.init(jax.random.PRNGKey(0), jnp.zeros((1, 8)))
     params = hvd.broadcast_parameters(params, root_rank=0)
