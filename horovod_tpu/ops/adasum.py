@@ -7,21 +7,33 @@ for a pair of gradients a, b the combination
     a' = (1 - dot(a,b) / (2*||a||^2)) * a + (1 - dot(a,b) / (2*||b||^2)) * b
 
 is scale-invariant (orthogonal gradients add, parallel gradients
-average), applied recursively over a binary tree of ranks (the
-reference's recursive vector-halving / distance-doubling,
-``adasum_mpi.cc``).
+average), applied over a binary tree of ranks.
 
-Here each of the log2(n) levels is one ``ppermute`` partner exchange over
-the ICI mesh plus fused elementwise math — no point-to-point MPI.  Dot
-products and norms are computed in fp32 regardless of input dtype, like
-the reference's fp16 AVX kernels accumulating in fp32 (``adasum.h:439+``).
-Set sizes must be powers of two (the reference's recursive tree also
-requires this, padding odd worlds via its MPI communicator construction).
+Communication schedule: **vector-halving / distance-doubling** like the
+reference's fused path (``adasum.h:380-439``, ``adasum_mpi.cc``):
+
+  * level l exchanges *half* of the current segment with partner
+    ``i XOR 2^l`` (one ``ppermute`` of V/2^(l+1) elements), so total
+    per-rank traffic is O(V), not O(V log n);
+  * the pair coefficients need dot/norm of the *logical* subtree
+    vectors, which after halving live distributed across the merging
+    group — a 3-scalar ``psum`` over that group per level supplies
+    them (the reference's ``SumAllreduceWithComm`` of
+    {anormsq, bnormsq, dot} over ``reduction_comms_[l]``);
+  * a final tiled ``all_gather`` + static bit-reversal reorder
+    reconstructs the full vector on every rank.
+
+Non-power-of-two sets fold stragglers first: the k - p extra ranks
+(p = largest power of two <= k) each pair-combine into a core rank
+before the tree runs, like the reference's communicator construction
+folding odd worlds.  Dot products and norms are fp32 regardless of
+input dtype, like the reference's fp16 AVX kernels accumulating in
+fp32 (``adasum.h:439+``).
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -44,51 +56,130 @@ def _adasum_pair(a: jax.Array, b: jax.Array) -> jax.Array:
     return (ca * af + cb * bf).astype(a.dtype)
 
 
+def _bitrev(i: int, bits: int) -> int:
+    out = 0
+    for _ in range(bits):
+        out = (out << 1) | (i & 1)
+        i >>= 1
+    return out
+
+
 def adasum_allreduce(
     x: jax.Array,
     axis: str = WORLD_AXIS,
     process_set: Optional[ProcessSet] = None,
 ) -> jax.Array:
-    """Recursive-doubling Adasum over a mesh axis.
+    """Vector-halving / distance-doubling Adasum over a mesh axis.
 
-    Level l exchanges full vectors with the partner rank ``r XOR 2^l``
-    (one ppermute per level) and combines adaptively; after log2(n)
-    levels every rank holds the Adasum of all n contributions.
+    Any set size works (stragglers fold in pairwise first).  Members
+    receive the Adasum of all member contributions; non-members of
+    ``process_set`` pass their input through unchanged.
     """
     n = lax.axis_size(axis)
     ranks = list(process_set.ranks) if process_set is not None else list(range(n))
     k = len(ranks)
-    if k & (k - 1):
-        raise ValueError(
-            f"Adasum requires a power-of-two set size, got {k} "
-            "(reference adasum_mpi.cc builds a power-of-two reduction tree)"
-        )
     if k == 1:
         return x
-
-    idx = lax.axis_index(axis)
-    if process_set is not None and k != n:
-        mask_tab = np.zeros((n,), dtype=np.bool_)
-        for r in ranks:
-            mask_tab[r] = True
-        mask = jnp.asarray(mask_tab)[idx]
+    p = 1 << (k.bit_length() - 1)  # largest power of two <= k
+    if p == k:
+        extras = 0
     else:
-        mask = None
+        extras = k - p
+    levels = p.bit_length() - 1
 
-    y = x
-    level = 1
-    while level < k:
-        # Partner permutation in set-relative coordinates.
-        perm = []
-        pos = {r: i for i, r in enumerate(ranks)}
-        for r in range(n):
-            if r in pos:
-                partner = ranks[pos[r] ^ level]
-                perm.append((r, partner))
-            else:
-                perm.append((r, r))
-        partner_val = lax.ppermute(y, axis, perm=perm)
-        combined = _adasum_pair(y, partner_val)
-        y = combined if mask is None else jnp.where(mask, combined, y)
-        level <<= 1
-    return y
+    # Communication stays in the input dtype (the reference's fp16 path
+    # moves fp16 on the wire, adasum.h:439+); only the scalar dot/norm
+    # accumulation below runs in fp32.
+    shape, dtype = x.shape, x.dtype
+    idx = lax.axis_index(axis)
+    flat = x.reshape(-1)
+    size = flat.shape[0]
+    seg = -(-size // p)  # ceil
+    padded = seg * p
+    if padded != size:
+        flat = jnp.pad(flat, (0, padded - size))
+
+    member = np.zeros((n,), np.bool_)
+    for r in ranks:
+        member[r] = True
+    member_mask = jnp.asarray(member)[idx]
+
+    def self_loops(pairs):
+        # ppermute needs unique sources and unique destinations; ranks
+        # outside the exchange keep their value via a self-loop (ranks
+        # that send without receiving simply get zeros, which is fine —
+        # their buffer is dead after the send).
+        srcs = {a for a, _ in pairs}
+        dsts = {b for _, b in pairs}
+        return pairs + [
+            (r, r) for r in range(n) if r not in srcs and r not in dsts
+        ]
+
+    # ---- fold phase: extras pair-combine into the first `extras` cores.
+    y = flat
+    if extras:
+        perm = self_loops(
+            [(ranks[p + i], ranks[i]) for i in range(extras)]
+        )
+        recv = lax.ppermute(y, axis, perm=perm)
+        fold_tab = np.zeros((n,), np.bool_)
+        for i in range(extras):
+            fold_tab[ranks[i]] = True
+        fold_mask = jnp.asarray(fold_tab)[idx]
+        y = jnp.where(fold_mask, _adasum_pair(y, recv), y)
+
+    # ---- VHDD tree over the p core members (ranks[:p]).
+    core = ranks[:p]
+    core_tab = np.full((n,), 0, np.int64)
+    core_member = np.zeros((n,), np.bool_)
+    for i, r in enumerate(core):
+        core_tab[r] = i
+        core_member[r] = True
+    my_core = jnp.asarray(core_tab)[idx]  # member index (garbage off-core)
+    core_mask = jnp.asarray(core_member)[idx]
+
+    for level in range(levels):
+        d = 1 << level
+        half = y.shape[0] // 2
+        bit = (my_core >> level) & 1
+        keep = lax.dynamic_slice(y, (bit * half,), (half,))
+        send = lax.dynamic_slice(y, ((1 - bit) * half,), (half,))
+        perm = self_loops([(core[i], core[i ^ d]) for i in range(p)])
+        recv = lax.ppermute(send, axis, perm=perm)
+
+        keep32 = keep.astype(jnp.float32)
+        recv32 = recv.astype(jnp.float32)
+        dot = jnp.sum(keep32 * recv32)
+        n_keep = jnp.sum(keep32 * keep32)
+        n_recv = jnp.sum(recv32 * recv32)
+        # Subtree role: lower-half ranks (bit 0) hold "a" pieces.
+        na_c = jnp.where(bit == 0, n_keep, n_recv)
+        nb_c = jnp.where(bit == 0, n_recv, n_keep)
+        # Per-merging-group scalar sums via a slotted psum (XLA has no
+        # unequal replica groups through lax.psum; one tiny (p/2d, 3)
+        # all-reduce replaces the reference's per-communicator
+        # SumAllreduceWithComm).
+        ngroups = p // (2 * d)
+        my_group = my_core // (2 * d)
+        scalars = jnp.stack([dot, na_c, nb_c])
+        scalars = jnp.where(core_mask, scalars, jnp.zeros_like(scalars))
+        slots = jnp.zeros((ngroups, 3), jnp.float32).at[my_group].set(scalars)
+        sums = lax.psum(slots, axis)
+        s = sums[my_group]
+        g_dot, g_na, g_nb = s[0], s[1], s[2]
+        ca = jnp.where(g_na > 0, 1.0 - g_dot / (2.0 * g_na), 1.0)
+        cb = jnp.where(g_nb > 0, 1.0 - g_dot / (2.0 * g_nb), 1.0)
+        c_keep = jnp.where(bit == 0, ca, cb)
+        c_recv = jnp.where(bit == 0, cb, ca)
+        y = (c_keep * keep32 + c_recv * recv32).astype(dtype)
+
+    # ---- reconstruct: gather segments, undo the bit-reversal layout.
+    gathered = lax.all_gather(y, axis, tiled=True).reshape(n, seg)
+    rows = np.asarray(
+        [core[_bitrev(j, levels)] for j in range(p)], np.int32
+    )
+    result = gathered[jnp.asarray(rows)].reshape(padded)[:size]
+    result = result.reshape(shape).astype(dtype)
+    if process_set is not None and k != n:
+        return jnp.where(member_mask, result, x)
+    return result
