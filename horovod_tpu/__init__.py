@@ -54,6 +54,12 @@ from .ops.eager import (  # noqa: F401
     reducescatter,
     synchronize,
 )
+from .ops.sparse import (  # noqa: F401
+    IndexedSlices,
+    dense_grad_to_indexed_slices,
+    sparse_allreduce,
+    sparse_allreduce_eager,
+)
 
 init = _runtime.init
 shutdown = _runtime.shutdown

@@ -52,6 +52,12 @@ class Handle:
             return True
 
     def wait(self):
+        try:
+            wd = get_runtime().stall_watchdog
+        except Exception:  # after shutdown: plain unguarded wait
+            wd = None
+        if wd is not None:
+            return wd.wait(self.value, self.name or "collective")
         jax.block_until_ready(self.value)
         return self.value
 

@@ -28,6 +28,18 @@ from ..utils import env
 
 Meta = Tuple[Any, ...]
 
+# Trace-time fusion-threshold override, set by the autotune driver while a
+# step recompiles under a candidate threshold (the TPU analog of
+# ParameterManager pushing a new HOROVOD_FUSION_THRESHOLD into the running
+# background loop, parameter_manager.h:42-105).  Only consulted when the
+# caller did not pass an explicit threshold.
+_threshold_override: int | None = None
+
+
+def set_threshold_override(threshold_bytes: int | None) -> None:
+    global _threshold_override
+    _threshold_override = threshold_bytes
+
 
 def flatten_group(xs: Sequence[jax.Array]) -> Tuple[List[jax.Array], Meta]:
     """Concatenate tensors into one flat 1-D buffer per dtype.
@@ -78,9 +90,12 @@ def bucket_plan(
     ``HOROVOD_FUSION_THRESHOLD=0``.
     """
     if threshold_bytes is None:
-        threshold_bytes = env.get_int(
-            env.FUSION_THRESHOLD, env.DEFAULT_FUSION_THRESHOLD
-        )
+        if _threshold_override is not None:
+            threshold_bytes = _threshold_override
+        else:
+            threshold_bytes = env.get_int(
+                env.FUSION_THRESHOLD, env.DEFAULT_FUSION_THRESHOLD
+            )
     if threshold_bytes <= 0:
         return [[i] for i in range(len(sizes_bytes))]
     # Prefer the native planner (cpp/src/fusion.cc) when built.

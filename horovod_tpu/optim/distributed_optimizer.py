@@ -65,12 +65,93 @@ def _reduce_gradients(
     process_set: Optional[ProcessSet],
     fusion_threshold_bytes: Optional[int],
     groups: Optional[Sequence[Sequence[int]]] = None,
+    sparse_as_dense: bool = False,
 ) -> Any:
     """Bucket, compress, and allreduce a gradient pytree as few fused
-    collectives (the FuseResponses + fusion-buffer path, compiled)."""
-    leaves, treedef = jax.tree.flatten(grads)
+    collectives (the FuseResponses + fusion-buffer path, compiled).
+
+    ``IndexedSlices`` leaves take the sparse path — allgather of slices
+    (reference ``tensorflow/__init__.py:95-162``) — then densify locally
+    for the inner optimizer; ``sparse_as_dense=True`` densifies *before*
+    the reduction instead (reference ``torch/optimizer.py``
+    ``sparse_as_dense``), trading wire bytes for one fused collective.
+    """
+    from ..ops.sparse import IndexedSlices, densify, sparse_allreduce
+
+    is_sparse = lambda x: isinstance(x, IndexedSlices)
+    if sparse_as_dense:
+        grads = jax.tree.map(
+            lambda g: densify(g) if is_sparse(g) else g, grads,
+            is_leaf=is_sparse,
+        )
+    leaves, treedef = jax.tree.flatten(grads, is_leaf=is_sparse)
     if not leaves:
         return grads
+    sparse_idx = [i for i, g in enumerate(leaves) if is_sparse(g)]
+    if sparse_idx:
+        if op not in (Average, Sum):
+            raise ValueError(
+                "IndexedSlices gradients support op=Average or Sum only "
+                "(the reference's sparse path is allgather-based and has "
+                "no Adasum variant); pass sparse_as_dense=True to adasum "
+                "embedding gradients as dense tensors"
+            )
+
+        def reduce_sparse(s: IndexedSlices) -> jax.Array:
+            # Same wire semantics as the dense path: compress the
+            # payload, prescale before the collective, postscale after.
+            wire, ctx = compression.compress(s.values)
+            if prescale_factor != 1.0:
+                wire = wire * jnp.asarray(prescale_factor, wire.dtype)
+            out = sparse_allreduce(
+                IndexedSlices(s.indices, wire, s.dense_shape),
+                axis=axis, op=op, process_set=process_set,
+            )
+            vals = compression.decompress(out.values, ctx)
+            if postscale_factor != 1.0:
+                vals = vals * jnp.asarray(postscale_factor, vals.dtype)
+            reduced = densify(
+                IndexedSlices(out.indices, vals, s.dense_shape)
+            )
+            if process_set is not None:
+                # Non-members keep their own local gradient (the dense
+                # path's jnp.where(mask, y, x) pass-through,
+                # traced.py:236); allgather hands them zeros or foreign
+                # slices instead, so mask at the densified level.
+                from ..ops.traced import _set_info
+
+                _, mask, _, _ = _set_info(axis, process_set)
+                if mask is not None:
+                    reduced = jnp.where(mask, reduced, densify(s))
+            return reduced
+
+        sparse_set = set(sparse_idx)
+        dense_pos = [i for i in range(len(leaves)) if i not in sparse_set]
+        if groups is not None:
+            # Remap explicit group indices onto the dense-only leaf list.
+            old_to_new = {old: new for new, old in enumerate(dense_pos)}
+            bad = [i for g in groups for i in g if i in sparse_set]
+            if bad:
+                raise ValueError(
+                    f"groups reference IndexedSlices leaves {bad}; sparse "
+                    "gradients cannot join fusion groups (they reduce as "
+                    "allgather-of-slices, not fused allreduce)"
+                )
+            groups = [[old_to_new[i] for i in g] for g in groups]
+        reduced_sparse = {i: reduce_sparse(leaves[i]) for i in sparse_idx}
+        dense_reduced = _reduce_gradients(
+            [leaves[i] for i in dense_pos],
+            axis=axis, op=op, compression=compression,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor, process_set=process_set,
+            fusion_threshold_bytes=fusion_threshold_bytes, groups=groups,
+        )
+        out = list(leaves)
+        for i, t in zip(dense_pos, dense_reduced):
+            out[i] = t
+        for i, t in reduced_sparse.items():
+            out[i] = t
+        return jax.tree.unflatten(treedef, out)
 
     compressed = [compression.compress(g) for g in leaves]
     wire = [c[0] for c in compressed]
@@ -126,13 +207,18 @@ def DistributedOptimizer(
     process_set: Optional[ProcessSet] = None,
     fusion_threshold_bytes: Optional[int] = None,
     groups: Optional[Sequence[Sequence[int]]] = None,
+    sparse_as_dense: bool = False,
     axis=WORLD_AXIS,
 ) -> optax.GradientTransformation:
     """Wrap an optax transform with distributed gradient reduction.
 
     Mirrors ``hvd.DistributedOptimizer`` keyword-for-keyword where the
-    concept survives on TPU (no ``named_parameters``/``sparse_as_dense``:
-    JAX gradients are a dense pytree by construction).
+    concept survives on TPU (no ``named_parameters``: JAX gradients are
+    a named pytree by construction).  Gradient pytrees may carry
+    :class:`~horovod_tpu.ops.sparse.IndexedSlices` leaves (from
+    ``dense_grad_to_indexed_slices``); those reduce as allgather-of-
+    slices unless ``sparse_as_dense=True`` densifies them first
+    (reference ``torch/optimizer.py`` knob of the same name).
     """
     if gradient_predivide_factor != 1.0:
         if op != Average:
@@ -159,6 +245,7 @@ def DistributedOptimizer(
             process_set=process_set,
             fusion_threshold_bytes=fusion_threshold_bytes,
             groups=groups,
+            sparse_as_dense=sparse_as_dense,
         )
 
     def init_fn(params):
@@ -182,7 +269,15 @@ def DistributedOptimizer(
         # Local gradient aggregation (reference
         # LocalGradientAggregationHelper / optimizer.py
         # backward_passes_per_step): accumulate locally, reduce + step
-        # every k-th call, zero updates in between.
+        # every k-th call, zero updates in between.  Sparse leaves
+        # densify into the (dense) accumulator, like the reference's
+        # aggregation helper which only handles dense buffers.
+        from ..ops.sparse import IndexedSlices as _IS, densify as _densify
+
+        grads = jax.tree.map(
+            lambda g: _densify(g) if isinstance(g, _IS) else g, grads,
+            is_leaf=lambda x: isinstance(x, _IS),
+        )
         acc = jax.tree.map(lambda a, g: a + g, state.acc, grads)
         counter = state.counter + 1
         boundary = (counter % k) == 0
@@ -204,6 +299,10 @@ def DistributedOptimizer(
         updates, acc, inner = lax.cond(boundary, do_step, no_step, (acc, state.inner))
         return updates, DistributedOptimizerState(counter=counter, acc=acc, inner=inner)
 
+    # Autotune eligibility marker: with an explicit threshold the trace-
+    # time override in fusion.bucket_plan is never consulted, so TrainStep
+    # must not burn recompiles exploring candidates that change nothing.
+    update_fn._hvd_fusion_threshold = fusion_threshold_bytes
     return optax.GradientTransformation(init_fn, update_fn)
 
 
@@ -286,9 +385,13 @@ class TrainStep:
                 opt_state = opt_state._replace(
                     acc=jax.tree.map(lambda a: a[0], opt_state.acc)
                 )
-            loss, model_state, aux, grads = compute_grads(params, model_state, batch)
-            updates, opt_state = optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
+            with jax.named_scope("hvd_compute_grads"):
+                loss, model_state, aux, grads = compute_grads(
+                    params, model_state, batch
+                )
+            with jax.named_scope("hvd_reduce_and_update"):
+                updates, opt_state = optimizer.update(grads, opt_state, params)
+                params = optax.apply_updates(params, updates)
             loss = lax.pmean(loss, axis)
             if isinstance(opt_state, DistributedOptimizerState) and opt_state.acc is not None:
                 opt_state = opt_state._replace(
@@ -325,6 +428,45 @@ class TrainStep:
         self._batch_spec = batch_spec
         self._state_specs = state_specs
 
+        # Transparent autotuning (reference ParameterManager,
+        # parameter_manager.h:42-105): with HVD_TPU_AUTOTUNE=1 the step
+        # drives suggest -> recompile-under-threshold -> observe windows
+        # by itself and freezes on the winner.  Each candidate threshold
+        # is its own compiled variant (threshold is a trace-time
+        # constant), keyed into the step cache.
+        from ..utils import env as _env
+
+        self._autotune = None
+        # Eligible only for a DistributedOptimizer without an explicit
+        # threshold: the marker must be PRESENT and None — a plain optax
+        # transform (no marker) never consults the fusion threshold, so
+        # exploring candidates would recompile for nothing.
+        marker = getattr(optimizer.update, "_hvd_fusion_threshold", "absent")
+        if _env.get_bool(_env.AUTOTUNE) and marker is None:
+            from ..utils.autotune import AutotuneDriver
+
+            self._autotune = AutotuneDriver()
+        self._mark_cycles = _env.get_bool(_env.TIMELINE_MARK_CYCLES)
+
+    def _build_step(self, specs):
+        in_specs = (self._param_spec, P(), specs, self._batch_spec)
+        out_specs = (self._param_spec,)
+        if self.stateful:
+            out_specs += (P(),)
+        out_specs += (specs, P())
+        if self.has_aux and not self.stateful:
+            out_specs += (P(),)
+        return jax.jit(
+            jax.shard_map(
+                self._step_body,
+                mesh=self.mesh,
+                in_specs=in_specs,
+                out_specs=out_specs,
+                check_vma=False,
+            ),
+            donate_argnums=(0, 1, 2),
+        )
+
     def __call__(self, params, *args):
         if self.stateful:
             model_state, opt_state, batch = args
@@ -332,28 +474,38 @@ class TrainStep:
             opt_state, batch = args
             model_state = None
         specs = self._state_specs(opt_state)
-        key = (jax.tree.structure(opt_state), jax.tree.structure(model_state))
+        threshold = None
+        if self._autotune is not None:
+            threshold = self._autotune.threshold_bytes()
+        key = (
+            jax.tree.structure(opt_state),
+            jax.tree.structure(model_state),
+            threshold,
+        )
         fn = self._step_cache.get(key)
         if fn is None:
-            in_specs = (self._param_spec, P(), specs, self._batch_spec)
-            out_specs = (self._param_spec,)
-            if self.stateful:
-                out_specs += (P(),)
-            out_specs += (specs, P())
-            if self.has_aux and not self.stateful:
-                out_specs += (P(),)
-            fn = jax.jit(
-                jax.shard_map(
-                    self._step_body,
-                    mesh=self.mesh,
-                    in_specs=in_specs,
-                    out_specs=out_specs,
-                    check_vma=False,
-                ),
-                donate_argnums=(0, 1, 2),
-            )
+            fn = self._build_step(specs)
             self._step_cache[key] = fn
-        return fn(params, model_state, opt_state, batch)
+
+        rt = get_runtime()
+        tl = rt.timeline
+        if tl is not None:
+            tl.begin("TrainStep", "STEP")
+        try:
+            # Tracing for a new cache entry happens inside this call, so
+            # the candidate threshold must be visible to bucket_plan now.
+            fusion.set_threshold_override(threshold)
+            with jax.profiler.TraceAnnotation("hvd_train_step"):
+                out = fn(params, model_state, opt_state, batch)
+        finally:
+            fusion.set_threshold_override(None)
+            if tl is not None:
+                tl.end("TrainStep", "STEP")
+                if self._mark_cycles:
+                    tl.mark_cycle()
+        if self._autotune is not None:
+            self._autotune.after_step(out[-1])
+        return out
 
 
 def distributed_train_step(

@@ -79,6 +79,19 @@ class Runtime:
                 from .utils.timeline import Timeline
 
                 self.timeline = Timeline(timeline_path)
+        # Stall watchdog over blocking waits (reference stall_inspector.cc,
+        # warn default 60 s, stall_inspector.h:78). Disabled like the
+        # reference via HOROVOD_STALL_CHECK_DISABLE.
+        self.stall_watchdog = None
+        if not env.get_bool(env.STALL_CHECK_DISABLE):
+            from .utils.stall import StallWatchdog
+
+            self.stall_watchdog = StallWatchdog(
+                warn_seconds=env.get_float(env.STALL_CHECK_TIME_SECONDS, 60.0),
+                shutdown_seconds=env.get_float(
+                    env.STALL_SHUTDOWN_TIME_SECONDS, 0.0
+                ),
+            )
         get_logger().info(
             "initialized: %d device(s), %d process(es), platform=%s",
             self.size,
@@ -162,6 +175,9 @@ class Runtime:
         from .ops import eager
 
         eager.clear_cache()
+        if self.stall_watchdog is not None:
+            self.stall_watchdog.close()
+            self.stall_watchdog = None
         if self.timeline is not None:
             self.timeline.close()
             self.timeline = None

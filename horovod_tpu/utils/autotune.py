@@ -97,3 +97,63 @@ class FusionAutotuner:
     @property
     def converged(self) -> bool:
         return self._frozen is not None
+
+
+class AutotuneDriver:
+    """Transparent window loop over :class:`FusionAutotuner`.
+
+    The reference tunes *online*: ``ParameterManager::Update`` counts
+    reduced bytes per cycle, scores a window, and flips knobs without
+    user involvement (``parameter_manager.h:42-105``, ``.cc:118-170``).
+    This driver gives ``TrainStep`` the same hands-off behavior: it
+    owns the window bookkeeping (steps per window, wall-clock scoring
+    with a sync at each boundary, compile-step exclusion) and yields the
+    fusion threshold each step should trace with.
+
+    Protocol::
+
+        thr = driver.threshold_bytes()        # before building/running step
+        out = step(...)                       # possibly a recompile
+        driver.after_step(out)                # scores windows, advances
+
+    Scores are steps/sec over the window excluding its first step (which
+    pays the recompile for a new threshold — the reference excludes
+    warmup samples the same way).
+    """
+
+    def __init__(self, window_steps: Optional[int] = None, **tuner_kwargs):
+        import time as _time
+
+        self._time = _time
+        self.tuner = FusionAutotuner(**tuner_kwargs)
+        self.window_steps = window_steps or env.get_int("AUTOTUNE_WINDOW", 16)
+        self._steps_in_window = 0
+        self._t0: Optional[float] = None
+
+    def threshold_bytes(self) -> int:
+        return self.tuner.threshold_bytes()
+
+    @property
+    def converged(self) -> bool:
+        return self.tuner.converged
+
+    def after_step(self, out) -> None:
+        """Advance the window; ``out`` is any step output to sync on."""
+        if self.tuner.converged:
+            return
+        import jax
+
+        self._steps_in_window += 1
+        if self._steps_in_window == 1:
+            # First step of a window pays tracing+compile for the new
+            # threshold; fence it out of the timed region.
+            jax.block_until_ready(out)
+            self._t0 = self._time.perf_counter()
+            return
+        if self._steps_in_window >= self.window_steps:
+            jax.block_until_ready(out)
+            dt = self._time.perf_counter() - self._t0
+            timed_steps = self._steps_in_window - 1
+            self.tuner.observe(timed_steps / max(dt, 1e-9))
+            self._steps_in_window = 0
+            self._t0 = None

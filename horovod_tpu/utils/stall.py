@@ -1,0 +1,151 @@
+"""Stall detection for blocking collective waits.
+
+Reference: ``horovod/common/stall_inspector.{h,cc}`` — the background
+loop checks tensors pending longer than ``HOROVOD_STALL_CHECK_TIME_SECONDS``
+(default 60, ``stall_inspector.h:78``), warns with the offending names,
+and optionally aborts after ``HOROVOD_STALL_SHUTDOWN_TIME_SECONDS``.
+
+On TPU the collective itself executes inside a compiled XLA program, so
+the observable stall point is the *host-side wait* (``block_until_ready``
+/ a device->host transfer that never completes because a peer died or a
+DCN link hung).  ``StallWatchdog`` tracks named waits via the native
+``StallInspector`` (cpp/src/stall.cc) when built, or the pure-Python
+fallback below, and a daemon thread reports stalls periodically.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+from .logging import get_logger
+
+
+class PyStallInspector:
+    """Pure-Python mirror of the native StallInspector ABI."""
+
+    def __init__(self, warn_seconds: float = 60.0, shutdown_seconds: float = 0.0):
+        self.warn = warn_seconds
+        self.shutdown_after = shutdown_seconds
+        self._pending: dict = {}
+        self._lock = threading.Lock()
+
+    def begin(self, name: str) -> None:
+        with self._lock:
+            self._pending[name] = time.monotonic()
+
+    def end(self, name: str) -> None:
+        with self._lock:
+            self._pending.pop(name, None)
+
+    def report(self) -> Tuple[List[str], bool]:
+        now = time.monotonic()
+        stalled, shutdown = [], False
+        with self._lock:
+            for name, t0 in self._pending.items():
+                age = now - t0
+                if age >= self.warn:
+                    stalled.append(name)
+                if self.shutdown_after > 0 and age >= self.shutdown_after:
+                    shutdown = True
+        return stalled, shutdown
+
+    def close(self) -> None:
+        with self._lock:
+            self._pending.clear()
+
+
+class StallWatchdog:
+    """Daemon poll thread over a (native or Python) stall inspector.
+
+    ``wait(value, name)`` is the guarded replacement for
+    ``jax.block_until_ready`` on any cross-process-dependent wait: the
+    op is registered before blocking and cleared after, so the poll
+    thread can warn — the reference's background-loop check
+    (``operations.cc`` BackgroundThreadLoop -> CheckForStalledTensors)
+    recast for the host-wait world.
+    """
+
+    def __init__(
+        self,
+        warn_seconds: float = 60.0,
+        shutdown_seconds: float = 0.0,
+        on_stall: Optional[Callable[[List[str]], None]] = None,
+        poll_seconds: Optional[float] = None,
+    ):
+        from .. import native
+
+        if native.available():
+            self.inspector = native.StallInspector(warn_seconds, shutdown_seconds)
+        else:
+            self.inspector = PyStallInspector(warn_seconds, shutdown_seconds)
+        self.warn_seconds = warn_seconds
+        self.shutdown_seconds = shutdown_seconds
+        self._on_stall = on_stall
+        self._warned: set = set()
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._poll = poll_seconds or max(0.05, min(warn_seconds / 2.0, 10.0))
+        self._thread = threading.Thread(
+            target=self._loop, name="hvd_tpu_stall_watchdog", daemon=True
+        )
+        self._thread.start()
+
+    def wait(self, value, name: str):
+        import jax
+
+        # Concurrent or repeated waits may share a user-facing name
+        # (eager handles default to "collective"); key each wait
+        # uniquely so one finishing cannot clear another's pending
+        # entry.  The suffix is stripped for display in _loop.
+        with self._seq_lock:
+            self._seq += 1
+            key = f"{name}#{self._seq}"
+        self.inspector.begin(key)
+        try:
+            jax.block_until_ready(value)
+        finally:
+            self.inspector.end(key)
+            self._warned.discard(key)
+        return value
+
+    def begin(self, name: str) -> None:
+        self.inspector.begin(name)
+
+    def end(self, name: str) -> None:
+        self.inspector.end(name)
+        self._warned.discard(name)
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._poll):
+            try:
+                stalled, shutdown = self.inspector.report()
+            except Exception:
+                return  # inspector closed under us during shutdown
+            fresh = [s for s in stalled if s not in self._warned]
+            if fresh:
+                self._warned.update(fresh)
+                display = sorted({s.split("#", 1)[0] for s in fresh})
+                get_logger().warning(
+                    "One or more collectives stalled for over %.0fs. "
+                    "A peer process may have died or a network link hung. "
+                    "Stalled ops: %s",
+                    self.warn_seconds, ", ".join(display),
+                )
+                if self._on_stall is not None:
+                    self._on_stall(display)
+            if shutdown:
+                get_logger().critical(
+                    "Stall exceeded shutdown threshold (%.0fs); aborting "
+                    "(reference HOROVOD_STALL_SHUTDOWN_TIME_SECONDS semantics).",
+                    self.shutdown_seconds,
+                )
+                os._exit(134)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+        self.inspector.close()

@@ -1,0 +1,191 @@
+"""Hot-path observability: transparent autotune windows driven by
+TrainStep (reference ``parameter_manager.h:42-105``), timeline events
+from the compiled step (``common/timeline.cc``), and the stall
+watchdog over blocking waits (``stall_inspector.h:78``)."""
+
+import json
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.utils.stall import PyStallInspector, StallWatchdog
+
+
+@pytest.fixture(autouse=True)
+def _fresh_runtime():
+    # env-sensitive runtime construction: start each test uninitialized
+    # (init() is idempotent, so a leftover runtime would mask the env).
+    hvd.shutdown()
+    yield
+    hvd.shutdown()
+
+
+def _tiny_step(hvd_mod, n_params: int = 4):
+    params = {f"w{i}": jnp.ones((8, 8)) for i in range(n_params)}
+    tx = hvd_mod.DistributedOptimizer(optax.sgd(0.01))
+
+    def loss_fn(p, batch):
+        acc = 0.0
+        for k in sorted(p):
+            acc = acc + jnp.sum((batch @ p[k]) ** 2)
+        return acc
+
+    step = hvd_mod.distributed_train_step(loss_fn, tx)
+    opt_state = step.init(params)
+    batch = jnp.ones((8, 8))
+    return step, params, opt_state, batch
+
+
+class TestAutotuneDriven:
+    def test_threshold_changes_across_windows_and_freezes(self, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_AUTOTUNE", "1")
+        monkeypatch.setenv("HVD_TPU_AUTOTUNE_WINDOW", "2")
+        hvd.init()
+        try:
+            step, params, opt_state, batch = _tiny_step(hvd)
+            assert step._autotune is not None
+            seen = set()
+            for _ in range(40):
+                seen.add(step._autotune.threshold_bytes())
+                params, opt_state, loss = step(params, opt_state, batch)
+                if step._autotune.converged:
+                    break
+            assert step._autotune.converged, "driver never froze"
+            # The tuner explored more than one candidate threshold and
+            # each candidate produced its own compiled step variant.
+            assert len(seen) > 1
+            assert len(step._step_cache) > 1
+            frozen = step._autotune.threshold_bytes()
+            params, opt_state, loss = step(params, opt_state, batch)
+            assert step._autotune.threshold_bytes() == frozen
+            assert np.isfinite(float(loss))
+        finally:
+            hvd.shutdown()
+
+    def test_autotune_skipped_for_explicit_threshold(self, monkeypatch):
+        """An explicit fusion_threshold_bytes pins bucketing, so the
+        driver must not burn recompiles exploring no-op candidates."""
+        monkeypatch.setenv("HVD_TPU_AUTOTUNE", "1")
+        hvd.init()
+        try:
+            params = {"w": jnp.ones((4, 4))}
+            tx = hvd.DistributedOptimizer(
+                optax.sgd(0.01), fusion_threshold_bytes=1 << 20
+            )
+
+            def loss_fn(p, batch):
+                return jnp.sum((batch @ p["w"]) ** 2)
+
+            step = hvd.distributed_train_step(loss_fn, tx)
+            assert step._autotune is None
+        finally:
+            hvd.shutdown()
+
+    def test_autotune_off_single_variant(self, monkeypatch):
+        monkeypatch.delenv("HVD_TPU_AUTOTUNE", raising=False)
+        hvd.init()
+        try:
+            step, params, opt_state, batch = _tiny_step(hvd)
+            assert step._autotune is None
+            for _ in range(3):
+                params, opt_state, loss = step(params, opt_state, batch)
+            assert len(step._step_cache) == 1
+        finally:
+            hvd.shutdown()
+
+
+class TestTrainStepTimeline:
+    def test_timeline_records_step_events(self, monkeypatch, tmp_path):
+        path = tmp_path / "timeline.json"
+        monkeypatch.setenv("HVD_TPU_TIMELINE", str(path))
+        hvd.init()
+        try:
+            step, params, opt_state, batch = _tiny_step(hvd)
+            for _ in range(3):
+                params, opt_state, loss = step(params, opt_state, batch)
+            float(loss)
+        finally:
+            hvd.shutdown()  # closes + flushes the timeline
+        events = json.loads(path.read_text())
+        steps = [e for e in events if e.get("name") == "TrainStep"]
+        begins = [e for e in steps if e.get("ph") == "B"]
+        ends = [e for e in steps if e.get("ph") == "E"]
+        assert len(begins) == 3 and len(ends) == 3
+
+    def test_timeline_mark_cycles(self, monkeypatch, tmp_path):
+        path = tmp_path / "timeline.json"
+        monkeypatch.setenv("HVD_TPU_TIMELINE", str(path))
+        monkeypatch.setenv("HVD_TPU_TIMELINE_MARK_CYCLES", "1")
+        hvd.init()
+        try:
+            step, params, opt_state, batch = _tiny_step(hvd)
+            params, opt_state, loss = step(params, opt_state, batch)
+            float(loss)
+        finally:
+            hvd.shutdown()
+        events = json.loads(path.read_text())
+        assert any(e.get("ph") == "i" for e in events)
+
+
+class TestStallWatchdog:
+    def test_py_inspector_report(self):
+        ins = PyStallInspector(warn_seconds=0.05)
+        ins.begin("allreduce.grad")
+        time.sleep(0.1)
+        stalled, shutdown = ins.report()
+        assert stalled == ["allreduce.grad"]
+        assert not shutdown
+        ins.end("allreduce.grad")
+        assert ins.report() == ([], False)
+        ins.close()
+
+    def test_watchdog_warns_on_stall(self):
+        hits = []
+        wd = StallWatchdog(
+            warn_seconds=0.05, on_stall=hits.append, poll_seconds=0.02
+        )
+        try:
+            wd.begin("allgather.emb")
+            deadline = time.monotonic() + 2.0
+            while not hits and time.monotonic() < deadline:
+                time.sleep(0.02)
+            assert hits and "allgather.emb" in hits[0]
+            wd.end("allgather.emb")
+        finally:
+            wd.close()
+
+    def test_watchdog_quiet_on_fast_ops(self):
+        hits = []
+        wd = StallWatchdog(
+            warn_seconds=0.5, on_stall=hits.append, poll_seconds=0.02
+        )
+        try:
+            out = wd.wait(jnp.ones(4) * 2, "allreduce.fast")
+            assert float(out.sum()) == 8.0
+            time.sleep(0.1)
+            assert not hits
+        finally:
+            wd.close()
+
+    def test_runtime_owns_watchdog(self):
+        hvd.init()
+        try:
+            from horovod_tpu.runtime import get_runtime
+
+            assert get_runtime().stall_watchdog is not None
+        finally:
+            hvd.shutdown()
+
+    def test_disable_env(self, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_STALL_CHECK_DISABLE", "1")
+        hvd.init()
+        try:
+            from horovod_tpu.runtime import get_runtime
+
+            assert get_runtime().stall_watchdog is None
+        finally:
+            hvd.shutdown()
