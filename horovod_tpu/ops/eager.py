@@ -352,39 +352,45 @@ def alltoall(
         )
         return _delocalize(_jitted("alltoall", static)(x), was_local)
 
-    if process_set is not None and _ps_id(process_set) != 0:
-        raise NotImplementedError(
-            "alltoall with explicit splits is currently only supported on "
-            "the global process set (the padded chunk layout is built for "
-            "world ranks); use the equal-split form for subsets"
-        )
+    # Uneven splits, any process set: the reference negotiates
+    # recvsplits through the controller for arbitrary sets
+    # (collective_operations.h:209-272, controller.cc:483); here the
+    # splits matrix is in hand (single controller), so padding to the
+    # max split plays that role.  ``splits`` rows index *set members* in
+    # set order (world ranks for the global set).
+    members = (
+        list(process_set.ranks) if process_set is not None
+        and _ps_id(process_set) != 0 else list(range(n))
+    )
+    k = len(members)
     splits = np.asarray(splits)
-    if splits.shape != (n, n):
+    if splits.shape != (k, k):
         raise HorovodTpuError(
-            f"splits must have shape (size, size)=({n},{n}); got {splits.shape}"
+            f"splits must have shape (set_size, set_size)=({k},{k}); "
+            f"got {splits.shape}"
         )
     d0 = x.shape[1]
     if (splits.sum(axis=1) != d0).any():
         raise HorovodTpuError("each rank's splits must sum to its row count")
     max_chunk = int(splits.max())
-    # Pad each (r -> j) chunk to max_chunk host-side via gather indices,
-    # run the equal-split all_to_all, and return recv counts.
-    pad_idx = np.zeros((n, n * max_chunk), dtype=np.int32)
-    valid = np.zeros((n, n * max_chunk), dtype=bool)
+    # Pad each (member m -> member j) chunk to max_chunk host-side via
+    # gather indices, run the equal-split all_to_all, return recv counts.
+    pad_idx = np.zeros((n, k * max_chunk), dtype=np.int32)
+    valid = np.zeros((n, k * max_chunk), dtype=bool)
     offs = np.concatenate(
-        [np.zeros((n, 1), dtype=np.int64), np.cumsum(splits, axis=1)], axis=1
+        [np.zeros((k, 1), dtype=np.int64), np.cumsum(splits, axis=1)], axis=1
     )
-    for r in range(n):
-        for j in range(n):
-            c = int(splits[r, j])
+    for m, r in enumerate(members):
+        for j in range(k):
+            c = int(splits[m, j])
             base = j * max_chunk
-            pad_idx[r, base : base + c] = offs[r, j] + np.arange(c)
+            pad_idx[r, base : base + c] = offs[m, j] + np.arange(c)
             valid[r, base : base + c] = True
     gathered = jnp.take_along_axis(
-        x, jnp.asarray(pad_idx).reshape(n, n * max_chunk, *([1] * (x.ndim - 2))), axis=1
+        x, jnp.asarray(pad_idx).reshape(n, k * max_chunk, *([1] * (x.ndim - 2))), axis=1
     ) if x.ndim > 2 else jnp.take_along_axis(x, jnp.asarray(pad_idx), axis=1)
     gathered = jnp.where(
-        jnp.asarray(valid).reshape((n, n * max_chunk) + (1,) * (x.ndim - 2)),
+        jnp.asarray(valid).reshape((n, k * max_chunk) + (1,) * (x.ndim - 2)),
         gathered,
         jnp.zeros_like(gathered),
     )
@@ -392,12 +398,16 @@ def alltoall(
         ("process_set_id", _ps_id(process_set)),
     )
     out = _delocalize(_jitted("alltoall", static)(gathered), was_local)
-    recv_splits = splits.T  # recv_splits[r][j] = rows r gets from j
+    # recv_splits in world-rank rows: member rows get splits.T[m]
+    # (rows member m receives from each member), non-members zeros.
+    recv_world = np.zeros((n, k), dtype=splits.dtype)
+    for m, r in enumerate(members):
+        recv_world[r] = splits.T[m]
     if was_local:
         # match the local-rows layout of `out`: only this process's ranks
         first = rt.rank
-        recv_splits = recv_splits[first : first + len(rt.local_devices)]
-    return out, jnp.asarray(recv_splits)
+        recv_world = recv_world[first : first + len(rt.local_devices)]
+    return out, jnp.asarray(recv_world)
 
 
 def alltoall_async(x, splits=None, name: Optional[str] = None, **kwargs) -> Handle:
@@ -415,11 +425,78 @@ def barrier(process_set: Optional[ProcessSet] = None) -> None:
     jax.block_until_ready(_jitted("allreduce", static)(token))
 
 
+_join_epoch = 0
+
+
 def join() -> int:
-    """Reference ``hvd.join()`` (``operations.cc:1714``): lets a rank with
-    no more data participate in peers' collectives with zero
-    contributions.  Under single-controller SPMD uneven per-rank batches
-    cannot arise inside one process; across processes this is a barrier.
-    Returns the last joined rank like the reference (here: size-1)."""
-    barrier()
-    return get_runtime().size - 1
+    """Reference ``hvd.join()`` (``operations.cc:1714``, JoinOp;
+    ``controller.cc:262-317``): a rank with no more data announces it is
+    done and blocks until every rank has joined; all ranks then learn
+    which rank joined *last* (the reference uses that to know which rank
+    still had data and therefore holds the freshest state to broadcast).
+
+    Multi-process: each process KV-registers its join arrival in the
+    launcher's controller (scope ``__join__/<epoch>``), barriers on the
+    full process count, then reads every arrival record — the max
+    (arrival_time, rank) wins.  The epoch counter makes repeated joins
+    use fresh scopes (join is collective: every process calls it the
+    same number of times, so epochs agree).
+
+    Single-controller worlds cannot have uneven per-rank data inside one
+    process, so all ranks join simultaneously: after a device barrier
+    the answer is ``size - 1`` (the reference's deterministic tie
+    order).  For uneven-data *device* loops use
+    ``traced.join_average(x, active)`` inside the step instead.
+    """
+    global _join_epoch
+    rt = get_runtime()
+    if rt.process_count <= 1:
+        barrier()
+        return rt.size - 1
+
+    import os
+    import struct
+    import time as _time
+
+    from ..runner import controller_py
+    from ..utils import env as _env
+
+    addr = _env.get_env(_env.RENDEZVOUS_ADDR)
+    port = _env.get_env(_env.RENDEZVOUS_PORT)
+    secret = os.environ.get("HVD_TPU_SECRET")
+    if not (addr and port and secret):
+        # No controller (hand-rolled multi-process launch): a device
+        # barrier still gives join's blocking semantics; last rank
+        # unknown.
+        barrier()
+        return rt.size - 1
+
+    epoch = _join_epoch
+    _join_epoch += 1
+    scope = f"__join__/{epoch}"
+    client = controller_py.make_client(addr, int(port), secret,
+                                       rank=rt.process_rank)
+    try:
+        # Arrival MUST be stamped before any blocking synchronization:
+        # the timestamp is the join order (the reference's controller
+        # sees EnqueueJoin arrival order the same way).  Stamping after
+        # a barrier would record post-barrier scheduling noise.
+        client.put(scope, str(rt.process_rank),
+                   struct.pack(">d", _time.time()))
+        client.barrier(f"join_{epoch}", rt.process_count)
+        arrivals = []
+        for p in range(rt.process_count):
+            raw = client.get(scope, str(p), timeout_ms=30000)
+            if raw is not None and len(raw) == 8:
+                arrivals.append((struct.unpack(">d", raw)[0], p))
+        last_process = max(arrivals)[1] if arrivals else rt.process_count - 1
+        barrier()  # device-level quiesce after everyone joined
+    finally:
+        client.close()
+    # Translate to a world rank: the last device rank owned by that
+    # process (the reference returns a rank id, operations.cc:1752).
+    owned = [
+        r for r, d in enumerate(rt.devices)
+        if d.process_index == last_process
+    ]
+    return owned[-1] if owned else rt.size - 1

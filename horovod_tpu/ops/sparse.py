@@ -62,18 +62,32 @@ def dense_grad_to_indexed_slices(
 
     ``ids`` are the token ids of the local batch (any shape); ``nnz``
     is the static row capacity (>= number of distinct ids; extra slots
-    become no-op padding).  Deduplicates ids so each touched row is
-    extracted exactly once — the dense gradient row already holds the
-    *sum* over occurrences, so duplicates would double-count on
-    densify.
+    become no-op padding — ``nnz = ids.size`` is always safe).
+    Deduplicates ids so each touched row is extracted exactly once —
+    the dense gradient row already holds the *sum* over occurrences, so
+    duplicates would double-count on densify.
+
+    Capacity overflow (more distinct ids than ``nnz``) cannot be
+    represented with static shapes; rather than silently dropping
+    gradient rows, the values are poisoned to NaN so the
+    misconfiguration surfaces on the first loss/update.  When
+    ``nnz >= ids.size`` overflow is impossible and no check is traced.
     """
     flat = ids.reshape(-1).astype(jnp.int32)
-    uids = jnp.unique(flat, size=nnz, fill_value=-1)
+    if nnz >= flat.shape[0]:
+        uids = jnp.unique(flat, size=nnz, fill_value=-1)
+    else:
+        ext = jnp.unique(flat, size=nnz + 1, fill_value=-1)
+        uids = ext[:nnz]
+        overflow = ext[nnz] >= 0  # an (nnz+1)-th distinct id exists
+        uids = jnp.where(overflow, jnp.full_like(uids, -1), uids)
     mask = uids >= 0
     safe = jnp.where(mask, uids, 0)
     values = dense_grad[safe] * mask.astype(dense_grad.dtype)[
         (...,) + (None,) * (dense_grad.ndim - 1)
     ]
+    if nnz < flat.shape[0]:
+        values = jnp.where(overflow, jnp.nan, values.astype(values.dtype))
     return IndexedSlices(safe, values, tuple(dense_grad.shape))
 
 

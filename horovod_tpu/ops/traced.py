@@ -95,6 +95,131 @@ def _scale(x: jax.Array, factor: float) -> jax.Array:
     return x * jnp.asarray(factor, dtype=x.dtype)
 
 
+def _ring_threshold_bytes() -> int:
+    """Payload size above which arbitrary-set collectives switch from the
+    masked whole-world lowering (one XLA collective, but every chip pays
+    full bandwidth) to member-only ppermute rings (2(k-1) linear steps,
+    non-members idle).  The reference never faces the choice — its
+    per-set communicators always touch only members (process_set.h:26-80)
+    — but XLA replica_groups must tile the axis evenly, so small payloads
+    keep the low-latency masked form."""
+    return env.get_int(env.SET_RING_THRESHOLD, 1 << 14)
+
+
+def _set_shift_perm(ranks, n: int, shift: int):
+    """ppermute pairs shifting by ``shift`` inside the member ring;
+    everyone else self-loops (local copy, no ICI traffic)."""
+    k = len(ranks)
+    pairs = [(ranks[i], ranks[(i + shift) % k]) for i in range(k)]
+    members = set(ranks)
+    pairs += [(r, r) for r in range(n) if r not in members]
+    return pairs
+
+
+def _ring_set_sum(x: jax.Array, axis: Axis, ranks, position) -> jax.Array:
+    """Member-only ring allreduce (reduce-scatter + allgather phases).
+
+    Per-member traffic ~2V over 2(k-1) ppermute steps; non-members move
+    nothing.  Accumulation in the input dtype (the fused-allreduce
+    contract; compression is the caller's knob)."""
+    n = _axis_size(axis)
+    k = len(ranks)
+    shape, V = x.shape, x.size
+    c = -(-V // k)
+    flat = x.reshape(-1)
+    if c * k != V:
+        flat = jnp.pad(flat, (0, c * k - V))
+    buf = flat.reshape(k, c)
+    nxt = _set_shift_perm(ranks, n, 1)
+
+    for s in range(k - 1):  # reduce-scatter phase
+        send_idx = jnp.mod(position - s, k)
+        chunk = lax.dynamic_slice_in_dim(buf, send_idx, 1, 0)
+        recv = lax.ppermute(chunk, axis, perm=nxt)
+        recv_idx = jnp.mod(position - s - 1, k)
+        cur = lax.dynamic_slice_in_dim(buf, recv_idx, 1, 0)
+        buf = lax.dynamic_update_slice_in_dim(buf, cur + recv, recv_idx, 0)
+    for s in range(k - 1):  # allgather phase
+        send_idx = jnp.mod(position + 1 - s, k)
+        chunk = lax.dynamic_slice_in_dim(buf, send_idx, 1, 0)
+        recv = lax.ppermute(chunk, axis, perm=nxt)
+        recv_idx = jnp.mod(position - s, k)
+        buf = lax.dynamic_update_slice_in_dim(buf, recv, recv_idx, 0)
+    return buf.reshape(-1)[:V].reshape(shape)
+
+
+def _tree_set_broadcast(
+    x: jax.Array, axis: Axis, ranks, root_rank: int
+) -> jax.Array:
+    """Binomial-tree one-to-all over set members via ppermute.
+
+    ceil(log2 k) rounds; round j doubles the holder count.  Total wire
+    bytes (k-1)·V spread over members only — the masked-psum lowering
+    moves V on all n ranks.  Holder/receiver sets per round are static
+    rank tables, so the only traced data is the payload itself."""
+    n = _axis_size(axis)
+    k = len(ranks)
+    if k == 1:
+        return x
+    y = x
+    idx = lax.axis_index(axis)
+    span = 1
+    while span < k:
+        pairs = []
+        recv_tab = np.zeros((n,), np.bool_)
+        for i in range(k):
+            vq = (i - root_rank) % k
+            if vq < span and vq + span < k:
+                dst = ranks[(root_rank + vq + span) % k]
+                pairs.append((ranks[i], dst))
+                recv_tab[dst] = True
+        srcs = {a for a, _ in pairs}
+        dsts = {b for _, b in pairs}
+        pairs += [
+            (r, r) for r in range(n) if r not in srcs and r not in dsts
+        ]
+        recv = lax.ppermute(y, axis, perm=pairs)
+        is_recv = jnp.asarray(recv_tab)[idx]
+        y = jnp.where(is_recv, recv, y)
+        span <<= 1
+    return y
+
+
+def _ring_set_alltoall(x: jax.Array, axis: Axis, ranks, position) -> jax.Array:
+    """Member-only all-to-all: k-1 shifted ppermutes, each moving one
+    row-chunk (bandwidth-optimal ~V per member; non-members idle)."""
+    n = _axis_size(axis)
+    k = len(ranks)
+    rows = x.shape[0] // k
+    out = x  # chunk for myself already sits at row-block `position`
+    for s in range(1, k):
+        send_idx = jnp.mod(position + s, k)
+        chunk = lax.dynamic_slice_in_dim(x, send_idx * rows, rows, 0)
+        recv = lax.ppermute(
+            chunk, axis, perm=_set_shift_perm(ranks, n, s)
+        )
+        recv_idx = jnp.mod(position - s, k)
+        out = lax.dynamic_update_slice_in_dim(out, recv, recv_idx * rows, 0)
+    return out
+
+
+def _ring_set_allgather(x: jax.Array, axis: Axis, ranks, position) -> jax.Array:
+    """Member-only ring allgather: k-1 ppermute steps passing blocks
+    around the set ring; non-members idle (vs the slot-psum fallback
+    which moves k·V over every chip in the world)."""
+    n = _axis_size(axis)
+    k = len(ranks)
+    out = jnp.zeros((k,) + x.shape, x.dtype)
+    out = lax.dynamic_update_slice_in_dim(out, x[None], position, 0)
+    cur = x
+    nxt = _set_shift_perm(ranks, n, 1)
+    for s in range(1, k):
+        cur = lax.ppermute(cur, axis, perm=nxt)
+        src_idx = jnp.mod(position - s, k)
+        out = lax.dynamic_update_slice_in_dim(out, cur[None], src_idx, 0)
+    return out.reshape((k * x.shape[0],) + x.shape[1:])
+
+
 def _grouped_sum(x: jax.Array, axis: Axis, groups, group_size: int) -> jax.Array:
     """Within-group sum via reduce_scatter + all_gather with replica
     groups; flattens and pads so the scatter dimension tiles evenly."""
@@ -189,7 +314,7 @@ def allreduce(
             _scale(x, prescale_factor), axis=axis, process_set=process_set
         )
 
-    groups, mask, _, set_size = _set_info(axis, process_set)
+    groups, mask, position, set_size = _set_info(axis, process_set)
     x = _scale(x, prescale_factor)
     if op == Average:
         postscale_factor = postscale_factor / set_size
@@ -208,6 +333,14 @@ def allreduce(
             # concurrently (shard_map's psum does not take
             # axis_index_groups; psum_scatter/all_gather do).
             y = _grouped_sum(x, axis, groups, len(groups[0]))
+        elif (
+            set_size >= 2
+            and x.size * x.dtype.itemsize >= _ring_threshold_bytes()
+        ):
+            # Arbitrary set, large payload: member-only ring — only the
+            # set's chips touch the wire (the per-set communicator
+            # behavior of the reference, process_set.h:26-80).
+            y = _ring_set_sum(x, axis, process_set.ranks, position)
         else:
             y = lax.psum(jnp.where(mask, x, jnp.zeros_like(x)), axis)
     elif op in (Min, Max):
@@ -291,7 +424,14 @@ def allgather(
     if groups is not None:
         y = lax.all_gather(x, axis, tiled=True, axis_index_groups=groups)
         return jnp.where(mask, y, jnp.zeros_like(y))
-    # Arbitrary set: scatter into per-member slots and sum-place.
+    if x.size * x.dtype.itemsize >= _ring_threshold_bytes():
+        # Arbitrary set, large payload: member-only ring.  Non-members
+        # self-loop through every ppermute, so mask their buffer to the
+        # documented zeros.
+        y = _ring_set_allgather(x, axis, process_set.ranks, position)
+        return jnp.where(mask, y, jnp.zeros_like(y))
+    # Arbitrary set, small payload: scatter into per-member slots and
+    # sum-place (one collective, lowest latency).
     slots = jnp.zeros((set_size,) + x.shape, dtype=x.dtype)
     contrib = jnp.where(mask, x, jnp.zeros_like(x))
     slots = lax.dynamic_update_index_in_dim(slots, contrib, position, 0)
@@ -317,6 +457,11 @@ def broadcast(
     if mask is None:
         src = jnp.where(idx == root_rank, x, jnp.zeros_like(x))
         return lax.psum(src, axis)
+    if x.size * x.dtype.itemsize >= _ring_threshold_bytes():
+        # Real one-to-all lowering: binomial ppermute tree touching only
+        # member chips instead of a whole-world masked psum.
+        y = _tree_set_broadcast(x, axis, process_set.ranks, root_rank)
+        return jnp.where(mask, y, x)
     global_root = process_set.ranks[root_rank]
     src = jnp.where(idx == global_root, x, jnp.zeros_like(x))
     y = lax.psum(src, axis)
@@ -379,7 +524,7 @@ def alltoall(
     all_to_all requires equal splits); this traced form is also the
     Ulysses sequence-parallel primitive (see parallel/ulysses.py).
     """
-    groups, mask, _, set_size = _set_info(axis, process_set)
+    groups, mask, position, set_size = _set_info(axis, process_set)
     if x.shape[0] % set_size != 0:
         raise ValueError(
             f"alltoall dim 0 ({x.shape[0]}) must be divisible by set size "
@@ -393,10 +538,11 @@ def alltoall(
             axis_index_groups=groups,
         )
         return jnp.where(mask, y, jnp.zeros_like(y))
-    raise NotImplementedError(
-        "alltoall on a process set that does not evenly partition the world "
-        "requires padding; use the eager API or an equal partition."
-    )
+    # Arbitrary set: member-only shifted-ppermute exchange (the reference
+    # negotiates per-set communicators; XLA all_to_all can't express an
+    # uneven partition, so the ring carries it).
+    y = _ring_set_alltoall(x, axis, process_set.ranks, position)
+    return jnp.where(mask, y, jnp.zeros_like(y))
 
 
 def barrier(axis: Axis = WORLD_AXIS, process_set: Optional[ProcessSet] = None) -> jax.Array:

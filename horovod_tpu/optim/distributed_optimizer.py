@@ -477,6 +477,18 @@ class TrainStep:
         threshold = None
         if self._autotune is not None:
             threshold = self._autotune.threshold_bytes()
+            if self._autotune.converged and len(self._step_cache) > 1:
+                # Exploration over: drop the losing compiled variants
+                # (each is a full XLA executable holding device code).
+                frozen_key = (
+                    jax.tree.structure(opt_state),
+                    jax.tree.structure(model_state),
+                    threshold,
+                )
+                self._step_cache = {
+                    k: v for k, v in self._step_cache.items()
+                    if k == frozen_key
+                }
         key = (
             jax.tree.structure(opt_state),
             jax.tree.structure(model_state),

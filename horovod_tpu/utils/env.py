@@ -28,6 +28,11 @@ STALL_SHUTDOWN_TIME_SECONDS = "STALL_SHUTDOWN_TIME_SECONDS"
 ELASTIC_ENABLED = "ELASTIC"
 DYNAMIC_PROCESS_SETS = "DYNAMIC_PROCESS_SETS"
 HIERARCHICAL_ALLREDUCE = "HIERARCHICAL_ALLREDUCE"  # reference HOROVOD_HIERARCHICAL_ALLREDUCE
+# Payload bytes above which arbitrary (non-partition) process-set
+# collectives use member-only ppermute rings/trees instead of masked
+# whole-world collectives. No reference analog (MPI communicators always
+# touch members only); the knob trades latency vs non-member bandwidth.
+SET_RING_THRESHOLD = "SET_RING_THRESHOLD"
 PROCESS_SETS = "PROCESS_SETS"
 BATCH_D2D_MEMCOPIES = "BATCH_D2D_MEMCOPIES"
 NUM_STREAMS = "NUM_STREAMS"

@@ -75,6 +75,26 @@ def test_run_broadcast_object():
     assert results[0] == results[1] == {"vec": [1, 2, 3]}
 
 
+def _uneven_join():
+    """Rank 0 exhausts its data first and joins early; rank 1 keeps
+    training for a while, then joins.  Both must learn rank 1 joined
+    last (reference join semantics, operations.cc:1714)."""
+    import time
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    if hvd.process_rank() == 1:
+        time.sleep(1.0)  # "still has batches"
+    return hvd.join()
+
+
+def test_run_true_join_last_rank():
+    results = runner.run(_uneven_join, np=2, use_cpu_devices=True)
+    # process 1 joined last; its (only) device rank is world rank 1
+    assert results[0] == results[1] == 1
+
+
 def test_run_worker_failure_raises():
     def boom():
         raise RuntimeError("worker exploded")

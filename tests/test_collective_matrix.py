@@ -1,0 +1,203 @@
+"""Collective x dtype x process-set sweep and error-case matrix.
+
+Models the reference's exhaustive parallel test enumeration
+(``test/parallel/test_torch.py`` — allreduce/allgather/broadcast across
+every supported dtype, process-set variants, and typed error cases)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu.exceptions import HorovodTpuError
+
+N = 8
+
+DTYPES = [
+    np.float32, np.float64, np.float16, jnp.bfloat16,
+    np.int32, np.int64, np.int8, np.uint8,
+]
+
+
+def _tol(dtype):
+    if dtype in (np.float16, jnp.bfloat16):
+        return dict(rtol=1e-2, atol=1e-2)
+    # float64 silently downcasts to f32 under JAX's default x64-disabled
+    # mode, so exact comparison is off the table for it too.
+    return dict(rtol=1e-5, atol=1e-6)
+
+
+def _is_float(dtype):
+    return jnp.issubdtype(jnp.dtype(dtype), jnp.floating)
+
+
+def _data(dtype, shape=(N, 5), seed=0):
+    rng = np.random.RandomState(seed)
+    if _is_float(dtype):
+        return rng.uniform(-2, 2, shape).astype(dtype)
+    return rng.randint(0, 7, shape).astype(dtype)
+
+
+class TestDtypeSweep:
+    @pytest.mark.parametrize("dtype", DTYPES, ids=str)
+    def test_allreduce_sum(self, hvd_module, dtype):
+        x = _data(dtype)
+        y = np.asarray(hvd.allreduce(x, op=hvd.Sum)).astype(np.float64)
+        expect = np.asarray(x).astype(np.float64).sum(axis=0)
+        for r in range(N):
+            np.testing.assert_allclose(y[r], expect, **_tol(dtype))
+
+    @pytest.mark.parametrize("dtype", DTYPES, ids=str)
+    def test_allreduce_average(self, hvd_module, dtype):
+        x = _data(dtype, seed=1)
+        y = np.asarray(hvd.allreduce(x, average=True)).astype(np.float64)
+        expect = np.asarray(x).astype(np.float64).mean(axis=0)
+        if not _is_float(dtype):
+            # integer average truncates like the reference's int path
+            expect = np.trunc(expect)
+        for r in range(N):
+            np.testing.assert_allclose(y[r], expect, **_tol(dtype))
+
+    @pytest.mark.parametrize("dtype", DTYPES, ids=str)
+    @pytest.mark.parametrize("opname", ["min", "max"])
+    def test_allreduce_minmax(self, hvd_module, dtype, opname):
+        x = _data(dtype, seed=2)
+        op = hvd.Min if opname == "min" else hvd.Max
+        y = np.asarray(hvd.allreduce(x, op=op)).astype(np.float64)
+        red = np.min if opname == "min" else np.max
+        expect = red(np.asarray(x).astype(np.float64), axis=0)
+        for r in range(N):
+            np.testing.assert_allclose(y[r], expect, **_tol(dtype))
+
+    @pytest.mark.parametrize("dtype", DTYPES, ids=str)
+    def test_allgather(self, hvd_module, dtype):
+        x = _data(dtype, shape=(N, 2, 3), seed=3)
+        y = np.asarray(hvd.allgather(x))
+        expect = np.asarray(x).reshape(N * 2, 3).astype(np.float64)
+        for r in range(N):
+            np.testing.assert_allclose(
+                y[r].astype(np.float64), expect, **_tol(dtype)
+            )
+
+    @pytest.mark.parametrize("dtype", DTYPES, ids=str)
+    def test_broadcast(self, hvd_module, dtype):
+        x = _data(dtype, seed=4)
+        y = np.asarray(hvd.broadcast(x, root_rank=3))
+        for r in range(N):
+            np.testing.assert_allclose(
+                y[r].astype(np.float64),
+                np.asarray(x)[3].astype(np.float64), **_tol(dtype)
+            )
+
+    @pytest.mark.parametrize("dtype", [np.float32, jnp.bfloat16, np.int32],
+                             ids=str)
+    def test_alltoall(self, hvd_module, dtype):
+        x = _data(dtype, shape=(N, N, 2), seed=5)
+        y = np.asarray(hvd.alltoall(x))
+        for r in range(N):
+            for j in range(N):
+                np.testing.assert_array_equal(y[r, j], np.asarray(x)[j, r])
+
+    @pytest.mark.parametrize("dtype", [np.float32, np.float16, np.int32],
+                             ids=str)
+    def test_reducescatter(self, hvd_module, dtype):
+        x = _data(dtype, shape=(N, N, 3), seed=6)
+        y = np.asarray(hvd.reducescatter(x, op=hvd.Sum)).astype(np.float64)
+        full = np.asarray(x).astype(np.float64).sum(axis=0)
+        for r in range(N):  # rank r's shard keeps the leading dim: (1, 3)
+            np.testing.assert_allclose(y[r], full[r : r + 1], **_tol(dtype))
+
+
+class TestProcessSetSweep:
+    @pytest.mark.parametrize("dtype", [np.float32, jnp.bfloat16, np.int32],
+                             ids=str)
+    @pytest.mark.parametrize("members", [[0, 1, 2, 3], [1, 5, 6]],
+                             ids=["partition", "arbitrary"])
+    def test_allreduce_sum_subset(self, hvd_module, monkeypatch, dtype,
+                                  members):
+        monkeypatch.setenv("HVD_TPU_DYNAMIC_PROCESS_SETS", "1")
+        ps = hvd.add_process_set(members)
+        x = _data(dtype, seed=7)
+        y = np.asarray(hvd.allreduce(x, op=hvd.Sum, process_set=ps)).astype(
+            np.float64
+        )
+        expect = np.asarray(x[members]).astype(np.float64).sum(axis=0)
+        for r in members:
+            np.testing.assert_allclose(y[r], expect, **_tol(dtype))
+        others = [r for r in range(N) if r not in members]
+        np.testing.assert_array_equal(
+            y[others], np.asarray(x)[others].astype(np.float64)
+        )
+        hvd.remove_process_set(ps)
+
+
+class TestErrorMatrix:
+    def test_average_and_op_mutually_exclusive(self, hvd_module):
+        with pytest.raises(ValueError, match="either average or op"):
+            hvd.allreduce(np.zeros((N, 2), np.float32), average=True,
+                          op=hvd.Sum)
+
+    def test_wrong_leading_dim_rejected(self, hvd_module):
+        with pytest.raises(HorovodTpuError, match="leading"):
+            hvd.allreduce(np.zeros((N + 1, 2), np.float32))
+
+    def test_scalar_rejected(self, hvd_module):
+        with pytest.raises(HorovodTpuError):
+            hvd.allreduce(np.float32(1.0))
+
+    def test_unregistered_process_set_rejected(self, hvd_module):
+        from horovod_tpu.process_sets import ProcessSet
+
+        ghost = ProcessSet([0, 1])
+        with pytest.raises(HorovodTpuError, match="not registered"):
+            hvd.allreduce(np.zeros((N, 2), np.float32), process_set=ghost)
+
+    def test_alltoall_bad_splits_sum(self, hvd_module):
+        splits = np.full((N, N), 1)
+        splits[0, 0] = 2  # row sums no longer equal the row count
+        with pytest.raises(HorovodTpuError, match="sum"):
+            hvd.alltoall(np.zeros((N, N, 2), np.float32), splits=splits)
+
+    def test_alltoall_bad_splits_shape(self, hvd_module):
+        with pytest.raises(HorovodTpuError, match="shape"):
+            hvd.alltoall(np.zeros((N, N, 2), np.float32),
+                         splits=np.ones((2, 2), np.int32))
+
+    def test_reducescatter_indivisible(self, hvd_module):
+        with pytest.raises(Exception, match="divisible"):
+            hvd.reducescatter(np.zeros((N, N + 1, 2), np.float32))
+
+    def test_grouped_allreduce_empty(self, hvd_module):
+        assert hvd.grouped_allreduce([]) == []
+
+    def test_adasum_with_average_flag_conflict(self, hvd_module):
+        with pytest.raises(ValueError, match="either average or op"):
+            hvd.allreduce(np.zeros((N, 2), np.float32), average=True,
+                          op=hvd.Adasum)
+
+
+class TestGroupedOps:
+    @pytest.mark.parametrize("dtype", [np.float32, jnp.bfloat16], ids=str)
+    def test_grouped_mixed_shapes(self, hvd_module, dtype):
+        xs = [_data(dtype, shape=(N, s), seed=s) for s in (3, 7, 1)]
+        ys = hvd.grouped_allreduce(xs, op=hvd.Sum)
+        for x, y in zip(xs, ys):
+            expect = np.asarray(x).astype(np.float64).sum(axis=0)
+            for r in range(N):
+                np.testing.assert_allclose(
+                    np.asarray(y)[r].astype(np.float64), expect, **_tol(dtype)
+                )
+
+    def test_grouped_mixed_dtypes(self, hvd_module):
+        xs = [
+            _data(np.float32, shape=(N, 4), seed=10),
+            _data(np.int32, shape=(N, 4), seed=11),
+            _data(np.float32, shape=(N, 2), seed=12),
+        ]
+        ys = hvd.grouped_allreduce(xs, op=hvd.Sum)
+        for x, y in zip(xs, ys):
+            expect = np.asarray(x).astype(np.float64).sum(axis=0)
+            for r in range(N):
+                np.testing.assert_allclose(
+                    np.asarray(y)[r].astype(np.float64), expect, rtol=1e-5
+                )

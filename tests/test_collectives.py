@@ -130,11 +130,13 @@ def test_allreduce_removed_process_set_rejected(hvd_module, monkeypatch):
         hvd.allreduce(stacked(), process_set=ps)
 
 
-def test_alltoall_splits_with_subset_rejected(hvd_module, monkeypatch):
+def test_alltoall_splits_subset_shape_validated(hvd_module, monkeypatch):
+    """Subset splits are supported now (member-indexed matrix); a
+    world-shaped splits matrix for a 4-member set must be rejected."""
     monkeypatch.setenv("HVD_TPU_DYNAMIC_PROCESS_SETS", "1")
     ps = hvd.add_process_set([0, 1, 2, 3])
     splits = np.full((N, N), 2)
-    with pytest.raises(NotImplementedError):
+    with pytest.raises(Exception, match="set_size"):
         hvd.alltoall(stacked(shape=(16, 2)), splits=splits, process_set=ps)
     hvd.remove_process_set(ps)
 

@@ -47,6 +47,18 @@ def test_dense_grad_to_indexed_slices_dedup():
     np.testing.assert_allclose(np.asarray(densify(s)), np.asarray(dense))
 
 
+def test_capacity_overflow_poisons_not_drops():
+    """More distinct ids than nnz can't be represented statically; the
+    failure must be loud (NaN), never a silent row drop."""
+    dense = jnp.ones((VOCAB, DIM))
+    ids = jnp.arange(6)  # 6 distinct ids
+    s = dense_grad_to_indexed_slices(dense, ids, nnz=4)
+    assert bool(jnp.isnan(s.values).any())
+    # exactly-fitting capacity stays clean
+    s_ok = dense_grad_to_indexed_slices(dense, ids, nnz=6)
+    assert not bool(jnp.isnan(s_ok.values).any())
+
+
 def test_densify_duplicate_indices_sum():
     s = IndexedSlices(
         jnp.array([2, 2, 5, 0]),
