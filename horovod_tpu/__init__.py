@@ -40,6 +40,7 @@ from .ops.eager import (  # noqa: F401
     Sum,
     allgather,
     allgather_async,
+    allgather_v,
     allreduce,
     allreduce_async,
     alltoall,

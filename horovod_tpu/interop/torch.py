@@ -200,3 +200,212 @@ def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None):
 
 def allgather_object(obj, name: Optional[str] = None):
     return _functions.allgather_object(obj)
+
+
+# ---- training path (reference torch/optimizer.py:506) -------------------
+
+class _DistributedOptimizer:
+    """Torch optimizer wrapper that averages gradients across processes
+    before each applied step (reference ``horovod.torch
+    .DistributedOptimizer``, ``torch/optimizer.py:506``).
+
+    The reference hooks each parameter's grad accumulator and overlaps
+    NCCL allreduces with backward; here the torch model lives on host
+    CPU and the collective rides the TPU runtime's eager path, so the
+    reduction happens in ``step()`` as ONE fused flat allreduce per
+    dtype (the fusion-buffer behavior, without the background cycle).
+
+    ``backward_passes_per_step=k`` keeps the reference's local
+    aggregation contract: grads accumulate locally (the caller simply
+    does not ``zero_grad`` between backwards) and only every k-th
+    ``step()`` reduces and applies, scaled by ``1/k``.
+    """
+
+    def __init__(self, optimizer, op: int = _eager.Average,
+                 backward_passes_per_step: int = 1,
+                 average_aggregated_gradients: bool = True,
+                 gradient_predivide_factor: float = 1.0,
+                 process_set=None):
+        if gradient_predivide_factor != 1.0 and op != _eager.Average:
+            raise ValueError(
+                "gradient_predivide_factor requires op=Average "
+                "(reference torch/optimizer.py:194)"
+            )
+        self._opt = optimizer
+        self._op = op
+        self._k = int(backward_passes_per_step)
+        if self._k < 1:
+            raise ValueError("backward_passes_per_step must be >= 1")
+        self._avg_agg = average_aggregated_gradients
+        self._prescale = 1.0 / gradient_predivide_factor
+        self._postscale = gradient_predivide_factor
+        self._process_set = process_set
+        self._calls = 0
+        self._synchronized = False
+        self._should_synchronize = True
+
+    # Everything not overridden forwards to the real optimizer
+    # (param_groups, state_dict, zero_grad, add_param_group, ...).
+    def __getattr__(self, name):
+        if name == "_opt":  # not yet set (e.g. mid-unpickle): no recursion
+            raise AttributeError(name)
+        return getattr(self._opt, name)
+
+    @property
+    def backward_passes_per_step(self) -> int:
+        return self._k
+
+    def set_backward_passes_per_step(self, k: int) -> None:
+        self._k = int(k)
+
+    # The inherited torch Optimizer mutators would rebind state onto the
+    # wrapper instance while step() applies self._opt — delegate them
+    # explicitly so there is exactly one optimizer state.
+    def state_dict(self):
+        return self._opt.state_dict()
+
+    def load_state_dict(self, state_dict):
+        return self._opt.load_state_dict(state_dict)
+
+    def add_param_group(self, group):
+        return self._opt.add_param_group(group)
+
+    def skip_synchronize(self):
+        """Context manager: apply the next step() without reducing —
+        pair with an explicit ``synchronize()`` before gradient clipping
+        (reference ``torch/optimizer.py`` ``skip_synchronize``)."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def ctx():
+            self._should_synchronize = False
+            try:
+                yield
+            finally:
+                self._should_synchronize = True
+
+        return ctx()
+
+    def _grads(self):
+        for group in self._opt.param_groups:
+            for p in group["params"]:
+                if p.grad is not None:
+                    yield p
+
+    def synchronize(self) -> None:
+        """Reduce all present grads in place, fused per dtype
+        (reference ``synchronize()``, torch/mpi_ops.py:865).
+
+        The torch model is per-*process* (one CPU copy per controller),
+        so the reduction is process-level: ``process_allgather`` of the
+        flat buffer + a local mean/sum — correct regardless of how many
+        TPU chips each controller owns (the eager device-rank layouts
+        would weight processes by their chip count)."""
+        torch = _torch()
+        params = list(self._grads())
+        self._synchronized = True  # reduced (or nothing to reduce)
+        if not params or _is_single_process():
+            return
+        import jax.numpy as jnp
+        from jax.experimental import multihost_utils
+
+        from ..ops.traced import Average, Sum
+
+        if self._op not in (Average, Sum):
+            raise ValueError(
+                "torch DistributedOptimizer supports op=Average or Sum"
+            )
+        member_procs = None
+        apply_result = True
+        if self._process_set is not None:
+            from .. import runtime
+
+            rt = runtime.get_runtime()
+            member_procs = sorted({
+                rt.devices[r].process_index for r in self._process_set.ranks
+            })
+            # process_allgather is collective: every process must call
+            # it; non-members just discard the result and keep their
+            # local grads (the masked pass-through contract).
+            apply_result = rt.process_rank in member_procs
+        by_dtype: Dict[Any, list] = {}
+        for p in params:
+            by_dtype.setdefault(p.grad.dtype, []).append(p)
+        for dtype, ps in by_dtype.items():
+            flat = torch.cat([p.grad.reshape(-1) for p in ps])
+            wire = jnp.asarray(_tensor_to_numpy(torch, flat))
+            if self._prescale != 1.0:
+                wire = wire * self._prescale
+            gathered = multihost_utils.process_allgather(wire)  # (P, n)
+            if member_procs is not None:
+                gathered = gathered[jnp.asarray(member_procs)]
+            red = (
+                gathered.mean(axis=0) if self._op == Average
+                else gathered.sum(axis=0)
+            )
+            if self._postscale != 1.0:
+                red = red * self._postscale
+            if not apply_result:
+                continue
+            reduced = _to_torch(red, flat)
+            offset = 0
+            with torch.no_grad():
+                for p in ps:
+                    n = p.grad.numel()
+                    p.grad.copy_(
+                        reduced[offset : offset + n].reshape(p.grad.shape)
+                    )
+                    offset += n
+
+    def step(self, closure=None):
+        self._calls += 1
+        if self._calls % self._k != 0:
+            return None  # accumulation step: no reduce, no apply
+        if self._k > 1 and self._avg_agg:
+            torch = _torch()
+            with torch.no_grad():
+                for p in self._grads():
+                    p.grad.mul_(1.0 / self._k)
+        # An explicit synchronize() before step() (grad clipping etc.)
+        # already reduced — reducing again would re-sum the global sum
+        # (reference _synchronized/skip_synchronize contract).
+        if self._should_synchronize and not self._synchronized:
+            self.synchronize()
+        self._synchronized = False
+        return self._opt.step(closure)
+
+    def zero_grad(self, *a, **kw):
+        return self._opt.zero_grad(*a, **kw)
+
+
+def DistributedOptimizer(optimizer, named_parameters=None,
+                         op: int = _eager.Average,
+                         backward_passes_per_step: int = 1,
+                         average_aggregated_gradients: bool = True,
+                         gradient_predivide_factor: float = 1.0,
+                         process_set=None):
+    """Reference-named constructor (``hvd.DistributedOptimizer``);
+    ``named_parameters`` is accepted for API parity but unused — the
+    fused flat reduction needs no per-parameter names.
+
+    Like the reference (torch/optimizer.py:718 dynamic subclassing),
+    the returned object IS-A ``type(optimizer)`` so
+    ``isinstance(opt, torch.optim.Optimizer)`` checks in LR schedulers
+    / grad scalers pass; its own ``__init__`` never runs — all
+    optimizer state lives in (and forwards to) the wrapped instance.
+    """
+    del named_parameters
+    cls = type(
+        "Distributed" + type(optimizer).__name__,
+        (_DistributedOptimizer, type(optimizer)),
+        {},
+    )
+    obj = cls.__new__(cls)
+    _DistributedOptimizer.__init__(
+        obj, optimizer, op=op,
+        backward_passes_per_step=backward_passes_per_step,
+        average_aggregated_gradients=average_aggregated_gradients,
+        gradient_predivide_factor=gradient_predivide_factor,
+        process_set=process_set,
+    )
+    return obj

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from .. import native
 from ..exceptions import HorovodTpuError
 from ..process_sets import ProcessSet
 from ..runtime import WORLD_AXIS, get_runtime
@@ -81,6 +82,78 @@ def _record(name: Optional[str], op: str, nbytes: int):
     tl = get_runtime().timeline
     if tl is not None:
         tl.record_op(name or op, op, nbytes)
+
+
+# numeric wire ids for dtypes crossing hvd_wire_encode_request's u8 slot
+_WIRE_DTYPES = [
+    "float32", "float64", "float16", "bfloat16", "int32", "int64",
+    "int16", "int8", "uint8", "uint16", "uint32", "uint64", "bool",
+]
+
+
+def _consistency_check(rtype: int, x: jax.Array, name: Optional[str],
+                       root: int = -1, process_set=None,
+                       extra: str = "") -> None:
+    """Cross-process collective validation (opt-in via
+    ``HVD_TPU_CONSISTENCY_CHECK``).
+
+    Each process encodes its submission as a wire Request
+    (``cpp/src/wire.cc``, the reference ``common/message.cc`` record),
+    the encoded records are allgathered, and any disagreement in
+    (type, dtype, payload dims, name, root) raises — the reference
+    controller performs exactly this validation while constructing
+    responses; under SPMD it is a debug-mode cross-check.
+    """
+    from ..utils import env as _env
+
+    if not _env.get_bool(_env.CONSISTENCY_CHECK):
+        return
+    rt = get_runtime()
+    if rt.process_count <= 1:
+        return
+    from .. import functions
+
+    dt = jnp.dtype(x.dtype).name
+    dtype_id = (
+        _WIRE_DTYPES.index(dt) if dt in _WIRE_DTYPES else 255
+    )
+    dims = list(x.shape[1:])  # per-rank payload shape (row layout-free)
+    # Fold process-set membership and op-specific payload (e.g. alltoall
+    # splits) into the wire name so per-set / per-split mismatches are
+    # caught too — the reference controller validates those as part of
+    # the request (message.h request fields).
+    ps_tag = (
+        ",".join(map(str, process_set.ranks)) if process_set is not None
+        else "world"
+    )
+    wire_name = f"{name or ''}|ps={ps_tag}|{extra}"
+    if native.available():
+        blob = native.encode_request(
+            rt.process_rank, rtype, dtype_id, root, dims, wire_name
+        )
+        records = [
+            native.decode_request(b)
+            for b in functions.allgather_object(blob)
+        ]
+    else:  # pure-Python fallback record
+        records = functions.allgather_object({
+            "rank": rt.process_rank, "type": rtype, "dtype": dtype_id,
+            "root": root, "dims": dims, "name": wire_name,
+        })
+    base = records[0]
+
+    def sig(r):
+        return (r["type"], r["dtype"], tuple(r["dims"]), r["name"],
+                r["root"])
+
+    for r in records[1:]:
+        if sig(r) != sig(base):
+            raise HorovodTpuError(
+                "collective consistency check failed: process "
+                f"{r['rank']} submitted {sig(r)} but process "
+                f"{base['rank']} submitted {sig(base)} (reference "
+                "controller.cc mismatched-collective error)"
+            )
 
 
 def _ps_id(process_set: Optional[ProcessSet]) -> Optional[int]:
@@ -225,6 +298,8 @@ def allreduce(
         op = Average if (average is None or average) else Sum
     x, was_local = _stacked(x)
     _record(name, "ALLREDUCE", x.nbytes)
+    _consistency_check(native.REQUEST_ALLREDUCE, x, name,
+                       process_set=process_set)
     static = (
         ("op", op),
         ("prescale_factor", float(prescale_factor)),
@@ -281,6 +356,8 @@ def allgather(
     gathers go through ``functions.allgather_object``."""
     x, was_local = _stacked(x)
     _record(name, "ALLGATHER", x.nbytes)
+    _consistency_check(native.REQUEST_ALLGATHER, x, name,
+                       process_set=process_set)
     static = (
         ("process_set_id", _ps_id(process_set)),
     )
@@ -289,6 +366,94 @@ def allgather(
 
 def allgather_async(x, name: Optional[str] = None, **kwargs) -> Handle:
     return Handle(allgather(x, name=name, **kwargs), name)
+
+
+def allgather_v(
+    xs: Sequence[jax.Array],
+    process_set: Optional[ProcessSet] = None,
+    name: Optional[str] = None,
+) -> jax.Array:
+    """Ragged allgather: per-rank tensors whose *first* dimensions
+    differ concatenate along dim 0 (reference ``AllgatherOp`` with
+    controller-negotiated recvcounts,
+    ``collective_operations.h:129-179``, ``controller.cc:483``).
+
+    ``xs`` is a list of this controller's per-rank tensors — all
+    ``size`` of them in the single-controller world, or this process's
+    ``local`` rows under multi-process (matching the stacked-layout
+    conventions).  Sizes are negotiated with a fixed-size allgather of
+    the row counts (the KV-negotiation analog — one tiny collective in
+    place of the reference's controller round-trip), rows pad to the
+    max, one equal-shape allgather moves the data, and the result trims
+    back on host.  Every rank receives the same
+    ``(sum(sizes), *trailing)`` array.
+    """
+    rt = get_runtime()
+    xs = [jnp.asarray(x) for x in xs]
+    if not xs or any(x.ndim == 0 for x in xs):
+        raise HorovodTpuError("allgather_v takes a list of >=1-D arrays")
+    trailing = xs[0].shape[1:]
+    for x in xs:
+        if x.shape[1:] != trailing:
+            raise HorovodTpuError(
+                f"allgather_v trailing dims must match: {x.shape[1:]} vs "
+                f"{trailing}"
+            )
+    members = (
+        list(process_set.ranks)
+        if process_set is not None and _ps_id(process_set) != 0
+        else list(range(rt.size))
+    )
+    if len(xs) == rt.size:  # single-controller stacked form
+        row = min(members)
+        my_ranks = list(range(rt.size))
+    else:  # multi-process local-rows form
+        my_ranks = [
+            r for r, d in enumerate(rt.devices)
+            if d.process_index == rt.process_rank
+        ]
+        in_set = [i for i, r in enumerate(my_ranks) if r in set(members)]
+        row = in_set[0] if in_set else 0
+    if len(xs) != len(my_ranks):
+        raise HorovodTpuError(
+            f"allgather_v takes one array per owned rank "
+            f"({len(my_ranks)}); got {len(xs)}"
+        )
+    # 1) negotiate sizes out of band (the reference's controller
+    # recvcount negotiation, controller.cc:483).  The object allgather
+    # reaches every process regardless of set membership, so ALL
+    # processes agree on max_rows — a member-masked collective would
+    # hand non-members zeros and desynchronize the padded shapes.
+    from .. import functions
+
+    per_proc = functions.allgather_object(
+        {r: int(x.shape[0]) for r, x in zip(my_ranks, xs)}
+    )
+    world_counts: dict = {}
+    for d in per_proc:
+        world_counts.update(d)
+    sizes = np.asarray([world_counts[r] for r in members], np.int64)
+    max_rows = int(sizes.max()) if len(sizes) else 0
+
+    # 2) pad (truncating non-member rows beyond the member max — their
+    # data never reaches the result) and run the equal-shape allgather
+    def fit_rows(x):
+        x = x[:max_rows]
+        return jnp.pad(
+            x, [(0, max_rows - x.shape[0])] + [(0, 0)] * len(trailing)
+        )
+
+    padded = jnp.stack([fit_rows(x) for x in xs])
+    # (timeline: the nested allgather records the payload; a second
+    # ALLGATHER_V record would double-count bytes)
+    gathered = allgather(padded, process_set=process_set, name=name)
+    # member result rows are identical; trim the padding back out
+    world = np.asarray(gathered)[row]
+    world = world.reshape((-1, max_rows) + trailing)
+    pieces = [world[i, : int(sizes[i])] for i in range(world.shape[0])]
+    return jnp.concatenate(pieces, axis=0) if pieces else jnp.zeros(
+        (0,) + trailing, xs[0].dtype
+    )
 
 
 def broadcast(
@@ -300,6 +465,8 @@ def broadcast(
     """Stacked broadcast: every in-set row becomes row[root]."""
     x, was_local = _stacked(x)
     _record(name, "BROADCAST", x.nbytes)
+    _consistency_check(native.REQUEST_BROADCAST, x, name,
+                       root=int(root_rank), process_set=process_set)
     static = (
         ("root_rank", int(root_rank)),
         ("process_set_id", _ps_id(process_set)),
@@ -319,6 +486,8 @@ def reducescatter(
 ) -> jax.Array:
     x, was_local = _stacked(x)
     _record(name, "REDUCESCATTER", x.nbytes)
+    _consistency_check(native.REQUEST_REDUCESCATTER, x, name,
+                       process_set=process_set)
     static = (
         ("op", op),
         ("process_set_id", _ps_id(process_set)),
@@ -344,6 +513,10 @@ def alltoall(
     """
     x, was_local = _stacked(x)
     _record(name, "ALLTOALL", x.nbytes)
+    _consistency_check(native.REQUEST_ALLTOALL, x, name,
+                       process_set=process_set,
+                       extra="" if splits is None else
+                       f"splits={np.asarray(splits).tolist()}")
     rt = get_runtime()
     n = rt.size
     if splits is None:

@@ -15,4 +15,6 @@ cluster); ``run()`` and ``TpuEstimator.fit`` require ``pyspark``.
 
 from .store import FilesystemStore, LocalStore, Store  # noqa: F401
 from .estimator import TpuEstimator  # noqa: F401
+from .keras import KerasEstimator  # noqa: F401
+from .torch import TorchEstimator  # noqa: F401
 from .runner import run  # noqa: F401

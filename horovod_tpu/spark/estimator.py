@@ -37,6 +37,50 @@ def _fresh_data_dir(path: str) -> None:
         os.remove(stale)
 
 
+def _write_partitions(df, cols, store) -> str:
+    """Materialize the DataFrame to the store as compressed columnar npz
+    shards, one per Spark partition, written by the executors (reference
+    ``util.prepare_data``, parquet via petastorm; compression analog of
+    ``store.py:89-105``).  The store prefix must be a shared filesystem
+    (the reference requires the same of its HDFS/DBFS stores)."""
+    path = store.get_train_data_path()
+    _fresh_data_dir(path)
+
+    def write_partition(idx, rows_iter):
+        rows = list(rows_iter)
+        if rows:
+            arrays = {c: np.asarray([row[c] for row in rows]) for c in cols}
+            np.savez_compressed(
+                os.path.join(path, f"part-{idx}.npz"), **arrays
+            )
+        yield idx
+
+    df.select(*cols).rdd.mapPartitionsWithIndex(write_partition).count()
+    return path
+
+
+def _write_single_shard(store, named_arrays) -> str:
+    """One-shard write for the Spark-free ``fit_on_arrays`` path (same
+    compressed columnar format as ``_write_partitions``)."""
+    path = store.get_train_data_path()
+    _fresh_data_dir(path)
+    np.savez_compressed(os.path.join(path, "part-0.npz"), **named_arrays)
+    return path
+
+
+def _transform_df(df, predict, feature_col):
+    """Shared Spark ``transform``: adds a ``prediction`` column via a
+    pandas-free UDF over ``predict`` (reference returns a Transformer)."""
+    import pyspark.sql.functions as F
+    from pyspark.sql.types import ArrayType, FloatType
+
+    @F.udf(ArrayType(FloatType()))
+    def _udf(v):
+        return [float(p) for p in predict(np.asarray(v)[None, ...])[0]]
+
+    return df.withColumn("prediction", _udf(df[feature_col]))
+
+
 class TpuEstimator:
     """Sklearn-style fit/predict over distributed TPU training.
 
@@ -88,26 +132,9 @@ class TpuEstimator:
     # -- data materialization (petastorm-parquet equivalent) --------------
 
     def _prepare_data(self, df) -> str:
-        """Materialize the DataFrame to the store as columnar npz shards,
-        one per Spark partition, written by the executors (reference
-        ``util.prepare_data``, parquet via petastorm).  The store prefix
-        must be a shared filesystem (the reference requires the same of
-        its HDFS/DBFS stores)."""
-        cols = self.feature_cols + self.label_cols
-        path = self.store.get_train_data_path()
-        _fresh_data_dir(path)
-
-        def write_partition(idx, rows_iter):
-            rows = list(rows_iter)
-            if rows:
-                arrays = {
-                    c: np.asarray([row[c] for row in rows]) for c in cols
-                }
-                np.savez(os.path.join(path, f"part-{idx}.npz"), **arrays)
-            yield idx
-
-        df.select(*cols).rdd.mapPartitionsWithIndex(write_partition).count()
-        return path
+        return _write_partitions(
+            df, self.feature_cols + self.label_cols, self.store
+        )
 
     def fit(self, df) -> "TpuModel":
         """Distributed-train on a Spark DataFrame; returns a TpuModel."""
@@ -138,9 +165,7 @@ class TpuEstimator:
     def fit_on_arrays(self, **named_arrays) -> "TpuModel":
         """Spark-free fit over in-memory arrays (single-controller path;
         used by tests and by notebook users without a cluster)."""
-        path = self.store.get_train_data_path()
-        _fresh_data_dir(path)
-        np.savez(os.path.join(path, "part-0.npz"), **named_arrays)
+        path = _write_single_shard(self.store, named_arrays)
         params = _train_worker(
             pickle.dumps(self.model), pickle.dumps(self.optimizer),
             pickle.dumps(self.loss), path, self.feature_cols,
@@ -149,6 +174,66 @@ class TpuEstimator:
         )
         return TpuModel(model=self.model, params=params,
                         feature_cols=self.feature_cols)
+
+
+def _load_columns(data_path, feature_cols, label_cols, *, partitioned=True):
+    """Read the columnar shards back into (features, labels).
+
+    Partitioned reads (reference: petastorm hands each worker its own
+    row-groups, ``spark/common/store.py:89-105``): with multiple
+    controller processes, each process opens only its round-robin slice
+    of the part files instead of the whole dataset — the read volume
+    per worker is O(data/size).  Single-controller worlds read
+    everything (the in-process loader shards by index).
+    """
+    import glob
+
+    import horovod_tpu as hvd
+
+    parts = sorted(glob.glob(os.path.join(data_path, "part-*.npz")))
+    if not parts:
+        raise FileNotFoundError(f"no data shards under {data_path}")
+    pc = hvd.process_count()
+    did_partition = partitioned and pc > 1 and len(parts) >= pc
+    if did_partition:
+        parts = parts[hvd.process_rank()::pc]
+    blobs = [np.load(p) for p in parts]
+
+    def column(c):
+        return np.concatenate([b[c] for b in blobs], axis=0)
+
+    if len(label_cols) != 1:
+        raise ValueError("exactly one label column is supported")
+    # Multiple feature columns are joined along the last axis (the
+    # dense-assembler convention the reference's estimators use).
+    if len(feature_cols) == 1:
+        features = column(feature_cols[0])
+    else:
+        feats = [np.atleast_2d(column(c).T).T.astype(np.float32)
+                 for c in feature_cols]
+        features = np.concatenate(feats, axis=-1)
+    labels = column(label_cols[0])
+    return features, labels, did_partition
+
+
+def _sync_steps_per_epoch(loader, did_partition) -> Optional[int]:
+    """Agree on steps/epoch across processes after partitioned reads.
+
+    Returns None when index sharding is in effect (every process sees
+    the same global length).  Raises instead of silently training zero
+    steps when some rank's partition is smaller than one batch."""
+    import horovod_tpu as hvd
+
+    if not did_partition:
+        return None
+    steps = min(hvd.allgather_object(len(loader)))
+    if steps == 0:
+        raise ValueError(
+            "partitioned data shard smaller than one batch on at least "
+            "one worker (steps/epoch = 0); reduce batch_size, repartition "
+            "the DataFrame, or use fewer workers"
+        )
+    return steps
 
 
 def _train_worker(model_blob, opt_blob, loss_blob, data_path, feature_cols,
@@ -166,30 +251,11 @@ def _train_worker(model_blob, opt_blob, loss_blob, data_path, feature_cols,
     store = FilesystemStore(store_prefix)
 
     hvd.init()
-    # Load all partition shards (written by _prepare_data) and stitch
-    # columns back together; the ArrayDataLoader then takes this rank's
-    # 1/size index shard.
-    import glob
-
-    parts = sorted(glob.glob(os.path.join(data_path, "part-*.npz")))
-    if not parts:
-        raise FileNotFoundError(f"no data shards under {data_path}")
-    blobs = [np.load(p) for p in parts]
-
-    def column(c):
-        return np.concatenate([b[c] for b in blobs], axis=0)
-
-    if len(label_cols) != 1:
-        raise ValueError("exactly one label column is supported")
-    # Multiple feature columns are joined along the last axis (the
-    # dense-assembler convention the reference's estimators use).
-    if len(feature_cols) == 1:
-        features = [column(feature_cols[0])]
-    else:
-        feats = [np.atleast_2d(column(c).T).T.astype(np.float32)
-                 for c in feature_cols]
-        features = [np.concatenate(feats, axis=-1)]
-    labels = [column(label_cols[0])]
+    feats, labs, did_partition = _load_columns(
+        data_path, feature_cols, label_cols
+    )
+    features = [feats]
+    labels = [labs]
 
     x0 = jnp.asarray(features[0][:1], jnp.float32)
     params = model.init(jax.random.PRNGKey(0), x0)
@@ -211,13 +277,19 @@ def _train_worker(model_blob, opt_blob, loss_blob, data_path, feature_cols,
 
     from ..data import ArrayDataLoader
 
+    # Partitioned reads already gave this process disjoint rows; index
+    # sharding on top would skip data.  Collectives are per-step, so all
+    # processes must agree on steps/epoch: take the min across ranks.
     loader = ArrayDataLoader(
         [np.asarray(features[0]), np.asarray(labels[0])],
-        batch_size=batch_size, shard=True,
+        batch_size=batch_size, shard=not did_partition,
     )
+    steps_per_epoch = _sync_steps_per_epoch(loader, did_partition)
     for epoch in range(epochs):
         loader.set_epoch(epoch)
-        for xb, yb in loader:
+        for i, (xb, yb) in enumerate(loader):
+            if steps_per_epoch is not None and i >= steps_per_epoch:
+                break
             params, opt_state, _ = step(
                 params, opt_state,
                 (jnp.asarray(xb, jnp.float32), jnp.asarray(yb)),
@@ -246,14 +318,4 @@ class TpuModel:
         ))
 
     def transform(self, df):
-        import pyspark.sql.functions as F
-        from pyspark.sql.types import ArrayType, FloatType
-
-        col = self.feature_cols[0]
-        predict = self.predict
-
-        @F.udf(ArrayType(FloatType()))
-        def _udf(v):
-            return [float(p) for p in predict(np.asarray(v)[None, ...])[0]]
-
-        return df.withColumn("prediction", _udf(df[col]))
+        return _transform_df(df, self.predict, self.feature_cols[0])

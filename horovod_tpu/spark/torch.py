@@ -1,0 +1,203 @@
+"""Torch-style estimator: trains a ``torch.nn.Module`` with the
+interop collective bridge.
+
+Reference: ``horovod/spark/torch/estimator.py:506`` (TorchEstimator) —
+takes a torch model + a torch loss + an optimizer factory, trains it
+data-parallel on the executors, checkpoints the ``state_dict`` through
+the Store, and returns a transformer.  TPU re-design: the torch model
+stays on host CPU (torch has no TPU backend here); gradient averaging
+rides the runtime's eager collectives through
+``horovod_tpu.interop.torch.DistributedOptimizer``, so multi-process
+fits synchronize exactly like the reference's hooks-and-allreduce
+loop.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Optional, Sequence
+
+import cloudpickle as pickle
+import numpy as np
+
+from .estimator import _load_columns
+from .store import LocalStore, Store
+
+
+class TorchEstimator:
+    """Sklearn-style fit/predict over a torch model.
+
+    ``optimizer`` is a factory ``params_iterable -> torch.optim
+    .Optimizer`` (the reference passes a torch optimizer instance and
+    re-binds it remotely; a factory is the pickle-clean equivalent).
+    """
+
+    def __init__(
+        self,
+        model=None,
+        optimizer: Optional[Callable] = None,
+        loss: Optional[Callable] = None,
+        feature_cols: Sequence[str] = ("features",),
+        label_cols: Sequence[str] = ("label",),
+        batch_size: int = 32,
+        epochs: int = 1,
+        backward_passes_per_step: int = 1,
+        num_proc: Optional[int] = None,
+        store: Optional[Store] = None,
+        run_id: Optional[str] = None,
+        verbose: int = 1,
+        extra_env: Optional[dict] = None,
+    ):
+        if model is None or optimizer is None or loss is None:
+            raise ValueError("model, optimizer and loss are required")
+        self.model = model
+        self.optimizer = optimizer
+        self.loss = loss
+        self.feature_cols = list(feature_cols)
+        self.label_cols = list(label_cols)
+        self.batch_size = batch_size
+        self.epochs = epochs
+        self.backward_passes_per_step = backward_passes_per_step
+        self.num_proc = num_proc
+        self.store = store or LocalStore()
+        self.run_id = run_id or "run_torch_default"
+        self.verbose = verbose
+        self.extra_env = extra_env
+
+    def _has_checkpoint(self) -> bool:
+        return self.store.load_checkpoint(self.run_id) is not None
+
+    def _worker_args(self, data_path: str) -> tuple:
+        return (
+            pickle.dumps(self.model), pickle.dumps(self.optimizer),
+            pickle.dumps(self.loss), data_path, self.feature_cols,
+            self.label_cols, self.batch_size, self.epochs,
+            self.backward_passes_per_step, self.store.prefix_path,
+            self.run_id,
+        )
+
+    def fit(self, df) -> "TorchModel":
+        from .estimator import _write_partitions
+
+        data_path = _write_partitions(
+            df, self.feature_cols + self.label_cols, self.store
+        )
+        from . import runner as spark_runner
+
+        results = spark_runner.run(
+            _torch_worker, args=self._worker_args(data_path),
+            num_proc=self.num_proc, extra_env=self.extra_env,
+            verbose=self.verbose,
+        )
+        return self._wrap(results[0])
+
+    def fit_on_arrays(self, **named_arrays) -> "TorchModel":
+        from .estimator import _write_single_shard
+
+        return self._wrap(
+            _torch_worker(
+                *self._worker_args(_write_single_shard(self.store,
+                                                       named_arrays))
+            )
+        )
+
+    def _wrap(self, state_np) -> "TorchModel":
+        import torch
+
+        model = self.model
+        state = {k: torch.as_tensor(v) for k, v in state_np.items()}
+        model.load_state_dict(state)
+        return TorchModel(model=model, feature_cols=self.feature_cols)
+
+
+def _torch_worker(model_blob, opt_blob, loss_blob, data_path, feature_cols,
+                  label_cols, batch_size, epochs, bpps, store_prefix,
+                  run_id):
+    """Per-rank torch training body (reference ``spark/torch/remote.py``:
+    broadcast initial state -> hooks-allreduce loop -> rank-0
+    checkpoint)."""
+    import torch
+
+    import horovod_tpu as hvd
+    import horovod_tpu.interop.torch as hvd_torch
+    from .store import FilesystemStore
+    from ..data import ArrayDataLoader
+
+    model = pickle.loads(model_blob)
+    opt_factory = pickle.loads(opt_blob)
+    loss_fn = pickle.loads(loss_blob)
+    store = FilesystemStore(store_prefix)
+
+    hvd.init()
+    feats, labs, did_partition = _load_columns(
+        data_path, feature_cols, label_cols
+    )
+
+    ckpt = store.load_checkpoint(run_id)
+    if ckpt is not None:
+        model.load_state_dict(
+            {k: torch.as_tensor(v) for k, v in ckpt.items()}
+        )
+    hvd_torch.broadcast_parameters(model.state_dict(), root_rank=0)
+
+    optimizer = hvd_torch.DistributedOptimizer(
+        opt_factory(model.parameters()),
+        backward_passes_per_step=bpps,
+    )
+
+    loader = ArrayDataLoader(
+        [np.asarray(feats), np.asarray(labs)], batch_size=batch_size,
+        shard=not did_partition,
+    )
+    from .estimator import _sync_steps_per_epoch
+
+    steps_per_epoch = _sync_steps_per_epoch(loader, did_partition)
+
+    model.train()
+    # zero_grad must follow the optimizer's own global call counter, not
+    # a per-epoch index: when steps/epoch is not a multiple of bpps the
+    # two schedules would drift and re-apply stale gradients.
+    global_calls = 0
+    for epoch in range(epochs):
+        loader.set_epoch(epoch)
+        for i, (xb, yb) in enumerate(loader):
+            if steps_per_epoch is not None and i >= steps_per_epoch:
+                break
+            x = torch.as_tensor(np.asarray(xb), dtype=torch.float32)
+            y = torch.as_tensor(np.asarray(yb))
+            loss = loss_fn(model(x), y)
+            loss.backward()
+            optimizer.step()
+            global_calls += 1
+            if global_calls % bpps == 0:
+                optimizer.zero_grad()
+
+    state_np = {
+        k: v.detach().cpu().numpy() for k, v in model.state_dict().items()
+    }
+    if hvd.rank() == 0:
+        store.save_checkpoint(run_id, state_np)
+    return state_np
+
+
+class TorchModel:
+    """Trained torch model wrapper (reference returns a Transformer)."""
+
+    def __init__(self, model, feature_cols):
+        self.model = model
+        self.feature_cols = feature_cols
+
+    def predict(self, x) -> np.ndarray:
+        import torch
+
+        self.model.eval()
+        with torch.no_grad():
+            out = self.model(
+                torch.as_tensor(np.asarray(x), dtype=torch.float32)
+            )
+        return out.numpy()
+
+    def transform(self, df):
+        from .estimator import _transform_df
+
+        return _transform_df(df, self.predict, self.feature_cols[0])

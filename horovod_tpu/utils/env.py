@@ -22,6 +22,11 @@ TIMELINE_MARK_CYCLES = "TIMELINE_MARK_CYCLES"
 AUTOTUNE = "AUTOTUNE"
 AUTOTUNE_LOG = "AUTOTUNE_LOG"
 LOG_LEVEL = "LOG_LEVEL"
+# Debug mode: every eager collective cross-checks its wire Request
+# (type/dtype/shape/name) across processes before dispatch, erroring on
+# mismatch — the reference controller's negotiation-time validation
+# (controller.cc ConstructResponse error joining) as an opt-in check.
+CONSISTENCY_CHECK = "CONSISTENCY_CHECK"
 STALL_CHECK_DISABLE = "STALL_CHECK_DISABLE"
 STALL_CHECK_TIME_SECONDS = "STALL_CHECK_TIME_SECONDS"
 STALL_SHUTDOWN_TIME_SECONDS = "STALL_SHUTDOWN_TIME_SECONDS"

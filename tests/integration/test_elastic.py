@@ -124,6 +124,111 @@ def test_elastic_membership_change(tmp_path):
     )
 
 
+COST_WORKER_SCRIPT = textwrap.dedent(
+    """
+    import os, sys, time
+    t_start = time.perf_counter()
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+    import horovod_tpu as hvd
+    from horovod_tpu.elastic import ObjectState
+
+    hvd.init()
+    params = {"w": jnp.zeros((32, 32)), "b": jnp.zeros((32,))}
+    tx = hvd.DistributedOptimizer(optax.adam(1e-3))
+
+    def loss_fn(p, batch):
+        h = jnp.tanh(batch @ p["w"] + p["b"])
+        return jnp.mean((h @ p["w"]) ** 2)
+
+    step = hvd.distributed_train_step(loss_fn, tx)
+    opt_state = step.init(params)
+    batch = jnp.ones((4 * hvd.size(), 32))
+    state = ObjectState(epoch=0)
+
+    first_step = [True]
+
+    @hvd.elastic.run
+    def train(state):
+        global params, opt_state
+        while state.epoch < 4:
+            p2, o2, loss = step(params, opt_state, batch)
+            params, opt_state = p2, o2
+            float(loss)
+            if first_step[0]:
+                first_step[0] = False
+                # init -> first completed step = the round's restart cost
+                # (fresh process per round, so this fires once per round)
+                cost = time.perf_counter() - t_start
+                with open(os.environ["RESULTS_FILE"]
+                          + f".{os.environ['HVD_TPU_CROSS_RANK']}", "a") as fh:
+                    fh.write(f"round={os.environ['HVD_TPU_ELASTIC_ROUND']} "
+                             f"restart_cost_s={cost:.3f}\\n")
+            time.sleep(0.4)
+            state.epoch += 1
+            state.commit()
+        return state.epoch
+
+    train(state)
+    """
+)
+
+
+def test_elastic_restart_cost_bounded(tmp_path):
+    """Measures the full cost of a membership-change restart (process
+    respawn + hvd re-init + recompile + first step) and bounds the
+    second round via the persistent XLA compilation cache (reference
+    concern: elastic reset cost; TPU twist: recompilation dominates, so
+    JAX_COMPILATION_CACHE_DIR turns round-2 compiles into cache reads)."""
+    script = tmp_path / "worker.py"
+    script.write_text(COST_WORKER_SCRIPT)
+    results_file = str(tmp_path / "results")
+    cache_dir = str(tmp_path / "xla_cache")
+
+    discovery = ScriptedDiscovery([
+        (2.5, {"localhost": 2}),
+        (1e9, {"localhost": 3}),
+    ])
+    driver = ElasticDriver(HostManager(discovery), min_np=2, max_np=4)
+    driver.start_discovery()
+    rc = driver.run_rounds(
+        [sys.executable, str(script)],
+        extra_env={
+            "RESULTS_FILE": results_file,
+            "JAX_COMPILATION_CACHE_DIR": cache_dir,
+            "JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS": "0",
+            "JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES": "0",
+            **WORKER_ENV,
+        },
+    )
+    assert rc == 0
+    assert driver.rounds >= 2
+
+    costs = {}
+    for fn in os.listdir(tmp_path):
+        if fn.startswith("results."):
+            for l in (tmp_path / fn).read_text().splitlines():
+                parts = dict(kv.split("=") for kv in l.split())
+                rnd = int(parts["round"])
+                costs.setdefault(rnd, []).append(
+                    float(parts["restart_cost_s"])
+                )
+    assert len(costs) >= 2, f"need costs from >=2 rounds, got {costs}"
+    first, last = min(costs), max(costs)
+    c1 = max(costs[first])
+    c2 = max(costs[last])
+    print(f"elastic restart cost: round{first}={c1:.2f}s "
+          f"round{last}={c2:.2f}s (cache dir {cache_dir})")
+    # The restart (world resize!) must not cost more than the cold
+    # start plus slack: compile work is bounded by the persistent cache.
+    assert c2 <= c1 * 2.0 + 2.0, (first, c1, last, c2)
+
+
 def test_elastic_worker_failure_blacklists_and_continues(tmp_path):
     """A worker that dies is handled: the driver starts a new round
     (reference fault-tolerance-without-scaling case)."""

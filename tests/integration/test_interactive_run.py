@@ -95,6 +95,43 @@ def test_run_true_join_last_rank():
     assert results[0] == results[1] == 1
 
 
+def _consistency_ok():
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    x = np.ones((1, 4), np.float32)
+    return float(np.asarray(hvd.allreduce(x, op=hvd.Sum))[0, 0])
+
+
+def _consistency_mismatch():
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.exceptions import HorovodTpuError
+
+    hvd.init()
+    # rank 1 submits a different dtype — the wire-Request cross-check
+    # must catch it before dispatch (reference controller.cc validation)
+    dtype = np.float32 if hvd.process_rank() == 0 else np.int32
+    try:
+        hvd.allreduce(np.ones((1, 4), dtype), op=hvd.Sum)
+        return "no-error"
+    except HorovodTpuError as e:
+        return "caught" if "consistency" in str(e) else f"wrong: {e}"
+
+
+def test_run_consistency_check_modes():
+    env = {"HVD_TPU_CONSISTENCY_CHECK": "1"}
+    ok = runner.run(_consistency_ok, np=2, use_cpu_devices=True,
+                    extra_env=env)
+    assert ok == [2.0, 2.0]
+    res = runner.run(_consistency_mismatch, np=2, use_cpu_devices=True,
+                     extra_env=env)
+    assert res == ["caught", "caught"]
+
+
 def test_run_worker_failure_raises():
     def boom():
         raise RuntimeError("worker exploded")

@@ -108,6 +108,39 @@ class TestDtypeSweep:
             np.testing.assert_allclose(y[r], full[r : r + 1], **_tol(dtype))
 
 
+class TestAllgatherV:
+    def test_ragged_first_dims(self, hvd_module):
+        rng = np.random.RandomState(0)
+        xs = [rng.randn(r + 1, 3).astype(np.float32) for r in range(N)]
+        out = np.asarray(hvd.allgather_v(xs))
+        expect = np.concatenate(xs, axis=0)
+        assert out.shape == (N * (N + 1) // 2, 3)
+        np.testing.assert_allclose(out, expect, rtol=1e-6)
+
+    def test_ragged_with_empty_rank(self, hvd_module):
+        xs = [np.ones((2, 2), np.float32) for _ in range(N)]
+        xs[3] = np.zeros((0, 2), np.float32)  # a rank with no rows
+        out = np.asarray(hvd.allgather_v(xs))
+        assert out.shape == ((N - 1) * 2, 2)
+        np.testing.assert_allclose(out, 1.0)
+
+    def test_ragged_subset(self, hvd_module, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_DYNAMIC_PROCESS_SETS", "1")
+        ps = hvd.add_process_set([0, 1, 2])
+        xs = [np.full((r + 1, 2), float(r), np.float32) for r in range(N)]
+        out = np.asarray(hvd.allgather_v(xs, process_set=ps))
+        expect = np.concatenate([xs[0], xs[1], xs[2]], axis=0)
+        np.testing.assert_allclose(out, expect)
+        hvd.remove_process_set(ps)
+
+    def test_trailing_mismatch_rejected(self, hvd_module):
+        from horovod_tpu.exceptions import HorovodTpuError
+
+        xs = [np.ones((2, 3))] * (N - 1) + [np.ones((2, 4))]
+        with pytest.raises(HorovodTpuError, match="trailing"):
+            hvd.allgather_v(xs)
+
+
 class TestProcessSetSweep:
     @pytest.mark.parametrize("dtype", [np.float32, jnp.bfloat16, np.int32],
                              ids=str)
