@@ -1,0 +1,244 @@
+"""TensorFlow binding: the ``horovod.tensorflow`` surface over the TPU
+runtime.
+
+Reference: ``horovod/tensorflow/__init__.py`` — collectives on
+``tf.Tensor``s, ``broadcast_variables`` (``:276``),
+``DistributedGradientTape`` (``:759``) and ``DistributedOptimizer``
+(``:627``) that allreduce gradients (IndexedSlices as
+allgather-of-slices, ``:95-162``) before application.
+
+TPU re-design mirrors ``interop/torch``: the TF model lives on the host
+(this build has no TF-on-TPU path); tensors cross into the runtime as
+numpy, collectives ride the eager layer (single-controller) or a
+process-level gather (multi-controller), exactly the role the
+reference's TF ops play around a training loop.  Gradients reduce at
+``gradient()``/``apply_gradients()`` time as ONE fused flat collective
+per dtype (the fusion-buffer behavior without the background cycle).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from .. import functions as _functions
+from ..ops import eager as _eager
+
+
+def _tf():
+    try:
+        import tensorflow  # noqa: F811
+
+        return tensorflow
+    except ImportError as e:  # pragma: no cover
+        raise ImportError(
+            "horovod_tpu.interop.tf requires the `tensorflow` package"
+        ) from e
+
+
+def _to_np(t) -> np.ndarray:
+    return np.asarray(t)
+
+
+def _is_single_process() -> bool:
+    from .. import runtime
+
+    return runtime.get_runtime().process_count == 1
+
+
+def _process_reduce(arr: np.ndarray, average: bool) -> np.ndarray:
+    """Process-level mean/sum (the torch-bridge lowering: one flat
+    gather across controllers, reduced locally)."""
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(jnp.asarray(arr))
+    red = gathered.mean(axis=0) if average else gathered.sum(axis=0)
+    return np.asarray(red)
+
+
+# ---- collectives (reference tensorflow/mpi_ops.py surface) --------------
+
+def allreduce(tensor, average: Optional[bool] = None, op: Optional[int] = None,
+              name: Optional[str] = None, process_set=None,
+              prescale_factor: float = 1.0, postscale_factor: float = 1.0):
+    """``hvd.allreduce`` on a tf.Tensor (stacked ``(size, ...)``
+    convention like the JAX eager API).  ``tf.IndexedSlices`` reduce as
+    allgather-of-slices (reference ``tensorflow/__init__.py:95-162``)."""
+    tf = _tf()
+    if isinstance(tensor, tf.IndexedSlices):
+        avg = (
+            average if average is not None
+            else (op is None or op == _eager.Average)
+        )
+        values = tensor.values
+        if prescale_factor != 1.0:
+            values = values * prescale_factor
+        values = allgather(values, process_set=process_set)
+        indices = allgather(tensor.indices, process_set=process_set)
+        if avg:
+            from .. import runtime
+
+            values = values / runtime.get_runtime().size
+        if postscale_factor != 1.0:
+            values = values * postscale_factor
+        return tf.IndexedSlices(
+            values=values, indices=indices, dense_shape=tensor.dense_shape
+        )
+    y = _eager.allreduce(
+        _to_np(tensor),
+        average=average, op=op, name=name, process_set=process_set,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+    )
+    return tf.constant(np.asarray(y))
+
+
+def allgather(tensor, name: Optional[str] = None, process_set=None):
+    tf = _tf()
+    return tf.constant(np.asarray(_eager.allgather(
+        _to_np(tensor), name=name, process_set=process_set
+    )))
+
+
+def broadcast(tensor, root_rank: int, name: Optional[str] = None,
+              process_set=None):
+    tf = _tf()
+    return tf.constant(np.asarray(_eager.broadcast(
+        _to_np(tensor), root_rank, name=name, process_set=process_set
+    )))
+
+
+def alltoall(tensor, splits=None, name: Optional[str] = None,
+             process_set=None):
+    tf = _tf()
+    out = _eager.alltoall(
+        _to_np(tensor), splits, name=name, process_set=process_set
+    )
+    if isinstance(out, tuple):
+        return tf.constant(np.asarray(out[0])), tf.constant(np.asarray(out[1]))
+    return tf.constant(np.asarray(out))
+
+
+def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None):
+    return _functions.broadcast_object(obj, root_rank=root_rank)
+
+
+def allgather_object(obj, name: Optional[str] = None):
+    return _functions.allgather_object(obj)
+
+
+# ---- variable plumbing (reference tensorflow/__init__.py:276) -----------
+
+def broadcast_variables(variables, root_rank: int = 0):
+    """Assign every variable its ``root_rank`` value (reference
+    ``broadcast_variables`` — called on ``model.variables`` +
+    ``optimizer.variables()`` before training).  Ships as ONE batched
+    object broadcast like the torch bridge."""
+    if _is_single_process():
+        return
+    payload = [v.numpy() for v in variables]
+    synced = _functions.broadcast_object(payload, root_rank=root_rank)
+    for v, val in zip(variables, synced):
+        v.assign(val)
+
+
+# ---- gradient reduction (DistributedGradientTape / DistributedOptimizer)
+
+def _reduce_grads(tf, grads: List[Any], average: bool) -> List[Any]:
+    """Fused process-level reduction of a gradient list; IndexedSlices
+    entries reduce as gathered slices (never densified on the wire)."""
+    if _is_single_process():
+        return list(grads)
+    from .. import runtime
+
+    rt = runtime.get_runtime()
+    out: List[Any] = list(grads)
+    dense_idx = [
+        i for i, g in enumerate(grads)
+        if g is not None and not isinstance(g, tf.IndexedSlices)
+    ]
+    # one flat buffer per dtype (fusion-buffer behavior)
+    by_dtype: Dict[str, List[int]] = {}
+    for i in dense_idx:
+        by_dtype.setdefault(grads[i].dtype.name, []).append(i)
+    for dtype_name, idxs in by_dtype.items():
+        flats = [np.asarray(grads[i]).reshape(-1) for i in idxs]
+        splits = np.cumsum([f.size for f in flats])[:-1]
+        red = _process_reduce(np.concatenate(flats), average)
+        for i, piece in zip(idxs, np.split(red, splits)):
+            out[i] = tf.constant(
+                piece.reshape(np.asarray(grads[i]).shape), grads[i].dtype
+            )
+    for i, g in enumerate(grads):
+        if isinstance(g, tf.IndexedSlices):
+            # allgather-of-slices across processes (reference :123-162)
+            vals = _functions.allgather_object(
+                (np.asarray(g.indices), np.asarray(g.values))
+            )
+            indices = np.concatenate([v[0] for v in vals])
+            values = np.concatenate([v[1] for v in vals])
+            if average:
+                values = values / rt.process_count
+            out[i] = tf.IndexedSlices(
+                values=tf.constant(values),
+                indices=tf.constant(indices),
+                dense_shape=g.dense_shape,
+            )
+    return out
+
+
+class DistributedGradientTape:
+    """Wraps ``tf.GradientTape``: ``gradient()`` returns cross-process
+    reduced gradients (reference ``tensorflow/__init__.py:759``)."""
+
+    def __init__(self, tape, average: bool = True, process_set=None,
+                 sparse_as_dense: bool = False):
+        self._tape = tape
+        self._average = average
+        self._sparse_as_dense = sparse_as_dense
+
+    def __getattr__(self, name):
+        if name == "_tape":
+            raise AttributeError(name)
+        return getattr(self._tape, name)
+
+    def gradient(self, target, sources, output_gradients=None):
+        tf = _tf()
+        grads = self._tape.gradient(target, sources, output_gradients)
+        flat = tf.nest.flatten(grads)
+        if self._sparse_as_dense:
+            flat = [
+                tf.convert_to_tensor(g)
+                if isinstance(g, tf.IndexedSlices) else g
+                for g in flat
+            ]
+        return tf.nest.pack_sequence_as(
+            grads, _reduce_grads(tf, flat, self._average)
+        )
+
+
+def DistributedOptimizer(optimizer, average: bool = True,
+                         sparse_as_dense: bool = False, process_set=None):
+    """Wrap a ``tf.keras`` optimizer so ``apply_gradients`` reduces
+    first (reference ``tensorflow/__init__.py:627``)."""
+    tf = _tf()
+
+    class _Wrapped(optimizer.__class__):
+        def apply_gradients(self_w, grads_and_vars, **kwargs):
+            pairs = list(grads_and_vars)
+            grads = [g for g, _ in pairs]
+            if sparse_as_dense:
+                grads = [
+                    tf.convert_to_tensor(g)
+                    if isinstance(g, tf.IndexedSlices) else g
+                    for g in grads
+                ]
+            reduced = _reduce_grads(tf, grads, average)
+            return super().apply_gradients(
+                zip(reduced, [v for _, v in pairs]), **kwargs
+            )
+
+    obj = optimizer  # share all state with the wrapped instance
+    obj.__class__ = _Wrapped
+    return obj

@@ -58,6 +58,7 @@ class TransformerConfig:
     attn_impl: str = "flash"     # "flash" | "full" | "ring" | "ulysses"
     sp_axis: str = SP_AXIS
     tp_axis: str = TP_AXIS
+    remat: bool = False          # jax.checkpoint each block (long-context)
     # MoE (0 ⇒ dense FFN everywhere):
     moe_every: int = 0           # use MoE FFN in every k-th block
     num_experts_local: int = 1
@@ -202,16 +203,25 @@ class Transformer(nn.Module):
         x = (x + jnp.take(wpe, pos, axis=0)[None]).astype(cfg.dtype)
 
         aux_total = jnp.zeros((), jnp.float32)
+        # remat: recompute block activations in backward instead of
+        # storing them (jax.checkpoint) — the standard FLOPs-for-HBM
+        # trade that unlocks larger batch/sequence (long-context).
+        block_cls = nn.remat(Block) if cfg.remat else Block
         for i in range(cfg.num_layers):
             use_moe = (
                 cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
             )
-            x, aux = Block(cfg, use_moe=use_moe, name=f"block_{i}")(x)
+            x, aux = block_cls(cfg, use_moe=use_moe, name=f"block_{i}")(x)
             aux_total = aux_total + aux
 
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
         # Tied output head (GPT-2 style): logits via embed transpose.
-        logits = emb.attend(x.astype(jnp.float32))
+        # The head matmul is ~25% of model FLOPs at T=1024 — run it in
+        # the compute dtype (bf16 hits the MXU at full rate; fp32 runs
+        # at ~1/8) and cast up for the fp32 softmax/loss downstream.
+        logits = (
+            x.astype(cfg.dtype) @ emb.embedding.T.astype(cfg.dtype)
+        ).astype(jnp.float32)
         return logits, aux_total
 
 

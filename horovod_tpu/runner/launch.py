@@ -201,6 +201,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     parser.add_argument("--autotune", action="store_true")
     parser.add_argument("--autotune-log-file")
     parser.add_argument("--log-level")
+    parser.add_argument("--use-mpi", action="store_true",
+                        help="launch workers via mpirun (reference "
+                        "horovodrun --use-mpi; MPI is launcher-only — "
+                        "collectives still ride XLA)")
+    parser.add_argument("--mpi-args", default="",
+                        help="extra args appended to the mpirun line")
     parser.add_argument("--config-file",
                         help="JSON/YAML config with the same knobs "
                         "(CLI flags win on conflict)")
@@ -250,6 +256,24 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
         from .elastic_launch import launch_elastic
 
         return launch_elastic(args)
+    if args.use_mpi:
+        import shlex
+
+        from .mpi_run import mpi_run
+
+        hosts = args.hosts
+        if args.hostfile and not hosts:
+            # translate the hostfile to mpirun -H syntax
+            hosts = ",".join(
+                f"{h.hostname}:{h.slots}"
+                for h in hosts_mod.parse_host_files(args.hostfile)
+            )
+        return mpi_run(
+            args.np, hosts, args.command,
+            extra_env=env_from_args(args),
+            mpi_args=shlex.split(args.mpi_args) if args.mpi_args else None,
+            verbose=args.verbose,
+        )
     if args.hostfile:
         host_list = hosts_mod.parse_host_files(args.hostfile)
     elif args.hosts:

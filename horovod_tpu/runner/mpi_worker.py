@@ -1,0 +1,65 @@
+"""Per-worker shim for the MPI launch path.
+
+Translates MPI launcher rank env (Open MPI ``OMPI_COMM_WORLD_*``, PMI
+``PMI_RANK``/``PMI_SIZE``, PMIx, Slurm ``SLURM_PROCID``) into this
+framework's worker env contract (the variables ``make_worker_env``
+sets, ``runner/launch.py:40``), then execs the user command.  The
+reference reads the same variables inside its MPI context
+(``horovod/runner/mpi_run.py`` env plumbing + ``common/basics.py``);
+here MPI is launcher-only, so the mapping happens once up front.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+
+def resolve_mpi_env(environ=None) -> dict:
+    """Return the HVD_TPU_* entries derived from the MPI-provided env
+    (pure function, unit-testable)."""
+    e = environ if environ is not None else os.environ
+    out = {}
+
+    def first(*names):
+        for n in names:
+            if n in e:
+                return e[n]
+        return None
+
+    rank = first("OMPI_COMM_WORLD_RANK", "PMIX_RANK", "PMI_RANK",
+                 "SLURM_PROCID")
+    size = first("OMPI_COMM_WORLD_SIZE", "PMI_SIZE", "SLURM_NTASKS")
+    local_rank = first("OMPI_COMM_WORLD_LOCAL_RANK", "MPI_LOCALRANKID",
+                       "SLURM_LOCALID")
+    local_size = first("OMPI_COMM_WORLD_LOCAL_SIZE", "MPI_LOCALNRANKS")
+    if local_size is None and "SLURM_TASKS_PER_NODE" in e:
+        # Slurm run-length syntax: "2(x3)" or "4,2" — this node's count
+        # is the first segment's value (homogeneous layouts; the env
+        # contract wants a plain integer).
+        seg = e["SLURM_TASKS_PER_NODE"].split(",")[0]
+        local_size = seg.split("(")[0]
+    if rank is not None:
+        out["HVD_TPU_CROSS_RANK"] = rank
+    if size is not None:
+        out["HVD_TPU_CROSS_SIZE"] = size
+    if local_rank is not None:
+        out["HVD_TPU_LOCAL_RANK"] = local_rank
+    if local_size is not None:
+        out["HVD_TPU_LOCAL_SIZE"] = local_size
+    return out
+
+
+def main() -> int:
+    os.environ.update(resolve_mpi_env())
+    cmd = sys.argv[1:]
+    if not cmd:
+        print("usage: python -m horovod_tpu.runner.mpi_worker cmd...",
+              file=sys.stderr)
+        return 2
+    os.execvp(cmd[0], cmd)
+    return 127  # unreachable
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,134 @@
+"""TensorFlow binding tests (reference ``test/parallel/test_tensorflow.py``
+scope, scaled to the single-controller stacked convention)."""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+
+tf = pytest.importorskip("tensorflow")
+
+import horovod_tpu.interop.tf as hvd_tf  # noqa: E402
+
+N = 8
+
+
+class TestCollectives:
+    def test_allreduce_average(self, hvd_module):
+        x = tf.constant(np.arange(N * 4, dtype=np.float32).reshape(N, 4))
+        y = hvd_tf.allreduce(x)
+        expect = np.asarray(x).mean(axis=0)
+        for r in range(N):
+            np.testing.assert_allclose(y.numpy()[r], expect, rtol=1e-6)
+
+    def test_allreduce_sum_op(self, hvd_module):
+        x = tf.ones((N, 3))
+        y = hvd_tf.allreduce(x, op=hvd.Sum)
+        np.testing.assert_allclose(y.numpy(), float(N))
+
+    def test_allgather(self, hvd_module):
+        x = tf.constant(np.random.RandomState(0).randn(N, 2, 3), tf.float32)
+        y = hvd_tf.allgather(x)
+        expect = np.asarray(x).reshape(N * 2, 3)
+        np.testing.assert_allclose(y.numpy()[0], expect, rtol=1e-6)
+
+    def test_broadcast(self, hvd_module):
+        x = tf.constant(np.random.RandomState(1).randn(N, 5), tf.float32)
+        y = hvd_tf.broadcast(x, root_rank=2)
+        for r in range(N):
+            np.testing.assert_allclose(y.numpy()[r], x.numpy()[2])
+
+    def test_indexed_slices_allreduce(self, hvd_module):
+        slices = tf.IndexedSlices(
+            values=tf.ones((N, 2, 4)),
+            indices=tf.constant(np.tile([1, 3], (N, 1)), tf.int32),
+            dense_shape=tf.constant([8, 4]),
+        )
+        out = hvd_tf.allreduce(slices)
+        assert isinstance(out, tf.IndexedSlices)
+        # gathered slices: N ranks x 2 rows each, averaged values
+        assert out.values.shape[1] == N * 2
+        np.testing.assert_allclose(out.values.numpy(), 1.0 / N)
+
+    def test_broadcast_variables_single_process_noop(self, hvd_module):
+        v = tf.Variable([1.0, 2.0])
+        hvd_tf.broadcast_variables([v], root_rank=0)
+        np.testing.assert_allclose(v.numpy(), [1.0, 2.0])
+
+
+class TestGradientTape:
+    def test_tape_reduces_dense(self, hvd_module):
+        w = tf.Variable([[1.0], [2.0]])
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_sum(tf.matmul(tf.ones((3, 2)), w))
+        dtape = hvd_tf.DistributedGradientTape(tape)
+        (g,) = dtape.gradient(loss, [w])
+        # single process: reduction is identity
+        np.testing.assert_allclose(g.numpy(), [[3.0], [3.0]])
+
+    def test_tape_sparse_as_dense(self, hvd_module):
+        emb = tf.Variable(tf.ones((10, 4)))
+        with tf.GradientTape() as tape:
+            rows = tf.gather(emb, [1, 3])
+            loss = tf.reduce_sum(rows)
+        dtape = hvd_tf.DistributedGradientTape(tape, sparse_as_dense=True)
+        (g,) = dtape.gradient(loss, [emb])
+        assert not isinstance(g, tf.IndexedSlices)
+        assert g.shape == (10, 4)
+
+    def test_tape_passthrough_attrs(self, hvd_module):
+        with tf.GradientTape(persistent=True) as tape:
+            pass
+        dtape = hvd_tf.DistributedGradientTape(tape)
+        assert dtape.watch.__func__ is tape.watch.__func__
+        assert dtape.watch.__self__ is tape
+
+
+class TestDistributedOptimizer:
+    def test_apply_gradients_trains(self, hvd_module):
+        w = tf.Variable([[0.0], [0.0]])
+        opt = hvd_tf.DistributedOptimizer(
+            tf.keras.optimizers.SGD(learning_rate=0.5)
+        )
+        X = tf.constant([[1.0, 0.0], [0.0, 1.0]])
+        for _ in range(20):
+            with tf.GradientTape() as tape:
+                loss = tf.reduce_mean(
+                    (tf.matmul(X, w) - tf.constant([[1.0], [2.0]])) ** 2
+                )
+            grads = tape.gradient(loss, [w])
+            opt.apply_gradients(zip(grads, [w]))
+        np.testing.assert_allclose(
+            w.numpy(), [[1.0], [2.0]], atol=0.05
+        )
+
+
+def test_multiprocess_tape_averages():
+    """Two processes, different grads: DistributedGradientTape must hand
+    both the mean (reference DistributedGradientTape contract)."""
+    import sys
+
+    import cloudpickle
+
+    import horovod_tpu.runner as runner
+
+    def worker():
+        import numpy as np
+        import tensorflow as tf
+
+        import horovod_tpu as hvd
+        import horovod_tpu.interop.tf as hvd_tf
+
+        hvd.init()
+        scale = float(hvd.process_rank() + 1)  # grads: 1x vs 2x
+        w = tf.Variable([[1.0], [1.0]])
+        with tf.GradientTape() as tape:
+            loss = scale * tf.reduce_sum(tf.matmul(tf.ones((1, 2)), w))
+        dtape = hvd_tf.DistributedGradientTape(tape)
+        (g,) = dtape.gradient(loss, [w])
+        return g.numpy().reshape(-1).tolist()
+
+    cloudpickle.register_pickle_by_value(sys.modules[__name__])
+    results = runner.run(worker, np=2, use_cpu_devices=True)
+    # mean of grad 1 and grad 2 = 1.5 on both processes
+    np.testing.assert_allclose(results, [[1.5, 1.5], [1.5, 1.5]])

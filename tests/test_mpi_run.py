@@ -1,0 +1,144 @@
+"""MPI launch path (reference ``horovod/runner/mpi_run.py`` +
+``test/single/test_run.py`` mpirun command construction tests)."""
+
+import os
+import stat
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu.runner import mpi_run as mr
+from horovod_tpu.runner.mpi_worker import resolve_mpi_env
+
+
+class TestCommandConstruction:
+    def test_basic_shape(self):
+        cmd = mr.get_mpi_command(
+            4, "host1:2,host2:2", ["python", "train.py"],
+            {"HVD_TPU_SECRET": "s", "PYTHONPATH": "/x", "HOME": "/root"},
+        )
+        assert cmd[0] == "mpirun"
+        assert "--allow-run-as-root" in cmd
+        i = cmd.index("-np")
+        assert cmd[i + 1] == "4"
+        i = cmd.index("-H")
+        assert cmd[i + 1] == "host1:2,host2:2"
+        # framework env forwarded; unrelated env not
+        xs = [cmd[j + 1] for j, a in enumerate(cmd) if a == "-x"]
+        assert "HVD_TPU_SECRET" in xs and "PYTHONPATH" in xs
+        assert "HOME" not in xs
+        # worker shim wraps the user command
+        j = cmd.index("-m")
+        assert cmd[j + 1] == "horovod_tpu.runner.mpi_worker"
+        assert cmd[-2:] == ["python", "train.py"]
+
+    def test_extra_mpi_args(self):
+        cmd = mr.get_mpi_command(
+            2, None, ["echo"], {}, mpi_args=["--map-by", "socket"]
+        )
+        k = cmd.index("--map-by")
+        assert cmd[k + 1] == "socket"
+        assert "-H" not in cmd
+
+    def test_unavailable_raises(self, monkeypatch):
+        monkeypatch.setenv("PATH", "/nonexistent")
+        assert not mr.is_mpi_available()
+        with pytest.raises(RuntimeError, match="mpirun not found"):
+            mr.mpi_run(2, None, ["echo"])
+
+
+class TestWorkerShim:
+    def test_resolve_openmpi_env(self):
+        env = {
+            "OMPI_COMM_WORLD_RANK": "3",
+            "OMPI_COMM_WORLD_SIZE": "8",
+            "OMPI_COMM_WORLD_LOCAL_RANK": "1",
+            "OMPI_COMM_WORLD_LOCAL_SIZE": "4",
+        }
+        out = resolve_mpi_env(env)
+        assert out == {
+            "HVD_TPU_CROSS_RANK": "3",
+            "HVD_TPU_CROSS_SIZE": "8",
+            "HVD_TPU_LOCAL_RANK": "1",
+            "HVD_TPU_LOCAL_SIZE": "4",
+        }
+
+    def test_resolve_slurm_env(self):
+        out = resolve_mpi_env({"SLURM_PROCID": "5", "SLURM_NTASKS": "16"})
+        assert out["HVD_TPU_CROSS_RANK"] == "5"
+        assert out["HVD_TPU_CROSS_SIZE"] == "16"
+
+    def test_resolve_slurm_tasks_per_node_runlength(self):
+        out = resolve_mpi_env({
+            "SLURM_PROCID": "0", "SLURM_NTASKS": "6",
+            "SLURM_LOCALID": "1", "SLURM_TASKS_PER_NODE": "2(x3)",
+        })
+        assert out["HVD_TPU_LOCAL_SIZE"] == "2"  # integer, not "2(x3)"
+        out2 = resolve_mpi_env({"SLURM_TASKS_PER_NODE": "4,2"})
+        assert out2["HVD_TPU_LOCAL_SIZE"] == "4"
+
+    def test_resolve_empty(self):
+        assert resolve_mpi_env({}) == {}
+
+    def test_shim_execs_command(self, tmp_path):
+        proc = subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.runner.mpi_worker",
+             sys.executable, "-c",
+             "import os; print(os.environ.get('HVD_TPU_CROSS_RANK'))"],
+            env={**os.environ, "OMPI_COMM_WORLD_RANK": "2",
+                 "PYTHONPATH": os.path.dirname(os.path.dirname(
+                     os.path.abspath(__file__)))},
+            capture_output=True, text=True, timeout=60,
+        )
+        assert proc.returncode == 0, proc.stderr
+        assert proc.stdout.strip() == "2"
+
+
+def test_mpi_run_end_to_end_with_fake_mpirun(tmp_path, monkeypatch):
+    """Full mpi_run flow against a fake mpirun that spawns np local
+    shim processes with OMPI env — the reference tests fake the mpirun
+    binary the same way."""
+    fake = tmp_path / "mpirun"
+    fake.write_text(
+        """#!/usr/bin/env python3
+import os, subprocess, sys
+args = sys.argv[1:]
+np_ = 1
+cmd = []
+i = 0
+while i < len(args):
+    if args[i] == "-np":
+        np_ = int(args[i + 1]); i += 2
+    elif args[i] in ("-H", "-x", "--map-by"):
+        i += 2
+    elif args[i].startswith("--"):
+        i += 1
+    else:
+        cmd = args[i:]; break
+procs = []
+for r in range(np_):
+    env = dict(os.environ)
+    env["OMPI_COMM_WORLD_RANK"] = str(r)
+    env["OMPI_COMM_WORLD_SIZE"] = str(np_)
+    procs.append(subprocess.Popen(cmd, env=env))
+sys.exit(max(p.wait() for p in procs))
+"""
+    )
+    fake.chmod(fake.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH", f"{tmp_path}:{os.environ['PATH']}")
+    assert mr.is_mpi_available()
+
+    out_file = tmp_path / "out"
+    rc = mr.mpi_run(
+        2, None,
+        [sys.executable, "-c",
+         "import os; open(os.environ['OUT'], 'a').write("
+         "os.environ['HVD_TPU_CROSS_RANK'] + ':' + "
+         "os.environ['HVD_TPU_CROSS_SIZE'] + ':' + "
+         "('y' if os.environ.get('HVD_TPU_SECRET') else 'n') + '\\n')"],
+        extra_env={"OUT": str(out_file)},
+    )
+    assert rc == 0
+    lines = sorted(out_file.read_text().splitlines())
+    assert lines == ["0:2:y", "1:2:y"]

@@ -164,3 +164,22 @@ def test_shard_local_attention_on_sp_mesh_raises():
     )
     with pytest.raises(ValueError, match="shard-local"):
         jax.jit(f)(params, toks)
+
+
+def test_remat_matches_no_remat():
+    """cfg.remat must change memory behavior only — identical logits
+    and gradients (jax.checkpoint semantics)."""
+    toks = jnp.asarray(np.random.RandomState(0).randint(0, 256, (2, 16)))
+    plain = gpt_tiny()
+    remat = gpt_tiny(remat=True)
+    params = plain.init(jax.random.PRNGKey(0), toks)
+
+    def loss(model, p):
+        logits, aux = model.apply(p, toks)
+        return jnp.mean(logits ** 2) + aux
+
+    l1, g1 = jax.value_and_grad(lambda p: loss(plain, p))(params)
+    l2, g2 = jax.value_and_grad(lambda p: loss(remat, p))(params)
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
+    for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
