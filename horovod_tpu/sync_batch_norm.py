@@ -1,51 +1,125 @@
 """Cross-replica synchronized BatchNorm.
 
-Reference: ``horovod/torch/sync_batch_norm.py`` (218 LoC) and
-``horovod/tensorflow/sync_batch_norm.py`` — both allreduce the batch
-moments across ranks before normalizing.
+Reference: ``horovod/torch/sync_batch_norm.py`` (forward: allreduce of
+per-rank mean + inverse-count-weighted var, ``:120-160``; hand-written
+backward allreducing weight/bias grads) and
+``horovod/tensorflow/sync_batch_norm.py`` (sum + sum-of-squares
+allreduce).
 
-On TPU this is a first-class XLA pattern: flax's ``nn.BatchNorm``
-already takes ``axis_name``/``axis_index_groups`` and computes moments
-with a fused cross-replica mean over the mesh axis.  ``SyncBatchNorm``
-is a configured constructor pinning that axis to the world axis (or a
-process-set partition), so reference users get the same drop-in name
-with the collective compiled into the training step instead of a
-hand-written allreduce of sum/sum-of-squares.
+TPU re-design: the moments collective is traced into the training step
+— one fused ``(2F+1)``-element allreduce of
+``[sum, sum_of_squares, count]`` over the mesh axis (the TF variant's
+algorithm; count participates so arbitrary process sets and future
+uneven batches weight correctly).  The backward pass is autodiff
+through that collective: differentiating ``psum`` inserts the mirror
+``psum``, which is exactly the reference's hand-written backward
+(``sync_batch_norm.py:162-218``) — XLA derives it for free.
+
+Unlike pinning flax's ``nn.BatchNorm(axis_name=...)``, this module
+syncs over *any* process set (masked/ring lowering, not just XLA
+replica-group partitions) and degrades gracefully outside ``shard_map``
+(local moments — the single-device test/init path, matching the other
+modules' convention).
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Any, Optional
 
 import flax.linen as nn
+import jax.numpy as jnp
+from jax import lax
 
+from .ops import traced
+from .parallel.tensor import _axis_present
 from .process_sets import ProcessSet
-from .runtime import WORLD_AXIS, get_runtime
+from .runtime import WORLD_AXIS
 
 
-def SyncBatchNorm(
-    *,
-    axis_name: Optional[str] = WORLD_AXIS,
-    process_set: Optional[ProcessSet] = None,
-    **kwargs,
-) -> nn.BatchNorm:
-    """Build a BatchNorm whose moments are averaged across the mesh.
+class SyncBatchNorm(nn.Module):
+    """BatchNorm whose batch moments are reduced across the mesh.
 
-    Must run inside a ``shard_map``/``distributed_train_step`` context
-    (the moments collective needs the mesh axis) — initialize the model
-    with ``use_running_average=True`` (eval mode) outside it.
-    ``process_set``
-    restricts the sync group like the reference's ``process_set``
-    argument, lowering to XLA replica groups; it must evenly partition
-    the world.
+    Drop-in for ``nn.BatchNorm`` (same param/stat names: ``scale``,
+    ``bias``, ``mean``, ``var``; features on the last axis); initialize
+    with ``use_running_average=True`` outside ``shard_map`` (the
+    collective needs the mesh axis), train inside
+    ``distributed_train_step`` / ``shard_map``.
+
+    Note: before round 3 this was a configured ``nn.BatchNorm``
+    factory, so flax variable trees were keyed ``BatchNorm_<i>``;
+    checkpoints from that era need the module key renamed to
+    ``SyncBatchNorm_<i>`` on restore.
     """
-    groups = None
-    if process_set is not None and process_set.process_set_id != 0:
-        table = get_runtime().process_set_table
-        groups = table.partition_groups(process_set)
-        if groups is None:
-            raise ValueError(
-                "SyncBatchNorm process_set must evenly partition the world "
-                f"(XLA replica groups); got {list(process_set.ranks)}"
+
+    use_running_average: Optional[bool] = None
+    axis_name: Optional[str] = WORLD_AXIS
+    process_set: Optional[ProcessSet] = None
+    momentum: float = 0.99  # flax nn.BatchNorm drop-in default
+    epsilon: float = 1e-5
+    dtype: Optional[Any] = None
+    use_bias: bool = True
+    use_scale: bool = True
+
+    @nn.compact
+    def __call__(self, x, use_running_average: Optional[bool] = None):
+        use_ra = nn.merge_param(
+            "use_running_average", self.use_running_average,
+            use_running_average,
+        )
+        features = x.shape[-1]
+        ra_mean = self.variable(
+            "batch_stats", "mean", lambda: jnp.zeros((features,), jnp.float32)
+        )
+        ra_var = self.variable(
+            "batch_stats", "var", lambda: jnp.ones((features,), jnp.float32)
+        )
+
+        if use_ra:
+            mean, var = ra_mean.value, ra_var.value
+        else:
+            xf = x.astype(jnp.float32)
+            reduce_axes = tuple(range(x.ndim - 1))
+            local_sum = jnp.sum(xf, axis=reduce_axes)
+            local_sq = jnp.sum(xf * xf, axis=reduce_axes)
+            local_count = jnp.asarray(
+                xf.size // features, jnp.float32
             )
-    return nn.BatchNorm(axis_name=axis_name, axis_index_groups=groups, **kwargs)
+            if self.axis_name and _axis_present(self.axis_name):
+                # One fused allreduce of [sum | sum_sq | count] — the
+                # reference's two allreduces collapsed into a single
+                # (2F+1)-element collective; works on arbitrary process
+                # sets through the traced lowering.
+                packed = jnp.concatenate(
+                    [local_sum, local_sq, local_count[None]]
+                )
+                packed = traced.allreduce(
+                    packed, axis=self.axis_name, op=traced.Sum,
+                    process_set=self.process_set,
+                )
+                total_sum = packed[:features]
+                total_sq = packed[features : 2 * features]
+                count = packed[-1]
+            else:  # outside shard_map: local moments (init/test path)
+                total_sum, total_sq, count = local_sum, local_sq, local_count
+            mean = total_sum / count
+            var = total_sq / count - mean * mean
+            if not self.is_initializing():
+                ra_mean.value = (
+                    self.momentum * ra_mean.value
+                    + (1.0 - self.momentum) * mean
+                )
+                ra_var.value = (
+                    self.momentum * ra_var.value
+                    + (1.0 - self.momentum) * var
+                )
+
+        y = (x.astype(jnp.float32) - mean) * lax.rsqrt(var + self.epsilon)
+        if self.use_scale:
+            y = y * self.param(
+                "scale", nn.initializers.ones, (features,), jnp.float32
+            )
+        if self.use_bias:
+            y = y + self.param(
+                "bias", nn.initializers.zeros, (features,), jnp.float32
+            )
+        return y.astype(self.dtype or x.dtype)

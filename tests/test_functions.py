@@ -69,8 +69,8 @@ def test_sync_batch_norm_module(hvd_module):
     out_sharded, stats_sharded = f(params, stats, x)
 
     # single-device reference: identical net with a plain (unsynced)
-    # BatchNorm over the full global batch — same param tree (the
-    # SyncBatchNorm factory returns an nn.BatchNorm named BatchNorm_0)
+    # BatchNorm over the full global batch — same leaf names
+    # (scale/bias, mean/var), module key renamed across the trees
     class NetRef(nn.Module):
         @nn.compact
         def __call__(self, x, train=True):
@@ -78,15 +78,21 @@ def test_sync_batch_norm_module(hvd_module):
             x = nn.BatchNorm(use_running_average=not train)(x)
             return x
 
+    def renamed(tree):
+        return {
+            ("BatchNorm_0" if k == "SyncBatchNorm_0" else k): v
+            for k, v in tree.items()
+        }
+
     out_ref, updated_ref = NetRef().apply(
-        {"params": params, "batch_stats": stats}, x, train=True,
-        mutable=["batch_stats"],
+        {"params": renamed(params), "batch_stats": renamed(stats)}, x,
+        train=True, mutable=["batch_stats"],
     )
     np.testing.assert_allclose(
         np.asarray(out_sharded), np.asarray(out_ref), rtol=1e-4, atol=1e-5
     )
     np.testing.assert_allclose(
-        np.asarray(stats_sharded["BatchNorm_0"]["mean"]),
+        np.asarray(stats_sharded["SyncBatchNorm_0"]["mean"]),
         np.asarray(updated_ref["batch_stats"]["BatchNorm_0"]["mean"]),
         rtol=1e-4, atol=1e-6,
     )
