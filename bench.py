@@ -221,9 +221,21 @@ def main():
 
 
 if __name__ == "__main__":
+    # Hard deadline: a wedged device tunnel would otherwise hang forever
+    # and the driver would record nothing — emit an error JSON instead.
+    import os
+    import signal
+
+    def _deadline(signum, frame):
+        raise TimeoutError(
+            "bench deadline exceeded (device hang or tunnel stall)"
+        )
+
+    signal.signal(signal.SIGALRM, _deadline)
+    signal.alarm(int(os.environ.get("HVD_BENCH_DEADLINE_S", "480")))
     try:
         main()
-    except Exception as e:
+    except Exception as e:  # TimeoutError from the alarm lands here too
         print(json.dumps({
             "metric": "resnet50_synthetic_train_throughput",
             "value": 0.0,

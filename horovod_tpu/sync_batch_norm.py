@@ -59,6 +59,8 @@ class SyncBatchNorm(nn.Module):
     dtype: Optional[Any] = None
     use_bias: bool = True
     use_scale: bool = True
+    scale_init: Any = nn.initializers.ones
+    bias_init: Any = nn.initializers.zeros
 
     @nn.compact
     def __call__(self, x, use_running_average: Optional[bool] = None):
@@ -113,13 +115,22 @@ class SyncBatchNorm(nn.Module):
                     + (1.0 - self.momentum) * var
                 )
 
-        y = (x.astype(jnp.float32) - mean) * lax.rsqrt(var + self.epsilon)
+        # Fold the normalization into two (F,)-sized fp32 vectors and
+        # apply them in the compute dtype — the activation tensor never
+        # round-trips through fp32 (the bf16 BN fast path resnet.py
+        # measured at +19%): y = x * mult + shift.
+        mult = lax.rsqrt(var + self.epsilon)
         if self.use_scale:
-            y = y * self.param(
-                "scale", nn.initializers.ones, (features,), jnp.float32
+            mult = mult * self.param(
+                "scale", self.scale_init, (features,), jnp.float32
             )
+        shift = -mean * mult
         if self.use_bias:
-            y = y + self.param(
-                "bias", nn.initializers.zeros, (features,), jnp.float32
+            shift = shift + self.param(
+                "bias", self.bias_init, (features,), jnp.float32
             )
-        return y.astype(self.dtype or x.dtype)
+        out_dtype = self.dtype or x.dtype
+        return (
+            x.astype(out_dtype) * mult.astype(out_dtype)
+            + shift.astype(out_dtype)
+        )

@@ -98,3 +98,36 @@ def test_inception_v3_forward(hvd_module):
     logits = model.apply(variables, x, train=False)
     assert logits.shape == (1, 10)
     assert "batch_stats" in variables
+
+
+def test_resnet_sync_bn_matches_global_batch_norm(hvd_module):
+    """sync_bn=True: BN moments are the GLOBAL batch's (cross-replica
+    sync), so the sharded forward equals the unsharded forward."""
+    import jax
+    from jax import shard_map
+    from jax.sharding import PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu.models import ResNet
+
+    model = ResNet(stage_sizes=[1], num_classes=4, num_filters=8,
+                   dtype=jnp.float32, sync_bn=True)
+    x = jnp.asarray(
+        np.random.RandomState(0).rand(16, 8, 8, 3), jnp.float32
+    )
+    variables = model.init(jax.random.PRNGKey(0), x[:1], train=False)
+
+    def fwd(v, xb):
+        out, _ = model.apply(v, xb, train=True, mutable=["batch_stats"])
+        return out
+
+    sharded = jax.jit(shard_map(
+        fwd, mesh=hvd.mesh(), in_specs=(P(), P(hvd.WORLD_AXIS)),
+        out_specs=P(hvd.WORLD_AXIS), check_vma=False,
+    ))(variables, x)
+    # single-device reference: same model over the whole batch — the
+    # local moments ARE the global moments there
+    dense = fwd(variables, x)
+    np.testing.assert_allclose(
+        np.asarray(sharded), np.asarray(dense), rtol=2e-3, atol=2e-3
+    )
