@@ -55,6 +55,25 @@ def test_synthetic_benchmark_example():
     assert "Img/sec per chip" in out
 
 
+def test_embedding_sparse_example():
+    out = _run_example("embedding_sparse.py", "--steps", "120",
+                       "--batch-size", "16", "--lr", "2.0",
+                       "--num-samples", "32768")
+    lines = [l for l in out.splitlines() if l.startswith("step")]
+    assert lines, out
+    first = float(lines[0].split()[3])
+    last = float(lines[-1].split()[3])
+    assert last < first, (first, last)
+    assert "sparse reduction" in lines[-1]
+
+
+def test_embedding_sparse_as_dense_example():
+    out = _run_example("embedding_sparse.py", "--steps", "10",
+                       "--batch-size", "8", "--num-samples", "2048",
+                       "--sparse-as-dense")
+    assert "dense reduction" in out
+
+
 def test_gpt_pretrain_example():
     out = _run_example(
         "gpt_pretrain.py", "--dp", "2", "--sp", "2", "--tp", "2",
