@@ -95,6 +95,67 @@ def test_run_true_join_last_rank():
     assert results[0] == results[1] == 1
 
 
+def _multi_collective_suite():
+    """One worker body exercising every collective across 2 real
+    processes (the reference's test_static_run-style sweep)."""
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    r = hvd.process_rank()
+    out = {}
+
+    x = np.full((1, 3), float(r + 1), np.float32)
+    out["allgather"] = np.asarray(hvd.allgather(x)).tolist()
+    out["broadcast"] = np.asarray(hvd.broadcast(x, root_rank=1)).tolist()
+    out["reducescatter"] = np.asarray(
+        hvd.reducescatter(np.full((1, 2, 3), float(r + 1), np.float32))
+    ).tolist()
+    a2a = np.asarray(
+        hvd.alltoall(np.full((1, 2, 3), float(r + 1), np.float32))
+    )
+    out["alltoall"] = a2a.tolist()
+    out["allgather_v"] = np.asarray(
+        hvd.allgather_v([np.full((r + 1, 2), float(r), np.float32)])
+    ).tolist()
+    out["grouped"] = [
+        np.asarray(t).tolist()
+        for t in hvd.grouped_allreduce(
+            [x, np.full((1, 1), float(r), np.float32)], op=hvd.Sum
+        )
+    ]
+    return out
+
+
+def test_run_collective_sweep_across_processes():
+    results = runner.run(_multi_collective_suite, np=2, use_cpu_devices=True)
+    r0, r1 = results
+    # allgather: per-rank (3,) tensors concatenate to (6,)
+    assert np.asarray(r0["allgather"]).shape == (1, 6)
+    np.testing.assert_allclose(
+        np.asarray(r0["allgather"])[0], [1, 1, 1, 2, 2, 2]
+    )
+    np.testing.assert_allclose(r0["allgather"], r1["allgather"])
+    # broadcast from rank 1: everyone holds 2.0
+    np.testing.assert_allclose(np.asarray(r0["broadcast"]), 2.0)
+    np.testing.assert_allclose(np.asarray(r1["broadcast"]), 2.0)
+    # reducescatter: rank r gets row r of the summed (2,3) payload = 3.0
+    np.testing.assert_allclose(np.asarray(r0["reducescatter"]), 3.0)
+    np.testing.assert_allclose(np.asarray(r1["reducescatter"]), 3.0)
+    # alltoall: rank r's row j = rank j's chunk r
+    np.testing.assert_allclose(np.asarray(r0["alltoall"])[0, 0], 1.0)
+    np.testing.assert_allclose(np.asarray(r0["alltoall"])[0, 1], 2.0)
+    # ragged allgather: 1 row from rank 0 (value 0) + 2 rows from rank 1
+    v = np.asarray(r0["allgather_v"])
+    assert v.shape == (3, 2)
+    np.testing.assert_allclose(v[:, 0], [0.0, 1.0, 1.0])
+    np.testing.assert_allclose(r0["allgather_v"], r1["allgather_v"])
+    # grouped allreduce sums both tensors atomically
+    np.testing.assert_allclose(np.asarray(r0["grouped"][0]), 3.0)
+    np.testing.assert_allclose(np.asarray(r0["grouped"][1]), 1.0)
+
+
 def _consistency_ok():
     import numpy as np
 
