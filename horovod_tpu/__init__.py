@@ -172,6 +172,7 @@ from .optim import (  # noqa: F401,E402
     DistributedAdasumOptimizer,
     DistributedOptimizer,
     distributed_train_step,
+    fsdp_train_step,
     zero_train_step,
 )
 from .functions import (  # noqa: F401,E402

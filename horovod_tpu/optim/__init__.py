@@ -6,5 +6,6 @@ from .distributed_optimizer import (  # noqa: F401
 )
 from .zero import (  # noqa: F401
     sharded_gradient_transformation,
+    fsdp_train_step,
     zero_train_step,
 )

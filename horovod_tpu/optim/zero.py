@@ -1,4 +1,4 @@
-"""ZeRO-1 sharded optimizer states (capability add beyond the reference).
+"""ZeRO-1/ZeRO-3 sharded training states (capability add beyond the reference).
 
 The reference replicates optimizer state on every rank (its
 DistributedOptimizer wraps a local optimizer; only gradients cross the
@@ -153,5 +153,120 @@ def zero_train_step(
                     check_vma=False,
                 ), donate_argnums=(0, 1))
             return self._fn(params, opt_state, batch)
+
+    return _Step()
+
+
+def fsdp_train_step(
+    loss_fn,
+    tx: optax.GradientTransformation,
+    *,
+    axis=WORLD_AXIS,
+):
+    """ZeRO-3-style fully sharded step: *parameters and optimizer state*
+    both live as 1/N flat shards between steps.
+
+    Per step: one tiled ``all_gather`` re-materializes the full
+    parameter vector for fwd/bwd, one ``psum_scatter`` reduces
+    gradients straight into shards, and the optimizer update runs on
+    the 1/N slice — the same total wire bytes as an allreduce, with
+    persistent per-chip storage of ``(1 + opt_moments)/N`` of the
+    model instead of ``1 + opt_moments`` replicated (FSDP over the
+    flattened vector; per-layer gather scheduling is XLA's latency
+    hiding problem under jit).
+
+    Call convention::
+
+        step = fsdp_train_step(loss_fn, tx)
+        pshards, opt_state = step.init(params)          # shard it all
+        pshards, opt_state, loss = step(pshards, opt_state, batch)
+        params = step.gather(pshards)                   # eval/checkpoint
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from .. import runtime as _rt
+
+    rt = _rt.get_runtime()
+    mesh = rt.mesh
+    world = rt.size
+    meta = {}
+
+    def init_body(params):
+        flat, _ = ravel_pytree(params)
+        n = flat.shape[0]
+        padded = -(-n // world) * world
+        shard_len = padded // world
+        idx = lax.axis_index(axis)
+        flat = jnp.pad(flat, (0, padded - n))
+        pshard = lax.dynamic_slice(flat, (idx * shard_len,), (shard_len,))
+        return pshard, tx.init(pshard)
+
+    def step_body(pshard, opt_state, batch):
+        pfull = lax.all_gather(pshard, axis, tiled=True)[: meta["n"]]
+        params = meta["unravel"](pfull)
+        loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+        gflat, _ = ravel_pytree(grads)
+        gflat = jnp.pad(gflat, (0, meta["padded"] - meta["n"]))
+        gshard = lax.psum_scatter(
+            gflat, axis, scatter_dimension=0, tiled=True
+        ) / world
+        ushard, opt_state = tx.update(gshard, opt_state, pshard)
+        pshard = optax.apply_updates(pshard, ushard)
+        return pshard, opt_state, lax.pmean(loss, axis)
+
+    def gather_body(pshard):
+        return meta["unravel"](
+            lax.all_gather(pshard, axis, tiled=True)[: meta["n"]]
+        )
+
+    class _Step:
+        def __init__(self):
+            self._fn = None
+            self._gather = None
+
+        def init(self, params):
+            flat, unravel = ravel_pytree(params)
+            meta["n"] = flat.shape[0]
+            meta["padded"] = -(-meta["n"] // world) * world
+            meta["unravel"] = unravel
+            f = jax.shard_map(
+                init_body, mesh=mesh, in_specs=(P(),),
+                out_specs=(
+                    P(axis),
+                    jax.tree.map(
+                        lambda leaf: P(axis) if leaf.ndim > 0 else P(),
+                        jax.eval_shape(
+                            lambda: tx.init(jnp.zeros(
+                                (meta["padded"] // world,), flat.dtype
+                            ))
+                        ),
+                    ),
+                ),
+                check_vma=False,
+            )
+            return jax.jit(f)(params)
+
+        def __call__(self, pshard, opt_state, batch):
+            if self._fn is None:
+                state_spec = jax.tree.map(
+                    lambda leaf: P(axis) if getattr(leaf, "ndim", 0) > 0 else P(),
+                    opt_state,
+                )
+                batch_spec = jax.tree.map(lambda _: P(axis), batch)
+                self._fn = jax.jit(jax.shard_map(
+                    step_body, mesh=mesh,
+                    in_specs=(P(axis), state_spec, batch_spec),
+                    out_specs=(P(axis), state_spec, P()),
+                    check_vma=False,
+                ), donate_argnums=(0, 1))
+            return self._fn(pshard, opt_state, batch)
+
+        def gather(self, pshard):
+            if self._gather is None:
+                self._gather = jax.jit(jax.shard_map(
+                    gather_body, mesh=mesh, in_specs=(P(axis),),
+                    out_specs=P(), check_vma=False,
+                ))
+            return self._gather(pshard)
 
     return _Step()
