@@ -47,12 +47,16 @@ def main():
                         help="124M GPT-2-small config instead of tiny")
     parser.add_argument("--attn", default="ring",
                         choices=["ring", "ulysses", "flash", "full"])
+    parser.add_argument("--remat", action="store_true",
+                        help="jax.checkpoint each block (long-context "
+                        "activation memory)")
     args = parser.parse_args()
 
     hvd.init()
     mesh = make_mesh(dp=args.dp, sp=args.sp, tp=args.tp)
     build = gpt_small if args.small else gpt_tiny
-    model = build(attn_impl=args.attn, max_len=args.seq_per_sp * args.sp)
+    model = build(attn_impl=args.attn, max_len=args.seq_per_sp * args.sp,
+                  remat=args.remat)
     cfg = model.cfg
 
     b = args.batch_per_dp * args.dp

@@ -123,10 +123,7 @@ def zero_train_step(
             shard_len = -(-flat.shape[0] // world)
             return tx.init(jnp.zeros((shard_len,), flat.dtype))
 
-        shape = jax.eval_shape(abstract_init, params)
-        return jax.tree.map(
-            lambda leaf: P(axis) if leaf.ndim > 0 else P(), shape
-        )
+        return _state_spec(jax.eval_shape(abstract_init, params), axis)
 
     class _Step:
         def __init__(self):
@@ -141,10 +138,7 @@ def zero_train_step(
 
         def __call__(self, params, opt_state, batch):
             if self._fn is None:
-                state_spec = jax.tree.map(
-                    lambda leaf: P(axis) if getattr(leaf, "ndim", 0) > 0 else P(),
-                    opt_state,
-                )
+                state_spec = _state_spec(opt_state, axis)
                 batch_spec = jax.tree.map(lambda _: P(axis), batch)
                 self._fn = jax.jit(jax.shard_map(
                     step_body, mesh=mesh,
@@ -157,11 +151,53 @@ def zero_train_step(
     return _Step()
 
 
+def _state_spec(tree, axis):
+    """Spec pytree: array leaves shard over ``axis``, scalars replicate."""
+    from jax.sharding import PartitionSpec as P
+
+    return jax.tree.map(
+        lambda leaf: P(axis) if getattr(leaf, "ndim", 0) > 0 else P(), tree
+    )
+
+
+def _flat_layout(params_like, world: int):
+    """(n, padded, shard_len, ravel, unravel) for a param pytree.
+
+    Works on concrete arrays OR shape/dtype structs
+    (``jax.eval_shape`` output), so the layout can be rebuilt for
+    checkpoint restore without materializing full parameters.  The
+    ravel preserves each leaf's dtype (no common-dtype promotion)."""
+    import numpy as np
+
+    leaves, treedef = jax.tree.flatten(params_like)
+    shapes = [tuple(l.shape) for l in leaves]
+    dtypes = [jnp.dtype(l.dtype) for l in leaves]
+    sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+    n = sum(sizes)
+    padded = -(-n // world) * world
+
+    def ravel(tree):
+        ls = jax.tree.leaves(tree)
+        return jnp.concatenate(
+            [l.reshape(-1).astype(jnp.float32) for l in ls]
+        )
+
+    def unravel(flat):
+        out, off = [], 0
+        for sh, dt, sz in zip(shapes, dtypes, sizes):
+            out.append(flat[off : off + sz].reshape(sh).astype(dt))
+            off += sz
+        return jax.tree.unflatten(treedef, out)
+
+    return n, padded, padded // world, ravel, unravel
+
+
 def fsdp_train_step(
     loss_fn,
     tx: optax.GradientTransformation,
     *,
     axis=WORLD_AXIS,
+    example_params=None,
 ):
     """ZeRO-3-style fully sharded step: *parameters and optimizer state*
     both live as 1/N flat shards between steps.
@@ -181,6 +217,19 @@ def fsdp_train_step(
         pshards, opt_state = step.init(params)          # shard it all
         pshards, opt_state, loss = step(pshards, opt_state, batch)
         params = step.gather(pshards)                   # eval/checkpoint
+
+    Checkpoint restore without materializing full params: pass the
+    parameter *structure* up front (``example_params`` may be
+    ``jax.eval_shape`` output — no real arrays needed), then feed the
+    restored shards straight into ``step``/``gather``::
+
+        shapes = jax.eval_shape(model.init, rng, dummy)
+        step = fsdp_train_step(loss_fn, tx, example_params=shapes)
+        pshards, opt_state = restored  # from your checkpoint
+        pshards, opt_state, loss = step(pshards, opt_state, batch)
+
+    Sharding is over the flattened fp32-raveled vector; leaf dtypes are
+    restored on unravel.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -191,22 +240,40 @@ def fsdp_train_step(
     world = rt.size
     meta = {}
 
+    def _set_layout(params_like):
+        (meta["n"], meta["padded"], meta["shard_len"], meta["ravel"],
+         meta["unravel"]) = _flat_layout(params_like, world)
+
+    if example_params is not None:
+        _set_layout(example_params)
+
+    def _layout():
+        if "unravel" not in meta:
+            raise RuntimeError(
+                "fsdp_train_step: parameter layout unknown — call "
+                "init(params) first, or construct with "
+                "example_params=jax.eval_shape(model.init, ...) when "
+                "restoring shards from a checkpoint"
+            )
+        return meta
+
     def init_body(params):
-        flat, _ = ravel_pytree(params)
-        n = flat.shape[0]
-        padded = -(-n // world) * world
-        shard_len = padded // world
+        m = _layout()
+        flat = m["ravel"](params)
         idx = lax.axis_index(axis)
-        flat = jnp.pad(flat, (0, padded - n))
-        pshard = lax.dynamic_slice(flat, (idx * shard_len,), (shard_len,))
+        flat = jnp.pad(flat, (0, m["padded"] - m["n"]))
+        pshard = lax.dynamic_slice(
+            flat, (idx * m["shard_len"],), (m["shard_len"],)
+        )
         return pshard, tx.init(pshard)
 
     def step_body(pshard, opt_state, batch):
-        pfull = lax.all_gather(pshard, axis, tiled=True)[: meta["n"]]
-        params = meta["unravel"](pfull)
+        m = _layout()
+        pfull = lax.all_gather(pshard, axis, tiled=True)[: m["n"]]
+        params = m["unravel"](pfull)
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
-        gflat, _ = ravel_pytree(grads)
-        gflat = jnp.pad(gflat, (0, meta["padded"] - meta["n"]))
+        gflat = m["ravel"](grads)
+        gflat = jnp.pad(gflat, (0, m["padded"] - m["n"]))
         gshard = lax.psum_scatter(
             gflat, axis, scatter_dimension=0, tiled=True
         ) / world
@@ -215,8 +282,9 @@ def fsdp_train_step(
         return pshard, opt_state, lax.pmean(loss, axis)
 
     def gather_body(pshard):
-        return meta["unravel"](
-            lax.all_gather(pshard, axis, tiled=True)[: meta["n"]]
+        m = _layout()
+        return m["unravel"](
+            lax.all_gather(pshard, axis, tiled=True)[: m["n"]]
         )
 
     class _Step:
@@ -225,21 +293,18 @@ def fsdp_train_step(
             self._gather = None
 
         def init(self, params):
-            flat, unravel = ravel_pytree(params)
-            meta["n"] = flat.shape[0]
-            meta["padded"] = -(-meta["n"] // world) * world
-            meta["unravel"] = unravel
+            _set_layout(params)
             f = jax.shard_map(
                 init_body, mesh=mesh, in_specs=(P(),),
                 out_specs=(
                     P(axis),
-                    jax.tree.map(
-                        lambda leaf: P(axis) if leaf.ndim > 0 else P(),
+                    _state_spec(
                         jax.eval_shape(
                             lambda: tx.init(jnp.zeros(
-                                (meta["padded"] // world,), flat.dtype
+                                (meta["shard_len"],), jnp.float32
                             ))
                         ),
+                        axis,
                     ),
                 ),
                 check_vma=False,
@@ -247,11 +312,9 @@ def fsdp_train_step(
             return jax.jit(f)(params)
 
         def __call__(self, pshard, opt_state, batch):
+            _layout()
             if self._fn is None:
-                state_spec = jax.tree.map(
-                    lambda leaf: P(axis) if getattr(leaf, "ndim", 0) > 0 else P(),
-                    opt_state,
-                )
+                state_spec = _state_spec(opt_state, axis)
                 batch_spec = jax.tree.map(lambda _: P(axis), batch)
                 self._fn = jax.jit(jax.shard_map(
                     step_body, mesh=mesh,
@@ -262,6 +325,7 @@ def fsdp_train_step(
             return self._fn(pshard, opt_state, batch)
 
         def gather(self, pshard):
+            _layout()
             if self._gather is None:
                 self._gather = jax.jit(jax.shard_map(
                     gather_body, mesh=mesh, in_specs=(P(axis),),

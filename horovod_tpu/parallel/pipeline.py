@@ -28,6 +28,7 @@ def pipeline_apply(
     microbatches: jax.Array,
     axis: str = PP_AXIS,
     broadcast_outputs: bool = True,
+    remat_stage: bool = False,
 ) -> jax.Array:
     """Run microbatches through the n-stage pipeline.
 
@@ -40,7 +41,17 @@ def pipeline_apply(
 
     Returns [M, B, ...] outputs — on every device when
     ``broadcast_outputs`` (one psum), else valid on the last stage only.
+
+    ``remat_stage=True`` wraps the stage in ``jax.checkpoint`` so the
+    backward recomputes each stage invocation's *internal*
+    intermediates instead of storing them — the per-step stage inputs
+    (the loop carry) are still saved by the scan backward, so memory
+    remains linear in the schedule length; what shrinks is the
+    per-step constant (roughly the stage's intermediates-to-input
+    ratio, ~an order of magnitude for a transformer block).
     """
+    if remat_stage:
+        stage_fn = jax.checkpoint(stage_fn)
     n = lax.axis_size(axis)
     stage = lax.axis_index(axis)
     m = microbatches.shape[0]

@@ -244,6 +244,35 @@ def test_pipeline_differentiable():
     np.testing.assert_allclose(np.asarray(g), np.asarray(g_ref), atol=1e-5)
 
 
+def test_pipeline_remat_stage_same_grads():
+    """remat_stage trades recompute for memory only — identical grads."""
+    n, m, b, f = 8, 2, 1, 8
+    w = jax.random.normal(jax.random.PRNGKey(5), (n, f, f)) * 0.3
+    x = jnp.ones((m, b, f))
+    mesh = make_mesh(pp=8)
+
+    def make_loss(remat):
+        def loss(w):
+            def stage(wk, h):
+                return jnp.tanh(h @ wk)
+
+            f_sharded = shard_map(
+                lambda w, x: pipeline_apply(
+                    stage, w[0], x, axis="pp", remat_stage=remat
+                ),
+                mesh=mesh, in_specs=(P("pp"), P()), out_specs=P(),
+            )
+            return jnp.sum(f_sharded(w, x) ** 2)
+
+        return loss
+
+    g_plain = jax.jit(jax.grad(make_loss(False)))(w)
+    g_remat = jax.jit(jax.grad(make_loss(True)))(w)
+    np.testing.assert_allclose(
+        np.asarray(g_remat), np.asarray(g_plain), atol=1e-6
+    )
+
+
 # --------------------------------------------------------------- moe
 
 def test_moe_expert_parallel_matches_reference():
