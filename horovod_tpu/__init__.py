@@ -61,6 +61,7 @@ from .ops.sparse import (  # noqa: F401
     sparse_allreduce,
     sparse_allreduce_eager,
 )
+from .ops.quantized import quantized_allreduce  # noqa: F401
 
 init = _runtime.init
 shutdown = _runtime.shutdown

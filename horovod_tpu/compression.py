@@ -71,9 +71,15 @@ class BF16Compressor(Compressor):
         return tensor.astype(ctx) if ctx is not None and tensor.dtype != ctx else tensor
 
 
+from .ops.quantized import Int8Compressor  # noqa: E402
+
+
 class Compression:
-    """Namespace matching ``hvd.Compression`` exactly."""
+    """Namespace matching ``hvd.Compression`` exactly, extended with the
+    TPU-native ``bf16`` and the EQuARX-style ``int8`` quantized wire
+    (``ops/quantized.py``)."""
 
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+    int8 = Int8Compressor

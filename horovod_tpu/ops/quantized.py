@@ -1,0 +1,133 @@
+"""Int8-quantized allreduce (EQuARX-style, XLA-native).
+
+Technique reference: "EQuARX: Efficient Quantized AllReduce in XLA"
+(arXiv:2506.17615, listed in PAPERS.md) — decompose the allreduce into
+its reduce-scatter + allgather phases and quantize the wire of each
+phase to int8 with per-chunk fp32 scales, accumulating in full
+precision between them.  No reference-framework analog (the reference's
+strongest wire compression is fp16); this is a capability add that
+halves ICI bytes vs bf16 and quarters them vs fp32.
+
+Schedule (global set, n ranks, payload V):
+
+  1. split the local vector into n chunks; quantize each with its own
+     ``amax/127`` scale;
+  2. ``all_to_all`` the int8 chunks (+ a tiny fp32 scale vector): rank
+     j receives every rank's chunk j — the reduce-scatter phase wire;
+  3. dequantize and sum in fp32 → rank j holds the exact-summed chunk j
+     (one quantization error per term, no error compounding);
+  4. re-quantize the reduced chunk and ``all_gather`` (+ scales) — the
+     allgather phase wire; dequantize.
+
+Per-rank wire ≈ 2V int8 bytes (vs 4V for a bf16 allreduce's two
+phases).  Error: each element sees two independent round-to-nearest
+quantizations, |err| <= 0.5*(amax_in/127) + 0.5*(amax_sum/127).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..process_sets import ProcessSet
+from ..runtime import WORLD_AXIS
+from .traced import Average, Sum
+
+
+# Elements per quantization block.  Coarse (per-chunk) scales would let
+# one large-magnitude layer flush a co-fused small-magnitude layer's
+# gradients to zero inside a fusion bucket; EQuARX uses fine-grained
+# block scales for the same reason.  Overhead: 4/BLOCK bytes/element of
+# fp32 scales (~0.8% at 512).
+BLOCK = 512
+
+
+def _quantize_blocks(rows: jax.Array):
+    """Blockwise int8 quantization of (r, c) rows, c % BLOCK == 0.
+
+    Returns (q int8 (r, c), scales fp32 (r, c/BLOCK)).  Non-finite
+    blocks get a NaN scale so the corruption PROPAGATES through
+    dequantize (the fp16/bf16 compressors preserve inf/nan; silently
+    zeroing them would defeat overflow-skip logic downstream).
+    """
+    r, c = rows.shape
+    b = rows.reshape(r, c // BLOCK, BLOCK).astype(jnp.float32)
+    amax = jnp.max(jnp.abs(b), axis=-1)
+    finite = jnp.isfinite(amax)
+    safe = jnp.where(finite & (amax > 0), amax / 127.0, 1.0)
+    scale = jnp.where(finite, safe, jnp.nan).astype(jnp.float32)
+    q = jnp.clip(jnp.round(b / safe[..., None]), -127, 127)
+    return q.astype(jnp.int8).reshape(r, c), scale
+
+
+def quantized_allreduce(
+    x: jax.Array,
+    axis: str = WORLD_AXIS,
+    op: int = Average,
+    process_set: Optional[ProcessSet] = None,
+) -> jax.Array:
+    """In-jit int8-wire allreduce over a mesh axis (global set only:
+    the all_to_all phase needs the set to tile the axis; arbitrary
+    subsets fall back to the caller's dense path)."""
+    if op not in (Sum, Average):
+        raise ValueError("quantized_allreduce supports Sum/Average")
+    if process_set is not None and process_set.process_set_id != 0:
+        raise ValueError(
+            "quantized_allreduce runs on the global set; use the dense "
+            "path for subsets"
+        )
+    n = lax.axis_size(axis)
+    shape, dtype = x.shape, x.dtype
+    flat = x.reshape(-1)
+    V = flat.shape[0]
+    c = -(-V // (n * BLOCK)) * BLOCK  # chunk length, BLOCK-aligned
+    if c * n != V:
+        flat = jnp.pad(flat, (0, c * n - V))
+    chunks = flat.reshape(n, c)
+
+    def dequant(q, s):
+        r = q.shape[0]
+        return (
+            q.reshape(r, c // BLOCK, BLOCK).astype(jnp.float32)
+            * s[..., None]
+        ).reshape(r, c)
+
+    # Phase 1 wire: int8 chunks + fp32 block scales via all_to_all.
+    q, s = _quantize_blocks(chunks)        # (n, c) int8, (n, c/BLOCK)
+    qt = lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=True)
+    st = lax.all_to_all(s, axis, split_axis=0, concat_axis=0, tiled=True)
+    # Exact fp32 accumulation of the dequantized contributions.
+    mine = jnp.sum(dequant(qt, st), axis=0)                  # (c,)
+
+    # Phase 2 wire: re-quantized reduced chunk via all_gather.
+    q2, s2 = _quantize_blocks(mine[None])
+    qg = lax.all_gather(q2[0], axis, tiled=True)             # (n*c,)
+    sg = lax.all_gather(s2[0], axis, tiled=True)             # (n*c/BLOCK,)
+    out = dequant(
+        qg.reshape(n, c), sg.reshape(n, c // BLOCK)
+    ).reshape(-1)[:V]
+    if op == Average:
+        out = out / n
+    return out.reshape(shape).astype(dtype)
+
+
+class Int8Compressor:
+    """Marker compressor selecting the quantized-allreduce path in
+    ``DistributedOptimizer`` (``hvd.Compression.int8``).  Unlike
+    fp16/bf16 this is not a cast-around-the-collective — the
+    quantization lives inside the two-phase reduction — so
+    compress/decompress are identity and the optimizer dispatches the
+    bucket to :func:`quantized_allreduce` instead."""
+
+    quantized_wire = True
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor

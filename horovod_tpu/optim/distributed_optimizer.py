@@ -78,6 +78,19 @@ def _reduce_gradients(
     """
     from ..ops.sparse import IndexedSlices, densify, sparse_allreduce
 
+    # Quantized wire (Compression.int8) validation happens up front so
+    # it also covers all-sparse trees and sparse leaves (which would
+    # otherwise silently ship fp32 through the identity compressor).
+    quantized = getattr(compression, "quantized_wire", False)
+    if quantized and (
+        op not in (Average, Sum)
+        or (process_set is not None and process_set.process_set_id != 0)
+    ):
+        raise ValueError(
+            "Compression.int8 requires op=Average/Sum on the global "
+            "process set (ops/quantized.py)"
+        )
+
     is_sparse = lambda x: isinstance(x, IndexedSlices)
     if sparse_as_dense:
         grads = jax.tree.map(
@@ -89,6 +102,13 @@ def _reduce_gradients(
         return grads
     sparse_idx = [i for i, g in enumerate(leaves) if is_sparse(g)]
     if sparse_idx:
+        if quantized:
+            raise ValueError(
+                "Compression.int8 does not support IndexedSlices "
+                "gradients (the quantizer lives inside the dense "
+                "two-phase reduction); use sparse_as_dense=True or a "
+                "cast compressor (bf16/fp16)"
+            )
         if op not in (Average, Sum):
             raise ValueError(
                 "IndexedSlices gradients support op=Average or Sum only "
@@ -168,25 +188,46 @@ def _reduce_gradients(
         buckets = []
         rest = list(range(len(wire)))
     if rest:
-        sizes = [wire[i].size * wire[i].dtype.itemsize for i in rest]
+        # Wire bytes per element: 1 on the int8 path (the in-memory
+        # tensors stay fp32 there — compress() is identity), so buckets
+        # fill to the intended wire-size threshold.
+        wire_itemsize = (
+            (lambda t: 1) if quantized else (lambda t: t.dtype.itemsize)
+        )
+        sizes = [wire[i].size * wire_itemsize(wire[i]) for i in rest]
         dtypes = [str(wire[i].dtype) for i in rest]
         for b in fusion.bucket_plan(sizes, dtypes, fusion_threshold_bytes):
             buckets.append([rest[i] for i in b])
 
+    # Quantized wire (Compression.int8): the quantization lives inside
+    # the two-phase reduction, so the bucket dispatches to
+    # quantized_allreduce instead of cast-allreduce-cast.  Pre/postscale
+    # fold into the fp32 accumulation outside the quantizer.
+    def reduce_flat(f):
+        if quantized:
+            from ..ops.quantized import quantized_allreduce
+
+            if not jnp.issubdtype(f.dtype, jnp.floating):
+                return traced.allreduce(
+                    f, axis=axis, op=op,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor,
+                    process_set=process_set,
+                )
+            g = f if prescale_factor == 1.0 else f * prescale_factor
+            g = quantized_allreduce(g, axis=axis, op=op)
+            return g if postscale_factor == 1.0 else g * postscale_factor
+        return traced.allreduce(
+            f, axis=axis, op=op,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+            process_set=process_set,
+        )
+
     reduced = list(wire)
     for bucket in buckets:
         flats, meta = fusion.flatten_group([wire[i] for i in bucket])
-        out_flats = [
-            traced.allreduce(
-                f,
-                axis=axis,
-                op=op,
-                prescale_factor=prescale_factor,
-                postscale_factor=postscale_factor,
-                process_set=process_set,
-            )
-            for f in flats
-        ]
+        out_flats = [reduce_flat(f) for f in flats]
         for i, t in zip(bucket, fusion.unflatten_group(out_flats, meta)):
             reduced[i] = t
 

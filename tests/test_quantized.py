@@ -1,0 +1,187 @@
+"""Int8 quantized allreduce (EQuARX-style two-phase scheme,
+arXiv:2506.17615 via PAPERS.md)."""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax import shard_map
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.ops.quantized import quantized_allreduce
+from horovod_tpu.ops import traced
+from horovod_tpu.runtime import WORLD_AXIS
+
+N = 8
+
+
+def _mesh():
+    from horovod_tpu.runtime import get_runtime
+
+    return get_runtime().mesh
+
+
+def _run(x, **kw):
+    def body(v):
+        return quantized_allreduce(v[0], **kw)[None]
+
+    f = jax.jit(shard_map(
+        body, mesh=_mesh(), in_specs=(P(WORLD_AXIS),),
+        out_specs=P(WORLD_AXIS), check_vma=False,
+    ))
+    return np.asarray(f(jnp.asarray(x)))
+
+
+def test_exact_for_quantization_friendly_values(hvd_module):
+    # integers within +-127 quantize exactly (scale = amax/127 divides
+    # them when amax == 127)
+    rng = np.random.RandomState(0)
+    x = rng.randint(-127, 128, (N, 1024)).astype(np.float32)
+    x[:, 0] = 127.0  # pin amax so scale == 1 exactly
+    y = _run(x, op=traced.Sum)
+    expect = x.sum(axis=0)
+    # phase-2 scale is sum's amax/127; sums are integers <= 127*N so
+    # they re-quantize with bounded error
+    err = np.abs(y[0] - expect)
+    assert err.max() <= np.abs(expect).max() / 127.0 + 1e-4
+
+
+def test_relative_error_bounded(hvd_module):
+    rng = np.random.RandomState(1)
+    x = rng.randn(N, 4096).astype(np.float32)
+    y = _run(x, op=traced.Average)
+    expect = x.mean(axis=0)
+    # two quantizations: |err| <= 0.5*amax_in/127 + 0.5*amax_sum/(127*N)
+    bound = (
+        0.5 * np.abs(x).max(axis=1).max() / 127.0
+        + 0.5 * np.abs(x.sum(0)).max() / 127.0
+    ) / N * 2.0 + 1e-5
+    assert np.abs(y[0] - expect).max() <= bound
+
+
+def test_wire_is_int8(hvd_module):
+    """The collectives must carry s8 operands, not f32."""
+    V = 4096
+
+    def body(v):
+        return quantized_allreduce(v[0], op=traced.Sum)[None]
+
+    hlo = jax.jit(shard_map(
+        body, mesh=_mesh(), in_specs=(P(WORLD_AXIS),),
+        out_specs=P(WORLD_AXIS), check_vma=False,
+    )).lower(jnp.zeros((N, V), jnp.float32)).compile().as_text()
+    colls = [
+        l for l in hlo.splitlines()
+        if re.search(r"= \S+ (all-to-all|all-gather)\(", l)
+    ]
+    assert colls
+    # the payload-sized collectives are int8; fp32 appears only in the
+    # tiny scale exchanges
+    for line in colls:
+        if str(V) in line or str(V // N) in line:
+            assert "s8[" in line, line
+
+
+def test_block_scales_preserve_small_magnitude_regions(hvd_module):
+    """A huge-magnitude region must not flush a small-magnitude region
+    to zero — the reason for blockwise scales (EQuARX block design)."""
+    from horovod_tpu.ops.quantized import BLOCK
+
+    x = np.zeros((N, 4 * BLOCK), np.float32)
+    x[:, :BLOCK] = 1e3          # "layer A" block
+    x[:, BLOCK:] = 1e-4         # "layer B" blocks
+    y = _run(x, op=traced.Average)
+    # small region survives with small relative error
+    np.testing.assert_allclose(y[0][BLOCK:], 1e-4, rtol=2e-2)
+    np.testing.assert_allclose(y[0][:BLOCK], 1e3, rtol=2e-2)
+
+
+def test_nonfinite_propagates(hvd_module):
+    """inf/nan gradients must surface, not silently zero (the cast
+    compressors preserve non-finites; overflow-skip logic depends on
+    seeing them)."""
+    x = np.ones((N, 2048), np.float32)
+    x[3, 7] = np.inf
+    y = _run(x, op=traced.Sum)
+    assert not np.isfinite(y[0]).all()
+    x2 = np.ones((N, 2048), np.float32)
+    x2[1, 0] = np.nan
+    y2 = _run(x2, op=traced.Sum)
+    assert np.isnan(y2[0]).any()
+
+
+def test_int8_rejects_sparse_leaves(hvd_module):
+    from horovod_tpu.optim.distributed_optimizer import _reduce_gradients
+    from horovod_tpu.ops.sparse import IndexedSlices
+    from horovod_tpu.ops.traced import Average
+
+    s = IndexedSlices(jnp.zeros((2,), jnp.int32), jnp.zeros((2, 4)),
+                      (16, 4))
+    with pytest.raises(ValueError, match="IndexedSlices"):
+        _reduce_gradients(
+            {"emb": s}, axis=WORLD_AXIS, op=Average,
+            compression=hvd.Compression.int8, prescale_factor=1.0,
+            postscale_factor=1.0, process_set=None,
+            fusion_threshold_bytes=None,
+        )
+
+
+def test_zero_input_safe(hvd_module):
+    x = np.zeros((N, 128), np.float32)
+    y = _run(x, op=traced.Sum)
+    np.testing.assert_array_equal(y, 0.0)
+
+
+def test_rejects_subsets_and_bad_ops(hvd_module, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_DYNAMIC_PROCESS_SETS", "1")
+    ps = hvd.add_process_set([0, 1])
+    with pytest.raises(Exception, match="global"):
+        _run(np.ones((N, 8), np.float32), process_set=ps)
+    hvd.remove_process_set(ps)
+    with pytest.raises(ValueError, match="Sum/Average"):
+        _run(np.ones((N, 8), np.float32), op=traced.Max)
+
+
+def test_optimizer_int8_compression_trains(hvd_module):
+    rng = np.random.RandomState(2)
+    W = rng.randn(16, 1).astype(np.float32)
+    X = rng.randn(64 * N, 16).astype(np.float32)
+    Y = X @ W
+    params = {"w": jnp.zeros((16, 1))}
+    tx = hvd.DistributedOptimizer(
+        optax.sgd(0.05), compression=hvd.Compression.int8
+    )
+
+    def loss_fn(p, batch):
+        xb, yb = batch
+        return jnp.mean((xb @ p["w"] - yb) ** 2)
+
+    step = hvd.distributed_train_step(loss_fn, tx)
+    opt_state = step.init(params)
+    losses = []
+    for _ in range(60):
+        params, opt_state, loss = step(
+            params, opt_state, (jnp.asarray(X), jnp.asarray(Y))
+        )
+        losses.append(float(loss))
+    assert losses[-1] < losses[0] * 0.2, (losses[0], losses[-1])
+
+
+def test_optimizer_int8_rejects_subsets(hvd_module, monkeypatch):
+    monkeypatch.setenv("HVD_TPU_DYNAMIC_PROCESS_SETS", "1")
+    from horovod_tpu.optim.distributed_optimizer import _reduce_gradients
+    from horovod_tpu.ops.traced import Average
+
+    ps = hvd.add_process_set([0, 1])
+    with pytest.raises(ValueError, match="global"):
+        _reduce_gradients(
+            {"w": jnp.ones((4,))}, axis=WORLD_AXIS, op=Average,
+            compression=hvd.Compression.int8, prescale_factor=1.0,
+            postscale_factor=1.0, process_set=ps,
+            fusion_threshold_bytes=None,
+        )
+    hvd.remove_process_set(ps)
