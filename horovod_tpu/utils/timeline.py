@@ -102,6 +102,33 @@ class Timeline:
         self._thread.join()
 
 
+def start_timeline(path: str) -> None:
+    """Attach a timeline writer to the running runtime (reference
+    ``horovod_start_timeline``, ``operations.cc:1011`` — runtime
+    activation without the env var).  Replaces any active timeline."""
+    from .. import native
+    from ..runtime import get_runtime
+
+    rt = get_runtime()
+    if rt.timeline is not None:
+        rt.timeline.close()
+    if native.available():
+        rt.timeline = native.NativeTimeline(path)
+    else:
+        rt.timeline = Timeline(path)
+
+
+def stop_timeline() -> None:
+    """Flush and detach the active timeline (reference
+    ``horovod_stop_timeline``)."""
+    from ..runtime import get_runtime
+
+    rt = get_runtime()
+    if rt.timeline is not None:
+        rt.timeline.close()
+        rt.timeline = None
+
+
 # jax.profiler passthroughs (NVTX-range analog).
 _profiler_active = False
 

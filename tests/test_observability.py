@@ -131,6 +131,31 @@ class TestTrainStepTimeline:
         assert any(e.get("ph") == "i" for e in events)
 
 
+class TestRuntimeTimelineSwitch:
+    def test_start_stop_timeline(self, tmp_path):
+        """Runtime activation without the env var (reference
+        horovod_start_timeline, operations.cc:1011)."""
+        from horovod_tpu.utils.timeline import start_timeline, stop_timeline
+
+        hvd.init()
+        try:
+            from horovod_tpu.runtime import get_runtime
+
+            assert get_runtime().timeline is None
+            path = tmp_path / "runtime_timeline.json"
+            start_timeline(str(path))
+            assert get_runtime().timeline is not None
+            hvd.allreduce(np.ones((8, 2), np.float32), name="switched.op")
+            stop_timeline()
+            assert get_runtime().timeline is None
+            events = json.loads(path.read_text())
+            assert any(e.get("name") == "switched.op" for e in events)
+            # collectives after stop don't crash and don't record
+            hvd.allreduce(np.ones((8, 2), np.float32))
+        finally:
+            hvd.shutdown()
+
+
 class TestStallWatchdog:
     def test_py_inspector_report(self):
         ins = PyStallInspector(warn_seconds=0.05)
