@@ -49,6 +49,9 @@ def main():
                         "by world size via the linear-scaling rule")
     parser.add_argument("--warmup-epochs", type=int, default=5)
     parser.add_argument("--wd", type=float, default=5e-5)
+    parser.add_argument("--sync-bn", action="store_true",
+                        help="synchronized BatchNorm: moments allreduced "
+                        "across chips (hvd.SyncBatchNorm)")
     parser.add_argument("--image-size", type=int, default=176)
     parser.add_argument("--num-samples", type=int, default=2048,
                         help="synthetic dataset size (shrink for smoke tests)")
@@ -66,7 +69,8 @@ def main():
             n=args.num_samples, image_size=args.image_size
         )
 
-    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16)
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16,
+                     sync_bn=args.sync_bn)
     variables = model.init(
         jax.random.PRNGKey(0),
         jnp.zeros((1, args.image_size, args.image_size, 3)), train=True,

@@ -55,6 +55,17 @@ def test_synthetic_benchmark_example():
     assert "Img/sec per chip" in out
 
 
+def test_imagenet_resnet50_example():
+    """North-star example end to end (tiny shapes: full ResNet-50 depth
+    at 32px, one epoch) — compile dominates, hence the long timeout."""
+    out = _run_example(
+        "imagenet_resnet50.py", "--epochs", "1", "--batch-size", "2",
+        "--image-size", "32", "--num-samples", "32",
+        "--warmup-epochs", "1", timeout=560,
+    )
+    assert "loss" in out.lower()
+
+
 def test_embedding_sparse_example():
     out = _run_example("embedding_sparse.py", "--steps", "120",
                        "--batch-size", "16", "--lr", "2.0",
