@@ -26,6 +26,20 @@ from ..utils.logging import get_logger
 log = get_logger()
 
 
+def _validate_store_format(store_format: str) -> None:
+    """Fail at construction, not inside a Spark executor task."""
+    if store_format not in ("npz", "parquet"):
+        raise ValueError("store_format must be 'npz' or 'parquet'")
+    if store_format == "parquet":
+        from .store import parquet_available
+
+        if not parquet_available():
+            raise ValueError(
+                "store_format='parquet' requires pyarrow "
+                "(pip install horovod_tpu[parquet])"
+            )
+
+
 def _fresh_data_dir(path: str) -> None:
     """Create ``path`` and drop shards from any previous fit: a smaller
     partition count would otherwise leave stale part files that
@@ -33,16 +47,21 @@ def _fresh_data_dir(path: str) -> None:
     import glob
 
     os.makedirs(path, exist_ok=True)
-    for stale in glob.glob(os.path.join(path, "part-*.npz")):
-        os.remove(stale)
+    for pat in ("part-*.npz", "part-*.parquet"):
+        for stale in glob.glob(os.path.join(path, pat)):
+            os.remove(stale)
 
 
-def _write_partitions(df, cols, store) -> str:
-    """Materialize the DataFrame to the store as compressed columnar npz
+def _write_partitions(df, cols, store, fmt: str = "npz") -> str:
+    """Materialize the DataFrame to the store as compressed columnar
     shards, one per Spark partition, written by the executors (reference
-    ``util.prepare_data``, parquet via petastorm; compression analog of
-    ``store.py:89-105``).  The store prefix must be a shared filesystem
-    (the reference requires the same of its HDFS/DBFS stores)."""
+    ``util.prepare_data``; ``fmt="parquet"`` produces real
+    snappy-compressed parquet files — the petastorm-parity format,
+    ``spark/common/store.py:89-105``).  The store prefix must be a
+    shared filesystem (the reference requires the same of its HDFS/DBFS
+    stores)."""
+    from .store import write_shard
+
     path = store.get_train_data_path()
     _fresh_data_dir(path)
 
@@ -50,21 +69,21 @@ def _write_partitions(df, cols, store) -> str:
         rows = list(rows_iter)
         if rows:
             arrays = {c: np.asarray([row[c] for row in rows]) for c in cols}
-            np.savez_compressed(
-                os.path.join(path, f"part-{idx}.npz"), **arrays
-            )
+            write_shard(os.path.join(path, f"part-{idx}"), arrays, fmt)
         yield idx
 
     df.select(*cols).rdd.mapPartitionsWithIndex(write_partition).count()
     return path
 
 
-def _write_single_shard(store, named_arrays) -> str:
+def _write_single_shard(store, named_arrays, fmt: str = "npz") -> str:
     """One-shard write for the Spark-free ``fit_on_arrays`` path (same
-    compressed columnar format as ``_write_partitions``)."""
+    compressed columnar formats as ``_write_partitions``)."""
+    from .store import write_shard
+
     path = store.get_train_data_path()
     _fresh_data_dir(path)
-    np.savez_compressed(os.path.join(path, "part-0.npz"), **named_arrays)
+    write_shard(os.path.join(path, "part-0"), named_arrays, fmt)
     return path
 
 
@@ -104,7 +123,9 @@ class TpuEstimator:
         run_id: Optional[str] = None,
         verbose: int = 1,
         extra_env: Optional[dict] = None,
+        store_format: str = "npz",
     ):
+        _validate_store_format(store_format)
         if model is None:
             raise ValueError("model is required")
         if optimizer is None:
@@ -123,6 +144,7 @@ class TpuEstimator:
         self.run_id = run_id or "run_default"
         self.verbose = verbose
         self.extra_env = extra_env
+        self.store_format = store_format
 
     # -- checkpoint-resume (reference estimator.py:91 _has_checkpoint) ----
 
@@ -133,7 +155,8 @@ class TpuEstimator:
 
     def _prepare_data(self, df) -> str:
         return _write_partitions(
-            df, self.feature_cols + self.label_cols, self.store
+            df, self.feature_cols + self.label_cols, self.store,
+            fmt=self.store_format,
         )
 
     def fit(self, df) -> "TpuModel":
@@ -165,7 +188,8 @@ class TpuEstimator:
     def fit_on_arrays(self, **named_arrays) -> "TpuModel":
         """Spark-free fit over in-memory arrays (single-controller path;
         used by tests and by notebook users without a cluster)."""
-        path = _write_single_shard(self.store, named_arrays)
+        path = _write_single_shard(self.store, named_arrays,
+                                   fmt=self.store_format)
         params = _train_worker(
             pickle.dumps(self.model), pickle.dumps(self.optimizer),
             pickle.dumps(self.loss), path, self.feature_cols,
@@ -190,14 +214,19 @@ def _load_columns(data_path, feature_cols, label_cols, *, partitioned=True):
 
     import horovod_tpu as hvd
 
-    parts = sorted(glob.glob(os.path.join(data_path, "part-*.npz")))
+    from .store import read_shard
+
+    parts = sorted(
+        glob.glob(os.path.join(data_path, "part-*.npz"))
+        + glob.glob(os.path.join(data_path, "part-*.parquet"))
+    )
     if not parts:
         raise FileNotFoundError(f"no data shards under {data_path}")
     pc = hvd.process_count()
     did_partition = partitioned and pc > 1 and len(parts) >= pc
     if did_partition:
         parts = parts[hvd.process_rank()::pc]
-    blobs = [np.load(p) for p in parts]
+    blobs = [read_shard(p) for p in parts]
 
     def column(c):
         return np.concatenate([b[c] for b in blobs], axis=0)

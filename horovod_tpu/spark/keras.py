@@ -81,7 +81,8 @@ class KerasEstimator(TpuEstimator):
     def fit_on_arrays(self, **named_arrays) -> "TpuModel":
         from .estimator import _write_single_shard
 
-        path = _write_single_shard(self.store, named_arrays)
+        path = _write_single_shard(self.store, named_arrays,
+                                   fmt=self.store_format)
         params, history = _keras_worker(*self._worker_args(path))
         model = TpuModel(model=self.model, params=params,
                          feature_cols=self.feature_cols)

@@ -110,3 +110,92 @@ class LocalStore(FilesystemStore):
         if prefix_path is None:
             prefix_path = os.path.join(tempfile.gettempdir(), "hvd_tpu_store")
         super().__init__(prefix_path, **kwargs)
+
+
+# ---- columnar shard formats (reference: petastorm parquet,
+# ``spark/common/store.py:89-105``) --------------------------------------
+#
+# Two interchangeable shard formats under the train-data path:
+#   * npz  — compressed numpy archives (no extra deps, fast local path)
+#   * parquet — pyarrow tables with snappy compression; N-d columns are
+#     stored as FixedSizeList with the trailing shape in the schema
+#     metadata, so images/embeddings round-trip exactly.  This is the
+#     petastorm-parity format: real parquet files any Spark/pandas
+#     reader can open.
+
+def parquet_available() -> bool:
+    try:
+        import pyarrow  # noqa: F401
+
+        return True
+    except ImportError:  # pragma: no cover
+        return False
+
+
+def write_shard(path_no_ext: str, arrays: dict, fmt: str = "npz") -> str:
+    """Write one columnar shard; returns the file path written."""
+    import numpy as np
+
+    if fmt == "npz":
+        path = path_no_ext + ".npz"
+        np.savez_compressed(path, **arrays)
+        return path
+    if fmt != "parquet":
+        raise ValueError(f"unknown shard format {fmt!r}")
+    import json
+
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+
+    fields = {}
+    meta = {}
+    for c, a in arrays.items():
+        a = np.asarray(a)
+        if a.ndim <= 1:
+            fields[c] = pa.array(a)
+        else:
+            # explicit trailing product: reshape(-1) is ambiguous for
+            # zero-row arrays
+            flat = a.reshape(len(a), int(np.prod(a.shape[1:])))
+            fields[c] = pa.FixedSizeListArray.from_arrays(
+                pa.array(flat.reshape(-1)), flat.shape[1]
+            )
+            meta[f"shape:{c}"] = json.dumps(list(a.shape[1:]))
+    table = pa.table(fields)
+    if meta:
+        table = table.replace_schema_metadata(
+            {**(table.schema.metadata or {}),
+             **{k.encode(): v.encode() for k, v in meta.items()}}
+        )
+    path = path_no_ext + ".parquet"
+    pq.write_table(table, path, compression="snappy")
+    return path
+
+
+def read_shard(path: str) -> dict:
+    """Read one columnar shard (either format) back to numpy arrays."""
+    import numpy as np
+
+    if path.endswith(".npz"):
+        with np.load(path) as z:
+            return {k: z[k] for k in z.files}
+    import json
+
+    import pyarrow.parquet as pq
+
+    table = pq.read_table(path)
+    meta = {
+        k.decode(): v.decode()
+        for k, v in (table.schema.metadata or {}).items()
+    }
+    out = {}
+    for c in table.column_names:
+        col = table[c].combine_chunks()
+        shape_key = f"shape:{c}"
+        if shape_key in meta:
+            trailing = tuple(json.loads(meta[shape_key]))
+            flat = np.asarray(col.flatten())
+            out[c] = flat.reshape((len(col),) + trailing)
+        else:
+            out[c] = np.asarray(col)
+    return out

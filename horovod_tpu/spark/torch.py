@@ -47,7 +47,12 @@ class TorchEstimator:
         run_id: Optional[str] = None,
         verbose: int = 1,
         extra_env: Optional[dict] = None,
+        store_format: str = "npz",
     ):
+        from .estimator import _validate_store_format
+
+        _validate_store_format(store_format)
+        self.store_format = store_format
         if model is None or optimizer is None or loss is None:
             raise ValueError("model, optimizer and loss are required")
         self.model = model
@@ -80,7 +85,8 @@ class TorchEstimator:
         from .estimator import _write_partitions
 
         data_path = _write_partitions(
-            df, self.feature_cols + self.label_cols, self.store
+            df, self.feature_cols + self.label_cols, self.store,
+            fmt=self.store_format,
         )
         from . import runner as spark_runner
 
@@ -96,8 +102,9 @@ class TorchEstimator:
 
         return self._wrap(
             _torch_worker(
-                *self._worker_args(_write_single_shard(self.store,
-                                                       named_arrays))
+                *self._worker_args(_write_single_shard(
+                    self.store, named_arrays, fmt=self.store_format
+                ))
             )
         )
 
