@@ -234,6 +234,21 @@ if __name__ == "__main__":
     signal.signal(signal.SIGALRM, _deadline)
     signal.alarm(int(os.environ.get("HVD_BENCH_DEADLINE_S", "480")))
     try:
+        # Fail fast on a wedged device tunnel: probe device liveness in
+        # a short-lived subprocess before paying compiles in-process.
+        import subprocess
+
+        probe = subprocess.run(
+            [sys.executable, "-c",
+             "import jax, jax.numpy as jnp; "
+             "print(float(jnp.ones(8).sum()))"],
+            capture_output=True, text=True, timeout=150,
+            env=dict(os.environ),
+        )
+        if probe.returncode != 0:
+            raise TimeoutError(
+                f"device probe failed: {probe.stderr[-300:]}"
+            )
         main()
     except Exception as e:  # TimeoutError from the alarm lands here too
         print(json.dumps({
