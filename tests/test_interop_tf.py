@@ -132,3 +132,33 @@ def test_multiprocess_tape_averages():
     results = runner.run(worker, np=2, use_cpu_devices=True)
     # mean of grad 1 and grad 2 = 1.5 on both processes
     np.testing.assert_allclose(results, [[1.5, 1.5], [1.5, 1.5]])
+
+
+def test_keras_model_end_to_end(hvd_module):
+    """Full reference-style TF training recipe: broadcast_variables +
+    DistributedGradientTape + DistributedOptimizer on a keras Model."""
+    rng = np.random.RandomState(0)
+    X = tf.constant(rng.randn(128, 4).astype(np.float32))
+    w_true = rng.randn(4, 1).astype(np.float32)
+    Y = tf.constant(X.numpy() @ w_true)
+
+    model = tf.keras.Sequential([
+        tf.keras.layers.Dense(8, activation="tanh"),
+        tf.keras.layers.Dense(1),
+    ])
+    model.build((None, 4))
+    opt = hvd_tf.DistributedOptimizer(
+        tf.keras.optimizers.Adam(learning_rate=0.05)
+    )
+    hvd_tf.broadcast_variables(model.trainable_variables, root_rank=0)
+
+    first = None
+    for _ in range(60):
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_mean((model(X, training=True) - Y) ** 2)
+        dtape = hvd_tf.DistributedGradientTape(tape)
+        grads = dtape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        if first is None:
+            first = float(loss)
+    assert float(loss) < first * 0.3, (first, float(loss))
