@@ -142,3 +142,27 @@ class TestSubsetAlltoall:
         others = [r for r in range(N) if r not in members]
         assert (recv[others] == 0).all()
         hvd.remove_process_set(ps)
+
+
+class TestOverlappingSets:
+    def test_two_overlapping_sets_allreduce(self):
+        """Sets sharing rank 2 both reduce correctly (the masked and
+        ring lowerings are per-set pure functions, so overlap is
+        naturally supported — the reference needs disjoint
+        communicators per set but allows overlapping membership)."""
+        ps_a = hvd.add_process_set([0, 1, 2])
+        ps_b = hvd.add_process_set([2, 3, 4, 5])
+        x = np.random.RandomState(0).randn(N, 2048).astype(np.float32)
+        ya = np.asarray(hvd.allreduce(x, op=hvd.Sum, process_set=ps_a))
+        yb = np.asarray(hvd.allreduce(x, op=hvd.Sum, process_set=ps_b))
+        np.testing.assert_allclose(
+            ya[2], x[[0, 1, 2]].sum(0), rtol=1e-4, atol=1e-5
+        )
+        np.testing.assert_allclose(
+            yb[2], x[[2, 3, 4, 5]].sum(0), rtol=1e-4, atol=1e-5
+        )
+        # rank 6 is in neither set: passthrough both times
+        np.testing.assert_allclose(ya[6], x[6])
+        np.testing.assert_allclose(yb[6], x[6])
+        hvd.remove_process_set(ps_a)
+        hvd.remove_process_set(ps_b)
