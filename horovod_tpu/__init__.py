@@ -188,6 +188,7 @@ from .compression import Compression  # noqa: F401,E402
 from . import elastic  # noqa: F401,E402
 from .sync_batch_norm import SyncBatchNorm  # noqa: F401,E402
 from .metrics import metric_average  # noqa: F401,E402
+from .utils.timeline import start_timeline, stop_timeline  # noqa: F401,E402
 from . import callbacks  # noqa: F401,E402
 from . import data  # noqa: F401,E402
 from . import checkpoint  # noqa: F401,E402
