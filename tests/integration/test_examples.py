@@ -85,6 +85,16 @@ def test_embedding_sparse_as_dense_example():
     assert "dense reduction" in out
 
 
+def test_fsdp_gpt_example():
+    out = _run_example("fsdp_gpt.py", "--steps", "20")
+    lines = [l for l in out.splitlines() if l.startswith("step")]
+    assert lines
+    first = float(lines[0].split()[-1])
+    last = float(lines[-1].split()[-1])
+    assert last < first, (first, last)
+    assert "gathered eval logits" in out
+
+
 def test_gpt_pretrain_example():
     out = _run_example(
         "gpt_pretrain.py", "--dp", "2", "--sp", "2", "--tp", "2",
