@@ -198,6 +198,7 @@ def fsdp_train_step(
     *,
     axis=WORLD_AXIS,
     example_params=None,
+    compression=None,
 ):
     """ZeRO-3-style fully sharded step: *parameters and optimizer state*
     both live as 1/N flat shards between steps.
@@ -274,9 +275,18 @@ def fsdp_train_step(
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         gflat = m["ravel"](grads)
         gflat = jnp.pad(gflat, (0, m["padded"] - m["n"]))
-        gshard = lax.psum_scatter(
-            gflat, axis, scatter_dimension=0, tiled=True
-        ) / world
+        if compression is not None:
+            # wire compression on the reduce-scatter (the DP fused-
+            # allreduce compression knob, applied to the RS phase)
+            wire, ctx = compression.compress(gflat)
+            gshard = lax.psum_scatter(
+                wire, axis, scatter_dimension=0, tiled=True
+            )
+            gshard = compression.decompress(gshard, ctx) / world
+        else:
+            gshard = lax.psum_scatter(
+                gflat, axis, scatter_dimension=0, tiled=True
+            ) / world
         ushard, opt_state = tx.update(gshard, opt_state, pshard)
         pshard = optax.apply_updates(pshard, ushard)
         return pshard, opt_state, lax.pmean(loss, axis)

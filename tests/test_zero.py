@@ -170,3 +170,23 @@ class TestFSDP:
         step = fsdp_train_step(loss_fn, optax.sgd(0.1))
         with pytest.raises(RuntimeError, match="example_params"):
             step.gather(jnp.zeros((8,)))
+
+    def test_fsdp_bf16_wire_compression(self, hvd_module):
+        import horovod_tpu as hvd
+        from horovod_tpu.optim.zero import fsdp_train_step
+
+        params, (x, y), loss_fn = _problem()
+        step = fsdp_train_step(loss_fn, optax.sgd(0.1),
+                               compression=hvd.Compression.bf16)
+        pshards, opt_state = step.init(params)
+        ref = fsdp_train_step(loss_fn, optax.sgd(0.1))
+        rs, ro = ref.init(params)
+        for _ in range(3):
+            pshards, opt_state, loss = step(pshards, opt_state, (x, y))
+            rs, ro, rloss = ref(rs, ro, (x, y))
+        # bf16 wire: close to the uncompressed trajectory
+        np.testing.assert_allclose(
+            np.asarray(step.gather(pshards)["w"]),
+            np.asarray(ref.gather(rs)["w"]), rtol=2e-2, atol=2e-3,
+        )
+        assert np.isfinite(float(loss))
