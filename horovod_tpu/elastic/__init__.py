@@ -1,2 +1,3 @@
 from .state import ArrayState, ObjectState, State, TpuState  # noqa: F401
 from .run import run, run_fn  # noqa: F401
+from .remesh import reinit_world  # noqa: F401

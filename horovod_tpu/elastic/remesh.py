@@ -1,0 +1,70 @@
+"""Experimental in-process world re-initialization.
+
+Probe evidence (``tools/probe_remesh.py`` →
+``tools/probe_remesh_findings.json``): after a full XLA backend reset
+(``jax.extend.backend.clear_backends``), ``jax.distributed`` accepts a
+fresh ``initialize()`` with a *different* world in the same process —
+so a membership-change survivor CAN re-mesh without respawning, at
+least on the CPU backend.  The elastic driver's default remains
+respawn-per-round (``runner/elastic_driver.py:1-22``): the respawn path
+is validated on every backend, while live-TPU PJRT client teardown via
+``clear_backends`` is not, and recompilation — the dominant restart
+cost — happens either way (bound it with the persistent compilation
+cache, see ``tests/integration/test_elastic.py``).
+
+Use :func:`reinit_world` from a surviving worker after the launcher
+hands it the new world description; all live jax Arrays from the old
+backend become invalid — restore state from host copies or the KV
+store (``elastic.State`` commits are host-side for exactly this
+reason).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..utils.logging import get_logger
+
+
+def reinit_world(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Tear down the runtime + XLA backend and rejoin a new world.
+
+    With no arguments, re-initializes single-process (the surviving
+    worker continues alone on its local devices).  Passing the new
+    coordination triple rejoins a resized multi-process world.
+
+    EXPERIMENTAL: relies on ``jax.extend.backend.clear_backends``
+    (internal-adjacent API).  Every jax Array created before the call
+    is invalidated.
+    """
+    import jax
+
+    from .. import runtime as _rt
+
+    _rt.shutdown()
+    try:
+        jax.distributed.shutdown()
+    except Exception:  # not initialized / already down
+        pass
+    from jax.extend import backend as _xb
+
+    _xb.clear_backends()
+
+    for key in ("HVD_TPU_COORDINATOR_ADDR", "HVD_TPU_CROSS_RANK",
+                "HVD_TPU_CROSS_SIZE"):
+        os.environ.pop(key, None)
+    if coordinator_address is not None:
+        os.environ["HVD_TPU_COORDINATOR_ADDR"] = coordinator_address
+        os.environ["HVD_TPU_CROSS_SIZE"] = str(num_processes)
+        os.environ["HVD_TPU_CROSS_RANK"] = str(process_id)
+    get_logger().warning(
+        "reinit_world: backend reset, rejoining world "
+        "(coordinator=%s, processes=%s)",
+        coordinator_address or "<single-process>", num_processes or 1,
+    )
+    _rt.init()

@@ -5,11 +5,17 @@ loop, rank reassignment preserving surviving workers, worker respawn on
 new slots, blacklist on failure, ``reset_limit`` bound on membership
 changes.
 
-TPU redesign rationale: XLA compiles for a fixed mesh and
-``jax.distributed`` cannot re-initialize in-process (verified: the
-backend pins the first world), so a membership change restarts *all*
-worker processes for the new round instead of re-bootstrapping
-communicators inside survivors.  Training state survives rounds through
+TPU redesign rationale: XLA compiles for a fixed mesh, and a plain
+``jax.distributed`` re-``initialize()`` in-process fails once the
+backend exists (probe artifact: ``tools/probe_remesh_findings.json``,
+case B).  An in-process survivor path DOES exist through a full backend
+reset (case B2, exposed as the experimental
+``hvd.elastic.reinit_world``), but this driver defaults to restarting
+*all* worker processes per round: the respawn path is validated on
+every backend (live-TPU PJRT teardown via ``clear_backends`` is not),
+invalidates no in-flight host state, and recompilation — the dominant
+restart cost either way — is bounded by the persistent compilation
+cache, not by process reuse.  Training state survives rounds through
 the launcher KV store / checkpoints (``elastic/state.py`` persists
 commits when elastic env is present), which also covers the
 all-workers-lost case the reference cannot (its in-memory state dies

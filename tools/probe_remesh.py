@@ -1,0 +1,188 @@
+"""Probe: can an elastic membership change re-mesh IN-PROCESS?
+
+The elastic driver respawns every worker on membership change
+(``runner/elastic_driver.py:1-22``) instead of re-bootstrapping
+communicators inside survivors like the reference's Gloo path.  This
+script is the evidence for that design call (SURVEY.md §7 hard part
+(a)): it empirically tests each candidate in-process re-mesh mechanism
+on the CPU backend and prints a JSON report.
+
+Run: ``python tools/probe_remesh.py`` (forces an 8-device CPU backend).
+
+Probes:
+  A. single-process device-subset re-mesh — shrink/regrow the mesh over
+     a subset of this process's devices via ``hvd.shutdown()`` +
+     ``hvd.init(devices=...)``.  (This one WORKS — nothing about XLA
+     prevents new meshes over existing local devices; it is what the
+     runtime's ``devices=`` argument exists for.)
+  B. multi-process world resize — a 2-process world loses a peer; the
+     survivor calls ``jax.distributed.shutdown()`` then
+     ``initialize(num_processes=1)`` and tries a collective.  This is
+     what the reference's in-process elastic recovery would need.
+  C. backend reset — ``jax.clear_backends()`` (internal API) then a
+     fresh computation, probing whether the runtime tolerates a full
+     backend teardown mid-process.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+_ENV = {
+    **os.environ,
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": "--xla_force_host_platform_device_count=8",
+    "PYTHONPATH": REPO,
+}
+
+PROBE_A = textwrap.dedent("""
+    import jax
+    import numpy as np
+    import horovod_tpu as hvd
+
+    hvd.init(devices=jax.devices()[:8])
+    assert hvd.size() == 8
+    out8 = np.asarray(hvd.allreduce(np.ones((8, 2), np.float32), op=hvd.Sum))
+    assert out8[0, 0] == 8.0
+    hvd.shutdown()
+    # re-mesh over a 4-device "surviving" subset, same process
+    hvd.init(devices=jax.devices()[:4])
+    assert hvd.size() == 4
+    out4 = np.asarray(hvd.allreduce(np.ones((4, 2), np.float32), op=hvd.Sum))
+    assert out4[0, 0] == 4.0
+    hvd.shutdown()
+    print("A_OK")
+""")
+
+PROBE_B = textwrap.dedent("""
+    import os, sys
+    import jax
+
+    port = os.environ["PROBE_PORT"]
+    rank = int(os.environ["PROBE_RANK"])
+    jax.distributed.initialize(
+        coordinator_address=f"127.0.0.1:{port}", num_processes=2,
+        process_id=rank,
+    )
+    assert jax.process_count() == 2
+    n0 = len(jax.devices())
+    if rank == 1:
+        sys.exit(0)  # peer "dies" after the world is up
+    # survivor: attempt in-process re-initialization to world=1
+    jax.distributed.shutdown()
+    try:
+        jax.distributed.initialize(
+            coordinator_address=f"127.0.0.1:{int(port) + 1}",
+            num_processes=1, process_id=0,
+        )
+        import jax.numpy as jnp
+        v = float(jnp.ones(4).sum())
+        print(f"B_REINIT_OK devices_before={n0} "
+              f"devices_after={len(jax.devices())} value={v}")
+    except Exception as e:
+        print(f"B_REINIT_FAILED {type(e).__name__}: {e}")
+        # B2: does a full backend reset unblock the re-init?
+        try:
+            from jax.extend import backend as _xb
+
+            _xb.clear_backends()
+            jax.distributed.initialize(
+                coordinator_address=f"127.0.0.1:{int(port) + 2}",
+                num_processes=1, process_id=0,
+            )
+            import jax.numpy as jnp
+            v = float(jnp.ones(4).sum())
+            print(f"B2_RESET_REINIT_OK devices={len(jax.devices())} "
+                  f"value={v} processes={jax.process_count()}")
+        except Exception as e2:
+            print(f"B2_RESET_REINIT_FAILED {type(e2).__name__}: {e2}")
+""")
+
+PROBE_C = textwrap.dedent("""
+    import jax
+    import jax.numpy as jnp
+
+    a = float(jnp.ones(8).sum())
+    reset = getattr(jax, "clear_backends", None)
+    if reset is None:
+        try:
+            from jax.extend import backend as _xb
+            reset = getattr(_xb, "clear_backends", None)
+        except ImportError:
+            pass
+    if reset is None:
+        print("C_NO_PUBLIC_API: this JAX exposes no backend-reset "
+              "entry point (jax.clear_backends was removed)")
+    else:
+        try:
+            reset()
+            b = float(jnp.ones(8).sum())
+            print(f"C_CLEAR_OK before={a} after={b} "
+                  f"devices={len(jax.devices())}")
+        except Exception as e:
+            print(f"C_CLEAR_FAILED {type(e).__name__}: {e}")
+""")
+
+
+def _run(code, extra_env=None, timeout=240):
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            env={**_ENV, **(extra_env or {})},
+            capture_output=True, text=True, timeout=timeout,
+        )
+        return proc.returncode, (proc.stdout + proc.stderr).strip()
+    except subprocess.TimeoutExpired as e:
+        out = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) else (e.stdout or "")
+        return -1, f"TIMEOUT after {timeout}s: {out[-400:]}"
+
+
+def main():
+    report = {}
+
+    rc, out = _run(PROBE_A)
+    report["A_single_process_subset_remesh"] = {
+        "works": rc == 0 and "A_OK" in out,
+        "detail": out[-400:],
+    }
+
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    p1 = subprocess.Popen(
+        [sys.executable, "-c", PROBE_B],
+        env={**_ENV, "PROBE_PORT": str(port), "PROBE_RANK": "1"},
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+    rc, out = _run(PROBE_B, {"PROBE_PORT": str(port), "PROBE_RANK": "0"},
+                   timeout=180)
+    p1.wait(timeout=30)
+    report["B_multiprocess_world_resize"] = {
+        "works": rc == 0 and "B_REINIT_OK" in out,
+        "works_after_backend_reset": "B2_RESET_REINIT_OK" in out,
+        "detail": out[-700:],
+    }
+
+    rc, out = _run(PROBE_C)
+    report["C_backend_reset"] = {
+        "works": rc == 0 and "C_CLEAR_OK" in out,
+        "detail": out[-400:],
+    }
+
+    report["conclusion"] = (
+        "in-process re-mesh over a process's own devices works (A); "
+        "the respawn-per-round design is required exactly when the "
+        "PROCESS SET changes — see B for what the survivor experiences."
+    )
+    print(json.dumps(report, indent=2))
+
+
+if __name__ == "__main__":
+    main()
