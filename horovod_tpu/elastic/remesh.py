@@ -46,18 +46,45 @@ def reinit_world(
 
     from .. import runtime as _rt
 
+    # Validate inputs and resolve the backend-reset entry point BEFORE
+    # any teardown — failing after shutdown would strand the survivor
+    # with no runtime at all.
+    if coordinator_address is not None and (
+        num_processes is None or process_id is None
+    ):
+        raise ValueError(
+            "reinit_world: coordinator_address requires num_processes "
+            "and process_id (a partial triple would silently fall back "
+            "to a single-process world)"
+        )
+    reset = None
+    try:
+        from jax.extend import backend as _xb
+
+        reset = getattr(_xb, "clear_backends", None)
+    except ImportError:
+        pass
+    if reset is None:
+        reset = getattr(jax, "clear_backends", None)
+    if reset is None:
+        raise RuntimeError(
+            "reinit_world: this JAX exposes no backend-reset entry "
+            "point (neither jax.extend.backend.clear_backends nor "
+            "jax.clear_backends); use the respawn-per-round path"
+        )
+
     _rt.shutdown()
     try:
         jax.distributed.shutdown()
     except Exception:  # not initialized / already down
         pass
-    from jax.extend import backend as _xb
+    reset()
 
-    _xb.clear_backends()
-
-    for key in ("HVD_TPU_COORDINATOR_ADDR", "HVD_TPU_CROSS_RANK",
-                "HVD_TPU_CROSS_SIZE"):
-        os.environ.pop(key, None)
+    # Clear BOTH env spellings the knob layer reads (utils/env.py
+    # falls back from HVD_TPU_* to HOROVOD_*).
+    for name in ("COORDINATOR_ADDR", "CROSS_RANK", "CROSS_SIZE"):
+        os.environ.pop("HVD_TPU_" + name, None)
+        os.environ.pop("HOROVOD_" + name, None)
     if coordinator_address is not None:
         os.environ["HVD_TPU_COORDINATOR_ADDR"] = coordinator_address
         os.environ["HVD_TPU_CROSS_SIZE"] = str(num_processes)

@@ -6,7 +6,6 @@ respawn-per-round rationale plus the experimental
 
 import json
 import os
-import socket
 import subprocess
 import sys
 import textwrap
@@ -74,10 +73,9 @@ SURVIVOR = textwrap.dedent("""
 
 
 def test_survivor_reinit_world_in_process():
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
+    from horovod_tpu.runner.launch import free_port
+
+    port = free_port()
     env = {**os.environ, **_ENV, "PROBE_PORT": str(port)}
     p1 = subprocess.Popen(
         [sys.executable, "-c", SURVIVOR],
@@ -93,3 +91,10 @@ def test_survivor_reinit_world_in_process():
     out = p0.stdout + p0.stderr
     assert p0.returncode == 0, out[-800:]
     assert "SURVIVOR_REMESH_OK" in out
+
+
+def test_reinit_world_validates_partial_triple():
+    import horovod_tpu.elastic as elastic
+
+    with pytest.raises(ValueError, match="num_processes"):
+        elastic.reinit_world(coordinator_address="10.0.0.5:1234")

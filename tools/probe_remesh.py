@@ -150,12 +150,10 @@ def main():
         "detail": out[-400:],
     }
 
-    import socket
+    sys.path.insert(0, REPO)
+    from horovod_tpu.runner.launch import free_port
 
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
+    port = free_port()
     p1 = subprocess.Popen(
         [sys.executable, "-c", PROBE_B],
         env={**_ENV, "PROBE_PORT": str(port), "PROBE_RANK": "1"},
