@@ -120,9 +120,18 @@ def is_homogeneous() -> bool:
     return rt.size == rt.local_size * rt.cross_size
 
 
-# ---- Capability flags (reference horovod_*_built / *_enabled) ----
+# ---- Capability flags (reference horovod_*_built / *_enabled,
+# common/basics.py) — the non-TPU backends report absent ----
 
 def mpi_enabled() -> bool:
+    return False
+
+
+def mpi_built() -> bool:
+    return False
+
+
+def mpi_threads_supported() -> bool:
     return False
 
 
@@ -130,7 +139,27 @@ def gloo_enabled() -> bool:
     return False
 
 
+def gloo_built() -> bool:
+    return False
+
+
 def nccl_built() -> bool:
+    return False
+
+
+def ddl_built() -> bool:
+    return False
+
+
+def ccl_built() -> bool:
+    return False
+
+
+def cuda_built() -> bool:
+    return False
+
+
+def rocm_built() -> bool:
     return False
 
 
