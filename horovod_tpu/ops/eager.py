@@ -231,13 +231,34 @@ def _delocalize(y: jax.Array, was_local: bool) -> jax.Array:
     )
 
 
-@functools.lru_cache(maxsize=None)
+def _make_jitted_cache():
+    """Bounded dispatch cache (reference ResponseCache capacity knob,
+    ``HOROVOD_CACHE_CAPACITY`` default 1024, response_cache.h)."""
+    from ..utils import env as _env
+
+    cap = _env.get_int(_env.CACHE_CAPACITY, 1024)
+    return functools.lru_cache(maxsize=cap if cap > 0 else None)(
+        _jitted_build
+    )
+
+
+_jitted_cache = None
+
+
 def _jitted(fn_name: str, static: Tuple) -> callable:
+    global _jitted_cache
+    if _jitted_cache is None:  # env read deferred to first dispatch
+        _jitted_cache = _make_jitted_cache()
+    return _jitted_cache(fn_name, static)
+
+
+def _jitted_build(fn_name: str, static: Tuple) -> callable:
     """Build + cache the jitted shard_map dispatch for one op config.
 
     The cache is the TPU analog of the reference ResponseCache: repeat
     collectives with the same signature skip straight to the compiled
-    executable.  Cleared on shutdown (the mesh is baked in).
+    executable (LRU-bounded by ``HVD_TPU_CACHE_CAPACITY``).  Cleared on
+    shutdown (the mesh is baked in).
     """
     mesh = _mesh()
     kwargs = dict(static)
@@ -272,8 +293,12 @@ def _jitted(fn_name: str, static: Tuple) -> callable:
 
 
 def clear_cache() -> None:
-    """Drop compiled dispatches (called on shutdown / mesh change)."""
-    _jitted.cache_clear()
+    """Drop compiled dispatches (called on shutdown / mesh change);
+    the capacity env is re-read on the next dispatch."""
+    global _jitted_cache
+    if _jitted_cache is not None:
+        _jitted_cache.cache_clear()
+        _jitted_cache = None
 
 
 def allreduce(

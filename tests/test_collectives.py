@@ -282,13 +282,13 @@ def test_traced_inside_shard_map(hvd_module):
 def test_compile_cache_reuse(hvd_module):
     """Second identical call must hit the compiled cache (ResponseCache
     analog)."""
-    from horovod_tpu.ops.eager import _jitted
+    from horovod_tpu.ops import eager
 
-    before = _jitted.cache_info().hits
     x = stacked()
     hvd.allreduce(x)
+    before = eager._jitted_cache.cache_info().hits
     hvd.allreduce(x + 1)
-    assert _jitted.cache_info().hits > before
+    assert eager._jitted_cache.cache_info().hits > before
 
 
 def test_hierarchical_allreduce_matches_flat(hvd_module):
@@ -355,3 +355,20 @@ def test_join_average_none_active(hvd_module):
         out_specs=P(hvd.WORLD_AXIS), check_vma=False,
     ))
     np.testing.assert_allclose(np.asarray(f(jnp.asarray(x), jnp.asarray(zero))), 0.0)
+
+
+def test_dispatch_cache_capacity_bounded(hvd_module, monkeypatch):
+    """HVD_TPU_CACHE_CAPACITY bounds the compiled-dispatch LRU
+    (reference HOROVOD_CACHE_CAPACITY, response_cache.h)."""
+    from horovod_tpu.ops import eager
+
+    eager.clear_cache()
+    monkeypatch.setenv("HVD_TPU_CACHE_CAPACITY", "2")
+    try:
+        for d in (2, 3, 4, 5):  # four distinct signatures
+            hvd.allreduce(np.ones((N, d), np.float32), op=hvd.Sum)
+        info = eager._jitted_cache.cache_info()
+        assert info.maxsize == 2
+        assert info.currsize <= 2
+    finally:
+        eager.clear_cache()  # next dispatch re-reads the default env
