@@ -68,6 +68,17 @@ class Runtime:
         self.process_set_table = ProcessSetTable(self.size)
         for ps in process_sets or ():
             self.process_set_table.add(ps, dynamic_ok=True)
+        # Launcher-declared sets: HVD_TPU_PROCESS_SETS="0,1;2,3"
+        # (the env-side mirror of init(process_sets=...), letting hvdrun
+        # configure rank subsets without code changes).
+        spec = env.get_env(env.PROCESS_SETS)
+        if spec:
+            for group in spec.split(";"):
+                ranks = [int(r) for r in group.split(",") if r.strip()]
+                if ranks:
+                    self.process_set_table.add(
+                        ProcessSet(ranks), dynamic_ok=True
+                    )
         self.timeline = None
         timeline_path = env.get_env(env.TIMELINE)
         if timeline_path:

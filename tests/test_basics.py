@@ -73,3 +73,25 @@ def test_init_with_process_sets():
         assert hvd.get_process_set_ids() == [0, 1, 2]
     finally:
         hvd.shutdown()
+
+
+def test_process_sets_from_env(monkeypatch):
+    """HVD_TPU_PROCESS_SETS declares rank subsets at init (the env
+    mirror of init(process_sets=...))."""
+    hvd.shutdown()
+    monkeypatch.setenv("HVD_TPU_PROCESS_SETS", "0,1;2,3,4")
+    hvd.init()
+    try:
+        ids = hvd.get_process_set_ids()
+        assert len(ids) == 3  # global + two declared
+        x = np.ones((8, 2), np.float32)
+        from horovod_tpu.process_sets import ProcessSet
+
+        table = __import__("horovod_tpu").runtime.get_runtime().process_set_table
+        declared = [table.get(i) for i in ids if i != 0]
+        rank_sets = sorted(tuple(ps.ranks) for ps in declared)
+        assert rank_sets == [(0, 1), (2, 3, 4)]
+        y = np.asarray(hvd.allreduce(x, op=hvd.Sum, process_set=declared[0]))
+        assert np.isfinite(y).all()
+    finally:
+        hvd.shutdown()
