@@ -17,7 +17,6 @@ For fully-traced schedules (no host involvement at all), use
 
 from __future__ import annotations
 
-import math
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from . import functions, metrics

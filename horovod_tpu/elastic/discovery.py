@@ -9,7 +9,7 @@ from __future__ import annotations
 
 import subprocess
 import threading
-from typing import Dict, List, Optional, Set
+from typing import Dict, Set
 
 from ..runner import hosts as hosts_mod
 from ..utils.logging import get_logger

@@ -11,7 +11,7 @@ after a re-rendezvous.
 from __future__ import annotations
 
 import copy
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Dict, Optional
 
 import jax
 
