@@ -156,3 +156,21 @@ def test_elastic_hosts_updated_continues(hvd_module):
     wrapped = run_fn(train, lambda: None)
     assert wrapped(state) == 42
     assert calls["n"] == 2
+
+
+def test_broadcast_optimizer_state_and_variables_aliases(hvd_module):
+    """broadcast_variables / broadcast_optimizer_state mirror the
+    reference surfaces (tensorflow/functions.py:276,
+    torch/functions.py:118) over optax pytrees."""
+    import optax
+
+    params = {"w": jnp.ones((4, 2))}
+    tx = optax.adam(1e-3)
+    state = tx.init(params)
+    # single-controller broadcast: result equals input, full structure
+    out = hvd.broadcast_optimizer_state(state, root_rank=0)
+    assert jax.tree.structure(out) == jax.tree.structure(state)
+    for a, b in zip(jax.tree.leaves(out), jax.tree.leaves(state)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b))
+    v = hvd.broadcast_variables({"w": jnp.full((3,), 7.0)}, root_rank=0)
+    np.testing.assert_allclose(np.asarray(v["w"]), 7.0)
