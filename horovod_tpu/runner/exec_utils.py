@@ -24,9 +24,11 @@ def is_local(hostname: str) -> bool:
 def routable_addr(assignments) -> str:
     """Address remote workers should dial to reach a service running in
     this (driver) process: loopback when every slot is local, else this
-    host's resolvable address.  Shared by the static and elastic launch
-    paths so the two cannot diverge."""
-    if all(is_local(a.hostname) for a in assignments):
+    host's resolvable address.  Shared by the static, elastic, and jsrun
+    launch paths so they cannot diverge.  Accepts SlotInfo-likes (with a
+    ``hostname`` attr) or plain hostname strings."""
+    names = [getattr(a, "hostname", a) for a in assignments]
+    if all(is_local(h) for h in names):
         return "127.0.0.1"
     return socket.gethostbyname(socket.gethostname())
 

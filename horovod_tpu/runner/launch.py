@@ -19,10 +19,11 @@ from __future__ import annotations
 
 import argparse
 import secrets as pysecrets
+import shlex
 import socket
 import sys
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 from ..utils.logging import get_logger
 from ..version import __version__
@@ -37,6 +38,49 @@ def free_port() -> int:
     return port
 
 
+def start_job_services(np_: int, worker_hosts: List[str]) -> Tuple[object, Dict[str, str]]:
+    """Start the KV/rendezvous controller in this (launcher) process and
+    build the service env every launch path exports — one implementation
+    shared by the static, mpirun, and jsrun paths so they cannot drift.
+
+    ``worker_hosts`` is ordered: worker 0 — which hosts the
+    ``jax.distributed`` coordinator per the env contract above — runs on
+    ``worker_hosts[0]``.  Loopback addresses are only used when every
+    worker is local to the launcher.  Returns ``(server, env)``; the
+    caller owns ``server.stop()``.
+    """
+    secret = pysecrets.token_hex(16)
+    server = controller_py.make_server(secret, np_)
+    all_local = all(exec_utils.is_local(h) for h in worker_hosts)
+    if all_local:
+        coordinator_host = "127.0.0.1"
+    elif exec_utils.is_local(worker_hosts[0]):
+        # worker 0 runs on this launcher host but peers are remote: they
+        # must dial a routable name, not the literal "localhost".
+        coordinator_host = exec_utils.routable_addr(worker_hosts)
+    else:
+        coordinator_host = worker_hosts[0]
+    env = {
+        "HVD_TPU_COORDINATOR_ADDR": f"{coordinator_host}:{free_port()}",
+        "HVD_TPU_CROSS_SIZE": str(np_),
+        "HVD_TPU_RENDEZVOUS_ADDR": exec_utils.routable_addr(worker_hosts),
+        "HVD_TPU_RENDEZVOUS_PORT": str(server.port),
+        "HVD_TPU_SECRET": secret,
+    }
+    return server, env
+
+
+def slot_env_entries(slot: hosts_mod.SlotInfo) -> Dict[str, str]:
+    """The per-slot half of the worker env contract."""
+    return {
+        "HVD_TPU_CROSS_RANK": str(slot.rank),
+        "HVD_TPU_CROSS_SIZE": str(slot.size),
+        "HVD_TPU_LOCAL_RANK": str(slot.local_rank),
+        "HVD_TPU_LOCAL_SIZE": str(slot.local_size),
+        "HVD_TPU_HOSTNAME": slot.hostname,
+    }
+
+
 def make_worker_env(
     slot: hosts_mod.SlotInfo,
     coordinator_addr: str,
@@ -47,14 +91,10 @@ def make_worker_env(
 ) -> Dict[str, str]:
     env = {
         "HVD_TPU_COORDINATOR_ADDR": coordinator_addr,
-        "HVD_TPU_CROSS_RANK": str(slot.rank),
-        "HVD_TPU_CROSS_SIZE": str(slot.size),
-        "HVD_TPU_LOCAL_RANK": str(slot.local_rank),
-        "HVD_TPU_LOCAL_SIZE": str(slot.local_size),
-        "HVD_TPU_HOSTNAME": slot.hostname,
         "HVD_TPU_RENDEZVOUS_ADDR": rendezvous_addr,
         "HVD_TPU_RENDEZVOUS_PORT": str(rendezvous_port),
         "HVD_TPU_SECRET": secret,
+        **slot_env_entries(slot),
     }
     if extra_env:
         env.update(extra_env)
@@ -76,27 +116,23 @@ def launch_static(
     terminating the remaining workers on failure like the reference.
     """
     assignments = hosts_mod.get_host_assignments(host_list, np_)
-    secret = pysecrets.token_hex(16)
-    server = controller_py.make_server(secret, np_)
-    rendezvous_addr = exec_utils.routable_addr(assignments)
-    coordinator_host = (
-        "127.0.0.1"
-        if exec_utils.is_local(assignments[0].hostname)
-        else assignments[0].hostname
+    server, service_env = start_job_services(
+        np_, [a.hostname for a in assignments]
     )
-    coordinator_addr = f"{coordinator_host}:{free_port()}"
     if verbose:
         get_logger().warning(
-            "launching %d process(es) on %d host(s); rendezvous %s:%d",
-            np_, assignments[-1].cross_size, rendezvous_addr, server.port,
+            "launching %d process(es) on %d host(s); rendezvous %s:%s",
+            np_, assignments[-1].cross_size,
+            service_env["HVD_TPU_RENDEZVOUS_ADDR"],
+            service_env["HVD_TPU_RENDEZVOUS_PORT"],
         )
     workers = []
     try:
         for slot in assignments:
-            env = make_worker_env(
-                slot, coordinator_addr, rendezvous_addr, server.port, secret,
-                extra_env,
-            )
+            env = dict(service_env)
+            env.update(slot_env_entries(slot))
+            if extra_env:
+                env.update(extra_env)
             workers.append(
                 exec_utils.WorkerProcess(
                     slot.rank, slot.hostname, command, env,
@@ -205,8 +241,12 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
                         help="launch workers via mpirun (reference "
                         "horovodrun --use-mpi; MPI is launcher-only — "
                         "collectives still ride XLA)")
+    parser.add_argument("--use-jsrun", action="store_true",
+                        help="launch workers via jsrun inside an LSF "
+                        "allocation (reference js_run.py; launcher-only)")
     parser.add_argument("--mpi-args", default="",
-                        help="extra args appended to the mpirun line")
+                        help="extra args appended to the mpirun (or, "
+                        "with --use-jsrun, the jsrun) command line")
     parser.add_argument("--config-file",
                         help="JSON/YAML config with the same knobs "
                         "(CLI flags win on conflict)")
@@ -221,12 +261,24 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
         from .config_parser import apply_config_to_args, parse_config_file
 
         apply_config_to_args(args, parse_config_file(args.config_file))
+    # Launcher-conflict validation runs AFTER the config file is folded
+    # in, so elastic knobs declared there are caught too.
+    if args.use_mpi and args.use_jsrun:
+        parser.error("--use-mpi and --use-jsrun are mutually exclusive")
+    if args.use_jsrun and (args.min_np is not None or args.max_np is not None
+                           or args.discovery_script):
+        parser.error("--use-jsrun cannot be combined with elastic flags "
+                     "(--min-np/--max-np/--host-discovery-script)")
     if not args.command:
         parser.error("no worker command given")
     if args.command[0] == "--":
         args.command = args.command[1:]
     if args.np is None and args.min_np is None:
-        parser.error("-np (or --min-np for elastic) is required")
+        from . import lsf
+
+        if not lsf.using_lsf():
+            parser.error("-np (or --min-np for elastic) is required "
+                         "(inferred from the allocation under LSF)")
     return args
 
 
@@ -252,13 +304,24 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
     if args.check_build:
         check_build()
         return 0
+    from . import lsf
+
+    if args.np is None and args.min_np is None:
+        # np was allowed to be omitted only under LSF: infer one worker
+        # per allocated host BEFORE any launch branch consumes args.np —
+        # but never against an explicit -H/--hostfile, whose slot layout
+        # the user chose deliberately.
+        if args.hosts or args.hostfile:
+            print("hvdrun: -np is required when -H/--hostfile is given "
+                  "(LSF inference applies only to allocation-derived "
+                  "hosts)", file=sys.stderr)
+            return 2
+        args.np = len(lsf.get_compute_hosts())
     if args.discovery_script or args.min_np is not None:
         from .elastic_launch import launch_elastic
 
         return launch_elastic(args)
     if args.use_mpi:
-        import shlex
-
         from .mpi_run import mpi_run
 
         hosts = args.hosts
@@ -268,16 +331,50 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
                 f"{h.hostname}:{h.slots}"
                 for h in hosts_mod.parse_host_files(args.hostfile)
             )
+        if not hosts and lsf.using_lsf():
+            # Same allocation-derived hosts the static branch uses —
+            # otherwise mpirun gets no -H and packs every worker onto
+            # the launch host.
+            hosts = ",".join(
+                f"{h.hostname}:{h.slots}"
+                for h in lsf.lsf_host_list(np_=args.np)
+            )
         return mpi_run(
             args.np, hosts, args.command,
             extra_env=env_from_args(args),
             mpi_args=shlex.split(args.mpi_args) if args.mpi_args else None,
             verbose=args.verbose,
         )
+    if args.use_jsrun:
+        jsrun_hosts = None
+        if args.hostfile and not args.hosts:
+            jsrun_hosts = {
+                h.hostname: h.slots
+                for h in hosts_mod.parse_host_files(args.hostfile)
+            }
+        elif args.hosts:
+            jsrun_hosts = {
+                h.hostname: h.slots
+                for h in hosts_mod.parse_hosts(args.hosts)
+            }
+        return lsf.js_run(
+            args.np, args.command,
+            hosts=jsrun_hosts,
+            extra_env=env_from_args(args),
+            extra_args=shlex.split(args.mpi_args) if args.mpi_args else None,
+            verbose=args.verbose,
+        )
     if args.hostfile:
         host_list = hosts_mod.parse_host_files(args.hostfile)
     elif args.hosts:
         host_list = hosts_mod.parse_hosts(args.hosts)
+    elif lsf.using_lsf():
+        # Inside an LSF allocation with no explicit hosts: use the
+        # job's allocated hosts, one worker process per host — growing
+        # slots when an explicit -np exceeds the host count (reference
+        # launch.py consults LSFUtils the same way before defaulting to
+        # localhost).
+        host_list = lsf.lsf_host_list(np_=args.np)
     else:
         host_list = [hosts_mod.HostInfo("localhost", args.np)]
     return launch_static(

@@ -1,0 +1,337 @@
+"""LSF cluster detection and ``jsrun`` launch.
+
+Reference: ``horovod/runner/util/lsf.py`` (LSFUtils — cluster detection
++ host/core/gpu discovery via IBM CSM) and ``horovod/runner/js_run.py``
+(jsrun command + ERF rankfile construction).
+
+TPU re-design: the reference resolves its allocation through the CSM
+daemons found on Summit-class machines and binds one process per GPU;
+here the allocation is read straight from the standard LSF job env
+(``LSB_DJOB_HOSTFILE`` / ``LSB_MCPU_HOSTS`` / ``LSB_HOSTS`` — present
+under every LSF, CSM or not), and a "slot" is a worker process (one per
+host by default, owning that host's chips — same convention as
+:mod:`horovod_tpu.runner.hosts`).  ``jsrun`` remains only a *process
+launcher*: the data plane is XLA, so the jsrun command wraps each
+worker in the :mod:`horovod_tpu.runner.mpi_worker` shim, which
+translates the PMIx rank env jsrun provides into this framework's
+worker env contract.
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import tempfile
+from shlex import quote
+from typing import Dict, List, Optional
+
+from . import hosts as hosts_mod
+
+
+def using_lsf(environ=None) -> bool:
+    """True when running inside an LSF job allocation (reference
+    ``LSFUtils.using_lsf``: presence of ``LSB_JOBID``)."""
+    e = environ if environ is not None else os.environ
+    return "LSB_JOBID" in e
+
+
+def _hosts_from_djob_hostfile(path: str) -> Dict[str, int]:
+    """``LSB_DJOB_HOSTFILE`` lists one hostname per allocated slot
+    (repeated per core); collapse repeats into host -> slot count."""
+    counts: Dict[str, int] = {}
+    with open(path) as fh:
+        for line in fh:
+            host = line.strip()
+            if host:
+                counts[host] = counts.get(host, 0) + 1
+    return counts
+
+
+def _hosts_from_mcpu(spec: str) -> Dict[str, int]:
+    """``LSB_MCPU_HOSTS`` is ``"host1 n1 host2 n2 ..."``."""
+    toks = spec.split()
+    if len(toks) % 2:
+        raise ValueError(f"malformed LSB_MCPU_HOSTS: {spec!r}")
+    counts: Dict[str, int] = {}
+    for host, n in zip(toks[0::2], toks[1::2]):
+        counts[host] = counts.get(host, 0) + int(n)
+    return counts
+
+
+def _hosts_from_lsb_hosts(spec: str) -> Dict[str, int]:
+    """``LSB_HOSTS`` repeats each hostname once per slot."""
+    counts: Dict[str, int] = {}
+    for host in spec.split():
+        counts[host] = counts.get(host, 0) + 1
+    return counts
+
+
+def get_allocated_hosts(environ=None) -> Dict[str, int]:
+    """Ordered ``{host: cores}`` for the current LSF allocation.
+
+    Precedence mirrors LSF's own documentation: the job hostfile is
+    authoritative, ``LSB_MCPU_HOSTS`` is its compact form, and
+    ``LSB_HOSTS`` (which caps at a few thousand chars) is the fallback.
+    The first host listed is the launch host, as LSF guarantees.
+    """
+    e = environ if environ is not None else os.environ
+    path = e.get("LSB_DJOB_HOSTFILE")
+    if path and os.path.exists(path):
+        return _hosts_from_djob_hostfile(path)
+    if e.get("LSB_MCPU_HOSTS"):
+        return _hosts_from_mcpu(e["LSB_MCPU_HOSTS"])
+    if e.get("LSB_HOSTS"):
+        return _hosts_from_lsb_hosts(e["LSB_HOSTS"])
+    raise RuntimeError(
+        "inside an LSF job (LSB_JOBID set) but none of LSB_DJOB_HOSTFILE/"
+        "LSB_MCPU_HOSTS/LSB_HOSTS describe the allocation"
+    )
+
+
+def get_compute_hosts(environ=None) -> List[str]:
+    """Compute hostnames in allocation order (reference
+    ``LSFUtils.get_compute_hosts`` — which queries CSM for the compute
+    node list, implicitly excluding Summit-style launch nodes).
+
+    Without CSM the launch node is recognized by its signature: the
+    FIRST listed host (LSF guarantees that is the launch host) holding
+    exactly one slot while every other host holds more.  Such a host
+    cannot run jsrun tasks and owns no chips, so it is dropped.  Set
+    ``HVD_TPU_LSF_INCLUDE_LAUNCH_HOST=1`` to keep it (e.g. single-host
+    or genuinely heterogeneous allocations are never dropped anyway).
+    """
+    e = environ if environ is not None else os.environ
+    counts = get_allocated_hosts(environ)
+    hosts = list(counts)
+    if (len(hosts) >= 2
+            and counts[hosts[0]] == 1
+            and all(counts[h] > 1 for h in hosts[1:])
+            and e.get("HVD_TPU_LSF_INCLUDE_LAUNCH_HOST", "") != "1"):
+        return hosts[1:]
+    return hosts
+
+
+def get_num_cores(environ=None) -> int:
+    """Cores allocated on the first compute host (reference
+    ``LSFUtils.get_num_cores``)."""
+    counts = get_allocated_hosts(environ)
+    return counts[get_compute_hosts(environ)[0]]
+
+
+def lsf_host_list(
+    environ=None, np_: Optional[int] = None
+) -> List[hosts_mod.HostInfo]:
+    """The allocation as launcher ``HostInfo`` records.
+
+    Default is one worker process per host (the TPU convention — one
+    process owns all chips on a host), not one per core as the
+    reference's GPU binding would.  When an explicit ``np_`` exceeds
+    the host count, slots grow evenly (``spread_workers``) so
+    ``get_host_assignments`` can place every requested worker.
+    """
+    hosts = get_compute_hosts(environ)
+    if np_ is not None and np_ > len(hosts):
+        slots = spread_workers(np_, hosts)
+        return [hosts_mod.HostInfo(h, s) for h, s in slots.items()]
+    return [hosts_mod.HostInfo(h, 1) for h in hosts]
+
+
+# ---------------------------------------------------------------------------
+# jsrun
+# ---------------------------------------------------------------------------
+
+def is_jsrun_installed() -> bool:
+    """Reference ``js_run.is_jsrun_installed``."""
+    return shutil.which("jsrun") is not None
+
+
+def generate_jsrun_rankfile(
+    num_proc: int,
+    host_slots: Dict[str, int],
+    cores_per_proc,
+    path: Optional[str] = None,
+) -> str:
+    """Write an ERF (explicit resource file) splitting each host's cores
+    evenly among its worker processes (reference
+    ``js_run.generate_jsrun_rankfile`` — same file format, but core
+    counts come from the LSF env instead of CSM queries).
+
+    ``cores_per_proc`` is an int (uniform) or a ``{host: cores}`` dict —
+    LSF allocations are often heterogeneous (the launch/batch host
+    typically has fewer slots than the compute hosts), so per-host core
+    budgets keep the cpu ranges valid on every host.
+    """
+    if path is None:
+        fd, path = tempfile.mkstemp(prefix="hvd_tpu_jsrun_", suffix=".erf")
+        os.close(fd)
+    remaining = num_proc
+    lines = ["overlapping_rs: allow", "cpu_index_using: logical"]
+    rank = 0
+    for host, slots in host_slots.items():
+        if remaining <= 0:
+            break
+        take = min(slots, remaining)
+        remaining -= take
+        per = (cores_per_proc.get(host, 1)
+               if isinstance(cores_per_proc, dict) else cores_per_proc)
+        per = max(1, per)
+        lines.append("")
+        cpu = 0
+        for _ in range(take):
+            lines.append(
+                f"rank: {rank}: {{ hostname: {host}; "
+                f"cpu: {{{cpu}-{cpu + per - 1}}} }}"
+            )
+            rank += 1
+            cpu += per
+    if remaining > 0:
+        raise ValueError(
+            f"LSF allocation provides {num_proc - remaining} slot(s), "
+            f"{num_proc} requested"
+        )
+    with open(path, "w") as fh:
+        fh.write("\n".join(lines) + "\n")
+    return path
+
+
+def spread_workers(np_: int, hostnames: List[str]) -> Dict[str, int]:
+    """Spread ``np_`` workers evenly across hosts (one worker per host
+    when np_ == nhosts — the TPU convention: a worker owns its host's
+    chips — generalizing to balanced counts when np_ > nhosts)."""
+    nhosts = len(hostnames)
+    base, extra = divmod(np_, nhosts) if nhosts else (0, 0)
+    out = {
+        h: base + (1 if i < extra else 0) for i, h in enumerate(hostnames)
+    }
+    return {h: s for h, s in out.items() if s > 0}
+
+
+def get_jsrun_command(
+    np_: int,
+    command: List[str],
+    *,
+    rankfile: Optional[str] = None,
+    output_filename: Optional[str] = None,
+    extra_args: Optional[List[str]] = None,
+) -> List[str]:
+    """Build the jsrun command line (exposed for tests).
+
+    jsrun starts ``np_`` resource-set tasks; each task runs the
+    ``mpi_worker`` shim (jsrun exports ``PMIX_RANK``), which rewrites
+    rank env and execs the user command.  Env forwarding is implicit —
+    jsrun propagates the launch environment — so unlike ``mpirun`` no
+    ``-x`` flags are needed.
+    """
+    import sys
+
+    cmd = ["jsrun"]
+    if rankfile:
+        cmd += ["--erf_input", rankfile]
+    else:
+        # one task per resource set, one resource set per process
+        cmd += ["--nrs", str(np_), "--tasks_per_rs", "1"]
+    if output_filename:
+        cmd += ["--stdio_stdout", output_filename,
+                "--stdio_stderr", output_filename]
+    cmd += list(extra_args or [])
+    cmd += [sys.executable, "-m", "horovod_tpu.runner.mpi_worker"]
+    cmd += list(command)
+    return cmd
+
+
+def js_run(
+    np_: int,
+    command: List[str],
+    *,
+    hosts: Optional[Dict[str, int]] = None,
+    extra_env: Optional[Dict[str, str]] = None,
+    extra_args: Optional[List[str]] = None,
+    output_filename: Optional[str] = None,
+    verbose: bool = False,
+) -> int:
+    """Launch ``np_`` workers through jsrun inside an LSF allocation
+    (reference ``js_run.js_run``).  The rendezvous controller runs in
+    this process, exactly like the mpirun path.
+
+    ``hosts`` (``{host: slots}``) overrides worker placement (the
+    ``hvdrun -H`` path, reference ``settings.hosts``); hosts must
+    belong to the allocation and slot counts must fit its cores.
+    """
+    import subprocess
+
+    from .launch import start_job_services
+    from ..utils.logging import get_logger
+
+    if not using_lsf():
+        raise RuntimeError(
+            "--use-jsrun requires an LSF job allocation (LSB_JOBID is "
+            "not set); submit through bsub or use another launcher"
+        )
+    if not is_jsrun_installed():
+        raise RuntimeError(
+            "jsrun not found on PATH (reference js_run raises the same); "
+            "run inside an LSF/JSM allocation or use another launcher"
+        )
+    host_cores = get_allocated_hosts()
+    if hosts is not None:
+        unknown = [h for h in hosts if h not in host_cores]
+        if unknown:
+            raise ValueError(
+                f"-H host(s) {unknown} are not part of the LSF "
+                f"allocation {list(host_cores)}"
+            )
+        if sum(hosts.values()) < np_:
+            raise ValueError(
+                f"-H provides {sum(hosts.values())} slot(s), "
+                f"{np_} requested"
+            )
+        # Normalize the -H request to the workers actually PLACED (the
+        # rankfile fills hosts in order up to np_): capacity checks and
+        # core budgets must reflect placement, not the raw request.
+        worker_slots = {}
+        remaining = np_
+        for h, s in hosts.items():
+            if remaining <= 0:
+                break
+            take = min(s, remaining)
+            worker_slots[h] = take
+            remaining -= take
+    else:
+        # Workers spread evenly across the compute hosts, NOT packed
+        # onto the first host: each worker owns a host's chips.
+        worker_slots = spread_workers(np_, get_compute_hosts())
+    over = {h: s for h, s in worker_slots.items() if s > host_cores[h]}
+    if over:
+        capacity = sum(host_cores[h] for h in worker_slots)
+        raise ValueError(
+            f"allocation provides {capacity} core slot(s) on "
+            f"{list(worker_slots)}, {np_} worker(s) requested "
+            f"(oversubscribed: {over})"
+        )
+    rankfile = generate_jsrun_rankfile(
+        np_, worker_slots,
+        {h: host_cores[h] // s for h, s in worker_slots.items()},
+    )
+    # Worker 0 (the jax.distributed coordinator) runs on the first
+    # rankfile host; the shared helper points the coordinator addr
+    # there and the rendezvous addr at this launcher process.
+    server, service_env = start_job_services(np_, list(worker_slots))
+    env = dict(os.environ)
+    env.update(service_env)
+    if extra_env:
+        env.update(extra_env)
+    cmd = get_jsrun_command(
+        np_, command, rankfile=rankfile,
+        output_filename=output_filename, extra_args=extra_args,
+    )
+    if verbose:
+        get_logger().warning("jsrun launch: %s",
+                             " ".join(quote(c) for c in cmd))
+    try:
+        return subprocess.run(cmd, env=env).returncode
+    finally:
+        server.stop()
+        try:
+            os.unlink(rankfile)
+        except OSError:
+            pass

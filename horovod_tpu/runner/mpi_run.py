@@ -15,14 +15,13 @@ exports its address through ``-x``, exactly like the static launcher.
 from __future__ import annotations
 
 import os
-import secrets as pysecrets
 import shutil
 import subprocess
 import sys
 from typing import Dict, List, Optional
 
-from . import controller_py, hosts as hosts_mod
-from .launch import free_port
+from . import hosts as hosts_mod
+from .launch import start_job_services
 from ..utils.logging import get_logger
 
 # env vars forwarded to workers (reference mpi_run.py's -x list is the
@@ -89,29 +88,16 @@ def mpi_run(
             "mpirun not found on PATH (reference mpi_run.py raises the "
             "same); install Open MPI or use the default launcher"
         )
-    from . import exec_utils
-
-    secret = pysecrets.token_hex(16)
-    server = controller_py.make_server(secret, np_)
     host_list = (
         hosts_mod.parse_hosts(hosts) if hosts
         else [hosts_mod.HostInfo("localhost", np_)]
     )
     assignments = hosts_mod.get_host_assignments(host_list, np_)
-    # The controller server runs in THIS (launcher) process — workers
-    # must dial the launcher's routable address, not worker 0's host
-    # (same logic as launch_static).
-    rendezvous_addr = exec_utils.routable_addr(assignments)
-    first = host_list[0].hostname
-    coordinator_host = "127.0.0.1" if exec_utils.is_local(first) else first
+    server, service_env = start_job_services(
+        np_, [a.hostname for a in assignments]
+    )
     env = dict(os.environ)
-    env.update({
-        "HVD_TPU_COORDINATOR_ADDR": f"{coordinator_host}:{free_port()}",
-        "HVD_TPU_CROSS_SIZE": str(np_),
-        "HVD_TPU_RENDEZVOUS_ADDR": rendezvous_addr,
-        "HVD_TPU_RENDEZVOUS_PORT": str(server.port),
-        "HVD_TPU_SECRET": secret,
-    })
+    env.update(service_env)
     if extra_env:
         env.update(extra_env)
     cmd = get_mpi_command(

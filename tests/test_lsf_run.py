@@ -1,0 +1,283 @@
+"""LSF detection + jsrun launch path (reference
+``horovod/runner/util/lsf.py`` + ``horovod/runner/js_run.py``,
+``test/single/test_run.py`` jsrun command/rankfile tests)."""
+
+import os
+import stat
+import subprocess
+import sys
+
+import pytest
+
+from horovod_tpu.runner import hosts as hosts_mod
+from horovod_tpu.runner import lsf
+
+
+class TestDetection:
+    def test_using_lsf(self):
+        assert lsf.using_lsf({"LSB_JOBID": "123"})
+        assert not lsf.using_lsf({})
+
+    def test_hosts_from_djob_hostfile(self, tmp_path):
+        hf = tmp_path / "hostfile"
+        hf.write_text("node1\nnode1\nnode1\nnode2\nnode2\nnode2\n")
+        env = {"LSB_JOBID": "1", "LSB_DJOB_HOSTFILE": str(hf)}
+        assert lsf.get_allocated_hosts(env) == {"node1": 3, "node2": 3}
+        assert lsf.get_compute_hosts(env) == ["node1", "node2"]
+        assert lsf.get_num_cores(env) == 3
+
+    def test_hosts_from_mcpu(self):
+        env = {"LSB_JOBID": "1", "LSB_MCPU_HOSTS": "batch1 4 batch2 4"}
+        assert lsf.get_allocated_hosts(env) == {"batch1": 4, "batch2": 4}
+
+    def test_hosts_from_lsb_hosts(self):
+        env = {"LSB_JOBID": "1", "LSB_HOSTS": "a a b"}
+        assert lsf.get_allocated_hosts(env) == {"a": 2, "b": 1}
+
+    def test_malformed_mcpu_raises(self):
+        with pytest.raises(ValueError):
+            lsf._hosts_from_mcpu("host1 4 host2")
+
+    def test_no_allocation_info_raises(self):
+        with pytest.raises(RuntimeError):
+            lsf.get_allocated_hosts({"LSB_JOBID": "1"})
+
+    def test_host_list_one_worker_per_host(self):
+        env = {"LSB_JOBID": "1", "LSB_MCPU_HOSTS": "n1 40 n2 40"}
+        hl = lsf.lsf_host_list(env)
+        assert hl == [hosts_mod.HostInfo("n1", 1), hosts_mod.HostInfo("n2", 1)]
+
+    def test_host_list_grows_slots_for_large_np(self):
+        """Explicit -np beyond the host count spreads slots instead of
+        making get_host_assignments raise."""
+        env = {"LSB_JOBID": "1", "LSB_MCPU_HOSTS": "localhost 16"}
+        hl = lsf.lsf_host_list(env, np_=4)
+        assert hl == [hosts_mod.HostInfo("localhost", 4)]
+
+    def test_launch_host_excluded_by_signature(self):
+        """Summit-style batch node (1 slot, first) is dropped from the
+        compute list; HVD_TPU_LSF_INCLUDE_LAUNCH_HOST keeps it."""
+        env = {"LSB_JOBID": "1",
+               "LSB_MCPU_HOSTS": "batch1 1 cn1 40 cn2 40"}
+        assert lsf.get_compute_hosts(env) == ["cn1", "cn2"]
+        env["HVD_TPU_LSF_INCLUDE_LAUNCH_HOST"] = "1"
+        assert lsf.get_compute_hosts(env) == ["batch1", "cn1", "cn2"]
+
+    def test_single_host_never_excluded(self):
+        env = {"LSB_JOBID": "1", "LSB_MCPU_HOSTS": "onlyhost 1"}
+        assert lsf.get_compute_hosts(env) == ["onlyhost"]
+
+
+class TestRankfile:
+    def test_rankfile_contents(self, tmp_path):
+        path = str(tmp_path / "rf.erf")
+        out = lsf.generate_jsrun_rankfile(
+            4, {"n1": 2, "n2": 2}, cores_per_proc=10, path=path
+        )
+        assert out == path
+        text = open(path).read()
+        assert "overlapping_rs: allow" in text
+        assert "cpu_index_using: logical" in text
+        # 4 ranks, cores split 10 apiece, restarting per host
+        assert "rank: 0: { hostname: n1; cpu: {0-9} }" in text
+        assert "rank: 1: { hostname: n1; cpu: {10-19} }" in text
+        assert "rank: 2: { hostname: n2; cpu: {0-9} }" in text
+        assert "rank: 3: { hostname: n2; cpu: {10-19} }" in text
+
+    def test_rankfile_truncates_to_np(self, tmp_path):
+        path = str(tmp_path / "rf.erf")
+        lsf.generate_jsrun_rankfile(1, {"n1": 2, "n2": 2}, 4, path=path)
+        text = open(path).read()
+        assert "rank: 0" in text and "rank: 1" not in text
+        assert "n2" not in text
+
+    def test_rankfile_heterogeneous_cores(self, tmp_path):
+        """Per-host core budgets: a 1-core batch host next to 40-core
+        compute hosts must not clamp (or overflow) the others."""
+        path = str(tmp_path / "rf.erf")
+        lsf.generate_jsrun_rankfile(
+            3, {"batch1": 1, "cn1": 1, "cn2": 1},
+            {"batch1": 1, "cn1": 40, "cn2": 40}, path=path,
+        )
+        text = open(path).read()
+        assert "rank: 0: { hostname: batch1; cpu: {0-0} }" in text
+        assert "rank: 1: { hostname: cn1; cpu: {0-39} }" in text
+        assert "rank: 2: { hostname: cn2; cpu: {0-39} }" in text
+
+    def test_rankfile_insufficient_slots_raises(self, tmp_path):
+        with pytest.raises(ValueError):
+            lsf.generate_jsrun_rankfile(
+                8, {"n1": 2}, 4, path=str(tmp_path / "rf.erf")
+            )
+
+
+class TestSpread:
+    def test_one_per_host(self):
+        assert lsf.spread_workers(2, ["a", "b"]) == {"a": 1, "b": 1}
+
+    def test_balanced_overflow(self):
+        assert lsf.spread_workers(5, ["a", "b"]) == {"a": 3, "b": 2}
+
+    def test_fewer_workers_than_hosts(self):
+        assert lsf.spread_workers(1, ["a", "b", "c"]) == {"a": 1}
+
+
+class TestJsrunCommand:
+    def test_command_shape(self):
+        cmd = lsf.get_jsrun_command(
+            4, ["python", "train.py"], rankfile="/tmp/rf.erf",
+        )
+        assert cmd[0] == "jsrun"
+        i = cmd.index("--erf_input")
+        assert cmd[i + 1] == "/tmp/rf.erf"
+        j = cmd.index("-m")
+        assert cmd[j + 1] == "horovod_tpu.runner.mpi_worker"
+        assert cmd[-2:] == ["python", "train.py"]
+
+    def test_command_without_rankfile(self):
+        cmd = lsf.get_jsrun_command(8, ["echo"])
+        i = cmd.index("--nrs")
+        assert cmd[i + 1] == "8"
+        assert "--tasks_per_rs" in cmd
+
+    def test_output_file_and_extra_args(self):
+        cmd = lsf.get_jsrun_command(
+            2, ["echo"], output_filename="/tmp/out.log",
+            extra_args=["--smpiargs", "none"],
+        )
+        assert "--stdio_stdout" in cmd and "--stdio_stderr" in cmd
+        assert "--smpiargs" in cmd
+
+    def test_js_run_requires_jsrun(self, monkeypatch):
+        monkeypatch.setenv("LSB_JOBID", "1")
+        monkeypatch.setattr(lsf.shutil, "which", lambda _: None)
+        with pytest.raises(RuntimeError, match="jsrun not found"):
+            lsf.js_run(2, ["echo"])
+
+    def test_js_run_rejects_oversubscription(self, monkeypatch):
+        monkeypatch.setattr(lsf.shutil, "which", lambda _: "/usr/bin/jsrun")
+        monkeypatch.setenv("LSB_JOBID", "1")
+        monkeypatch.setenv("LSB_MCPU_HOSTS", "a 4 b 4")
+        with pytest.raises(ValueError, match="oversubscribed"):
+            lsf.js_run(16, ["echo"])
+
+    def test_js_run_rejects_foreign_hosts(self, monkeypatch):
+        monkeypatch.setattr(lsf.shutil, "which", lambda _: "/usr/bin/jsrun")
+        monkeypatch.setenv("LSB_JOBID", "1")
+        monkeypatch.setenv("LSB_MCPU_HOSTS", "a 4 b 4")
+        with pytest.raises(ValueError, match="not part of the LSF"):
+            lsf.js_run(2, ["echo"], hosts={"zz": 2})
+
+    def test_js_run_hosts_normalized_to_placement(self, monkeypatch,
+                                                  tmp_path):
+        """-H slot counts beyond np must not trip the capacity check:
+        only PLACED workers count (np=2 fits a 4-core host even when
+        -H requests 32 slots)."""
+        marker = tmp_path / "ran"
+        fake = tmp_path / "jsrun"
+        fake.write_text(f"#!/bin/bash\ntouch {marker}\n")
+        fake.chmod(0o755)
+        monkeypatch.setenv("PATH", f"{tmp_path}:{os.environ['PATH']}")
+        monkeypatch.setenv("LSB_JOBID", "1")
+        monkeypatch.setenv("LSB_MCPU_HOSTS", "localhost 4")
+        rc = lsf.js_run(2, ["echo"], hosts={"localhost": 32})
+        assert rc == 0 and marker.exists()
+
+    def test_js_run_outside_lsf_friendly_error(self, monkeypatch):
+        monkeypatch.delenv("LSB_JOBID", raising=False)
+        with pytest.raises(RuntimeError, match="requires an LSF job"):
+            lsf.js_run(2, ["echo"])
+
+    def test_conflicting_launchers_rejected(self):
+        from horovod_tpu.runner import launch
+
+        with pytest.raises(SystemExit):
+            launch.parse_args(["--use-mpi", "--use-jsrun", "-np", "2",
+                               "--", "echo"])
+        with pytest.raises(SystemExit):
+            launch.parse_args(["--use-jsrun", "--min-np", "2", "--", "echo"])
+        with pytest.raises(SystemExit):
+            launch.parse_args(["--use-jsrun", "-np", "2", "--max-np", "4",
+                               "--", "echo"])
+
+
+def test_js_run_end_to_end_with_fake_jsrun(tmp_path, monkeypatch):
+    """A fake ``jsrun`` on PATH execs the worker shim locally once per
+    requested rank with PMIX env, proving the full launch path: env
+    contract export, rankfile, shim translation, rc propagation."""
+    marker = tmp_path / "out"
+    fake = tmp_path / "jsrun"
+    fake.write_text(
+        "#!/bin/bash\n"
+        # find the '-m' python invocation at the tail of our argv
+        "while [[ $1 != *python* && $# -gt 0 ]]; do shift; done\n"
+        f"PMIX_RANK=0 OMPI_COMM_WORLD_SIZE=2 \"$@\" >> {marker} 2>&1\n"
+        f"PMIX_RANK=1 OMPI_COMM_WORLD_SIZE=2 \"$@\" >> {marker} 2>&1\n"
+    )
+    fake.chmod(fake.stat().st_mode | stat.S_IEXEC)
+    monkeypatch.setenv("PATH", f"{tmp_path}:{os.environ['PATH']}")
+    monkeypatch.setenv("LSB_JOBID", "77")
+    monkeypatch.setenv("LSB_MCPU_HOSTS", "localhost 4")
+    rc = lsf.js_run(
+        2,
+        [sys.executable, "-c",
+         "import os; print('rank', os.environ['HVD_TPU_CROSS_RANK'], "
+         "'size', os.environ['HVD_TPU_CROSS_SIZE'])"],
+    )
+    assert rc == 0
+    text = marker.read_text()
+    assert "rank 0 size 2" in text
+    assert "rank 1 size 2" in text
+
+
+def test_launcher_infers_hosts_under_lsf(monkeypatch):
+    """``hvdrun`` with no -H inside an LSF allocation uses the job's
+    hosts and infers np (reference launch.py LSFUtils integration)."""
+    from horovod_tpu.runner import launch
+
+    monkeypatch.setenv("LSB_JOBID", "5")
+    monkeypatch.setenv("LSB_MCPU_HOSTS", "localhost 2")
+    captured = {}
+
+    def fake_static(np_, host_list, command, **kw):
+        captured.update(np=np_, hosts=host_list, command=command)
+        return 0
+
+    monkeypatch.setattr(launch, "launch_static", fake_static)
+    rc = launch.run_commandline(["--", "echo", "hi"])
+    assert rc == 0
+    assert captured["np"] == 1
+    assert captured["hosts"] == [hosts_mod.HostInfo("localhost", 1)]
+    assert captured["command"] == ["echo", "hi"]
+
+
+def test_launcher_rejects_explicit_hosts_without_np_under_lsf(monkeypatch):
+    """-H with no -np must not silently take np from the allocation."""
+    from horovod_tpu.runner import launch
+
+    monkeypatch.setenv("LSB_JOBID", "5")
+    monkeypatch.setenv("LSB_MCPU_HOSTS", "n1 2 n2 2")
+    rc = launch.run_commandline(["-H", "a:4,b:4", "--", "echo", "hi"])
+    assert rc == 2
+
+
+def test_use_mpi_under_lsf_gets_allocation_hosts(monkeypatch):
+    """--use-mpi inside LSF forwards the allocation's hosts to mpirun
+    instead of packing workers onto the launch host."""
+    from horovod_tpu.runner import launch
+
+    monkeypatch.setenv("LSB_JOBID", "5")
+    monkeypatch.setenv("LSB_MCPU_HOSTS", "n1 40 n2 40")
+    captured = {}
+
+    def fake_mpi_run(np_, hosts, command, **kw):
+        captured.update(np=np_, hosts=hosts, command=command)
+        return 0
+
+    import horovod_tpu.runner.mpi_run as mpi_run_mod
+
+    monkeypatch.setattr(mpi_run_mod, "mpi_run", fake_mpi_run)
+    rc = launch.run_commandline(["--use-mpi", "--", "echo", "hi"])
+    assert rc == 0
+    assert captured["np"] == 2
+    assert captured["hosts"] == "n1:1,n2:1"
