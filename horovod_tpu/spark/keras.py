@@ -126,16 +126,21 @@ def _keras_worker(model_blob, opt_blob, loss_blob, metrics_blob,
 
     x0 = jnp.asarray(feats[:1], jnp.float32)
     params = model.init(jax.random.PRNGKey(0), x0)
+    # Resume decisions are rank-0's alone: with a non-shared store only
+    # the rank-0 host may see the checkpoint, and a per-rank start_epoch
+    # would desynchronize the per-epoch collective counts (hang).
     start_epoch = 0
     saved_opt_state = None
-    ckpt = store.load_checkpoint(run_id)
-    if ckpt is not None:
-        if isinstance(ckpt, dict) and "params" in ckpt and "epoch" in ckpt:
-            params = jax.tree.map(jnp.asarray, ckpt["params"])
-            start_epoch = int(ckpt["epoch"]) + 1
-            saved_opt_state = ckpt.get("opt_state")
-        else:  # plain-params checkpoint from the base estimator
-            params = jax.tree.map(jnp.asarray, ckpt)
+    if hvd.rank() == 0:
+        ckpt = store.load_checkpoint(run_id)
+        if ckpt is not None:
+            if isinstance(ckpt, dict) and "params" in ckpt and "epoch" in ckpt:
+                params = jax.tree.map(jnp.asarray, ckpt["params"])
+                start_epoch = int(ckpt["epoch"]) + 1
+                saved_opt_state = ckpt.get("opt_state")
+            else:  # plain-params checkpoint from the base estimator
+                params = jax.tree.map(jnp.asarray, ckpt)
+    start_epoch = int(hvd.broadcast_object(start_epoch, root_rank=0))
     params = hvd.broadcast_parameters(params, root_rank=0)
 
     tx = hvd.DistributedOptimizer(optimizer)
@@ -146,11 +151,15 @@ def _keras_worker(model_blob, opt_blob, loss_blob, metrics_blob,
 
     step = hvd.distributed_train_step(loss_fn, tx)
     opt_state = step.init(params)
-    if saved_opt_state is not None:
+    if bool(hvd.broadcast_object(saved_opt_state is not None, root_rank=0)):
         # Resume optimizer moments/schedule counters too — restarting
         # Adam m/v or a warmup schedule mid-run silently changes the
         # trajectory (reference estimators restore the full optimizer).
-        opt_state = jax.tree.map(jnp.asarray, saved_opt_state)
+        # Rank 0 holds the restored values; everyone takes them by
+        # broadcast so the moments stay bitwise-identical across ranks.
+        if saved_opt_state is not None:
+            opt_state = jax.tree.map(jnp.asarray, saved_opt_state)
+        opt_state = hvd.broadcast_parameters(opt_state, root_rank=0)
 
     @jax.jit
     def evaluate(p, x, y):
