@@ -17,4 +17,5 @@ from .store import FilesystemStore, LocalStore, Store  # noqa: F401
 from .estimator import TpuEstimator  # noqa: F401
 from .keras import KerasEstimator  # noqa: F401
 from .torch import TorchEstimator  # noqa: F401
+from .lightning import LightningEstimator  # noqa: F401
 from .runner import run  # noqa: F401

@@ -1,0 +1,277 @@
+"""Lightning-style estimator: trains modules that implement the
+PyTorch-Lightning protocol.
+
+Reference: ``horovod/spark/lightning/estimator.py:619``
+(LightningEstimator) + ``spark/lightning/remote.py`` — wraps a
+``LightningModule`` in a horovod-strategy Trainer on the executors.
+TPU re-design: no dependency on the ``pytorch_lightning`` package —
+the estimator drives any object speaking the *protocol* (duck-typed:
+``training_step(batch, batch_idx)``, ``configure_optimizers()``, and
+optionally ``validation_step``/``on_train_epoch_end``), which real
+``LightningModule`` subclasses satisfy when lightning IS installed.
+Gradient averaging rides
+:class:`horovod_tpu.interop.torch.DistributedOptimizer`, per-epoch
+state checkpoints go through the Store (resume like the reference's
+``_has_checkpoint``), and per-epoch train/val metrics come back as a
+Keras-shaped history dict.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import cloudpickle as pickle
+import numpy as np
+
+from .estimator import _load_columns
+from .store import LocalStore, Store
+from .torch import TorchModel
+
+_PROTOCOL = ("training_step", "configure_optimizers")
+
+
+def _check_protocol(model) -> None:
+    missing = [m for m in _PROTOCOL if not callable(getattr(model, m, None))]
+    if missing:
+        raise TypeError(
+            f"model does not implement the lightning protocol: missing "
+            f"{missing} (a pytorch_lightning.LightningModule, or any "
+            f"torch.nn.Module defining them, works)"
+        )
+
+
+class LightningEstimator:
+    """Sklearn-style fit/predict over a lightning-protocol module.
+
+    Unlike :class:`~horovod_tpu.spark.torch.TorchEstimator` there is no
+    ``loss``/``optimizer`` argument: the module's own ``training_step``
+    computes the loss and ``configure_optimizers`` builds the optimizer,
+    exactly as lightning defines them (reference estimator passes the
+    module to a lightning Trainer for the same reason).
+    """
+
+    def __init__(
+        self,
+        model=None,
+        feature_cols: Sequence[str] = ("features",),
+        label_cols: Sequence[str] = ("label",),
+        batch_size: int = 32,
+        epochs: int = 1,
+        validation: Optional[float] = None,
+        backward_passes_per_step: int = 1,
+        num_proc: Optional[int] = None,
+        store: Optional[Store] = None,
+        run_id: Optional[str] = None,
+        verbose: int = 1,
+        extra_env: Optional[dict] = None,
+        store_format: str = "npz",
+    ):
+        from .estimator import _validate_store_format
+
+        _validate_store_format(store_format)
+        if model is None:
+            raise ValueError("model is required")
+        _check_protocol(model)
+        if validation is not None and not (0.0 < validation < 1.0):
+            raise ValueError("validation must be a fraction in (0, 1)")
+        self.model = model
+        self.feature_cols = list(feature_cols)
+        self.label_cols = list(label_cols)
+        self.batch_size = batch_size
+        self.epochs = epochs
+        self.validation = validation
+        self.backward_passes_per_step = backward_passes_per_step
+        self.num_proc = num_proc
+        self.store = store or LocalStore()
+        self.run_id = run_id or "run_lightning_default"
+        self.verbose = verbose
+        self.extra_env = extra_env
+        self.store_format = store_format
+
+    def _has_checkpoint(self) -> bool:
+        return self.store.load_checkpoint(self.run_id) is not None
+
+    def _worker_args(self, data_path: str) -> tuple:
+        return (
+            pickle.dumps(self.model), data_path, self.feature_cols,
+            self.label_cols, self.batch_size, self.epochs,
+            self.validation, self.backward_passes_per_step,
+            self.store.prefix_path, self.run_id,
+        )
+
+    def fit(self, df) -> "TorchModel":
+        from .estimator import _write_partitions
+        from . import runner as spark_runner
+
+        data_path = _write_partitions(
+            df, self.feature_cols + self.label_cols, self.store,
+            fmt=self.store_format,
+        )
+        results = spark_runner.run(
+            _lightning_worker, args=self._worker_args(data_path),
+            num_proc=self.num_proc, extra_env=self.extra_env,
+            verbose=self.verbose,
+        )
+        return self._wrap(results[0])
+
+    def fit_on_arrays(self, **named_arrays) -> "TorchModel":
+        from .estimator import _write_single_shard
+
+        return self._wrap(
+            _lightning_worker(
+                *self._worker_args(_write_single_shard(
+                    self.store, named_arrays, fmt=self.store_format
+                ))
+            )
+        )
+
+    def _wrap(self, result) -> "TorchModel":
+        import torch
+
+        state_np, history = result
+        model = self.model
+        model.load_state_dict(
+            {k: torch.as_tensor(v) for k, v in state_np.items()}
+        )
+        wrapped = TorchModel(model=model, feature_cols=self.feature_cols)
+        wrapped.history = history
+        return wrapped
+
+
+def _lightning_worker(model_blob, data_path, feature_cols, label_cols,
+                      batch_size, epochs, validation, bpps, store_prefix,
+                      run_id):
+    """Per-rank lightning loop (reference ``spark/lightning/remote.py``:
+    the Trainer body — broadcast, training_step loop with hvd-wrapped
+    optimizer, validation_step epoch end, rank-0 checkpoint)."""
+    import torch
+
+    import horovod_tpu as hvd
+    import horovod_tpu.interop.torch as hvd_torch
+    from .store import FilesystemStore
+    from ..data import ArrayDataLoader
+
+    model = pickle.loads(model_blob)
+    store = FilesystemStore(store_prefix)
+
+    hvd.init()
+    feats, labs, did_partition = _load_columns(
+        data_path, feature_cols, label_cols
+    )
+    feats = np.asarray(feats)
+    labs = np.asarray(labs)
+
+    val = None
+    if validation:
+        n_val = max(1, int(len(feats) * validation))
+        val = (feats[-n_val:], labs[-n_val:])
+        feats, labs = feats[:-n_val], labs[:-n_val]
+
+    # Resume decisions are rank-0's alone: with a non-shared store only
+    # the rank-0 host may see the checkpoint, and a per-rank start_epoch
+    # would desynchronize the per-epoch collective counts (hang).  The
+    # broadcast below distributes both the weights and the epoch.
+    start_epoch = 0
+    if hvd.rank() == 0:
+        ckpt = store.load_checkpoint(run_id)
+        if ckpt is not None and isinstance(ckpt, dict) and "state" in ckpt:
+            model.load_state_dict(
+                {k: torch.as_tensor(v) for k, v in ckpt["state"].items()}
+            )
+            start_epoch = int(ckpt["epoch"]) + 1
+    start_epoch = int(hvd.broadcast_object(start_epoch, root_rank=0))
+    hvd_torch.broadcast_parameters(model.state_dict(), root_rank=0)
+
+    configured = model.configure_optimizers()
+    # lightning allows optimizer | (optimizers, schedulers) | list |
+    # {'optimizer': ..., 'lr_scheduler': ...}
+    schedulers = []
+    if isinstance(configured, dict):
+        optimizer = configured["optimizer"]
+        sch = configured.get("lr_scheduler")
+        if isinstance(sch, dict):  # lightning's scheduler-config dict
+            sch = sch.get("scheduler")
+        schedulers = [sch] if sch is not None else []
+    elif isinstance(configured, tuple) and len(configured) == 2:
+        optimizers, schedulers = configured
+        optimizer = optimizers[0] if isinstance(optimizers, (list, tuple)) \
+            else optimizers
+    elif isinstance(configured, (list, tuple)):
+        optimizer = configured[0]
+    else:
+        optimizer = configured
+    optimizer = hvd_torch.DistributedOptimizer(
+        optimizer, backward_passes_per_step=bpps
+    )
+
+    loader = ArrayDataLoader(
+        [feats, labs], batch_size=batch_size, shard=not did_partition,
+    )
+    from .estimator import _sync_steps_per_epoch
+
+    steps_per_epoch = _sync_steps_per_epoch(loader, did_partition)
+
+    history: dict = {}
+    model.train()
+    global_calls = 0
+    for epoch in range(start_epoch, epochs):
+        loader.set_epoch(epoch)
+        losses = []
+        for i, (xb, yb) in enumerate(loader):
+            if steps_per_epoch is not None and i >= steps_per_epoch:
+                break
+            batch = (
+                torch.as_tensor(np.asarray(xb), dtype=torch.float32),
+                torch.as_tensor(np.asarray(yb)),
+            )
+            loss = model.training_step(batch, i)
+            if isinstance(loss, dict):  # lightning allows {'loss': ...}
+                loss = loss["loss"]
+            loss.backward()
+            optimizer.step()
+            global_calls += 1
+            if global_calls % bpps == 0:
+                optimizer.zero_grad()
+            losses.append(float(loss.detach()))
+        for sch in (schedulers if isinstance(schedulers, (list, tuple))
+                    else [schedulers]):
+            if sch is not None and hasattr(sch, "step"):
+                sch.step()
+        local_loss = float(np.mean(losses)) if losses else float("nan")
+        logs = {"loss": float(hvd.metric_average(local_loss))}
+        if val is not None and callable(getattr(model, "validation_step",
+                                                None)):
+            model.eval()
+            with torch.no_grad():
+                out = model.validation_step(
+                    (torch.as_tensor(val[0], dtype=torch.float32),
+                     torch.as_tensor(val[1])), 0,
+                )
+            model.train()
+            if not isinstance(out, dict):
+                out = {"val_loss": out}
+            out = {
+                (k if k.startswith("val_") else f"val_{k}"):
+                float(torch.as_tensor(v).detach())
+                for k, v in out.items()
+            }
+            logs.update(hvd.metric_average(out))
+        if hasattr(model, "on_train_epoch_end"):
+            try:
+                model.on_train_epoch_end()
+            except TypeError:  # older signature takes outputs
+                pass
+        for k, v in logs.items():
+            history.setdefault(k, []).append(float(v))
+        if hvd.rank() == 0:
+            store.save_checkpoint(
+                run_id,
+                {"state": {k: v.detach().cpu().numpy()
+                           for k, v in model.state_dict().items()},
+                 "epoch": epoch},
+            )
+
+    state_np = {
+        k: v.detach().cpu().numpy() for k, v in model.state_dict().items()
+    }
+    return state_np, history
