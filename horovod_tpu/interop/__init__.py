@@ -7,3 +7,4 @@ zero-copy where DLPack allows.
 """
 
 from . import torch as torch  # noqa: F401
+from . import mxnet as mxnet  # noqa: F401  (lazy: importable without mxnet)

@@ -1,0 +1,257 @@
+"""MXNet binding over the eager collective layer (reference
+``horovod/mxnet/__init__.py`` + ``mxnet/mpi_ops.py``,
+``test/parallel/test_mxnet1.py`` semantics).
+
+mxnet is not installable in this environment, so a minimal stub module
+standing in for ``mxnet`` (NDArray with asnumpy/setitem, ``nd.array``,
+``gluon.Trainer``) is injected into ``sys.modules`` — the binding only
+touches that surface, by design.
+"""
+
+import sys
+import types
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+
+
+class FakeNDArray:
+    """ndarray wrapper with the NDArray surface the binding touches."""
+
+    def __init__(self, arr, ctx="cpu(0)"):
+        self._arr = np.asarray(arr)
+        self.context = ctx
+
+    def asnumpy(self):
+        return self._arr.copy()
+
+    def __setitem__(self, key, value):
+        if isinstance(value, FakeNDArray):
+            value = value._arr
+        self._arr[key] = value
+
+    @property
+    def shape(self):
+        return self._arr.shape
+
+    @property
+    def dtype(self):
+        return self._arr.dtype
+
+
+class FakeParam:
+    def __init__(self, arr, grad, grad_req="write"):
+        self._data = FakeNDArray(arr)
+        self._grad = FakeNDArray(grad)
+        self.grad_req = grad_req
+
+    def data(self):
+        return self._data
+
+    def set_data(self, v):
+        self._data = v if isinstance(v, FakeNDArray) else FakeNDArray(
+            np.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v)
+        )
+
+    def list_grad(self):
+        return [self._grad]
+
+
+def _install_fake_mxnet(monkeypatch):
+    mx = types.ModuleType("mxnet")
+
+    nd = types.ModuleType("mxnet.nd")
+
+    def nd_array(arr, dtype=None, ctx=None):
+        a = np.asarray(arr, dtype=dtype)
+        return FakeNDArray(a, ctx=ctx or "cpu(0)")
+
+    nd.array = nd_array
+    mx.nd = nd
+
+    gluon = types.ModuleType("mxnet.gluon")
+
+    class Trainer:
+        def __init__(self, params, optimizer, optimizer_params=None,
+                     kvstore=None):
+            self._params = list(params)
+            self.optimizer = optimizer
+            self.optimizer_params = optimizer_params
+            self.kvstore = kvstore
+
+        def step(self, batch_size):
+            self._allreduce_grads()
+
+        def _allreduce_grads(self):
+            pass
+
+    gluon.Trainer = Trainer
+    mx.gluon = gluon
+
+    monkeypatch.setitem(sys.modules, "mxnet", mx)
+    monkeypatch.setitem(sys.modules, "mxnet.nd", nd)
+    monkeypatch.setitem(sys.modules, "mxnet.gluon", gluon)
+    return mx
+
+
+@pytest.fixture()
+def hvd_mx(hvd_module, monkeypatch):
+    _install_fake_mxnet(monkeypatch)
+    import horovod_tpu.interop.mxnet as hvd_mx
+
+    return hvd_mx
+
+
+SIZE = 8
+
+
+class TestCollectives:
+    def test_allreduce_average(self, hvd_mx):
+        rows = np.arange(SIZE * 3, dtype=np.float32).reshape(SIZE, 3)
+        out = hvd_mx.allreduce(FakeNDArray(rows))
+        assert isinstance(out, FakeNDArray)
+        np.testing.assert_allclose(
+            out.asnumpy(), np.tile(rows.mean(0), (SIZE, 1)), rtol=1e-6
+        )
+
+    def test_allreduce_sum_inplace(self, hvd_mx):
+        rows = np.ones((SIZE, 2), np.float32)
+        t = FakeNDArray(rows)
+        out = hvd_mx.allreduce_(t, average=False)
+        assert out is t
+        np.testing.assert_allclose(t.asnumpy(), np.full((SIZE, 2), SIZE))
+
+    def test_grouped_allreduce(self, hvd_mx):
+        a = np.ones((SIZE, 2), np.float32)
+        b = 2 * np.ones((SIZE, 3), np.float32)
+        outs = hvd_mx.grouped_allreduce(
+            [FakeNDArray(a), FakeNDArray(b)], average=True
+        )
+        np.testing.assert_allclose(outs[0].asnumpy(), a)
+        np.testing.assert_allclose(outs[1].asnumpy(), b)
+
+    def test_broadcast(self, hvd_mx):
+        rows = np.arange(SIZE, dtype=np.float32)[:, None] * np.ones((1, 2))
+        out = hvd_mx.broadcast(FakeNDArray(rows.astype(np.float32)), 3)
+        np.testing.assert_allclose(
+            out.asnumpy(), np.full((SIZE, 2), 3.0)
+        )
+
+    def test_broadcast_inplace(self, hvd_mx):
+        rows = np.arange(SIZE, dtype=np.float32)[:, None]
+        t = FakeNDArray(rows.copy())
+        hvd_mx.broadcast_(t, 0)
+        np.testing.assert_allclose(t.asnumpy(), np.zeros((SIZE, 1)))
+
+    def test_allgather(self, hvd_mx):
+        rows = np.arange(SIZE, dtype=np.float32)[:, None, None]
+        out = hvd_mx.allgather(FakeNDArray(np.tile(rows, (1, 2, 3))))
+        # every rank sees all rows concatenated
+        assert out.asnumpy().shape == (SIZE, SIZE * 2, 3)
+
+    def test_alltoall(self, hvd_mx):
+        rows = np.arange(SIZE * SIZE, dtype=np.float32).reshape(SIZE, SIZE)
+        out = hvd_mx.alltoall(FakeNDArray(rows))
+        np.testing.assert_allclose(out.asnumpy(), rows.T)
+
+
+class TestBroadcastParameters:
+    def test_dict_of_ndarrays(self, hvd_mx):
+        params = {
+            "w": FakeNDArray(
+                np.arange(SIZE, dtype=np.float32)[:, None] + np.zeros((1, 2))
+            ),
+        }
+        hvd_mx.broadcast_parameters(params, root_rank=2)
+        np.testing.assert_allclose(
+            params["w"].asnumpy(), np.full((SIZE, 2), 2.0)
+        )
+
+    def test_gluon_params(self, hvd_mx):
+        p = FakeParam(
+            np.arange(SIZE, dtype=np.float32)[:, None],
+            np.zeros((SIZE, 1), np.float32),
+        )
+        hvd_mx.broadcast_parameters({"p": p}, root_rank=1)
+        np.testing.assert_allclose(p.data().asnumpy(), np.ones((SIZE, 1)))
+
+
+class FakeOptimizer:
+    def __init__(self):
+        self.updates = []
+        self.lr = 0.1
+
+    def update(self, index, weight, grad, state):
+        self.updates.append((index, grad.asnumpy().copy()))
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self.update(index, weight, grad, state)
+
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        return None
+
+    def set_learning_rate(self, lr):
+        self.lr = lr
+
+
+class TestDistributedOptimizer:
+    def test_update_averages_then_delegates(self, hvd_mx):
+        inner = FakeOptimizer()
+        opt = hvd_mx.DistributedOptimizer(inner)
+        rows = np.arange(SIZE, dtype=np.float32)[:, None] * np.ones((1, 2))
+        grad = FakeNDArray(rows.astype(np.float32))
+        w = FakeNDArray(np.zeros((SIZE, 2), np.float32))
+        opt.update(0, w, grad, None)
+        assert len(inner.updates) == 1
+        # grad rows replaced by the cross-rank average
+        mean = rows.mean(0)
+        np.testing.assert_allclose(
+            inner.updates[0][1], np.tile(mean, (SIZE, 1)), rtol=1e-6
+        )
+
+    def test_delegation(self, hvd_mx):
+        inner = FakeOptimizer()
+        opt = hvd_mx.DistributedOptimizer(inner)
+        opt.set_learning_rate(0.5)
+        assert inner.lr == 0.5
+        assert opt.lr == 0.5  # __getattr__ passthrough
+
+
+class TestDistributedTrainer:
+    def test_allreduce_grads_averages(self, hvd_mx):
+        g_rows = np.arange(SIZE, dtype=np.float32)[:, None]
+        p = FakeParam(np.zeros((SIZE, 1), np.float32), g_rows.copy())
+        trainer = hvd_mx.DistributedTrainer([p], FakeOptimizer())
+        trainer._allreduce_grads()
+        np.testing.assert_allclose(
+            p.list_grad()[0].asnumpy(),
+            np.full((SIZE, 1), g_rows.mean()), rtol=1e-6,
+        )
+
+    def test_null_grad_req_skipped(self, hvd_mx):
+        g = np.arange(SIZE, dtype=np.float32)[:, None]
+        p = FakeParam(np.zeros((SIZE, 1), np.float32), g.copy(),
+                      grad_req="null")
+        trainer = hvd_mx.DistributedTrainer([p], FakeOptimizer())
+        trainer._allreduce_grads()
+        np.testing.assert_allclose(p.list_grad()[0].asnumpy(), g)
+
+    def test_unwraps_distributed_optimizer(self, hvd_mx):
+        inner = FakeOptimizer()
+        trainer = hvd_mx.DistributedTrainer(
+            [], hvd_mx.DistributedOptimizer(inner)
+        )
+        assert trainer.optimizer is inner
+
+
+def test_import_without_mxnet_is_clean():
+    """The module imports fine without mxnet; only NDArray use raises."""
+    import horovod_tpu.interop.mxnet as m
+
+    with pytest.raises((ImportError, TypeError)):
+        m.allreduce(np.ones(3))  # not an NDArray -> TypeError before mx
