@@ -316,7 +316,11 @@ def run_commandline(argv: Optional[List[str]] = None) -> int:
                   "(LSF inference applies only to allocation-derived "
                   "hosts)", file=sys.stderr)
             return 2
-        args.np = len(lsf.get_compute_hosts())
+        try:
+            args.np = len(lsf.get_compute_hosts())
+        except RuntimeError as e:
+            print(f"hvdrun: {e}", file=sys.stderr)
+            return 2
     if args.discovery_script or args.min_np is not None:
         from .elastic_launch import launch_elastic
 

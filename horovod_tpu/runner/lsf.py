@@ -161,9 +161,6 @@ def generate_jsrun_rankfile(
     typically has fewer slots than the compute hosts), so per-host core
     budgets keep the cpu ranges valid on every host.
     """
-    if path is None:
-        fd, path = tempfile.mkstemp(prefix="hvd_tpu_jsrun_", suffix=".erf")
-        os.close(fd)
     remaining = num_proc
     lines = ["overlapping_rs: allow", "cpu_index_using: logical"]
     rank = 0
@@ -189,6 +186,10 @@ def generate_jsrun_rankfile(
             f"LSF allocation provides {num_proc - remaining} slot(s), "
             f"{num_proc} requested"
         )
+    # create the temp file only after validation so a raise leaks nothing
+    if path is None:
+        fd, path = tempfile.mkstemp(prefix="hvd_tpu_jsrun_", suffix=".erf")
+        os.close(fd)
     with open(path, "w") as fh:
         fh.write("\n".join(lines) + "\n")
     return path

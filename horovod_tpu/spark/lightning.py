@@ -172,6 +172,7 @@ def _lightning_worker(model_blob, data_path, feature_cols, label_cols,
     # would desynchronize the per-epoch collective counts (hang).  The
     # broadcast below distributes both the weights and the epoch.
     start_epoch = 0
+    ckpt = None
     if hvd.rank() == 0:
         ckpt = store.load_checkpoint(run_id)
         if ckpt is not None and isinstance(ckpt, dict) and "state" in ckpt:
@@ -183,8 +184,11 @@ def _lightning_worker(model_blob, data_path, feature_cols, label_cols,
     hvd_torch.broadcast_parameters(model.state_dict(), root_rank=0)
 
     configured = model.configure_optimizers()
-    # lightning allows optimizer | (optimizers, schedulers) | list |
-    # {'optimizer': ..., 'lr_scheduler': ...}
+    # lightning allows optimizer | (optimizer_list, scheduler_list) |
+    # list | {'optimizer': ..., 'lr_scheduler': ...}.  The
+    # (optimizers, schedulers) two-tuple form has BOTH elements as
+    # lists per lightning's contract — a bare 2-tuple of optimizers is
+    # multiple optimizers, of which this loop drives the first.
     schedulers = []
     if isinstance(configured, dict):
         optimizer = configured["optimizer"]
@@ -192,10 +196,11 @@ def _lightning_worker(model_blob, data_path, feature_cols, label_cols,
         if isinstance(sch, dict):  # lightning's scheduler-config dict
             sch = sch.get("scheduler")
         schedulers = [sch] if sch is not None else []
-    elif isinstance(configured, tuple) and len(configured) == 2:
+    elif (isinstance(configured, tuple) and len(configured) == 2
+          and isinstance(configured[0], (list, tuple))
+          and isinstance(configured[1], (list, tuple))):
         optimizers, schedulers = configured
-        optimizer = optimizers[0] if isinstance(optimizers, (list, tuple)) \
-            else optimizers
+        optimizer = optimizers[0]
     elif isinstance(configured, (list, tuple)):
         optimizer = configured[0]
     else:
@@ -203,6 +208,23 @@ def _lightning_worker(model_blob, data_path, feature_cols, label_cols,
     optimizer = hvd_torch.DistributedOptimizer(
         optimizer, backward_passes_per_step=bpps
     )
+    schedulers = [s for s in (schedulers if isinstance(
+        schedulers, (list, tuple)) else [schedulers]) if s is not None]
+
+    # Resume the optimizer moments and scheduler counters too —
+    # restarting Adam m/v or an LR schedule mid-run silently changes
+    # the trajectory.  Rank 0 read the checkpoint; everyone receives
+    # the same state by object broadcast, keeping ranks identical.
+    ckpt_d = ckpt if isinstance(ckpt, dict) else {}
+    resume = hvd.broadcast_object(
+        {"opt": ckpt_d.get("opt"), "sched": ckpt_d.get("sched")}
+        if hvd.rank() == 0 else None,
+        root_rank=0,
+    )
+    if resume.get("opt") is not None:
+        optimizer.load_state_dict(resume["opt"])
+    for sch, st in zip(schedulers, resume.get("sched") or []):
+        sch.load_state_dict(st)
 
     loader = ArrayDataLoader(
         [feats, labs], batch_size=batch_size, shard=not did_partition,
@@ -233,9 +255,8 @@ def _lightning_worker(model_blob, data_path, feature_cols, label_cols,
             if global_calls % bpps == 0:
                 optimizer.zero_grad()
             losses.append(float(loss.detach()))
-        for sch in (schedulers if isinstance(schedulers, (list, tuple))
-                    else [schedulers]):
-            if sch is not None and hasattr(sch, "step"):
+        for sch in schedulers:
+            if hasattr(sch, "step"):
                 sch.step()
         local_loss = float(np.mean(losses)) if losses else float("nan")
         logs = {"loss": float(hvd.metric_average(local_loss))}
@@ -256,11 +277,24 @@ def _lightning_worker(model_blob, data_path, feature_cols, label_cols,
                 for k, v in out.items()
             }
             logs.update(hvd.metric_average(out))
-        if hasattr(model, "on_train_epoch_end"):
+        hook = getattr(model, "on_train_epoch_end", None)
+        if callable(hook):
+            # Call only the modern zero-arg form; the legacy signature
+            # (taking epoch outputs, which this loop does not collect)
+            # is skipped by inspection rather than by swallowing
+            # TypeErrors the user's own hook body might raise.
+            import inspect
+
             try:
-                model.on_train_epoch_end()
-            except TypeError:  # older signature takes outputs
-                pass
+                required = [
+                    p for p in inspect.signature(hook).parameters.values()
+                    if p.default is p.empty and p.kind in (
+                        p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+                ]
+            except (ValueError, TypeError):
+                required = None
+            if required == []:
+                hook()
         for k, v in logs.items():
             history.setdefault(k, []).append(float(v))
         if hvd.rank() == 0:
@@ -268,6 +302,9 @@ def _lightning_worker(model_blob, data_path, feature_cols, label_cols,
                 run_id,
                 {"state": {k: v.detach().cpu().numpy()
                            for k, v in model.state_dict().items()},
+                 "opt": optimizer.state_dict(),
+                 "sched": [s.state_dict() for s in schedulers
+                           if hasattr(s, "state_dict")],
                  "epoch": epoch},
             )
 

@@ -55,7 +55,7 @@ class LitRegressor(torch.nn.Module):
 
 class LitWithScheduler(LitRegressor):
     def configure_optimizers(self):
-        opt = torch.optim.SGD(self.parameters(), lr=0.1)
+        opt = torch.optim.SGD(self.parameters(), lr=0.1, momentum=0.9)
         sch = torch.optim.lr_scheduler.StepLR(opt, step_size=1, gamma=0.5)
         return [opt], [sch]
 
@@ -117,6 +117,47 @@ class TestLightningEstimator:
         # StepLR gamma=0.5 stepped once per epoch: 0.1 -> 0.0125
         lr = est.model.configure_optimizers()[0][0].param_groups[0]["lr"]
         assert lr == pytest.approx(0.1)  # fresh optimizer unaffected
+
+    def test_optimizer_and_scheduler_state_resumed(self, hvd_module,
+                                                   tmp_path):
+        """Resume restores Adam moments and scheduler counters — the
+        checkpoint's sched state must show the TOTAL epochs stepped,
+        not a restart from zero."""
+        X, y = _regression_data()
+        store = LocalStore(str(tmp_path / "ostore"))
+        est1 = LightningEstimator(
+            model=LitWithScheduler(), batch_size=64, epochs=2,
+            store=store, run_id="opt_run",
+        )
+        est1.fit_on_arrays(features=X, label=y)
+        ck = store.load_checkpoint("opt_run")
+        assert ck["sched"][0]["last_epoch"] == 2
+        est2 = LightningEstimator(
+            model=LitWithScheduler(), batch_size=64, epochs=4,
+            store=store, run_id="opt_run",
+        )
+        est2.fit_on_arrays(features=X, label=y)
+        ck = store.load_checkpoint("opt_run")
+        # 2 resumed + 2 new epochs; a restart-from-zero would read 2
+        assert ck["sched"][0]["last_epoch"] == 4
+        assert ck["opt"]["state"], "optimizer state not checkpointed"
+
+    def test_two_optimizer_tuple_uses_first(self, hvd_module, tmp_path):
+        """A bare 2-tuple of optimizers is multiple optimizers (not
+        (optimizers, schedulers)); the first drives training and the
+        second must NOT be stepped as a scheduler."""
+        class TwoOpt(LitRegressor):
+            def configure_optimizers(self):
+                return (torch.optim.Adam(self.parameters(), lr=0.05),
+                        torch.optim.SGD(self.parameters(), lr=0.0))
+
+        X, y = _regression_data(n=64)
+        est = LightningEstimator(
+            model=TwoOpt(), batch_size=32, epochs=3,
+            store=LocalStore(str(tmp_path / "twostore")), run_id="two_run",
+        )
+        model = est.fit_on_arrays(features=X, label=y)
+        assert model.history["loss"][-1] < model.history["loss"][0]
 
     def test_protocol_enforced(self):
         with pytest.raises(TypeError, match="lightning protocol"):
