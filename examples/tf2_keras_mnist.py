@@ -1,0 +1,91 @@
+"""TF2/Keras MNIST through the interop bridge (the tracked
+``tf2_keras_mnist`` config — reference
+``examples/tensorflow2/tensorflow2_keras_mnist.py`` mechanics:
+``broadcast_variables`` after the first step, gradients averaged through
+``DistributedGradientTape``, lr scaled by world size).
+
+The keras model runs in TF on host CPU; gradient averaging rides the
+runtime's XLA eager collectives.
+
+Run: ``python examples/tf2_keras_mnist.py [--epochs N]``.
+"""
+
+import argparse
+
+import numpy as np
+
+import horovod_tpu as hvd
+import horovod_tpu.interop.tf as hvd_tf
+
+
+def synthetic_mnist(n=8192, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 28, 28, 1).astype(np.float32)
+    y = (x.mean(axis=(1, 2, 3)) * 1000).astype(np.int64) % 10
+    return x, y
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--lr", type=float, default=0.001)
+    parser.add_argument("--num-samples", type=int, default=8192)
+    args = parser.parse_args()
+
+    import tensorflow as tf
+
+    hvd.init()  # reference: hvd.init()
+    tf.random.set_seed(42)
+
+    model = tf.keras.Sequential([
+        tf.keras.layers.Conv2D(32, 3, activation="relu",
+                               input_shape=(28, 28, 1)),
+        tf.keras.layers.MaxPooling2D(),
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(64, activation="relu"),
+        tf.keras.layers.Dense(10),
+    ])
+    loss_obj = tf.keras.losses.SparseCategoricalCrossentropy(
+        from_logits=True
+    )
+    # reference: lr scaled by the data-parallel worker count
+    opt = tf.keras.optimizers.SGD(args.lr * hvd.process_count())
+
+    x, y = synthetic_mnist(args.num_samples)
+    # the torch/TF bridges reduce gradients at the PROCESS level
+    # (one framework model per host process), so data sharding and
+    # LR scaling follow process topology, not chip topology
+    x = x[hvd.process_rank()::hvd.process_count()]
+    y = y[hvd.process_rank()::hvd.process_count()]
+
+    first_batch = True
+    for epoch in range(args.epochs):
+        perm = np.random.RandomState(epoch).permutation(len(x))
+        losses = []
+        for i in range(0, len(x) - args.batch_size + 1, args.batch_size):
+            idx = perm[i:i + args.batch_size]
+            data = tf.constant(x[idx])
+            target = tf.constant(y[idx])
+            with tf.GradientTape() as tape:
+                logits = model(data, training=True)
+                loss = loss_obj(target, logits)
+            # reference: hvd.DistributedGradientTape wraps the tape
+            tape = hvd_tf.DistributedGradientTape(tape)
+            grads = tape.gradient(loss, model.trainable_variables)
+            opt.apply_gradients(zip(grads, model.trainable_variables))
+            if first_batch:
+                # reference: broadcast AFTER the first step so optimizer
+                # slot variables exist (tensorflow2_keras_mnist.py
+                # BroadcastGlobalVariablesCallback comment)
+                hvd_tf.broadcast_variables(model.variables, root_rank=0)
+                hvd_tf.broadcast_variables(opt.variables, root_rank=0)
+                first_batch = False
+            losses.append(float(loss))
+        avg = float(hvd.metric_average(float(np.mean(losses))))
+        if hvd.process_rank() == 0:
+            print(f"epoch {epoch}: loss {avg:.4f}")
+
+
+if __name__ == "__main__":
+    main()

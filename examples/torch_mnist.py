@@ -1,0 +1,100 @@
+"""PyTorch MNIST through the interop bridge (the tracked
+``pytorch_mnist`` config — reference
+``examples/pytorch/pytorch_mnist.py`` step for step: broadcast of
+initial parameters and optimizer state, ``DistributedOptimizer``
+allreduce each step, metric averaging at epoch end).
+
+The torch model runs on host CPU (torch has no TPU backend here);
+gradient averaging rides the runtime's XLA eager collectives, so
+multi-process runs synchronize exactly like the reference's
+hooks-and-allreduce loop.
+
+Run: ``python examples/torch_mnist.py [--epochs N]``.
+"""
+
+import argparse
+
+import numpy as np
+import torch
+import torch.nn.functional as F
+
+import horovod_tpu as hvd
+import horovod_tpu.interop.torch as hvd_torch
+
+
+class Net(torch.nn.Module):
+    """The reference script's small conv net (pytorch_mnist.py Net)."""
+
+    def __init__(self):
+        super().__init__()
+        self.conv1 = torch.nn.Conv2d(1, 10, kernel_size=5)
+        self.conv2 = torch.nn.Conv2d(10, 20, kernel_size=5)
+        self.fc1 = torch.nn.Linear(320, 50)
+        self.fc2 = torch.nn.Linear(50, 10)
+
+    def forward(self, x):
+        x = F.relu(F.max_pool2d(self.conv1(x), 2))
+        x = F.relu(F.max_pool2d(self.conv2(x), 2))
+        x = x.flatten(1)
+        x = F.relu(self.fc1(x))
+        return F.log_softmax(self.fc2(x), dim=1)
+
+
+def synthetic_mnist(n=8192, seed=0):
+    rng = np.random.RandomState(seed)
+    x = rng.rand(n, 1, 28, 28).astype(np.float32)
+    y = (x.mean(axis=(1, 2, 3)) * 1000).astype(np.int64) % 10
+    return x, y
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--epochs", type=int, default=2)
+    parser.add_argument("--batch-size", type=int, default=64)
+    parser.add_argument("--lr", type=float, default=0.01)
+    parser.add_argument("--momentum", type=float, default=0.5)
+    parser.add_argument("--num-samples", type=int, default=8192)
+    args = parser.parse_args()
+
+    hvd.init()  # reference: hvd.init()
+    torch.manual_seed(42)  # reference seeds before model construction
+
+    model = Net()
+    # reference: hvd.broadcast_parameters / broadcast_optimizer_state
+    hvd_torch.broadcast_parameters(model.state_dict(), root_rank=0)
+    optimizer = torch.optim.SGD(
+        model.parameters(), lr=args.lr * hvd.process_count(),
+        momentum=args.momentum,
+    )
+    hvd_torch.broadcast_optimizer_state(optimizer, root_rank=0)
+    optimizer = hvd_torch.DistributedOptimizer(optimizer)
+
+    x, y = synthetic_mnist(args.num_samples)
+    # shard rows like the reference DistributedSampler, by PROCESS:
+    # the torch/TF bridges reduce gradients at the process level
+    # (one framework model per host process), so data sharding and
+    # LR scaling follow process topology, not chip topology
+    x = x[hvd.process_rank()::hvd.process_count()]
+    y = y[hvd.process_rank()::hvd.process_count()]
+
+    model.train()
+    for epoch in range(args.epochs):
+        perm = np.random.RandomState(epoch).permutation(len(x))
+        losses = []
+        for i in range(0, len(x) - args.batch_size + 1, args.batch_size):
+            idx = perm[i:i + args.batch_size]
+            data = torch.from_numpy(x[idx])
+            target = torch.from_numpy(y[idx])
+            optimizer.zero_grad()
+            loss = F.nll_loss(model(data), target)
+            loss.backward()
+            optimizer.step()
+            losses.append(float(loss.detach()))
+        # reference: metric averaging across ranks at epoch end
+        avg = float(hvd.metric_average(float(np.mean(losses))))
+        if hvd.process_rank() == 0:
+            print(f"epoch {epoch}: loss {avg:.4f}")
+
+
+if __name__ == "__main__":
+    main()

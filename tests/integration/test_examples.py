@@ -39,6 +39,21 @@ def test_mnist_example():
     assert "loss" in out.lower()
 
 
+def test_torch_mnist_example():
+    pytest.importorskip("torch")
+    out = _run_example("torch_mnist.py", "--epochs", "1",
+                       "--batch-size", "16", "--num-samples", "256")
+    assert "loss" in out.lower()
+
+
+def test_tf2_keras_mnist_example():
+    pytest.importorskip("tensorflow")
+    out = _run_example("tf2_keras_mnist.py", "--epochs", "1",
+                       "--batch-size", "16", "--num-samples", "256",
+                       timeout=600)
+    assert "loss" in out.lower()
+
+
 def test_process_sets_example():
     out = _run_example("process_sets.py")
     assert "even-team avg: 3.0" in out
