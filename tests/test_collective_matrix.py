@@ -209,6 +209,41 @@ class TestErrorMatrix:
                           op=hvd.Adasum)
 
 
+class TestNames:
+    def test_duplicate_names_allowed_by_design(self, hvd_module):
+        """The reference errors on a duplicate in-flight tensor name
+        (its background queue keys submissions by name,
+        ``operations.cc`` EnqueueTensorAllreduce duplicate check).
+        Here there is no queue to collide in — XLA orders the program —
+        so the same name may be reused freely, sync or async."""
+        x = np.ones((N, 2), np.float32)
+        a = hvd.allreduce_async(x, name="dup", op=hvd.Sum)
+        b = hvd.allreduce_async(x, name="dup", op=hvd.Sum)
+        np.testing.assert_allclose(np.asarray(hvd.synchronize(a)),
+                                   np.asarray(hvd.synchronize(b)))
+        y1 = hvd.allreduce(x, name="dup", op=hvd.Sum)
+        y2 = hvd.allreduce(x, name="dup", op=hvd.Sum)
+        np.testing.assert_allclose(np.asarray(y1), np.asarray(y2))
+
+    def test_async_poll_and_wait(self, hvd_module):
+        h = hvd.allreduce_async(np.ones((N, 3), np.float32), name="h1")
+        assert hvd.poll(h) in (True, False)
+        out = np.asarray(h.wait())
+        np.testing.assert_allclose(out, 1.0)
+
+
+class TestGroupedErrorCases:
+    def test_grouped_mismatched_leading_dim(self, hvd_module):
+        xs = [np.ones((N, 2), np.float32), np.ones((N + 1, 2), np.float32)]
+        with pytest.raises(HorovodTpuError, match="leading"):
+            hvd.grouped_allreduce(xs, op=hvd.Sum)
+
+    def test_grouped_scalar_member_rejected(self, hvd_module):
+        xs = [np.ones((N, 2), np.float32), np.float32(3.0)]
+        with pytest.raises(HorovodTpuError):
+            hvd.grouped_allreduce(xs, op=hvd.Sum)
+
+
 class TestGroupedOps:
     @pytest.mark.parametrize("dtype", [np.float32, jnp.bfloat16], ids=str)
     def test_grouped_mixed_shapes(self, hvd_module, dtype):
