@@ -28,7 +28,11 @@ MODELS = {
 
 
 def build_benchmark(args):
-    model = MODELS[args.model](num_classes=1000, dtype=jnp.bfloat16)
+    kwargs = {}
+    if args.model.startswith("resnet") and args.stem != "conv7":
+        kwargs["stem"] = args.stem
+    model = MODELS[args.model](num_classes=1000, dtype=jnp.bfloat16,
+                               **kwargs)
     variables = model.init(
         jax.random.PRNGKey(0), jnp.zeros((1, args.image_size, args.image_size, 3)),
         train=True,
@@ -74,7 +78,13 @@ def main():
     parser.add_argument("--num-batches-per-iter", type=int, default=10)
     parser.add_argument("--num-iters", type=int, default=10)
     parser.add_argument("--fp16-allreduce", action="store_true")
+    parser.add_argument("--stem", default="conv7",
+                        choices=["conv7", "space_to_depth"],
+                        help="ResNet stem: space_to_depth folds the "
+                        "7x7/3ch conv for MXU utilization")
     args = parser.parse_args()
+    if args.stem != "conv7" and not args.model.startswith("resnet"):
+        parser.error(f"--stem {args.stem} only applies to resnet models")
 
     hvd.init()
     model, params, batch_stats, step = build_benchmark(args)

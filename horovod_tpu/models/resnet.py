@@ -48,6 +48,14 @@ class BottleneckBlock(nn.Module):
         return self.act(residual + y)
 
 
+def space_to_depth(x: jnp.ndarray, block: int = 2) -> jnp.ndarray:
+    """NHWC space-to-depth: (N, H, W, C) -> (N, H/b, W/b, C*b*b)."""
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // block, block, w // block, block, c)
+    x = x.transpose(0, 1, 3, 2, 4, 5)
+    return x.reshape(n, h // block, w // block, c * block * block)
+
+
 class ResNet(nn.Module):
     stage_sizes: Sequence[int]
     num_classes: int = 1000
@@ -58,6 +66,14 @@ class ResNet(nn.Module):
     # normalizing (hvd.SyncBatchNorm) — the per-replica-moments default
     # matches the reference benchmark configs.
     sync_bn: bool = False
+    # "conv7" = the canonical 7x7/2 stem; "space_to_depth" folds that
+    # conv into a 4x4/1 conv on 2x2-space-to-depth input (the MLPerf
+    # TPU trick): a 3-channel 7x7 conv feeds the 128-lane MXU only 3
+    # useful input channels, while the folded form feeds 12 on a
+    # quarter the spatial positions — mathematically the same function
+    # (see tests/test_models.py equivalence proof), much better MXU
+    # utilization.
+    stem: str = "conv7"
 
     @nn.compact
     def __call__(self, x, train: bool = True):
@@ -78,9 +94,32 @@ class ResNet(nn.Module):
             epsilon=1e-5,
             dtype=self.dtype,
         )
+        if self.stem not in ("conv7", "space_to_depth"):
+            raise ValueError(
+                f"unknown stem {self.stem!r}; expected 'conv7' or "
+                "'space_to_depth'"
+            )
         x = x.astype(self.dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
-                 name="conv_init")(x)
+        if self.stem == "space_to_depth":
+            if (x.shape[1] % 2) or (x.shape[2] % 2):
+                raise ValueError(
+                    "space_to_depth stem needs even input H/W "
+                    f"(got {x.shape[1]}x{x.shape[2]}); use stem='conv7' "
+                    "for odd sizes"
+                )
+            # Equivalent computation to conv7x7/2 pad 3: output i of
+            # that conv reads padded rows [2i, 2i+7) — blocks [i, i+4)
+            # after 2x2 s2d — so a 4x4 STRIDE-1 conv over the block
+            # grid computes the same function (kernel = the 7x7 zero-
+            # extended to 8x8 and folded into 4x4x(4C)); same output
+            # positions, 4x the MXU input channels.
+            x = jnp.pad(x, ((0, 0), (3, 3), (3, 3), (0, 0)))
+            x = space_to_depth(x, 2)
+            x = conv(self.num_filters, (4, 4), (1, 1), padding="VALID",
+                     name="conv_init_s2d")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2),
+                     padding=[(3, 3), (3, 3)], name="conv_init")(x)
         x = norm(name="bn_init")(x)
         x = self.act(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")

@@ -131,3 +131,65 @@ def test_resnet_sync_bn_matches_global_batch_norm(hvd_module):
     np.testing.assert_allclose(
         np.asarray(sharded), np.asarray(dense), rtol=2e-3, atol=2e-3
     )
+
+
+class TestSpaceToDepthStem:
+    """The MLPerf-TPU stem fold: conv7x7/2(pad 3) == s2d(2) + conv4x4/1
+    with the zero-extended, block-folded kernel (models/resnet.py)."""
+
+    def test_exact_equivalence_to_conv7(self, hvd_module):
+        import jax
+        from flax import linen as nn
+
+        from horovod_tpu.models.resnet import space_to_depth
+
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(2, 32, 32, 3), jnp.float32)
+        w7 = jnp.asarray(rng.randn(7, 7, 3, 16) * 0.1, jnp.float32)
+
+        ref = jax.lax.conv_general_dilated(
+            x, w7, window_strides=(2, 2), padding=[(3, 3), (3, 3)],
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+
+        # fold: zero-extend 7->8, then K4[kh,kw, ph*2C+pw*C+c, f]
+        #     = W8[2kh+ph, 2kw+pw, c, f]
+        w8 = jnp.pad(w7, ((0, 1), (0, 1), (0, 0), (0, 0)))
+        c = 3
+        w4 = np.zeros((4, 4, 4 * c, 16), np.float32)
+        for kh in range(4):
+            for kw in range(4):
+                for ph in range(2):
+                    for pw in range(2):
+                        w4[kh, kw, (ph * 2 + pw) * c:(ph * 2 + pw + 1) * c] = \
+                            w8[2 * kh + ph, 2 * kw + pw]
+        xp = jnp.pad(x, ((0, 0), (3, 3), (3, 3), (0, 0)))
+        xs = space_to_depth(xp, 2)
+        out = jax.lax.conv_general_dilated(
+            xs, jnp.asarray(w4), window_strides=(1, 1), padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+        assert out.shape == ref.shape
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=1e-5, atol=1e-5)
+
+    def test_resnet_s2d_stem_trains(self, hvd_module):
+        import jax
+        import optax
+
+        from horovod_tpu.models import ResNet
+
+        model = ResNet(stage_sizes=[1, 1], num_classes=4, num_filters=8,
+                       dtype=jnp.float32, stem="space_to_depth")
+        x = jnp.asarray(np.random.RandomState(0).rand(8, 32, 32, 3),
+                        jnp.float32)
+        variables = model.init(jax.random.PRNGKey(0), x, train=True)
+        logits, _ = model.apply(variables, x, train=True,
+                                mutable=["batch_stats"])
+        assert logits.shape == (8, 4)
+        # same spatial pipeline as the conv7 stem
+        conv7 = ResNet(stage_sizes=[1, 1], num_classes=4, num_filters=8,
+                       dtype=jnp.float32)
+        v7 = conv7.init(jax.random.PRNGKey(0), x, train=True)
+        l7, _ = conv7.apply(v7, x, train=True, mutable=["batch_stats"])
+        assert l7.shape == logits.shape
