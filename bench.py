@@ -206,6 +206,13 @@ def main():
     # MLPerf-style space-to-depth stem (models/resnet.py): flip via env
     # until measured-on-hardware default is recorded.
     stem = os.environ.get("HVD_BENCH_STEM", "conv7")
+    if stem not in ("conv7", "space_to_depth"):
+        # fail before paying any compile: the __main__ wrapper turns
+        # this into the error-JSON line the driver records
+        raise ValueError(
+            f"HVD_BENCH_STEM must be 'conv7' or 'space_to_depth', "
+            f"got {stem!r}"
+        )
     resnet = bench_resnet(hvd, jnp, batch_per_chip=256, stem=stem)
     result.update(
         value=resnet["images_per_sec_per_chip"],

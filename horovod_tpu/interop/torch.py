@@ -113,6 +113,199 @@ def alltoall(tensor, splits=None, name: Optional[str] = None,
     )
 
 
+def grouped_allreduce(tensors, op: int = _eager.Average,
+                      name: Optional[str] = None, process_set=None,
+                      prescale_factor: float = 1.0,
+                      postscale_factor: float = 1.0):
+    """Reference ``hvd.grouped_allreduce`` (``torch/mpi_ops.py``): one
+    fused collective over a list of tensors."""
+    ys = _eager.grouped_allreduce(
+        [_to_jax(t) for t in tensors], op=op, name=name,
+        process_set=process_set, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor,
+    )
+    return [_to_torch(y, t) for y, t in zip(ys, tensors)]
+
+
+# ---- in-place and async variants (reference torch/mpi_ops.py:114-887:
+# the `*_` ops write the result back into the input tensor; the
+# `*_async` ops return a handle resolved by synchronize()/poll()) ------
+
+class TorchHandle:
+    """Async handle over a dispatched collective (reference handle ints
+    from ``HandleManager``).  The XLA dispatch is already in flight;
+    ``wait()``/``synchronize`` converts to torch (and copies in place
+    for the ``*_async_`` variants)."""
+
+    def __init__(self, jax_value, like, inplace_target=None,
+                 name: Optional[str] = None):
+        self._h = _eager.Handle(jax_value, name)
+        self._like = like
+        self._target = inplace_target
+        self._result = None
+
+    def done(self) -> bool:
+        return self._h.done()
+
+    def wait(self):
+        if self._result is None:
+            out = self._h.wait()
+            torch = _torch()
+            if isinstance(out, (list, tuple)):
+                res = [_to_torch(y, t)
+                       for y, t in zip(out, self._like)]
+            else:
+                res = _to_torch(out, self._like)
+            if self._target is not None:
+                with torch.no_grad():
+                    if isinstance(res, list):
+                        for t, r in zip(self._target, res):
+                            t.copy_(r)
+                        res = self._target
+                    else:
+                        self._target.copy_(res)
+                        res = self._target
+            self._result = res
+        return self._result
+
+
+def synchronize(handle: TorchHandle):
+    """Reference ``hvd.synchronize(handle)`` (``torch/mpi_ops.py:849``)."""
+    return handle.wait()
+
+
+def poll(handle: TorchHandle) -> bool:
+    """Reference ``hvd.poll(handle)``."""
+    return handle.done()
+
+
+def allreduce_(tensor, op: int = _eager.Average,
+               name: Optional[str] = None, process_set=None,
+               prescale_factor: float = 1.0,
+               postscale_factor: float = 1.0):
+    out = allreduce(tensor, op=op, name=name, process_set=process_set,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor)
+    with _torch().no_grad():
+        tensor.copy_(out)
+    return tensor
+
+
+def broadcast_(tensor, root_rank: int, name: Optional[str] = None,
+               process_set=None):
+    out = broadcast(tensor, root_rank, name=name, process_set=process_set)
+    with _torch().no_grad():
+        tensor.copy_(out)
+    return tensor
+
+
+def grouped_allreduce_(tensors, **kwargs):
+    outs = grouped_allreduce(tensors, **kwargs)
+    with _torch().no_grad():
+        for t, o in zip(tensors, outs):
+            t.copy_(o)
+    return tensors
+
+
+def allreduce_async(tensor, op: int = _eager.Average,
+                    name: Optional[str] = None, process_set=None,
+                    prescale_factor: float = 1.0,
+                    postscale_factor: float = 1.0) -> TorchHandle:
+    y = _eager.allreduce(_to_jax(tensor), op=op, name=name,
+                         process_set=process_set,
+                         prescale_factor=prescale_factor,
+                         postscale_factor=postscale_factor)
+    return TorchHandle(y, tensor, name=name)
+
+
+def allreduce_async_(tensor, **kwargs) -> TorchHandle:
+    h = allreduce_async(tensor, **kwargs)
+    h._target = tensor
+    return h
+
+
+def allgather_async(tensor, name: Optional[str] = None,
+                    process_set=None) -> TorchHandle:
+    y = _eager.allgather(_to_jax(tensor), name=name,
+                         process_set=process_set)
+    return TorchHandle(y, tensor, name=name)
+
+
+def broadcast_async(tensor, root_rank: int, name: Optional[str] = None,
+                    process_set=None) -> TorchHandle:
+    y = _eager.broadcast(_to_jax(tensor), root_rank, name=name,
+                         process_set=process_set)
+    return TorchHandle(y, tensor, name=name)
+
+
+def broadcast_async_(tensor, root_rank: int, **kwargs) -> TorchHandle:
+    h = broadcast_async(tensor, root_rank, **kwargs)
+    h._target = tensor
+    return h
+
+
+def grouped_allreduce_async(tensors, op: int = _eager.Average,
+                            name: Optional[str] = None, process_set=None,
+                            **kwargs) -> TorchHandle:
+    ys = _eager.grouped_allreduce(
+        [_to_jax(t) for t in tensors], op=op, name=name,
+        process_set=process_set, **kwargs,
+    )
+    return TorchHandle(ys, list(tensors), name=name)
+
+
+def grouped_allreduce_async_(tensors, **kwargs) -> TorchHandle:
+    h = grouped_allreduce_async(tensors, **kwargs)
+    h._target = list(tensors)
+    return h
+
+
+def sparse_allreduce_async(tensor, name: Optional[str] = None,
+                           op: int = _eager.Average):
+    """Average a sparse COO tensor across processes (reference
+    ``torch/mpi_ops.py`` sparse_allreduce_async: allgather of
+    indices+values, summed at the destination — the IndexedSlices
+    strategy, ``tensorflow/__init__.py:95-162``).
+
+    Process-level like the rest of the torch data plumbing; returns a
+    handle whose ``synchronize`` yields a coalesced sparse tensor.
+    """
+    torch = _torch()
+    if not tensor.is_sparse:
+        raise ValueError("sparse_allreduce_async expects a sparse tensor")
+    if op not in (_eager.Average, _eager.Sum):
+        raise ValueError(
+            "sparse_allreduce_async supports Average/Sum only (the "
+            "gather-and-coalesce strategy is a summation)"
+        )
+    t = tensor.coalesce()
+    values_like = t.values()
+    payload = (
+        _tensor_to_numpy(torch, t.indices()),
+        _tensor_to_numpy(torch, values_like),  # handles bf16/grad/device
+        tuple(t.shape),
+    )
+    gathered = _functions.allgather_object(payload)
+
+    class _SparseHandle:
+        def done(self):
+            return True
+
+        def wait(self):
+            idx = np.concatenate([g[0] for g in gathered], axis=1)
+            vals = np.concatenate([g[1] for g in gathered], axis=0)
+            out = torch.sparse_coo_tensor(
+                torch.from_numpy(idx),
+                _to_torch(vals, values_like),
+                size=payload[2],
+            ).coalesce()  # duplicate coordinates sum here
+            if op == _eager.Average:
+                out = out / len(gathered)
+            return out
+
+    return _SparseHandle()
+
+
 # ---- parameter/object plumbing (reference torch/functions.py) -----------
 
 def _tensor_to_numpy(torch, v):

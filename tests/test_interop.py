@@ -77,3 +77,86 @@ def test_torch_bf16_allreduce_exact_wire_dtype(hvd_module):
 def test_torch_int64_rejected(hvd_module):
     with pytest.raises(TypeError, match="truncated"):
         hvd_torch.allreduce(torch.ones((N, 2), dtype=torch.int64))
+
+
+class TestInplaceAndAsync:
+    """In-place (`*_`) and async (`*_async`) variants (reference
+    ``torch/mpi_ops.py:114-887``)."""
+
+    def test_allreduce_inplace(self, hvd_module):
+        t = torch.ones(N, 2)
+        out = hvd_torch.allreduce_(t, op=hvd.Sum)
+        assert out is t
+        np.testing.assert_allclose(t.numpy(), float(N))
+
+    def test_broadcast_inplace(self, hvd_module):
+        t = torch.arange(N, dtype=torch.float32).reshape(N, 1)
+        hvd_torch.broadcast_(t, root_rank=3)
+        np.testing.assert_allclose(t.numpy(), 3.0)
+
+    def test_grouped_allreduce_and_inplace(self, hvd_module):
+        ts = [torch.ones(N, 2), 2 * torch.ones(N, 3)]
+        outs = hvd_torch.grouped_allreduce(ts, op=hvd.Average)
+        np.testing.assert_allclose(outs[0].numpy(), 1.0)
+        np.testing.assert_allclose(outs[1].numpy(), 2.0)
+        hvd_torch.grouped_allreduce_(ts, op=hvd.Sum)
+        np.testing.assert_allclose(ts[0].numpy(), float(N))
+
+    def test_allreduce_async_handle(self, hvd_module):
+        t = torch.ones(N, 2)
+        h = hvd_torch.allreduce_async(t, op=hvd.Sum, name="a")
+        assert hvd_torch.poll(h) in (True, False)
+        out = hvd_torch.synchronize(h)
+        assert torch.is_tensor(out)
+        np.testing.assert_allclose(out.numpy(), float(N))
+        # original untouched by the non-inplace async variant
+        np.testing.assert_allclose(t.numpy(), 1.0)
+
+    def test_allreduce_async_inplace(self, hvd_module):
+        t = torch.ones(N, 2)
+        h = hvd_torch.allreduce_async_(t, op=hvd.Sum)
+        out = hvd_torch.synchronize(h)
+        assert out is t
+        np.testing.assert_allclose(t.numpy(), float(N))
+
+    def test_broadcast_async_inplace(self, hvd_module):
+        t = torch.arange(N, dtype=torch.float32).reshape(N, 1)
+        hvd_torch.synchronize(hvd_torch.broadcast_async_(t, root_rank=1))
+        np.testing.assert_allclose(t.numpy(), 1.0)
+
+    def test_grouped_allreduce_async(self, hvd_module):
+        ts = [torch.ones(N, 2), torch.full((N, 1), 3.0)]
+        h = hvd_torch.grouped_allreduce_async_(ts, op=hvd.Average)
+        outs = hvd_torch.synchronize(h)
+        assert outs[0] is ts[0]
+        np.testing.assert_allclose(ts[1].numpy(), 3.0)
+
+    def test_allgather_and_broadcast_async(self, hvd_module):
+        t = torch.ones(N, 1, 2)
+        out = hvd_torch.synchronize(hvd_torch.allgather_async(t))
+        assert out.shape == (N, N, 2)
+        out2 = hvd_torch.synchronize(
+            hvd_torch.broadcast_async(t, root_rank=0)
+        )
+        np.testing.assert_allclose(out2.numpy(), 1.0)
+
+
+class TestSparseAllreduce:
+    def test_sparse_allreduce_single_process(self, hvd_module):
+        """Single process: the gather set is itself; averaging returns
+        the same (coalesced) tensor."""
+        i = torch.tensor([[0, 2, 2], [1, 0, 0]])
+        v = torch.tensor([1.0, 2.0, 3.0])
+        sp = torch.sparse_coo_tensor(i, v, (4, 3))
+        h = hvd_torch.sparse_allreduce_async(sp, name="emb")
+        out = hvd_torch.synchronize(h)
+        assert out.is_sparse
+        dense = out.to_dense().numpy()
+        want = np.zeros((4, 3), np.float32)
+        want[0, 1] = 1.0
+        want[2, 0] = 5.0  # duplicate coordinate summed
+        np.testing.assert_allclose(dense, want)
+
+    def test_sparse_rejects_dense(self, hvd_module):
+        with pytest.raises(ValueError, match="sparse"):
+            hvd_torch.sparse_allreduce_async(torch.ones(3, 3))
