@@ -128,6 +128,51 @@ def allgather_object(obj, name: Optional[str] = None):
     return _functions.allgather_object(obj)
 
 
+def broadcast_object_fn(root_rank: int = 0, name: Optional[str] = None):
+    """Reference ``tensorflow/functions.py`` ``broadcast_object_fn``:
+    a reusable closure for elastic state sync."""
+    def fn(obj):
+        return broadcast_object(obj, root_rank=root_rank, name=name)
+
+    return fn
+
+
+# ---- scalar query ops (reference ``mpi_ops.py:883-935``: HorovodSize/
+# Rank/LocalSize/LocalRank kernels + ProcessSetIncluded).  Topology is
+# static per process here, so the graph-mode ops are constants — usable
+# inside tf.function exactly like the reference's C++ scalar kernels. --
+
+def size_op(process_set_id: int = 0, name: Optional[str] = None):
+    from ..runtime import get_runtime
+    ps = get_runtime().process_set_table.get(process_set_id)
+    return _tf().constant(len(ps.ranks), name=name)
+
+
+def rank_op(name: Optional[str] = None):
+    from .. import rank
+    return _tf().constant(rank(), name=name)
+
+
+def local_size_op(name: Optional[str] = None):
+    from .. import local_size
+    return _tf().constant(local_size(), name=name)
+
+
+def local_rank_op(name: Optional[str] = None):
+    from .. import local_rank
+    return _tf().constant(local_rank(), name=name)
+
+
+def process_set_included_op(process_set_id: int = 0,
+                            name: Optional[str] = None):
+    """1 when this rank belongs to the process set, else 0 (reference
+    ``HorovodProcessSetIncluded``)."""
+    from .. import rank
+    from ..runtime import get_runtime
+    ps = get_runtime().process_set_table.get(process_set_id)
+    return _tf().constant(int(rank() in ps.ranks), name=name)
+
+
 # ---- variable plumbing (reference tensorflow/__init__.py:276) -----------
 
 def broadcast_variables(variables, root_rank: int = 0):

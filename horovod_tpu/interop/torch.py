@@ -119,6 +119,7 @@ def grouped_allreduce(tensors, op: int = _eager.Average,
                       postscale_factor: float = 1.0):
     """Reference ``hvd.grouped_allreduce`` (``torch/mpi_ops.py``): one
     fused collective over a list of tensors."""
+    tensors = list(tensors)
     ys = _eager.grouped_allreduce(
         [_to_jax(t) for t in tensors], op=op, name=name,
         process_set=process_set, prescale_factor=prescale_factor,
@@ -137,12 +138,17 @@ class TorchHandle:
     ``wait()``/``synchronize`` converts to torch (and copies in place
     for the ``*_async_`` variants)."""
 
-    def __init__(self, jax_value, like, inplace_target=None,
-                 name: Optional[str] = None):
+    def __init__(self, jax_value, like, name: Optional[str] = None):
         self._h = _eager.Handle(jax_value, name)
         self._like = like
-        self._target = inplace_target
+        # resolution target for the in-place (*_async_) variants, set
+        # via mark_inplace() by those wrappers
+        self._target = None
         self._result = None
+
+    def mark_inplace(self, target) -> "TorchHandle":
+        self._target = target
+        return self
 
     def done(self) -> bool:
         return self._h.done()
@@ -219,9 +225,7 @@ def allreduce_async(tensor, op: int = _eager.Average,
 
 
 def allreduce_async_(tensor, **kwargs) -> TorchHandle:
-    h = allreduce_async(tensor, **kwargs)
-    h._target = tensor
-    return h
+    return allreduce_async(tensor, **kwargs).mark_inplace(tensor)
 
 
 def allgather_async(tensor, name: Optional[str] = None,
@@ -239,14 +243,13 @@ def broadcast_async(tensor, root_rank: int, name: Optional[str] = None,
 
 
 def broadcast_async_(tensor, root_rank: int, **kwargs) -> TorchHandle:
-    h = broadcast_async(tensor, root_rank, **kwargs)
-    h._target = tensor
-    return h
+    return broadcast_async(tensor, root_rank, **kwargs).mark_inplace(tensor)
 
 
 def grouped_allreduce_async(tensors, op: int = _eager.Average,
                             name: Optional[str] = None, process_set=None,
                             **kwargs) -> TorchHandle:
+    tensors = list(tensors)
     ys = _eager.grouped_allreduce(
         [_to_jax(t) for t in tensors], op=op, name=name,
         process_set=process_set, **kwargs,
@@ -255,9 +258,8 @@ def grouped_allreduce_async(tensors, op: int = _eager.Average,
 
 
 def grouped_allreduce_async_(tensors, **kwargs) -> TorchHandle:
-    h = grouped_allreduce_async(tensors, **kwargs)
-    h._target = list(tensors)
-    return h
+    tensors = list(tensors)
+    return grouped_allreduce_async(tensors, **kwargs).mark_inplace(tensors)
 
 
 def sparse_allreduce_async(tensor, name: Optional[str] = None,
@@ -295,7 +297,7 @@ def sparse_allreduce_async(tensor, name: Optional[str] = None,
             idx = np.concatenate([g[0] for g in gathered], axis=1)
             vals = np.concatenate([g[1] for g in gathered], axis=0)
             out = torch.sparse_coo_tensor(
-                torch.from_numpy(idx),
+                torch.from_numpy(idx).to(values_like.device),
                 _to_torch(vals, values_like),
                 size=payload[2],
             ).coalesce()  # duplicate coordinates sum here

@@ -162,3 +162,32 @@ def test_keras_model_end_to_end(hvd_module):
         if first is None:
             first = float(loss)
     assert float(loss) < first * 0.3, (first, float(loss))
+
+
+class TestScalarOps:
+    """Reference scalar query kernels (``mpi_ops.cc:883-935``)."""
+
+    def test_topology_ops(self, hvd_module):
+        import horovod_tpu.interop.tf as hvd_tf
+
+        assert int(hvd_tf.size_op()) == hvd.size()
+        assert int(hvd_tf.rank_op()) == hvd.rank()
+        assert int(hvd_tf.local_size_op()) == hvd.local_size()
+        assert int(hvd_tf.local_rank_op()) == hvd.local_rank()
+        assert int(hvd_tf.process_set_included_op(0)) == 1
+
+    def test_size_op_for_subset(self, hvd_module, monkeypatch):
+        import horovod_tpu.interop.tf as hvd_tf
+
+        monkeypatch.setenv("HVD_TPU_DYNAMIC_PROCESS_SETS", "1")
+        ps = hvd.add_process_set([0, 1, 2])
+        assert int(hvd_tf.size_op(ps.process_set_id)) == 3
+        included = int(hvd_tf.process_set_included_op(ps.process_set_id))
+        assert included == (1 if hvd.rank() in (0, 1, 2) else 0)
+        hvd.remove_process_set(ps)
+
+    def test_broadcast_object_fn(self, hvd_module):
+        import horovod_tpu.interop.tf as hvd_tf
+
+        fn = hvd_tf.broadcast_object_fn(root_rank=0)
+        assert fn({"a": 1}) == {"a": 1}
