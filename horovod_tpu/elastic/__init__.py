@@ -1,3 +1,7 @@
 from .state import ArrayState, ObjectState, State, TpuState  # noqa: F401
 from .run import run, run_fn  # noqa: F401
 from .remesh import reinit_world  # noqa: F401
+from .framework_states import (  # noqa: F401
+    TensorFlowKerasState,
+    TorchState,
+)

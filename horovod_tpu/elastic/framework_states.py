@@ -1,0 +1,209 @@
+"""Framework-flavored elastic states.
+
+Reference: ``horovod/torch/elastic/state.py`` (``TorchState`` — model /
+optimizer handlers over ``ObjectState``) and
+``horovod/tensorflow/elastic.py`` (``TensorFlowKerasState``).  These
+wrap live framework objects: ``save()`` snapshots their state dicts to
+host memory, ``restore()`` loads the snapshot back, ``sync()``
+broadcasts from rank 0 through the object-broadcast path the interop
+bridges use.  Arbitrary extra attributes (epoch, batch, samplers) ride
+along with :class:`~horovod_tpu.elastic.state.ObjectState` semantics.
+"""
+
+from __future__ import annotations
+
+import copy
+from typing import Any, Dict
+
+from .. import functions
+from .state import ObjectState
+
+
+class TorchState(ObjectState):
+    """Elastic state around a torch model/optimizer (reference
+    ``torch/elastic/state.py:27``: ``TorchState(model=..., optimizer=...,
+    epoch=0, batch=0)``)."""
+
+    def __init__(self, model=None, optimizer=None, **kwargs):
+        import torch  # noqa: F401  (fail fast with a clear error)
+
+        self.model = model
+        self.optimizer = optimizer
+        self._model_snapshot = None
+        self._opt_snapshot = None
+        super().__init__(**kwargs)
+        self.save()
+
+    # -- handlers (reference ModelStateHandler / OptimizerStateHandler) --
+    def _snap(self):
+        model = (copy.deepcopy(self.model.state_dict())
+                 if self.model is not None else None)
+        opt = (copy.deepcopy(self.optimizer.state_dict())
+               if self.optimizer is not None else None)
+        return model, opt
+
+    def save(self) -> None:
+        super().save()
+        self._model_snapshot, self._opt_snapshot = self._snap()
+
+    def restore(self) -> None:
+        super().restore()
+        if self.model is not None and self._model_snapshot is not None:
+            self.model.load_state_dict(self._model_snapshot)
+        if self.optimizer is not None and self._opt_snapshot is not None:
+            self.optimizer.load_state_dict(self._opt_snapshot)
+
+    def sync(self) -> None:
+        from ..interop import torch as hvd_torch
+
+        if not self._saved_state:
+            # no plain attributes: ObjectState.sync would skip entirely,
+            # including persisted-snapshot adoption
+            self._load_persisted()
+        super().sync()  # plain attributes broadcast + persisted adopt
+        if self.model is not None:
+            hvd_torch.broadcast_parameters(
+                self.model.state_dict(), root_rank=0
+            )
+        if self.optimizer is not None:
+            hvd_torch.broadcast_optimizer_state(
+                self.optimizer, root_rank=0
+            )
+        self._model_snapshot, self._opt_snapshot = self._snap()
+
+    # Cross-round persistence: ship the state dicts as host tensors.
+    def _serialize(self):
+        import pickle
+
+        import torch
+
+        from ..interop.torch import _tensor_to_numpy
+
+        model, opt = self._snap()
+        wire_model = (
+            {k: _tensor_to_numpy(torch, v) if torch.is_tensor(v) else v
+             for k, v in model.items()} if model is not None else None
+        )
+        return pickle.dumps(
+            {"attrs": self._saved_state, "model": wire_model, "opt": opt}
+        )
+
+    def _deserialize(self, blob) -> bool:
+        import pickle
+
+        import torch
+
+        from ..interop.torch import _to_torch
+
+        try:
+            saved = pickle.loads(blob)
+        except Exception:
+            return False
+        if not isinstance(saved, dict) or "attrs" not in saved:
+            return False
+        if set(saved["attrs"]) != set(self._saved_state):
+            return False
+        # Load framework state FIRST (with rollback) so a failure never
+        # leaves half-adopted state; attrs mutate only after success.
+        pre_model, pre_opt = self._snap()
+        try:
+            if self.model is not None and saved.get("model") is not None:
+                self.model.load_state_dict({
+                    k: _to_torch(v, None) if not torch.is_tensor(v) else v
+                    for k, v in saved["model"].items()
+                })
+            if self.optimizer is not None and saved.get("opt") is not None:
+                self.optimizer.load_state_dict(saved["opt"])
+        except Exception:
+            if self.model is not None and pre_model is not None:
+                self.model.load_state_dict(pre_model)
+            if self.optimizer is not None and pre_opt is not None:
+                self.optimizer.load_state_dict(pre_opt)
+            return False
+        self._saved_state.update(saved["attrs"])
+        for k, v in saved["attrs"].items():
+            setattr(self, k, v)
+        return True
+
+
+class TensorFlowKerasState(ObjectState):
+    """Elastic state around a keras model/optimizer (reference
+    ``tensorflow/elastic.py`` ``TensorFlowKerasState(model, optimizer,
+    batch=0, epoch=0)``)."""
+
+    def __init__(self, model=None, optimizer=None, **kwargs):
+        import tensorflow  # noqa: F401  (fail fast with a clear error)
+
+        self.model = model
+        self.optimizer = optimizer
+        self._weights_snapshot = None
+        self._opt_snapshot = None
+        super().__init__(**kwargs)
+        self.save()
+
+    def _snap(self):
+        weights = (self.model.get_weights()
+                   if self.model is not None else None)
+        opt = ([v.numpy() for v in self.optimizer.variables]
+               if self.optimizer is not None else None)
+        return weights, opt
+
+    def _load(self, weights, opt) -> None:
+        if self.model is not None and weights is not None:
+            self.model.set_weights(weights)
+        if self.optimizer is not None and opt is not None:
+            for var, val in zip(self.optimizer.variables, opt):
+                var.assign(val)
+
+    def save(self) -> None:
+        super().save()
+        self._weights_snapshot, self._opt_snapshot = self._snap()
+
+    def restore(self) -> None:
+        super().restore()
+        self._load(self._weights_snapshot, self._opt_snapshot)
+
+    def sync(self) -> None:
+        if not self._saved_state:
+            self._load_persisted()
+        super().sync()
+        weights, opt = self._snap()
+        synced = functions.broadcast_object(
+            {"weights": weights, "opt": opt}, root_rank=0
+        )
+        self._load(synced["weights"], synced["opt"])
+        self._weights_snapshot, self._opt_snapshot = self._snap()
+
+    def _serialize(self):
+        import pickle
+
+        weights, opt = self._snap()
+        return pickle.dumps(
+            {"attrs": self._saved_state, "weights": weights, "opt": opt}
+        )
+
+    def _deserialize(self, blob) -> bool:
+        import pickle
+
+        try:
+            saved = pickle.loads(blob)
+        except Exception:
+            return False
+        if not isinstance(saved, dict) or "attrs" not in saved:
+            return False
+        if set(saved["attrs"]) != set(self._saved_state):
+            return False
+        # Framework state first (with rollback); attrs only on success.
+        pre_weights, pre_opt = self._snap()
+        try:
+            self._load(saved.get("weights"), saved.get("opt"))
+        except Exception:
+            try:
+                self._load(pre_weights, pre_opt)
+            except Exception:
+                pass
+            return False
+        self._saved_state.update(saved["attrs"])
+        for k, v in saved["attrs"].items():
+            setattr(self, k, v)
+        return True

@@ -1,0 +1,145 @@
+"""Framework-flavored elastic states (reference
+``torch/elastic/state.py`` TorchState, ``tensorflow/elastic.py``
+TensorFlowKerasState)."""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+
+torch = pytest.importorskip("torch")
+
+
+def _torch_pair():
+    model = torch.nn.Linear(4, 2)
+    opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+    return model, opt
+
+
+def _step(model, opt):
+    x = torch.randn(8, 4)
+    loss = model(x).pow(2).mean()
+    opt.zero_grad()
+    loss.backward()
+    opt.step()
+
+
+class TestTorchState:
+    def test_commit_restore_roundtrip(self, hvd_module):
+        from horovod_tpu.elastic import TorchState
+
+        model, opt = _torch_pair()
+        state = TorchState(model=model, optimizer=opt, epoch=3, batch=7)
+        w0 = {k: v.clone() for k, v in model.state_dict().items()}
+        state.commit()
+        _step(model, opt)
+        state.epoch = 9
+        assert not torch.equal(model.weight, w0["weight"])
+        state.restore()
+        assert torch.equal(model.weight, w0["weight"])
+        assert state.epoch == 3 and state.batch == 7
+
+    def test_restore_rolls_back_optimizer_momentum(self, hvd_module):
+        from horovod_tpu.elastic import TorchState
+
+        model, opt = _torch_pair()
+        _step(model, opt)  # populate momentum buffers
+        state = TorchState(model=model, optimizer=opt)
+        state.commit()
+        mom0 = {
+            k: v["momentum_buffer"].clone()
+            for k, v in opt.state_dict()["state"].items()
+        }
+        _step(model, opt)
+        state.restore()
+        for k, buf in opt.state_dict()["state"].items():
+            assert torch.equal(buf["momentum_buffer"], mom0[k])
+
+    def test_sync_single_process(self, hvd_module):
+        from horovod_tpu.elastic import TorchState
+
+        model, opt = _torch_pair()
+        state = TorchState(model=model, optimizer=opt, epoch=1)
+        state.sync()  # no-op broadcastable path must not raise
+        assert state.epoch == 1
+
+    def test_serialize_roundtrip(self, hvd_module):
+        from horovod_tpu.elastic import TorchState
+
+        model, opt = _torch_pair()
+        _step(model, opt)
+        state = TorchState(model=model, optimizer=opt, epoch=5)
+        blob = state._serialize()
+
+        model2, opt2 = _torch_pair()
+        state2 = TorchState(model=model2, optimizer=opt2, epoch=0)
+        assert state2._deserialize(blob)
+        assert state2.epoch == 5
+        assert torch.equal(model2.weight, model.weight)
+
+
+class TestTorchStateEdges:
+    def test_bf16_model_serializes(self, hvd_module):
+        from horovod_tpu.elastic import TorchState
+
+        model = torch.nn.Linear(4, 2).to(torch.bfloat16)
+        state = TorchState(model=model, epoch=1)
+        blob = state._serialize()
+        model2 = torch.nn.Linear(4, 2).to(torch.bfloat16)
+        state2 = TorchState(model=model2, epoch=0)
+        assert state2._deserialize(blob)
+        assert model2.weight.dtype == torch.bfloat16
+        assert torch.equal(model2.weight, model.weight)
+
+    def test_deserialize_incompatible_model_rolls_back(self, hvd_module):
+        from horovod_tpu.elastic import TorchState
+
+        model = torch.nn.Linear(4, 2)
+        state = TorchState(model=model, epoch=7)
+        blob = state._serialize()
+        other = torch.nn.Linear(8, 3)  # different shapes
+        w0 = other.weight.clone()
+        state2 = TorchState(model=other, epoch=0)
+        assert not state2._deserialize(blob)
+        assert state2.epoch == 0  # attrs untouched
+        assert torch.equal(other.weight, w0)  # weights rolled back
+
+
+class TestTensorFlowKerasState:
+    def test_commit_restore_roundtrip(self, hvd_module):
+        tf = pytest.importorskip("tensorflow")
+        from horovod_tpu.elastic import TensorFlowKerasState
+
+        model = tf.keras.Sequential(
+            [tf.keras.layers.Dense(2, input_shape=(4,))]
+        )
+        opt = tf.keras.optimizers.SGD(0.1)
+        state = TensorFlowKerasState(model=model, optimizer=opt, epoch=2)
+        w0 = [w.copy() for w in model.get_weights()]
+        state.commit()
+        # perturb
+        model.set_weights([w + 1.0 for w in model.get_weights()])
+        state.epoch = 8
+        state.restore()
+        for a, b in zip(model.get_weights(), w0):
+            np.testing.assert_allclose(a, b)
+        assert state.epoch == 2
+
+    def test_serialize_roundtrip(self, hvd_module):
+        tf = pytest.importorskip("tensorflow")
+        from horovod_tpu.elastic import TensorFlowKerasState
+
+        model = tf.keras.Sequential(
+            [tf.keras.layers.Dense(2, input_shape=(4,))]
+        )
+        state = TensorFlowKerasState(model=model, epoch=4)
+        blob = state._serialize()
+
+        model2 = tf.keras.Sequential(
+            [tf.keras.layers.Dense(2, input_shape=(4,))]
+        )
+        state2 = TensorFlowKerasState(model=model2, epoch=0)
+        assert state2._deserialize(blob)
+        assert state2.epoch == 4
+        for a, b in zip(model2.get_weights(), model.get_weights()):
+            np.testing.assert_allclose(a, b)
