@@ -58,35 +58,15 @@ def _chip_peak_tflops(device) -> float | None:
 def bench_resnet(hvd, jnp, batch_per_chip: int, iters: int = 20,
                  stem: str = "conv7") -> dict:
     import jax
-    import numpy as np
-    import optax
 
     from horovod_tpu.models import ResNet50
+    from horovod_tpu.utils.benchmarks import build_dp_step, timed_throughput
 
     image_size = 224
     model = ResNet50(num_classes=1000, dtype=jnp.bfloat16, stem=stem)
-    variables = model.init(
-        jax.random.PRNGKey(0), jnp.zeros((1, image_size, image_size, 3)),
-        train=True,
+    step, params, batch_stats, opt_state = build_dp_step(
+        hvd, model, image_size, compression=hvd.Compression.bf16,
     )
-    params, batch_stats = variables["params"], variables["batch_stats"]
-    params = hvd.broadcast_parameters(params, root_rank=0)
-
-    tx = hvd.DistributedOptimizer(
-        optax.sgd(0.01, momentum=0.9), compression=hvd.Compression.bf16
-    )
-
-    def loss_fn(p, stats, batch):
-        x, y = batch
-        logits, updated = model.apply(
-            {"params": p, "batch_stats": stats}, x, train=True,
-            mutable=["batch_stats"],
-        )
-        loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
-        return loss, updated["batch_stats"]
-
-    step = hvd.distributed_train_step(loss_fn, tx, stateful=True)
-    opt_state = step.init(params)
 
     global_batch = batch_per_chip * hvd.size()
     key = jax.random.PRNGKey(1)
@@ -95,22 +75,10 @@ def bench_resnet(hvd, jnp, batch_per_chip: int, iters: int = 20,
     )
     target = jax.random.randint(key, (global_batch,), 0, 1000, jnp.int32)
 
-    for _ in range(5):  # warmup + compile
-        params, batch_stats, opt_state, loss = step(
-            params, batch_stats, opt_state, (data, target)
-        )
-    # Force real completion with a scalar host transfer:
-    # block_until_ready is not a reliable fence on every PJRT transport
-    # (observed on the axon relay), but a device->host read is.
-    float(loss)
-
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        params, batch_stats, opt_state, loss = step(
-            params, batch_stats, opt_state, (data, target)
-        )
-    float(loss)  # final loss depends on the whole step chain
-    dt = time.perf_counter() - t0
+    dt, _ = timed_throughput(
+        step, params, batch_stats, opt_state, (data, target), iters,
+        warmup=5,
+    )
 
     ips_per_chip = global_batch * iters / dt / hvd.size()
     step_ms = dt / iters * 1000.0

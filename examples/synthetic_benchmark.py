@@ -11,10 +11,8 @@ Run: ``python examples/synthetic_benchmark.py [--model resnet50]``.
 import argparse
 import time
 
-import jax
 import jax.numpy as jnp
 import numpy as np
-import optax
 
 import horovod_tpu as hvd
 from horovod_tpu.models import InceptionV3, ResNet50, ResNet101, VGG16
@@ -28,43 +26,19 @@ MODELS = {
 
 
 def build_benchmark(args):
+    from horovod_tpu.utils.benchmarks import build_dp_step
+
     kwargs = {}
     if args.model.startswith("resnet") and args.stem != "conv7":
         kwargs["stem"] = args.stem
     model = MODELS[args.model](num_classes=1000, dtype=jnp.bfloat16,
                                **kwargs)
-    variables = model.init(
-        jax.random.PRNGKey(0), jnp.zeros((1, args.image_size, args.image_size, 3)),
-        train=True,
+    step, params, batch_stats, opt_state = build_dp_step(
+        hvd, model, args.image_size,
+        compression=hvd.Compression.fp16 if args.fp16_allreduce
+        else hvd.Compression.none,
     )
-    params = variables["params"]
-    batch_stats = variables.get("batch_stats")  # VGG has no BatchNorm
-    params = hvd.broadcast_parameters(params, root_rank=0)
-
-    tx = hvd.DistributedOptimizer(
-        optax.sgd(0.01, momentum=0.9),
-        compression=hvd.Compression.fp16 if args.fp16_allreduce else hvd.Compression.none,
-    )
-
-    if batch_stats is not None:
-        def loss_fn(p, stats, batch):
-            x, y = batch
-            logits, updated = model.apply(
-                {"params": p, "batch_stats": stats}, x, train=True,
-                mutable=["batch_stats"],
-            )
-            loss = optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
-            return loss, updated["batch_stats"]
-
-        step = hvd.distributed_train_step(loss_fn, tx, stateful=True)
-    else:
-        def loss_fn(p, batch):
-            x, y = batch
-            logits = model.apply({"params": p}, x, train=True)
-            return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
-
-        step = hvd.distributed_train_step(loss_fn, tx)
-    return model, params, batch_stats, step
+    return model, params, batch_stats, step, opt_state
 
 
 def main():
@@ -87,8 +61,7 @@ def main():
         parser.error(f"--stem {args.stem} only applies to resnet models")
 
     hvd.init()
-    model, params, batch_stats, step = build_benchmark(args)
-    opt_state = step.init(params)
+    model, params, batch_stats, step, opt_state = build_benchmark(args)
 
     global_batch = args.batch_size * hvd.size()
     rng = np.random.RandomState(0)
