@@ -94,6 +94,17 @@ int64_t hvd_wire_decode_request(const uint8_t* buf, int64_t len,
                                 int64_t* out_dims, int32_t dims_cap,
                                 int32_t* out_ndim, char* name_buf,
                                 int64_t name_cap);
+/* Response record (reference Response: response_type echoing the op or
+ * ERROR(=8), '\n'-joined tensor names, error message, tensor sizes).
+ * Encode returns bytes written or -1; decode returns bytes consumed. */
+int64_t hvd_wire_encode_response(int32_t rtype, const char* names,
+                                 const char* error, const int64_t* sizes,
+                                 int32_t nsizes, uint8_t* out, int64_t cap);
+int64_t hvd_wire_decode_response(const uint8_t* buf, int64_t len,
+                                 int32_t* out_rtype, char* names_buf,
+                                 int64_t names_cap, char* err_buf,
+                                 int64_t err_cap, int64_t* out_sizes,
+                                 int32_t sizes_cap, int32_t* out_nsizes);
 
 /* ---- TCP host controller (reference gloo rendezvous + http_store) ----
  * Server: a KV store + barrier/allgather coordination service run by the

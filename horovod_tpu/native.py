@@ -119,6 +119,17 @@ def _configure(lib: ctypes.CDLL) -> None:
         c.POINTER(c.c_int64), c.c_int32, c.POINTER(c.c_int32), c.c_char_p,
         c.c_int64,
     ]
+    lib.hvd_wire_encode_response.restype = c.c_int64
+    lib.hvd_wire_encode_response.argtypes = [
+        c.c_int32, c.c_char_p, c.c_char_p, c.POINTER(c.c_int64),
+        c.c_int32, c.POINTER(c.c_uint8), c.c_int64,
+    ]
+    lib.hvd_wire_decode_response.restype = c.c_int64
+    lib.hvd_wire_decode_response.argtypes = [
+        c.POINTER(c.c_uint8), c.c_int64, c.POINTER(c.c_int32), c.c_char_p,
+        c.c_int64, c.c_char_p, c.c_int64, c.POINTER(c.c_int64), c.c_int32,
+        c.POINTER(c.c_int32),
+    ]
     lib.hvd_ctrl_server_start.restype = c.c_void_p
     lib.hvd_ctrl_server_start.argtypes = [c.c_char_p, c.c_int32, c.c_char_p,
                                           c.c_int32]
@@ -394,6 +405,10 @@ REQUEST_ALLTOALL = 5
 REQUEST_REDUCESCATTER = 6
 REQUEST_BARRIER = 7
 
+# Response types echo the request type; ERROR signals a rejected
+# submission (reference message.h ResponseType).
+RESPONSE_ERROR = 8
+
 
 def encode_request(rank: int, rtype: int, dtype: int, root: int,
                    dims: Sequence[int], name: str) -> bytes:
@@ -437,6 +452,55 @@ def decode_request(buf: bytes):
         "root": root.value,
         "dims": list(dims[: ndim.value]),
         "name": name.value.decode(),
+        "consumed": consumed,
+    }
+
+
+def encode_response(rtype: int, names: Sequence[str], error: str = "",
+                    sizes: Sequence[int] = ()) -> bytes:
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native core unavailable")
+    names_b = "\n".join(names).encode()
+    error_b = error.encode()
+    # cap from BYTE lengths (multibyte text expands past char counts)
+    cap = 64 + len(names_b) + len(error_b) + 8 * len(sizes)
+    out = (ctypes.c_uint8 * cap)()
+    sizes_arr = (
+        (ctypes.c_int64 * max(1, len(sizes)))(*sizes) if sizes else None
+    )
+    n = lib.hvd_wire_encode_response(
+        rtype, names_b, error_b, sizes_arr, len(sizes), out, cap,
+    )
+    if n < 0:
+        raise ValueError("encode failed")
+    return bytes(out[:n])
+
+
+def decode_response(buf: bytes):
+    lib = load()
+    if lib is None:
+        raise RuntimeError("native core unavailable")
+    arr = (ctypes.c_uint8 * len(buf)).from_buffer_copy(buf)
+    rtype = ctypes.c_int32()
+    nsizes = ctypes.c_int32()
+    # every size costs 8 wire bytes, so len(buf)//8 + 1 can hold them all
+    sizes_cap = len(buf) // 8 + 1
+    sizes = (ctypes.c_int64 * sizes_cap)()
+    names = ctypes.create_string_buffer(max(8192, len(buf) + 1))
+    err = ctypes.create_string_buffer(max(4096, len(buf) + 1))
+    consumed = lib.hvd_wire_decode_response(
+        arr, len(buf), ctypes.byref(rtype), names, len(names), err,
+        len(err), sizes, sizes_cap, ctypes.byref(nsizes),
+    )
+    if consumed < 0:
+        raise ValueError("decode failed")
+    names_s = names.value.decode()
+    return {
+        "type": rtype.value,
+        "names": names_s.split("\n") if names_s else [],
+        "error": err.value.decode(),
+        "sizes": list(sizes[: nsizes.value]),
         "consumed": consumed,
     }
 

@@ -127,7 +127,8 @@ def _consistency_check(rtype: int, x: jax.Array, name: Optional[str],
         else "world"
     )
     wire_name = f"{name or ''}|ps={ps_tag}|{extra}"
-    if native.available():
+    use_native = native.available()
+    if use_native:
         blob = native.encode_request(
             rt.process_rank, rtype, dtype_id, root, dims, wire_name
         )
@@ -140,20 +141,46 @@ def _consistency_check(rtype: int, x: jax.Array, name: Optional[str],
             "rank": rt.process_rank, "type": rtype, "dtype": dtype_id,
             "root": root, "dims": dims, "name": wire_name,
         })
-    base = records[0]
 
     def sig(r):
         return (r["type"], r["dtype"], tuple(r["dims"]), r["name"],
                 r["root"])
 
-    for r in records[1:]:
-        if sig(r) != sig(base):
-            raise HorovodTpuError(
-                "collective consistency check failed: process "
-                f"{r['rank']} submitted {sig(r)} but process "
-                f"{base['rank']} submitted {sig(base)} (reference "
-                "controller.cc mismatched-collective error)"
+    # Coordinator pattern (reference controller.cc ConstructResponse):
+    # process 0 validates the gathered Requests and broadcasts ONE wire
+    # Response — OK echoing the op, or ERROR with the mismatch — which
+    # every process adopts, exactly how the reference's workers learn a
+    # submission was rejected.
+    response = None
+    if rt.process_rank == 0:
+        base = records[0]
+        error = ""
+        for r in records[1:]:
+            if sig(r) != sig(base):
+                error = (
+                    f"process {r['rank']} submitted {sig(r)} but process "
+                    f"{base['rank']} submitted {sig(base)} (reference "
+                    "controller.cc mismatched-collective error)"
+                )
+                break
+        if use_native:
+            response = (
+                native.encode_response(native.RESPONSE_ERROR, [], error)
+                if error else
+                native.encode_response(rtype, [wire_name], sizes=dims)
             )
+        else:
+            response = {"type": native.RESPONSE_ERROR if error else rtype,
+                        "names": [] if error else [wire_name],
+                        "error": error, "sizes": dims}
+    response = functions.broadcast_object(response, root_rank=0)
+    resp = (
+        native.decode_response(response) if use_native else response
+    )
+    if resp["type"] == native.RESPONSE_ERROR:
+        raise HorovodTpuError(
+            f"collective consistency check failed: {resp['error']}"
+        )
 
 
 def _ps_id(process_set: Optional[ProcessSet]) -> Optional[int]:

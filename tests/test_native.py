@@ -191,3 +191,59 @@ def test_autotune_finds_peak():
     best_x, best_y = at.best()
     assert abs(best_x - 23.0) < 1.5, f"best {best_x} too far from 23"
     at.close()
+
+
+class TestWireResponse:
+    """Response codec (reference Response record, message.h)."""
+
+    def test_ok_roundtrip(self):
+        from horovod_tpu import native
+
+        if not native.available():
+            pytest.skip("native core unavailable")
+        blob = native.encode_response(
+            native.REQUEST_ALLGATHER, ["t1", "t2"], "", [5, 9, 13]
+        )
+        d = native.decode_response(blob)
+        assert d["type"] == native.REQUEST_ALLGATHER
+        assert d["names"] == ["t1", "t2"]
+        assert d["sizes"] == [5, 9, 13]
+        assert d["error"] == ""
+        assert d["consumed"] == len(blob)
+
+    def test_error_roundtrip(self):
+        from horovod_tpu import native
+
+        if not native.available():
+            pytest.skip("native core unavailable")
+        blob = native.encode_response(
+            native.RESPONSE_ERROR, [], "rank 2 sent float16, rank 0 float32"
+        )
+        d = native.decode_response(blob)
+        assert d["type"] == native.RESPONSE_ERROR
+        assert d["names"] == []
+        assert "float16" in d["error"]
+
+    def test_truncated_rejected(self):
+        from horovod_tpu import native
+
+        if not native.available():
+            pytest.skip("native core unavailable")
+        blob = native.encode_response(0, ["x"], "", [1])
+        with pytest.raises(ValueError):
+            native.decode_response(blob[:4])
+
+    def test_unicode_and_many_sizes(self):
+        from horovod_tpu import native
+
+        if not native.available():
+            pytest.skip("native core unavailable")
+        # multibyte names/error (byte-length cap) + >64 sizes (no clamp)
+        blob = native.encode_response(
+            2, ["テンソル" * 20], "ошибка: несоответствие " * 5,
+            list(range(100)),
+        )
+        d = native.decode_response(blob)
+        assert d["names"] == ["テンソル" * 20]
+        assert "несоответствие" in d["error"]
+        assert d["sizes"] == list(range(100))
