@@ -239,6 +239,7 @@ class DistributedGradientTape:
 
     def __init__(self, tape, average: bool = True, process_set=None,
                  sparse_as_dense: bool = False):
+        _check_process_set(process_set)
         self._tape = tape
         self._average = average
         self._sparse_as_dense = sparse_as_dense
@@ -263,13 +264,38 @@ class DistributedGradientTape:
         )
 
 
+def _check_process_set(process_set) -> None:
+    """The TF/torch *gradient* bridges reduce at the PROCESS level
+    (multihost gather); chip-rank process sets do not map onto that
+    plane, so rather than silently reducing over the wrong group the
+    argument is rejected — use the JAX surface (or the eager
+    collectives, which support process sets fully) for subset
+    training."""
+    if process_set is not None:
+        raise ValueError(
+            "process_set is not supported by the process-level gradient "
+            "reduction bridges; use the JAX training surface or eager "
+            "collectives for process-set-scoped reductions"
+        )
+
+
 def DistributedOptimizer(optimizer, average: bool = True,
                          sparse_as_dense: bool = False, process_set=None):
     """Wrap a ``tf.keras`` optimizer so ``apply_gradients`` reduces
-    first (reference ``tensorflow/__init__.py:627``)."""
+    first (reference ``tensorflow/__init__.py:627``).
+
+    Idempotent: an already-wrapped optimizer is returned unchanged
+    (the wrapper masquerades under the base class name for
+    serialization, so callers cannot reliably detect wrapping
+    themselves)."""
+    _check_process_set(process_set)
+    if getattr(optimizer, "_hvd_wrapped", False):
+        return optimizer
     tf = _tf()
 
     class _Wrapped(optimizer.__class__):
+        _hvd_wrapped = True
+
         def apply_gradients(self_w, grads_and_vars, **kwargs):
             pairs = list(grads_and_vars)
             grads = [g for g, _ in pairs]
@@ -284,6 +310,35 @@ def DistributedOptimizer(optimizer, average: bool = True,
                 zip(reduced, [v for _, v in pairs]), **kwargs
             )
 
+    # Serialize under the BASE optimizer's name: keras saves the class
+    # name, and a saved model must stay loadable by plain keras (the
+    # reference ships custom_objects for the same reason); load_model
+    # below re-wraps after loading.
+    _Wrapped.__name__ = optimizer.__class__.__name__
+    _Wrapped.__qualname__ = optimizer.__class__.__qualname__
+    _Wrapped.__module__ = optimizer.__class__.__module__
     obj = optimizer  # share all state with the wrapped instance
     obj.__class__ = _Wrapped
     return obj
+
+
+def load_model(path, custom_objects=None, average: bool = True,
+               sparse_as_dense: bool = False, process_set=None):
+    """Load a keras model and re-wrap its optimizer with
+    :func:`DistributedOptimizer` (reference ``hvd.load_model``,
+    ``keras/__init__.py:167`` — which deserializes its wrapped optimizer
+    via custom_objects; here the wrapper serializes under the base
+    optimizer's name, so a plain keras load + re-wrap is equivalent and
+    the file stays loadable without horovod installed).
+
+    Wrap settings (``average``/``sparse_as_dense``) are NOT stored in
+    the file (that is what keeps it stock-loadable): pass the same
+    values used at training time."""
+    tf = _tf()
+    model = tf.keras.models.load_model(path, custom_objects=custom_objects)
+    opt = getattr(model, "optimizer", None)
+    if opt is not None:
+        DistributedOptimizer(opt, average=average,
+                             sparse_as_dense=sparse_as_dense,
+                             process_set=process_set)
+    return model

@@ -191,3 +191,75 @@ class TestScalarOps:
 
         fn = hvd_tf.broadcast_object_fn(root_rank=0)
         assert fn({"a": 1}) == {"a": 1}
+
+
+class TestLoadModel:
+    """hvd.load_model parity (reference keras/__init__.py:167)."""
+
+    def test_save_load_rewraps_optimizer(self, hvd_module, tmp_path):
+        import tensorflow as tf
+
+        import horovod_tpu.interop.tf as hvd_tf
+
+        model = tf.keras.Sequential(
+            [tf.keras.layers.Dense(2, input_shape=(4,))]
+        )
+        opt = hvd_tf.DistributedOptimizer(tf.keras.optimizers.SGD(0.1))
+        assert getattr(opt, "_hvd_wrapped", False)
+        # serializes under the base name, not the wrapper's
+        assert type(opt).__name__ == "SGD"
+        model.compile(optimizer=opt, loss="mse")
+        model.fit(np.zeros((8, 4), np.float32),
+                  np.zeros((8, 2), np.float32), epochs=1, verbose=0)
+        path = str(tmp_path / "m.keras")
+        model.save(path)
+
+        loaded = hvd_tf.load_model(path)
+        assert getattr(loaded.optimizer, "_hvd_wrapped", False)
+        # still usable for training after the re-wrap
+        loaded.fit(np.zeros((8, 4), np.float32),
+                   np.zeros((8, 2), np.float32), epochs=1, verbose=0)
+
+    def test_plain_keras_can_load_the_file(self, hvd_module, tmp_path):
+        import tensorflow as tf
+
+        import horovod_tpu.interop.tf as hvd_tf
+
+        model = tf.keras.Sequential(
+            [tf.keras.layers.Dense(1, input_shape=(3,))]
+        )
+        model.compile(
+            optimizer=hvd_tf.DistributedOptimizer(
+                tf.keras.optimizers.Adam(1e-3)
+            ),
+            loss="mse",
+        )
+        path = str(tmp_path / "plain.keras")
+        model.save(path)
+        # no horovod involvement: the file must load with stock keras
+        loaded = tf.keras.models.load_model(path)
+        assert loaded.optimizer is not None
+        assert not getattr(loaded.optimizer, "_hvd_wrapped", False)
+
+    def test_double_wrap_is_idempotent(self, hvd_module):
+        import tensorflow as tf
+
+        import horovod_tpu.interop.tf as hvd_tf
+
+        opt = hvd_tf.DistributedOptimizer(tf.keras.optimizers.SGD(0.1))
+        assert hvd_tf.DistributedOptimizer(opt) is opt
+
+    def test_process_set_rejected(self, hvd_module):
+        import tensorflow as tf
+
+        import horovod_tpu.interop.tf as hvd_tf
+        from horovod_tpu.process_sets import ProcessSet
+
+        with pytest.raises(ValueError, match="process-level"):
+            hvd_tf.DistributedOptimizer(
+                tf.keras.optimizers.SGD(0.1), process_set=ProcessSet([0, 1])
+            )
+        with pytest.raises(ValueError, match="process-level"):
+            hvd_tf.DistributedGradientTape(
+                tf.GradientTape(), process_set=ProcessSet([0, 1])
+            )
