@@ -1,0 +1,22 @@
+"""Shared plumbing for the process-level interop bridges."""
+
+from __future__ import annotations
+
+
+def member_processes(process_set):
+    """Chip-rank process set -> (sorted member PROCESS indices, whether
+    this process participates).
+
+    The torch/TF gradient bridges reduce at the process level (one
+    framework model per host process); a process is a member when any
+    of its chips is in the set.  ``(None, True)`` for the global set.
+    """
+    from .. import runtime
+
+    rt = runtime.get_runtime()
+    if process_set is None:
+        return None, True
+    members = sorted({
+        rt.devices[r].process_index for r in process_set.ranks
+    })
+    return members, rt.process_rank in members

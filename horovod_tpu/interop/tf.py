@@ -24,6 +24,7 @@ import numpy as np
 
 from .. import functions as _functions
 from ..ops import eager as _eager
+from ._common import member_processes as _member_processes
 
 
 def _tf():
@@ -47,13 +48,19 @@ def _is_single_process() -> bool:
     return runtime.get_runtime().process_count == 1
 
 
-def _process_reduce(arr: np.ndarray, average: bool) -> np.ndarray:
+def _process_reduce(arr: np.ndarray, average: bool,
+                    member_procs=None) -> np.ndarray:
     """Process-level mean/sum (the torch-bridge lowering: one flat
-    gather across controllers, reduced locally)."""
+    gather across controllers, reduced locally).  ``member_procs``
+    limits the reduction rows to a process subset — the allgather is
+    still collective (every process calls it), matching the masked
+    pass-through contract."""
     import jax.numpy as jnp
     from jax.experimental import multihost_utils
 
     gathered = multihost_utils.process_allgather(jnp.asarray(arr))
+    if member_procs is not None:
+        gathered = gathered[jnp.asarray(member_procs)]
     red = gathered.mean(axis=0) if average else gathered.sum(axis=0)
     return np.asarray(red)
 
@@ -190,14 +197,15 @@ def broadcast_variables(variables, root_rank: int = 0):
 
 # ---- gradient reduction (DistributedGradientTape / DistributedOptimizer)
 
-def _reduce_grads(tf, grads: List[Any], average: bool) -> List[Any]:
+def _reduce_grads(tf, grads: List[Any], average: bool,
+                  process_set=None) -> List[Any]:
     """Fused process-level reduction of a gradient list; IndexedSlices
-    entries reduce as gathered slices (never densified on the wire)."""
+    entries reduce as gathered slices (never densified on the wire).
+    With ``process_set``, only member processes' rows reduce and
+    non-members keep their local gradients (masked pass-through)."""
     if _is_single_process():
         return list(grads)
-    from .. import runtime
-
-    rt = runtime.get_runtime()
+    member_procs, included = _member_processes(process_set)
     out: List[Any] = list(grads)
     dense_idx = [
         i for i, g in enumerate(grads)
@@ -210,7 +218,10 @@ def _reduce_grads(tf, grads: List[Any], average: bool) -> List[Any]:
     for dtype_name, idxs in by_dtype.items():
         flats = [np.asarray(grads[i]).reshape(-1) for i in idxs]
         splits = np.cumsum([f.size for f in flats])[:-1]
-        red = _process_reduce(np.concatenate(flats), average)
+        red = _process_reduce(np.concatenate(flats), average,
+                              member_procs)
+        if not included:
+            continue  # non-member: keep local grads (pass-through)
         for i, piece in zip(idxs, np.split(red, splits)):
             out[i] = tf.constant(
                 piece.reshape(np.asarray(grads[i]).shape), grads[i].dtype
@@ -221,10 +232,14 @@ def _reduce_grads(tf, grads: List[Any], average: bool) -> List[Any]:
             vals = _functions.allgather_object(
                 (np.asarray(g.indices), np.asarray(g.values))
             )
+            if member_procs is not None:
+                vals = [vals[p] for p in member_procs]
+            if not included:
+                continue
             indices = np.concatenate([v[0] for v in vals])
             values = np.concatenate([v[1] for v in vals])
             if average:
-                values = values / rt.process_count
+                values = values / len(vals)
             out[i] = tf.IndexedSlices(
                 values=tf.constant(values),
                 indices=tf.constant(indices),
@@ -239,9 +254,9 @@ class DistributedGradientTape:
 
     def __init__(self, tape, average: bool = True, process_set=None,
                  sparse_as_dense: bool = False):
-        _check_process_set(process_set)
         self._tape = tape
         self._average = average
+        self._process_set = process_set
         self._sparse_as_dense = sparse_as_dense
 
     def __getattr__(self, name):
@@ -260,22 +275,8 @@ class DistributedGradientTape:
                 for g in flat
             ]
         return tf.nest.pack_sequence_as(
-            grads, _reduce_grads(tf, flat, self._average)
-        )
-
-
-def _check_process_set(process_set) -> None:
-    """The TF/torch *gradient* bridges reduce at the PROCESS level
-    (multihost gather); chip-rank process sets do not map onto that
-    plane, so rather than silently reducing over the wrong group the
-    argument is rejected — use the JAX surface (or the eager
-    collectives, which support process sets fully) for subset
-    training."""
-    if process_set is not None:
-        raise ValueError(
-            "process_set is not supported by the process-level gradient "
-            "reduction bridges; use the JAX training surface or eager "
-            "collectives for process-set-scoped reductions"
+            grads,
+            _reduce_grads(tf, flat, self._average, self._process_set),
         )
 
 
@@ -287,8 +288,9 @@ def DistributedOptimizer(optimizer, average: bool = True,
     Idempotent: an already-wrapped optimizer is returned unchanged
     (the wrapper masquerades under the base class name for
     serialization, so callers cannot reliably detect wrapping
-    themselves)."""
-    _check_process_set(process_set)
+    themselves).  ``process_set`` scopes the reduction to the member
+    PROCESSES of the chip-rank set (non-members apply local grads —
+    the torch bridge's mapping)."""
     if getattr(optimizer, "_hvd_wrapped", False):
         return optimizer
     tf = _tf()
@@ -305,7 +307,7 @@ def DistributedOptimizer(optimizer, average: bool = True,
                     if isinstance(g, tf.IndexedSlices) else g
                     for g in grads
                 ]
-            reduced = _reduce_grads(tf, grads, average)
+            reduced = _reduce_grads(tf, grads, average, process_set)
             return super().apply_gradients(
                 zip(reduced, [v for _, v in pairs]), **kwargs
             )

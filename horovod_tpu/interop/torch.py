@@ -510,19 +510,12 @@ class _DistributedOptimizer:
             raise ValueError(
                 "torch DistributedOptimizer supports op=Average or Sum"
             )
-        member_procs = None
-        apply_result = True
-        if self._process_set is not None:
-            from .. import runtime
+        from ._common import member_processes
 
-            rt = runtime.get_runtime()
-            member_procs = sorted({
-                rt.devices[r].process_index for r in self._process_set.ranks
-            })
-            # process_allgather is collective: every process must call
-            # it; non-members just discard the result and keep their
-            # local grads (the masked pass-through contract).
-            apply_result = rt.process_rank in member_procs
+        # process_allgather is collective: every process must call it;
+        # non-members just discard the result and keep their local
+        # grads (the masked pass-through contract).
+        member_procs, apply_result = member_processes(self._process_set)
         by_dtype: Dict[Any, list] = {}
         for p in params:
             by_dtype.setdefault(p.grad.dtype, []).append(p)

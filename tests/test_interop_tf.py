@@ -134,6 +134,40 @@ def test_multiprocess_tape_averages():
     np.testing.assert_allclose(results, [[1.5, 1.5], [1.5, 1.5]])
 
 
+def test_multiprocess_tape_process_set_subset():
+    """Two processes, a set containing only rank 0: process 0 reduces
+    over itself, process 1 keeps local grads (masked pass-through)."""
+    import sys
+
+    import cloudpickle
+
+    import horovod_tpu.runner as runner
+
+    def worker():
+        import tensorflow as tf
+
+        import horovod_tpu as hvd
+        import horovod_tpu.interop.tf as hvd_tf
+
+        hvd.init()
+        ps = hvd.add_process_set([0])
+        scale = float(hvd.process_rank() + 1)  # grads: 1x vs 2x
+        w = tf.Variable([[1.0], [1.0]])
+        with tf.GradientTape() as tape:
+            loss = scale * tf.reduce_sum(tf.matmul(tf.ones((1, 2)), w))
+        dtape = hvd_tf.DistributedGradientTape(tape, process_set=ps)
+        (g,) = dtape.gradient(loss, [w])
+        return g.numpy().reshape(-1).tolist()
+
+    cloudpickle.register_pickle_by_value(sys.modules[__name__])
+    results = runner.run(
+        worker, np=2, use_cpu_devices=True,
+        extra_env={"HVD_TPU_DYNAMIC_PROCESS_SETS": "1"},
+    )
+    np.testing.assert_allclose(results[0], [1.0, 1.0])  # member: own mean
+    np.testing.assert_allclose(results[1], [2.0, 2.0])  # non-member: local
+
+
 def test_keras_model_end_to_end(hvd_module):
     """Full reference-style TF training recipe: broadcast_variables +
     DistributedGradientTape + DistributedOptimizer on a keras Model."""
@@ -249,17 +283,19 @@ class TestLoadModel:
         opt = hvd_tf.DistributedOptimizer(tf.keras.optimizers.SGD(0.1))
         assert hvd_tf.DistributedOptimizer(opt) is opt
 
-    def test_process_set_rejected(self, hvd_module):
+    def test_process_set_single_process_passthrough(self, hvd_module,
+                                                    monkeypatch):
+        """Single process: subset reduction degenerates to identity."""
         import tensorflow as tf
 
         import horovod_tpu.interop.tf as hvd_tf
-        from horovod_tpu.process_sets import ProcessSet
 
-        with pytest.raises(ValueError, match="process-level"):
-            hvd_tf.DistributedOptimizer(
-                tf.keras.optimizers.SGD(0.1), process_set=ProcessSet([0, 1])
-            )
-        with pytest.raises(ValueError, match="process-level"):
-            hvd_tf.DistributedGradientTape(
-                tf.GradientTape(), process_set=ProcessSet([0, 1])
-            )
+        monkeypatch.setenv("HVD_TPU_DYNAMIC_PROCESS_SETS", "1")
+        ps = hvd.add_process_set([0, 1])
+        w = tf.Variable([[1.0], [2.0]])
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_sum(tf.matmul(tf.ones((1, 2)), w))
+        dtape = hvd_tf.DistributedGradientTape(tape, process_set=ps)
+        (g,) = dtape.gradient(loss, [w])
+        np.testing.assert_allclose(g.numpy(), [[1.0], [1.0]])
+        hvd.remove_process_set(ps)
