@@ -292,6 +292,14 @@ def DistributedOptimizer(optimizer, average: bool = True,
     PROCESSES of the chip-rank set (non-members apply local grads —
     the torch bridge's mapping)."""
     if getattr(optimizer, "_hvd_wrapped", False):
+        want = {"average": average, "sparse_as_dense": sparse_as_dense,
+                "process_set": process_set}
+        if getattr(optimizer, "_hvd_wrap_config", None) != want:
+            raise ValueError(
+                "optimizer is already wrapped with different settings "
+                f"({optimizer._hvd_wrap_config} vs requested {want}); "
+                "wrap the base optimizer instead"
+            )
         return optimizer
     tf = _tf()
 
@@ -321,6 +329,9 @@ def DistributedOptimizer(optimizer, average: bool = True,
     _Wrapped.__module__ = optimizer.__class__.__module__
     obj = optimizer  # share all state with the wrapped instance
     obj.__class__ = _Wrapped
+    obj._hvd_wrap_config = {"average": average,
+                            "sparse_as_dense": sparse_as_dense,
+                            "process_set": process_set}
     return obj
 
 

@@ -46,6 +46,22 @@ def test_torch_mnist_example():
     assert "loss" in out.lower()
 
 
+def test_lightning_mnist_example(tmp_path):
+    pytest.importorskip("torch")
+    out = _run_example("lightning_mnist.py", "--epochs", "1",
+                       "--batch-size", "32", "--num-samples", "256",
+                       "--store", str(tmp_path / "ls"))
+    assert "val_loss" in out
+
+
+def test_estimator_mnist_example(tmp_path):
+    pytest.importorskip("torch")
+    out = _run_example("estimator_mnist.py", "--epochs", "1",
+                       "--store", str(tmp_path / "es"), timeout=600)
+    assert "keras-style history" in out
+    assert "resumed for 1 new epoch(s)" in out
+
+
 def test_tf2_keras_mnist_example():
     pytest.importorskip("tensorflow")
     out = _run_example("tf2_keras_mnist.py", "--epochs", "1",

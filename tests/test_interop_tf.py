@@ -283,6 +283,15 @@ class TestLoadModel:
         opt = hvd_tf.DistributedOptimizer(tf.keras.optimizers.SGD(0.1))
         assert hvd_tf.DistributedOptimizer(opt) is opt
 
+    def test_rewrap_with_different_settings_raises(self, hvd_module):
+        import tensorflow as tf
+
+        import horovod_tpu.interop.tf as hvd_tf
+
+        opt = hvd_tf.DistributedOptimizer(tf.keras.optimizers.SGD(0.1))
+        with pytest.raises(ValueError, match="different settings"):
+            hvd_tf.DistributedOptimizer(opt, sparse_as_dense=True)
+
     def test_process_set_single_process_passthrough(self, hvd_module,
                                                     monkeypatch):
         """Single process: subset reduction degenerates to identity."""
