@@ -1,0 +1,122 @@
+"""Dtype sweep across the framework bindings (reference
+``test/parallel/test_torch.py``/``test_tensorflow.py`` enumerate every
+supported dtype per op; this sweeps the binding bridges — the eager
+layer itself is swept in test_collective_matrix.py)."""
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+
+torch = pytest.importorskip("torch")
+
+import horovod_tpu.interop.torch as hvd_torch  # noqa: E402
+
+N = 8
+
+TORCH_DTYPES = [torch.float32, torch.float16, torch.bfloat16, torch.int32]
+
+
+def _tol(dtype):
+    if dtype in (torch.float16, torch.bfloat16):
+        return dict(rtol=1e-2, atol=1e-2)
+    return dict(rtol=1e-5, atol=1e-6)
+
+
+class TestTorchDtypes:
+    @pytest.fixture(autouse=True)
+    def _seed(self):
+        torch.manual_seed(0)
+
+    @pytest.mark.parametrize("dtype", TORCH_DTYPES, ids=str)
+    def test_allreduce_sum(self, hvd_module, dtype):
+        if dtype.is_floating_point:
+            t = torch.rand(N, 5).to(dtype)
+        else:
+            t = torch.randint(0, 7, (N, 5), dtype=dtype)
+        out = hvd_torch.allreduce(t, op=hvd.Sum)
+        assert out.dtype == dtype
+        expect = t.to(torch.float64).sum(0)
+        for r in range(N):
+            np.testing.assert_allclose(
+                out[r].to(torch.float64).numpy(), expect.numpy(),
+                **_tol(dtype),
+            )
+
+    @pytest.mark.parametrize("dtype", TORCH_DTYPES, ids=str)
+    def test_broadcast(self, hvd_module, dtype):
+        if dtype.is_floating_point:
+            t = torch.arange(N, dtype=torch.float32).reshape(N, 1).to(dtype)
+        else:
+            t = torch.arange(N, dtype=dtype).reshape(N, 1)
+        out = hvd_torch.broadcast(t, root_rank=3)
+        assert out.dtype == dtype
+        np.testing.assert_allclose(out.to(torch.float64).numpy(), 3.0)
+
+    @pytest.mark.parametrize("dtype",
+                             [torch.float32, torch.bfloat16], ids=str)
+    def test_allgather(self, hvd_module, dtype):
+        t = torch.ones(N, 2, 3).to(dtype)
+        out = hvd_torch.allgather(t)
+        assert out.dtype == dtype
+        assert out.shape == (N, N * 2, 3)
+
+    def test_grouped_mixed_dtypes(self, hvd_module):
+        ts = [torch.ones(N, 2), torch.ones(N, 3, dtype=torch.bfloat16)]
+        outs = hvd_torch.grouped_allreduce(ts, op=hvd.Average)
+        assert outs[0].dtype == torch.float32
+        assert outs[1].dtype == torch.bfloat16
+        np.testing.assert_allclose(outs[0].numpy(), 1.0)
+
+
+class TestTFDtypes:
+    @pytest.fixture(autouse=True)
+    def _tf(self):
+        self.tf = pytest.importorskip("tensorflow")
+        import horovod_tpu.interop.tf as hvd_tf
+
+        self.hvd_tf = hvd_tf
+
+    @pytest.mark.parametrize("np_dtype",
+                             [np.float32, np.float16, np.int32], ids=str)
+    def test_allreduce_sum(self, hvd_module, np_dtype):
+        tf = self.tf
+        if np.issubdtype(np_dtype, np.floating):
+            x = tf.constant(
+                np.random.RandomState(0).rand(N, 4).astype(np_dtype)
+            )
+        else:
+            x = tf.constant(
+                np.random.RandomState(0).randint(0, 7, (N, 4)), np_dtype
+            )
+        y = self.hvd_tf.allreduce(x, op=hvd.Sum)
+        assert y.dtype == x.dtype
+        expect = np.asarray(x).astype(np.float64).sum(0)
+        tol = 1e-2 if np_dtype == np.float16 else 1e-5
+        for r in range(N):
+            np.testing.assert_allclose(
+                y.numpy()[r].astype(np.float64), expect, rtol=tol, atol=tol
+            )
+
+
+class TestMXNetDtypes:
+    @pytest.mark.parametrize("np_dtype", [np.float32, np.int32], ids=str)
+    def test_allreduce_sum(self, hvd_module, monkeypatch, np_dtype):
+        from test_interop_mxnet import FakeNDArray, _install_fake_mxnet
+
+        _install_fake_mxnet(monkeypatch)
+        import horovod_tpu.interop.mxnet as hvd_mx
+
+        if np.issubdtype(np_dtype, np.floating):
+            rows = np.random.RandomState(0).rand(N, 3).astype(np_dtype)
+        else:
+            rows = np.random.RandomState(0).randint(0, 7, (N, 3)).astype(
+                np_dtype
+            )
+        out = hvd_mx.allreduce(FakeNDArray(rows), average=False)
+        assert out.dtype == np_dtype
+        expect = rows.astype(np.float64).sum(0)
+        for r in range(N):
+            np.testing.assert_allclose(
+                out.asnumpy()[r].astype(np.float64), expect, rtol=1e-5
+            )
