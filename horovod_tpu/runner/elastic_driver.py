@@ -29,6 +29,7 @@ Worker exit-code contract (read by this driver):
 
 from __future__ import annotations
 
+import os
 import secrets as pysecrets
 import threading
 import time
@@ -43,6 +44,34 @@ from .launch import free_port, make_worker_env
 RESTART_CODE = 73
 
 DISCOVERY_PERIOD_S = 1.0  # reference driver.py:30
+
+
+def _with_compilation_cache(extra_env):
+    """Default a job-scoped persistent XLA compilation cache into the
+    worker env (recompilation dominates respawn-per-round restart cost
+    on TPU; measured in tests/integration/test_elastic.py::
+    test_elastic_restart_cost_bounded).
+
+    Precedence: HVD_TPU_NO_COMPILATION_CACHE=1 disables; an explicit
+    extra_env dir wins; a driver-environment dir is COPIED into the
+    worker env (remote ssh workers never inherit the driver
+    environment); otherwise a fresh temp dir is created and returned
+    for end-of-job cleanup.  Returns (env, created_dir_or_None).
+    """
+    env = dict(extra_env or {})
+    if (os.environ.get("HVD_TPU_NO_COMPILATION_CACHE", "") == "1"
+            or "JAX_COMPILATION_CACHE_DIR" in env):
+        return env, None
+    if "JAX_COMPILATION_CACHE_DIR" in os.environ:
+        env["JAX_COMPILATION_CACHE_DIR"] = (
+            os.environ["JAX_COMPILATION_CACHE_DIR"]
+        )
+        return env, None
+    import tempfile
+
+    created = tempfile.mkdtemp(prefix="hvd_tpu_xla_cache_")
+    env["JAX_COMPILATION_CACHE_DIR"] = created
+    return env, created
 
 
 class ElasticDriver:
@@ -128,6 +157,13 @@ class ElasticDriver:
         reach workers (e.g. ``task_runner`` fetches ``__run__/func``),
         mirroring ``horovod.run``'s KV-store func delivery.
         """
+        # Respawn-per-round makes recompilation the dominant restart
+        # cost on TPU; a job-scoped persistent XLA compilation cache
+        # turns round-2+ compiles into cache reads (measured in
+        # tests/integration/test_elastic.py::test_elastic_restart_cost
+        # _bounded).  Opt out with HVD_TPU_NO_COMPILATION_CACHE=1 or by
+        # setting JAX_COMPILATION_CACHE_DIR yourself.
+        extra_env, created_cache_dir = _with_compilation_cache(extra_env)
         secret = pysecrets.token_hex(16)
         server = controller_py.make_server(secret, self.min_np)
         control = controller_py.make_client(
@@ -202,6 +238,12 @@ class ElasticDriver:
             control.close()
             server.stop()
             self.stop()
+            if created_cache_dir is not None:
+                # job-scoped cache (a fresh dir per job): useless after
+                # the job and easily GBs of XLA programs — remove it
+                import shutil
+
+                shutil.rmtree(created_cache_dir, ignore_errors=True)
 
     def _watch_round(
         self,

@@ -226,3 +226,38 @@ def test_config_parser_apostrophe_in_value(tmp_path):
     parsed = parse_config_file(str(cfg))
     assert parsed["timeline"]["filename"] == "user's tl.json"
     assert parsed["timeline"]["quoted"] == "#literal"
+
+
+def test_elastic_driver_defaults_compilation_cache(monkeypatch, tmp_path):
+    """_with_compilation_cache: job-scoped default, explicit dir wins,
+    driver-env dir is copied for remote workers, opt-out respected."""
+    from horovod_tpu.runner.elastic_driver import _with_compilation_cache
+
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR", raising=False)
+    monkeypatch.delenv("HVD_TPU_NO_COMPILATION_CACHE", raising=False)
+
+    env, created = _with_compilation_cache({})
+    assert created is not None and "hvd_tpu_xla_cache_" in created
+    assert env["JAX_COMPILATION_CACHE_DIR"] == created
+    import shutil
+
+    shutil.rmtree(created, ignore_errors=True)
+
+    # explicit user dir wins, nothing created
+    env, created = _with_compilation_cache(
+        {"JAX_COMPILATION_CACHE_DIR": "/x"}
+    )
+    assert created is None and env["JAX_COMPILATION_CACHE_DIR"] == "/x"
+
+    # driver-env dir is COPIED into the worker env (remote ssh workers
+    # never inherit the driver environment), not merely skipped
+    monkeypatch.setenv("JAX_COMPILATION_CACHE_DIR", "/driver/cache")
+    env, created = _with_compilation_cache({})
+    assert created is None
+    assert env["JAX_COMPILATION_CACHE_DIR"] == "/driver/cache"
+    monkeypatch.delenv("JAX_COMPILATION_CACHE_DIR")
+
+    # opt-out respected
+    monkeypatch.setenv("HVD_TPU_NO_COMPILATION_CACHE", "1")
+    env, created = _with_compilation_cache({})
+    assert created is None and "JAX_COMPILATION_CACHE_DIR" not in env
