@@ -1,0 +1,82 @@
+"""Multi-process collective fuzz: a seeded random op sequence executed
+by 2 real processes, checked against numpy (reference analog: the
+randomized sweeps in ``test/parallel/test_torch.py`` run under real
+MPI workers rather than a single process).
+
+Every process derives the SAME global arrays from the seed, submits its
+process-local rows, and checks the returned rows against the numpy
+reduction of the global array — exercising ordering, dtype handling,
+and the multi-controller dispatch path under a long mixed workload.
+"""
+
+import sys
+
+import cloudpickle
+import numpy as np
+
+import horovod_tpu.runner as runner
+
+N_OPS = 24
+
+
+def _worker():
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    hvd.init()
+    size = hvd.size()
+    me = hvd.process_rank()
+    nproc = hvd.process_count()
+    rows_per_proc = size // nproc
+    lo = me * rows_per_proc
+    hi = lo + rows_per_proc
+
+    rng = np.random.RandomState(1234)  # same stream on every process
+    failures = []
+    for i in range(N_OPS):
+        op = rng.choice(["allreduce_avg", "allreduce_sum", "allgather",
+                         "broadcast", "alltoall"])
+        dtype = rng.choice([np.float32, np.int32])
+        cols = int(rng.randint(1, 6))
+        if dtype == np.float32:
+            full = rng.rand(size, size, cols).astype(dtype)
+        else:
+            full = rng.randint(0, 9, (size, size, cols)).astype(dtype)
+        root = int(rng.randint(0, size))
+        local = full[lo:hi]
+
+        if op == "allreduce_avg":
+            out = np.asarray(hvd.allreduce(local, average=True))
+            want = full.astype(np.float64).mean(axis=0)
+            if dtype == np.int32:
+                want = np.trunc(want)
+            want = np.broadcast_to(want, local.shape)
+        elif op == "allreduce_sum":
+            out = np.asarray(hvd.allreduce(local, op=hvd.Sum))
+            want = np.broadcast_to(
+                full.astype(np.float64).sum(axis=0), local.shape
+            )
+        elif op == "allgather":
+            out = np.asarray(hvd.allgather(local))
+            want = np.broadcast_to(
+                full.reshape(size * size, cols),
+                (rows_per_proc, size * size, cols),
+            )
+        elif op == "broadcast":
+            out = np.asarray(hvd.broadcast(local, root_rank=root))
+            want = np.broadcast_to(full[root], local.shape)
+        else:  # alltoall: even split, row r chunk j -> row j
+            out = np.asarray(hvd.alltoall(local))
+            want = full.transpose(1, 0, 2)[lo:hi]
+        if not np.allclose(out.astype(np.float64), want, rtol=1e-4,
+                           atol=1e-4):
+            failures.append((i, str(op), str(np.dtype(dtype))))
+    hvd.shutdown()
+    return failures
+
+
+def test_two_process_fuzz():
+    cloudpickle.register_pickle_by_value(sys.modules[__name__])
+    results = runner.run(_worker, np=2, use_cpu_devices=True)
+    assert results[0] == [] and results[1] == [], results
