@@ -114,9 +114,17 @@ class ElasticDriver:
         if self._discovery_thread:
             self._discovery_thread.join(timeout=5)
 
-    def wait_for_available_slots(self, min_np: int, timeout_s: float = 600) -> bool:
+    def wait_for_available_slots(
+        self, min_np: int, timeout_s: Optional[float] = None
+    ) -> bool:
         """Block until the discovered world can host min_np workers
-        (reference ``wait_for_available_slots``)."""
+        (reference ``wait_for_available_slots``; timeout from
+        ``HVD_TPU_ELASTIC_TIMEOUT`` / ``HOROVOD_ELASTIC_TIMEOUT``,
+        default 600 s like reference ``ELASTIC_TIMEOUT_SECS``)."""
+        if timeout_s is None:
+            from ..utils import env as hvd_env
+
+            timeout_s = hvd_env.get_int("ELASTIC_TIMEOUT", 600)
         deadline = time.monotonic() + timeout_s
         while time.monotonic() < deadline:
             if self.host_manager.available_slots() >= min_np:

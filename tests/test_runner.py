@@ -261,3 +261,27 @@ def test_elastic_driver_defaults_compilation_cache(monkeypatch, tmp_path):
     monkeypatch.setenv("HVD_TPU_NO_COMPILATION_CACHE", "1")
     env, created = _with_compilation_cache({})
     assert created is None and "JAX_COMPILATION_CACHE_DIR" not in env
+
+
+def test_elastic_timeout_env_knob(monkeypatch):
+    """HVD_TPU_ELASTIC_TIMEOUT / HOROVOD_ELASTIC_TIMEOUT set the
+    wait_for_available_slots deadline (reference ELASTIC_TIMEOUT_SECS)."""
+    from horovod_tpu.runner.elastic_driver import ElasticDriver
+
+    class NoSlots:
+        def available_slots(self):
+            return 0
+
+        current_hosts = {}
+
+    drv = ElasticDriver.__new__(ElasticDriver)
+    drv.host_manager = NoSlots()
+    import threading
+
+    drv._shutdown = threading.Event()
+    monkeypatch.setenv("HVD_TPU_ELASTIC_TIMEOUT", "0")
+    import time
+
+    t0 = time.monotonic()
+    assert not drv.wait_for_available_slots(2)
+    assert time.monotonic() - t0 < 2.0  # returned at the env deadline
