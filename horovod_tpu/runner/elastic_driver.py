@@ -124,15 +124,16 @@ class ElasticDriver:
         if timeout_s is None:
             from ..utils import env as hvd_env
 
-            timeout_s = hvd_env.get_int("ELASTIC_TIMEOUT", 600)
+            timeout_s = hvd_env.get_float(hvd_env.ELASTIC_TIMEOUT, 600.0)
         deadline = time.monotonic() + timeout_s
-        while time.monotonic() < deadline:
+        while True:
+            # slots first, deadline second: a zero timeout must still
+            # succeed immediately when capacity is already there
             if self.host_manager.available_slots() >= min_np:
                 return True
-            if self._shutdown.is_set():
+            if self._shutdown.is_set() or time.monotonic() >= deadline:
                 return False
             time.sleep(DISCOVERY_PERIOD_S)
-        return False
 
     def current_assignments(self) -> List[hosts_mod.SlotInfo]:
         hosts = [

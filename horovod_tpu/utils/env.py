@@ -31,6 +31,7 @@ STALL_CHECK_DISABLE = "STALL_CHECK_DISABLE"
 STALL_CHECK_TIME_SECONDS = "STALL_CHECK_TIME_SECONDS"
 STALL_SHUTDOWN_TIME_SECONDS = "STALL_SHUTDOWN_TIME_SECONDS"
 ELASTIC_ENABLED = "ELASTIC"
+ELASTIC_TIMEOUT = "ELASTIC_TIMEOUT"
 DYNAMIC_PROCESS_SETS = "DYNAMIC_PROCESS_SETS"
 HIERARCHICAL_ALLREDUCE = "HIERARCHICAL_ALLREDUCE"  # reference HOROVOD_HIERARCHICAL_ALLREDUCE
 # Payload bytes above which arbitrary (non-partition) process-set

@@ -285,3 +285,20 @@ def test_elastic_timeout_env_knob(monkeypatch):
     t0 = time.monotonic()
     assert not drv.wait_for_available_slots(2)
     assert time.monotonic() - t0 < 2.0  # returned at the env deadline
+
+    # fractional timeouts parse (get_float, not get_int)
+    monkeypatch.setenv("HVD_TPU_ELASTIC_TIMEOUT", "0.5")
+    t0 = time.monotonic()
+    assert not drv.wait_for_available_slots(2)
+    assert time.monotonic() - t0 < 3.0
+
+    # zero timeout still succeeds when capacity is already there
+    class HasSlots:
+        def available_slots(self):
+            return 4
+
+        current_hosts = {}
+
+    drv.host_manager = HasSlots()
+    monkeypatch.setenv("HVD_TPU_ELASTIC_TIMEOUT", "0")
+    assert drv.wait_for_available_slots(2)
