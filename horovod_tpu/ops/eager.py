@@ -380,6 +380,17 @@ def grouped_allreduce(
         raise ValueError("specify either average or op, not both")
     if op is None:
         op = Average if (average is None or average) else Sum
+    from ..utils import env as _env
+
+    if _env.get_bool(_env.DISABLE_GROUP_FUSION):
+        # Reference HOROVOD_DISABLE_GROUP_FUSION: ordered, unfused.
+        return [
+            allreduce(x, op=op, prescale_factor=prescale_factor,
+                      postscale_factor=postscale_factor,
+                      process_set=process_set,
+                      name=f"{name}.{i}" if name else None)
+            for i, x in enumerate(xs)
+        ]
     pairs = [_stacked(x) for x in xs]
     xs = [p[0] for p in pairs]
     _record(name, "GROUPED_ALLREDUCE", sum(x.nbytes for x in xs))

@@ -386,6 +386,17 @@ def grouped_allreduce(
     buffers — the explicit analog of the reference's fusion buffer — so
     the group completes as one XLA collective per dtype.
     """
+    if env.get_bool(env.DISABLE_GROUP_FUSION):
+        # Reference HOROVOD_DISABLE_GROUP_FUSION: keep the group atomic
+        # in ORDER but issue one collective per tensor (debugging aid
+        # when a fused flat buffer obscures a numeric issue).
+        return [
+            allreduce(
+                x, axis=axis, op=op, prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor, process_set=process_set,
+            )
+            for x in xs
+        ]
     from .fusion import flatten_group, unflatten_group
 
     flats, meta = flatten_group(xs)

@@ -131,9 +131,12 @@ class ElasticDriver:
             # succeed immediately when capacity is already there
             if self.host_manager.available_slots() >= min_np:
                 return True
-            if self._shutdown.is_set() or time.monotonic() >= deadline:
+            remaining = deadline - time.monotonic()
+            if self._shutdown.is_set() or remaining <= 0:
                 return False
-            time.sleep(DISCOVERY_PERIOD_S)
+            # shutdown-responsive sleep, clipped so fractional timeouts
+            # are honored instead of overshooting by a full period
+            self._shutdown.wait(min(DISCOVERY_PERIOD_S, remaining))
 
     def current_assignments(self) -> List[hosts_mod.SlotInfo]:
         hosts = [

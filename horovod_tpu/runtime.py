@@ -170,8 +170,16 @@ class Runtime:
             # coordination service (e.g. re-init in elastic mode after the
             # launcher set it up) is fine.
             try:
+                # HVD_TPU_START_TIMEOUT / HOROVOD_START_TIMEOUT bounds
+                # the rendezvous wait (reference horovod_start_timeout,
+                # common.h; its 30 s default is too tight for TPU
+                # runtime bring-up, so JAX's 300 s default stands).
                 jax.distributed.initialize(
-                    coordinator_address=coord, num_processes=nproc, process_id=pid
+                    coordinator_address=coord, num_processes=nproc,
+                    process_id=pid,
+                    initialization_timeout=env.get_int(
+                        env.START_TIMEOUT, 300
+                    ),
                 )
                 self._owns_distributed = True
             except RuntimeError as e:

@@ -32,6 +32,8 @@ STALL_CHECK_TIME_SECONDS = "STALL_CHECK_TIME_SECONDS"
 STALL_SHUTDOWN_TIME_SECONDS = "STALL_SHUTDOWN_TIME_SECONDS"
 ELASTIC_ENABLED = "ELASTIC"
 ELASTIC_TIMEOUT = "ELASTIC_TIMEOUT"
+START_TIMEOUT = "START_TIMEOUT"
+DISABLE_GROUP_FUSION = "DISABLE_GROUP_FUSION"
 DYNAMIC_PROCESS_SETS = "DYNAMIC_PROCESS_SETS"
 HIERARCHICAL_ALLREDUCE = "HIERARCHICAL_ALLREDUCE"  # reference HOROVOD_HIERARCHICAL_ALLREDUCE
 # Payload bytes above which arbitrary (non-partition) process-set

@@ -269,3 +269,48 @@ class TestGroupedOps:
                 np.testing.assert_allclose(
                     np.asarray(y)[r].astype(np.float64), expect, rtol=1e-5
                 )
+
+
+class TestGroupFusionKnob:
+    def test_disable_group_fusion_matches_fused(self, hvd_module,
+                                                monkeypatch):
+        """HOROVOD_DISABLE_GROUP_FUSION: same numerics, unfused lowering
+        (reference knob of the same name)."""
+        xs = [_data(np.float32, shape=(N, s), seed=s) for s in (3, 5)]
+        fused = [np.asarray(y) for y in hvd.grouped_allreduce(xs, op=hvd.Sum)]
+        monkeypatch.setenv("HVD_TPU_DISABLE_GROUP_FUSION", "1")
+        unfused = [np.asarray(y)
+                   for y in hvd.grouped_allreduce(xs, op=hvd.Sum)]
+        for a, b in zip(fused, unfused):
+            np.testing.assert_allclose(a, b, rtol=1e-6)
+
+    def test_disable_group_fusion_traced(self, hvd_module, monkeypatch):
+        import jax
+
+        from horovod_tpu.ops import traced
+
+        xs = [np.ones((4, 3), np.float32), np.ones((4, 2), np.float32)]
+
+        def run():
+            def f(*ts):
+                return tuple(
+                    traced.grouped_allreduce(list(ts), op=traced.Sum)
+                )
+
+            from jax.sharding import PartitionSpec as P
+
+            from horovod_tpu.runtime import WORLD_AXIS, get_runtime
+            mesh = get_runtime().mesh
+            spec = P(WORLD_AXIS)
+            return [
+                np.asarray(y) for y in jax.jit(jax.shard_map(
+                    f, mesh=mesh, in_specs=(spec, spec),
+                    out_specs=(spec, spec), check_vma=False,
+                ))(*[np.tile(x, (2, 1)) for x in xs])
+            ]
+
+        fused = run()
+        monkeypatch.setenv("HVD_TPU_DISABLE_GROUP_FUSION", "1")
+        unfused = run()
+        for a, b in zip(fused, unfused):
+            np.testing.assert_allclose(a, b, rtol=1e-6)
