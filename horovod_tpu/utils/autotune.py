@@ -40,11 +40,20 @@ class FusionAutotuner:
         self,
         low_bytes: int = 1 << 16,
         high_bytes: int = 1 << 28,
-        warmup_windows: int = 10,
+        warmup_windows: Optional[int] = None,
         log_path: Optional[str] = None,
     ):
         self.low = math.log2(low_bytes)
         self.high = math.log2(high_bytes)
+        if warmup_windows is None:
+            # Reference sub-knobs honored through the env layer:
+            # AUTOTUNE_WARMUP_SAMPLES sets the explore budget and
+            # AUTOTUNE_BAYES_OPT_MAX_SAMPLES caps total GP samples
+            # (parameter_manager.h:42-105 tunables of the same names).
+            warmup_windows = env.get_int(
+                "AUTOTUNE_WARMUP_SAMPLES",
+                env.get_int("AUTOTUNE_BAYES_OPT_MAX_SAMPLES", 10),
+            )
         self.warmup_windows = warmup_windows
         self._windows = 0
         self._frozen: Optional[int] = None
@@ -126,7 +135,10 @@ class AutotuneDriver:
 
         self._time = _time
         self.tuner = FusionAutotuner(**tuner_kwargs)
-        self.window_steps = window_steps or env.get_int("AUTOTUNE_WINDOW", 16)
+        self.window_steps = window_steps or env.get_int(
+            "AUTOTUNE_WINDOW",
+            env.get_int("AUTOTUNE_STEPS_PER_SAMPLE", 16),
+        )
         self._steps_in_window = 0
         self._t0: Optional[float] = None
 

@@ -32,3 +32,22 @@ def test_autotuner_log(tmp_path):
         tuner.observe(_synthetic_score(tuner.threshold_bytes()))
     lines = log.read_text().strip().splitlines()
     assert len(lines) == 3
+
+
+def test_reference_autotune_subknobs(monkeypatch):
+    """HOROVOD_AUTOTUNE_WARMUP_SAMPLES / _STEPS_PER_SAMPLE map onto the
+    warmup-window and window-steps knobs (reference parameter_manager
+    tunables of the same names)."""
+    from horovod_tpu.utils.autotune import AutotuneDriver, FusionAutotuner
+
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", "3")
+    t = FusionAutotuner()
+    assert t.warmup_windows == 3
+    for _ in range(3):
+        t.threshold_bytes()
+        t.observe(1.0)
+    assert t.converged
+
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", "5")
+    d = AutotuneDriver()
+    assert d.window_steps == 5
