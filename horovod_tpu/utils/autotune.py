@@ -46,15 +46,18 @@ class FusionAutotuner:
         self.low = math.log2(low_bytes)
         self.high = math.log2(high_bytes)
         if warmup_windows is None:
-            # Reference sub-knobs honored through the env layer:
-            # AUTOTUNE_WARMUP_SAMPLES sets the explore budget and
-            # AUTOTUNE_BAYES_OPT_MAX_SAMPLES caps total GP samples
-            # (parameter_manager.h:42-105 tunables of the same names).
+            # Reference sub-knob (parameter_manager.h:42-105):
+            # AUTOTUNE_BAYES_OPT_MAX_SAMPLES caps total GP samples —
+            # here the explore budget before freezing.
             warmup_windows = env.get_int(
-                "AUTOTUNE_WARMUP_SAMPLES",
-                env.get_int("AUTOTUNE_BAYES_OPT_MAX_SAMPLES", 10),
+                "AUTOTUNE_BAYES_OPT_MAX_SAMPLES", 10
             )
-        self.warmup_windows = warmup_windows
+        self.warmup_windows = max(1, warmup_windows)
+        # Reference AUTOTUNE_WARMUP_SAMPLES: number of leading samples
+        # DISCARDED before scoring (its default 3 covers cold caches);
+        # ours defaults to 0 because each window already fences out its
+        # compile step.
+        self._discard_left = max(0, env.get_int("AUTOTUNE_WARMUP_SAMPLES", 0))
         self._windows = 0
         self._frozen: Optional[int] = None
         self._current: Optional[float] = None
@@ -81,6 +84,9 @@ class FusionAutotuner:
     def observe(self, score: float) -> None:
         """Report the window score (bytes/sec or images/sec)."""
         if self._frozen is not None or self._current is None:
+            return
+        if self._discard_left > 0:
+            self._discard_left -= 1  # reference warmup sample: dropped
             return
         self._history.append((self._current, score))
         if self._native is not None:

@@ -35,14 +35,20 @@ def test_autotuner_log(tmp_path):
 
 
 def test_reference_autotune_subknobs(monkeypatch):
-    """HOROVOD_AUTOTUNE_WARMUP_SAMPLES / _STEPS_PER_SAMPLE map onto the
-    warmup-window and window-steps knobs (reference parameter_manager
-    tunables of the same names)."""
+    """Reference parameter_manager tunables map onto ours:
+    BAYES_OPT_MAX_SAMPLES = explore budget, WARMUP_SAMPLES = leading
+    samples discarded before scoring, STEPS_PER_SAMPLE = window
+    length."""
     from horovod_tpu.utils.autotune import AutotuneDriver, FusionAutotuner
 
-    monkeypatch.setenv("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", "3")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", "3")
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_WARMUP_SAMPLES", "2")
     t = FusionAutotuner()
     assert t.warmup_windows == 3
+    for _ in range(2):  # discarded warmup samples: no convergence credit
+        t.threshold_bytes()
+        t.observe(1.0)
+    assert not t.converged
     for _ in range(3):
         t.threshold_bytes()
         t.observe(1.0)
@@ -51,3 +57,12 @@ def test_reference_autotune_subknobs(monkeypatch):
     monkeypatch.setenv("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", "5")
     d = AutotuneDriver()
     assert d.window_steps == 5
+
+
+def test_autotune_nonpositive_warmup_clamped(monkeypatch):
+    from horovod_tpu.utils.autotune import FusionAutotuner
+
+    monkeypatch.setenv("HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", "0")
+    t = FusionAutotuner()
+    assert t.warmup_windows == 1
+    assert t.threshold_bytes() > 0  # no IndexError on the grid path
