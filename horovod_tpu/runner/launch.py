@@ -206,8 +206,33 @@ def check_build(out=None) -> None:
     lines.append(f"    {flag(has_pallas)} Pallas kernels")
     for ok, name in [(True, "process sets"), (True, "elastic"),
                      (True, "timeline"), (True, "autotune"),
-                     (True, "Adasum")]:
+                     (True, "Adasum"), (True, "ZeRO/FSDP"),
+                     (True, "TP/PP/SP/MoE")]:
         lines.append(f"    {flag(ok)} {name}")
+    lines += ["", "Available Bindings:"]
+    import importlib.util as _ilu
+
+    for mod, name in [("torch", "PyTorch (interop.torch)"),
+                      ("tensorflow", "TensorFlow/Keras (interop.tf)"),
+                      ("mxnet", "MXNet (interop.mxnet)")]:
+        # find_spec, not import: a capability report must not pay
+        # framework import time (or crash on a broken install)
+        try:
+            ok = _ilu.find_spec(mod) is not None
+        except (ImportError, ValueError):
+            ok = False
+        lines.append(f"    {flag(ok)} {name}")
+    lines += ["", "Available Launchers:"]
+    import shutil as _shutil
+
+    lines.append(f"    {flag(True)} static ssh (hvdrun)")
+    lines.append(f"    {flag(_shutil.which('mpirun') is not None)} mpirun "
+                 "(--use-mpi)")
+    from . import lsf as _lsf
+
+    lines.append(f"    {flag(_lsf.is_jsrun_installed())} jsrun "
+                 "(--use-jsrun)")
+    lines.append(f"    {flag(True)} elastic (--min-np/--max-np)")
     print("\n".join(lines), file=out)
 
 
