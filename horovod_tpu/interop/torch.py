@@ -106,11 +106,13 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None,
 
 def alltoall(tensor, splits=None, name: Optional[str] = None,
              process_set=None):
-    return _to_torch(
-        _eager.alltoall(_to_jax(tensor), splits, name=name,
-                        process_set=process_set),
-        tensor,
-    )
+    out = _eager.alltoall(_to_jax(tensor), splits, name=name,
+                          process_set=process_set)
+    if isinstance(out, tuple):
+        # uneven splits: (output, received_splits) like the reference's
+        # alltoall return (torch/mpi_ops.py:361)
+        return _to_torch(out[0], tensor), _to_torch(out[1], None)
+    return _to_torch(out, tensor)
 
 
 def grouped_allreduce(tensors, op: int = _eager.Average,

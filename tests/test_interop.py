@@ -160,3 +160,26 @@ class TestSparseAllreduce:
     def test_sparse_rejects_dense(self, hvd_module):
         with pytest.raises(ValueError, match="sparse"):
             hvd_torch.sparse_allreduce_async(torch.ones(3, 3))
+
+
+def test_torch_alltoall_uneven_splits_returns_received(hvd_module):
+    """Uneven splits return (output, received_splits) like the
+    reference alltoall (torch/mpi_ops.py:361)."""
+    # genuinely uneven entries (0/1/2 rows per destination) with equal
+    # row totals (the stacked layout's constraint): each rank sends an
+    # extra row to its right neighbor and none to the one after
+    splits = np.full((N, N), 1)
+    for r in range(N):
+        splits[r, (r + 1) % N] += 1
+        splits[r, (r + 2) % N] -= 1
+    t = torch.arange(N * N * 2, dtype=torch.float32).reshape(N, N, 2)
+    out, received = hvd_torch.alltoall(t, splits=splits)
+    assert torch.is_tensor(out) and torch.is_tensor(received)
+    np.testing.assert_array_equal(received.numpy(), splits.T)
+    # route check: the first row rank 1 receives is rank 0's first row
+    # (rank 0's block for rank 1 starts after its splits[0,0]=1 rows
+    # for rank 0... destination 1 offset = splits[0,0])
+    full = t.numpy()
+    np.testing.assert_allclose(
+        out.numpy()[1][0], full[0][int(splits[0, 0])]
+    )

@@ -255,3 +255,21 @@ def test_import_without_mxnet_is_clean():
 
     with pytest.raises((ImportError, TypeError)):
         m.allreduce(np.ones(3))  # not an NDArray -> TypeError before mx
+
+
+def test_alltoall_uneven_splits(hvd_mx):
+    splits = np.full((SIZE, SIZE), 1)
+    for r in range(SIZE):
+        splits[r, (r + 1) % SIZE] += 1
+        splits[r, (r + 2) % SIZE] -= 1
+    rows = np.arange(SIZE * SIZE * 2, dtype=np.float32).reshape(
+        SIZE, SIZE, 2
+    )
+    out, received = hvd_mx.alltoall(FakeNDArray(rows), splits=splits)
+    assert isinstance(out, FakeNDArray) and isinstance(received, FakeNDArray)
+    np.testing.assert_array_equal(received.asnumpy(), splits.T)
+    # routing: rank 1's first received row is rank 0's row at offset
+    # splits[0,0] (rank 0's block addressed to rank 1)
+    np.testing.assert_allclose(
+        out.asnumpy()[1][0], rows[0][int(splits[0, 0])]
+    )
