@@ -64,17 +64,191 @@ def _bitrev(i: int, bits: int) -> int:
     return out
 
 
+def _self_loops(pairs, n: int):
+    # ppermute needs unique sources and unique destinations; ranks
+    # outside the exchange keep their value via a self-loop (ranks
+    # that send without receiving simply get zeros, which is fine —
+    # their buffer is dead after the send).
+    srcs = {a for a, _ in pairs}
+    dsts = {b for _, b in pairs}
+    return pairs + [
+        (r, r) for r in range(n) if r not in srcs and r not in dsts
+    ]
+
+
+def _vhdd_over_groups(v: jax.Array, axis: str, n: int, groups) -> jax.Array:
+    """VHDD Adasum across same-position "rails" of sharded vectors.
+
+    ``groups`` is a list of disjoint same-size rank lists that together
+    partition the axis — rail i carries shard i of every host's vector,
+    so the G rails jointly hold the full logical host vectors.  Each
+    rail runs the halving/doubling exchanges on its own shard, but the
+    per-level pair scalars are summed across *all* rails and merge
+    members in one slotted (p/2d, 3) psum: the coefficients are the
+    full-vector dot/norms, so the sharded result is bit-for-bit the
+    Adasum of the unsharded host vectors.  (The reference's
+    ``AdasumGpuAllreduceOp`` lets each shard derive its own
+    coefficients from its piece alone — an approximation this design
+    gets to skip because the scalar reduction already crosses the world
+    axis.)
+    """
+    k = len(groups[0])
+    if k == 1:
+        return v
+    p = 1 << (k.bit_length() - 1)
+    extras = k - p
+    levels = p.bit_length() - 1
+    dtype = v.dtype
+
+    idx = lax.axis_index(axis)
+    pos_tab = np.zeros((n,), np.int64)
+    for g in groups:
+        for j, r in enumerate(g):
+            pos_tab[r] = j
+    my_pos = jnp.asarray(pos_tab)[idx]
+
+    size = v.shape[0]
+    seg = -(-size // p)
+    padded = seg * p
+    y = jnp.pad(v, (0, padded - size)) if padded != size else v
+
+    if extras:
+        perm = _self_loops(
+            [(g[p + i], g[i]) for g in groups for i in range(extras)], n
+        )
+        recv = lax.ppermute(y, axis, perm=perm)
+        # Fold scalars also sum across rails (full-vector dots).
+        y32, r32 = y.astype(jnp.float32), recv.astype(jnp.float32)
+        fold_mask = my_pos < extras
+        scal = jnp.stack([
+            jnp.sum(y32 * r32), jnp.sum(y32 * y32), jnp.sum(r32 * r32)
+        ])
+        scal = jnp.where(fold_mask, scal, jnp.zeros_like(scal))
+        slot_i = jnp.where(fold_mask, my_pos, 0)
+        sums = lax.psum(
+            jnp.zeros((extras, 3), jnp.float32).at[slot_i].set(scal), axis
+        )
+        s = sums[slot_i]
+        g_dot, g_na, g_nb = s[0], s[1], s[2]
+        ca = jnp.where(g_na > 0, 1.0 - g_dot / (2.0 * g_na), 1.0)
+        cb = jnp.where(g_nb > 0, 1.0 - g_dot / (2.0 * g_nb), 1.0)
+        folded = (ca * y32 + cb * r32).astype(dtype)
+        y = jnp.where(fold_mask, folded, y)
+
+    core_mask = my_pos < p
+    for level in range(levels):
+        d = 1 << level
+        half = y.shape[0] // 2
+        bit = (my_pos >> level) & 1
+        keep = lax.dynamic_slice(y, (bit * half,), (half,))
+        send = lax.dynamic_slice(y, ((1 - bit) * half,), (half,))
+        perm = _self_loops(
+            [(g[i], g[i ^ d]) for g in groups for i in range(p)], n
+        )
+        recv = lax.ppermute(send, axis, perm=perm)
+
+        keep32 = keep.astype(jnp.float32)
+        recv32 = recv.astype(jnp.float32)
+        dot = jnp.sum(keep32 * recv32)
+        n_keep = jnp.sum(keep32 * keep32)
+        n_recv = jnp.sum(recv32 * recv32)
+        na_c = jnp.where(bit == 0, n_keep, n_recv)
+        nb_c = jnp.where(bit == 0, n_recv, n_keep)
+        nmerge = p // (2 * d)
+        my_merge = my_pos // (2 * d)
+        scalars = jnp.stack([dot, na_c, nb_c])
+        scalars = jnp.where(core_mask, scalars, jnp.zeros_like(scalars))
+        # One slot per merge group, summed over all rails AND merge
+        # members: full-vector dot/norms, exact pair coefficients.
+        slots = (
+            jnp.zeros((nmerge, 3), jnp.float32).at[my_merge].set(scalars)
+        )
+        s = lax.psum(slots, axis)[my_merge]
+        g_dot, g_na, g_nb = s[0], s[1], s[2]
+        ca = jnp.where(g_na > 0, 1.0 - g_dot / (2.0 * g_na), 1.0)
+        cb = jnp.where(g_nb > 0, 1.0 - g_dot / (2.0 * g_nb), 1.0)
+        c_keep = jnp.where(bit == 0, ca, cb)
+        c_recv = jnp.where(bit == 0, cb, ca)
+        y = (c_keep * keep32 + c_recv * recv32).astype(dtype)
+
+    # Reconstruct inside each group: the gather rows follow the group's
+    # listed order, so core member j's segment sits at row j.
+    gathered = lax.all_gather(
+        y, axis, axis_index_groups=groups, tiled=True
+    ).reshape(k, seg)
+    rows = np.asarray([_bitrev(j, levels) for j in range(p)], np.int32)
+    return gathered[jnp.asarray(rows)].reshape(padded)[:size]
+
+
+def _hierarchical_adasum(x: jax.Array, axis: str) -> Optional[jax.Array]:
+    """Intra-host sum + cross-host Adasum (the ``AdasumGpuAllreduceOp``
+    schedule, ``adasum_gpu_operations.cc:44-329``):
+
+      1. intra-host reduce-scatter SUM — each local rank owns a 1/L
+         shard of its host's gradient sum (ICI traffic);
+      2. cross-host VHDD Adasum of the shards along each DCN "rail"
+         (rank i of every host) — cross-host payload is V/L per rail,
+         the reference's homogeneous-split rationale;
+      3. intra-host all-gather + divide by local_size (the reference's
+         postscale, ``operations.cc:1404-1410``) so the result is the
+         Adasum of per-host *average* gradients.
+
+    Returns ``None`` when the world is not a homogeneous host grid
+    (caller falls back to flat VHDD).
+    """
+    from .traced import host_groups
+
+    grid = host_groups(axis)
+    if grid is None:
+        return None
+    local_groups, cross_groups = grid
+    L = len(local_groups[0])
+    n = lax.axis_size(axis)
+
+    shape, dtype = x.shape, x.dtype
+    flat = x.reshape(-1)
+    size = flat.shape[0]
+    pad = (-size) % L
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    shard = lax.psum_scatter(
+        flat, axis, scatter_dimension=0,
+        axis_index_groups=local_groups, tiled=True,
+    )
+    reduced = _vhdd_over_groups(shard, axis, n, cross_groups)
+    out = lax.all_gather(
+        reduced, axis, axis_index_groups=local_groups, tiled=True
+    )
+    out = (out[:size] / L).astype(dtype)
+    return out.reshape(shape)
+
+
 def adasum_allreduce(
     x: jax.Array,
     axis: str = WORLD_AXIS,
     process_set: Optional[ProcessSet] = None,
+    hierarchical: Optional[bool] = None,
 ) -> jax.Array:
     """Vector-halving / distance-doubling Adasum over a mesh axis.
 
     Any set size works (stragglers fold in pairwise first).  Members
     receive the Adasum of all member contributions; non-members of
     ``process_set`` pass their input through unchanged.
+
+    ``hierarchical`` (default: the ``HVD_TPU_HIERARCHICAL_ALLREDUCE``
+    env knob) selects the two-stage intra-host-sum/cross-host-Adasum
+    schedule on multi-host grids — the ``AdasumGpuAllreduceOp`` analog
+    — falling back to the flat tree when the grid is ragged or a
+    process subset is requested.
     """
+    if hierarchical is None:
+        from ..utils import env
+
+        hierarchical = env.get_bool(env.HIERARCHICAL_ALLREDUCE, False)
+    if hierarchical and process_set is None:
+        y = _hierarchical_adasum(x, axis)
+        if y is not None:
+            return y
     n = lax.axis_size(axis)
     ranks = list(process_set.ranks) if process_set is not None else list(range(n))
     k = len(ranks)
@@ -105,15 +279,7 @@ def adasum_allreduce(
     member_mask = jnp.asarray(member)[idx]
 
     def self_loops(pairs):
-        # ppermute needs unique sources and unique destinations; ranks
-        # outside the exchange keep their value via a self-loop (ranks
-        # that send without receiving simply get zeros, which is fine —
-        # their buffer is dead after the send).
-        srcs = {a for a, _ in pairs}
-        dsts = {b for _, b in pairs}
-        return pairs + [
-            (r, r) for r in range(n) if r not in srcs and r not in dsts
-        ]
+        return _self_loops(pairs, n)
 
     # ---- fold phase: extras pair-combine into the first `extras` cores.
     y = flat

@@ -235,6 +235,39 @@ def _grouped_sum(x: jax.Array, axis: Axis, groups, group_size: int) -> jax.Array
     return full[:n].reshape(x.shape)
 
 
+def host_groups(axis: Axis):
+    """The (local_groups, cross_groups) host-grid partition, or ``None``
+    when the axis is not the full world or the grid is ragged.
+
+    ``local_groups[h]`` lists host h's ranks (ICI neighbors);
+    ``cross_groups[i]`` lists the i-th rank of every host (the DCN
+    "rail").  Ranks group by owning controller process, not assumed
+    contiguity; single-controller worlds overlay contiguous blocks.
+    """
+    from .. import runtime as _rt
+
+    rt = _rt.get_runtime()
+    L, H = rt.local_size, rt.cross_size
+    if (
+        L <= 1 or H <= 1 or L * H != rt.size
+        or _axis_size(axis) != rt.size
+    ):
+        return None
+    by_host: dict = {}
+    for r, d in enumerate(rt.devices):
+        by_host.setdefault(d.process_index, []).append(r)
+    if len(by_host) == 1:
+        # Single controller (tests / one-host worlds): hosts are a
+        # logical overlay; contiguous blocks are the only sensible map.
+        local_groups = [[h * L + i for i in range(L)] for h in range(H)]
+    else:
+        local_groups = [sorted(v) for _, v in sorted(by_host.items())]
+        if len(local_groups) != H or any(len(g) != L for g in local_groups):
+            return None
+    cross_groups = [[g[i] for g in local_groups] for i in range(L)]
+    return local_groups, cross_groups
+
+
 def _hierarchical_sum(x: jax.Array, axis: Axis) -> jax.Array:
     """Two-stage sum: reduce-scatter within each host (ICI), cross-host
     sum of the scattered shards (DCN), all-gather within host.
@@ -246,34 +279,13 @@ def _hierarchical_sum(x: jax.Array, axis: Axis) -> jax.Array:
     the payload (the reference's homogeneous-split rationale,
     ``nccl_operations.cc:297-335``).
     """
-    from .. import runtime as _rt
-
-    rt = _rt.get_runtime()
-    L, H = rt.local_size, rt.cross_size
-    # Only valid over the full world axis with a homogeneous host grid;
-    # anything else (hybrid sub-axes, ragged hosts) falls back to the
+    # Anything but a full-world homogeneous host grid falls back to the
     # flat psum, which is always correct.
-    if (
-        L <= 1 or H <= 1 or L * H != rt.size
-        or _axis_size(axis) != rt.size
-    ):
+    grid = host_groups(axis)
+    if grid is None:
         return lax.psum(x, axis)
-    # Group ranks by their owning controller process (the host), not by
-    # assumed contiguity — process indices need not be rank-contiguous.
-    by_host: dict = {}
-    for r, d in enumerate(rt.devices):
-        by_host.setdefault(d.process_index, []).append(r)
-    if len(by_host) == 1:
-        # Single controller (tests / one-host worlds): hosts are a
-        # logical overlay; contiguous blocks are the only sensible map.
-        local_groups = [[h * L + i for i in range(L)] for h in range(H)]
-    else:
-        local_groups = [sorted(v) for _, v in sorted(by_host.items())]
-        if len(local_groups) != H or any(len(g) != L for g in local_groups):
-            return lax.psum(x, axis)
-    cross_groups = [
-        [g[i] for g in local_groups] for i in range(L)
-    ]
+    local_groups, cross_groups = grid
+    L, H = len(local_groups[0]), len(local_groups)
     shape, n = x.shape, x.size
     pad = (-n) % L
     flat = jnp.pad(x.reshape(-1), (0, pad))
@@ -310,8 +322,12 @@ def allreduce(
     if op == Adasum:
         from .adasum import adasum_allreduce
 
-        return adasum_allreduce(
-            _scale(x, prescale_factor), axis=axis, process_set=process_set
+        return _scale(
+            adasum_allreduce(
+                _scale(x, prescale_factor), axis=axis,
+                process_set=process_set, hierarchical=hierarchical,
+            ),
+            postscale_factor,
         )
 
     groups, mask, position, set_size = _set_info(axis, process_set)
