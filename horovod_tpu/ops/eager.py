@@ -147,12 +147,14 @@ def _consistency_check(rtype: int, x: jax.Array, name: Optional[str],
                 r["root"])
 
     # Coordinator pattern (reference controller.cc ConstructResponse):
-    # process 0 validates the gathered Requests and broadcasts ONE wire
-    # Response — OK echoing the op, or ERROR with the mismatch — which
-    # every process adopts, exactly how the reference's workers learn a
-    # submission was rejected.
+    # the coordinator validates the gathered Requests and broadcasts ONE
+    # wire Response — OK echoing the op, or ERROR with the mismatch —
+    # which every process adopts, exactly how the reference's workers
+    # learn a submission was rejected.  The coordinator is the process
+    # owning devices[0] (broadcast_object(root_rank=0) sources from that
+    # process — with init(devices=subset) it need not be process 0).
     response = None
-    if rt.process_rank == 0:
+    if rt.process_rank == rt.devices[0].process_index:
         base = records[0]
         error = ""
         for r in records[1:]:
@@ -163,16 +165,30 @@ def _consistency_check(rtype: int, x: jax.Array, name: Optional[str],
                     "controller.cc mismatched-collective error)"
                 )
                 break
-        if use_native:
+        try:
+            if use_native:
+                response = (
+                    native.encode_response(native.RESPONSE_ERROR, [], error)
+                    if error else
+                    native.encode_response(rtype, [wire_name], sizes=dims)
+                )
+            else:
+                response = {
+                    "type": native.RESPONSE_ERROR if error else rtype,
+                    "names": [] if error else [wire_name],
+                    "error": error, "sizes": dims,
+                }
+        except Exception as e:
+            # Encoding failures (e.g. a wire name over the u16 cap) must
+            # reach every process as a symmetric ERROR response, not
+            # strand the non-coordinators inside the broadcast.
+            err = f"coordinator failed to encode response: {e}"
             response = (
-                native.encode_response(native.RESPONSE_ERROR, [], error)
-                if error else
-                native.encode_response(rtype, [wire_name], sizes=dims)
+                native.encode_response(native.RESPONSE_ERROR, [], err)
+                if use_native else
+                {"type": native.RESPONSE_ERROR, "names": [],
+                 "error": err, "sizes": dims}
             )
-        else:
-            response = {"type": native.RESPONSE_ERROR if error else rtype,
-                        "names": [] if error else [wire_name],
-                        "error": error, "sizes": dims}
     response = functions.broadcast_object(response, root_rank=0)
     resp = (
         native.decode_response(response) if use_native else response
@@ -658,7 +674,13 @@ def barrier(process_set: Optional[ProcessSet] = None) -> None:
     )
     rt = get_runtime()
     token = jnp.zeros((rt.size, 1), dtype=jnp.int32)
-    jax.block_until_ready(_jitted("allreduce", static)(token))
+    out = _jitted("allreduce", static)(token)
+    # A barrier blocks on every peer by definition — keep it visible to
+    # the stall inspector rather than hanging silently on a dead rank.
+    if rt.stall_watchdog is not None:
+        rt.stall_watchdog.wait(out, "barrier")
+    else:
+        jax.block_until_ready(out)
 
 
 _join_epoch = 0

@@ -224,10 +224,29 @@ def _reduce_gradients(
             process_set=process_set,
         )
 
+    # Per-bucket hot-path lanes (reference per-tensor activity lanes,
+    # common.h:73-105): a named_scope per bucket lands in the compiled
+    # program's op metadata — the device profiler attributes each fused
+    # collective to its bucket — and, when a timeline is active, the
+    # plan records one event per bucket at trace time so a slow bucket
+    # is identifiable without a full profiler trace.
+    from ..runtime import get_runtime_or_none
+
+    _rt = get_runtime_or_none()
+    tl = _rt.timeline if _rt is not None else None
     reduced = list(wire)
-    for bucket in buckets:
-        flats, meta = fusion.flatten_group([wire[i] for i in bucket])
-        out_flats = [reduce_flat(f) for f in flats]
+    for bi, bucket in enumerate(buckets):
+        nbytes = sum(
+            int(wire[i].size) * (1 if quantized else wire[i].dtype.itemsize)
+            for i in bucket
+        )
+        if tl is not None:
+            tl.record_op(
+                f"bucket{bi}[n={len(bucket)}]", "FUSION_PLAN", nbytes
+            )
+        with jax.named_scope(f"hvd_bucket{bi}_{nbytes}B"):
+            flats, meta = fusion.flatten_group([wire[i] for i in bucket])
+            out_flats = [reduce_flat(f) for f in flats]
         for i, t in zip(bucket, fusion.unflatten_group(out_flats, meta)):
             reduced[i] = t
 

@@ -155,23 +155,63 @@ class AutotuneDriver:
     def converged(self) -> bool:
         return self.tuner.converged
 
+    @staticmethod
+    def _sync(out) -> None:
+        """Watchdog-guarded sync: the window fence blocks on a step
+        output whose collectives depend on every peer — the most likely
+        place to hang on a dead process, so it must be visible to the
+        stall inspector (reference ``stall_inspector.h:78``), never a
+        bare ``block_until_ready``.
+        """
+        try:
+            from ..runtime import get_runtime
+
+            wd = get_runtime().stall_watchdog
+        except Exception:
+            wd = None
+        if wd is not None:
+            wd.wait(out, "TrainStep")
+        else:
+            import jax
+
+            jax.block_until_ready(out)
+
     def after_step(self, out) -> None:
         """Advance the window; ``out`` is any step output to sync on."""
         if self.tuner.converged:
             return
-        import jax
-
         self._steps_in_window += 1
         if self._steps_in_window == 1:
             # First step of a window pays tracing+compile for the new
             # threshold; fence it out of the timed region.
-            jax.block_until_ready(out)
+            self._sync(out)
             self._t0 = self._time.perf_counter()
             return
         if self._steps_in_window >= self.window_steps:
-            jax.block_until_ready(out)
+            self._sync(out)
             dt = self._time.perf_counter() - self._t0
             timed_steps = self._steps_in_window - 1
-            self.tuner.observe(timed_steps / max(dt, 1e-9))
+            score = timed_steps / max(dt, 1e-9)
+            threshold = self.tuner.threshold_bytes()
+            self.tuner.observe(score)
+            self._record_window(threshold, score)
             self._steps_in_window = 0
             self._t0 = None
+
+    @staticmethod
+    def _record_window(threshold: int, score: float) -> None:
+        """Window records land on the timeline (reference
+        ParameterManager's cycle records): one event per closed window
+        with the explored threshold and its steps/s score."""
+        try:
+            from ..runtime import get_runtime_or_none
+
+            rt = get_runtime_or_none()
+            tl = rt.timeline if rt is not None else None
+        except Exception:
+            tl = None
+        if tl is not None:
+            tl.record_op(
+                f"autotune threshold={threshold} score={score:.2f}steps/s",
+                "AUTOTUNE_WINDOW", threshold,
+            )

@@ -116,6 +116,65 @@ class TestTrainStepTimeline:
         ends = [e for e in steps if e.get("ph") == "E"]
         assert len(begins) == 3 and len(ends) == 3
 
+    def test_timeline_records_bucket_lanes(self, monkeypatch, tmp_path):
+        """VERDICT r3 item 7 gate: the fusion plan emits one FUSION_PLAN
+        record per bucket (name carries index + tensor count, args the
+        wire bytes), and the compiled step's HLO carries the per-bucket
+        named_scope so profiler traces attribute collectives to
+        buckets."""
+        path = tmp_path / "timeline.json"
+        monkeypatch.setenv("HVD_TPU_TIMELINE", str(path))
+        # tiny threshold -> multiple buckets for 4 params of 256 B each
+        monkeypatch.setenv("HVD_TPU_FUSION_THRESHOLD", "600")
+        hvd.init()
+        try:
+            step, params, opt_state, batch = _tiny_step(hvd)
+            params, opt_state, loss = step(params, opt_state, batch)
+            float(loss)
+        finally:
+            hvd.shutdown()
+        events = json.loads(path.read_text())
+        plans = [e for e in events if e.get("cat") == "FUSION_PLAN"]
+        assert len(plans) >= 2, plans  # 4x256B at 600B -> 2 buckets
+        assert all(e["args"]["bytes"] > 0 for e in plans)
+        assert any(e["name"].startswith("bucket0") for e in plans)
+
+    def test_compiled_step_hlo_names_buckets(self, monkeypatch):
+        import jax
+
+        monkeypatch.setenv("HVD_TPU_FUSION_THRESHOLD", "600")
+        hvd.init()
+        try:
+            step, params, opt_state, batch = _tiny_step(hvd)
+            # compile once, then inspect the lowered program's metadata
+            params, opt_state, loss = step(params, opt_state, batch)
+            float(loss)
+            fn = next(iter(step._step_cache.values()))
+            hlo = fn.lower(params, None, opt_state, batch).compile().as_text()
+            assert "hvd_bucket0" in hlo
+            assert "hvd_bucket1" in hlo
+        finally:
+            hvd.shutdown()
+
+    def test_autotune_writes_window_records(self, monkeypatch, tmp_path):
+        path = tmp_path / "timeline.json"
+        monkeypatch.setenv("HVD_TPU_TIMELINE", str(path))
+        monkeypatch.setenv("HVD_TPU_AUTOTUNE", "1")
+        monkeypatch.setenv("HVD_TPU_AUTOTUNE_WINDOW", "2")
+        hvd.init()
+        try:
+            step, params, opt_state, batch = _tiny_step(hvd)
+            for _ in range(5):  # at least two closed windows
+                params, opt_state, loss = step(params, opt_state, batch)
+            float(loss)
+        finally:
+            hvd.shutdown()
+        events = json.loads(path.read_text())
+        windows = [e for e in events if e.get("cat") == "AUTOTUNE_WINDOW"]
+        assert len(windows) >= 2, windows
+        assert all("threshold=" in e["name"] and "score=" in e["name"]
+                   for e in windows)
+
     def test_timeline_mark_cycles(self, monkeypatch, tmp_path):
         path = tmp_path / "timeline.json"
         monkeypatch.setenv("HVD_TPU_TIMELINE", str(path))
@@ -195,6 +254,39 @@ class TestStallWatchdog:
             assert not hits
         finally:
             wd.close()
+
+    def test_autotune_sync_is_watchdog_guarded(self, monkeypatch):
+        """VERDICT r3 gate: the hot-path window fence (AutotuneDriver
+        sync on the step output) must register with the stall inspector
+        under the name TrainStep — a never-ready future has to trigger
+        the warning, not hang invisibly in bare block_until_ready."""
+        import jax as _jax
+
+        hvd.init()
+        try:
+            from horovod_tpu.runtime import get_runtime
+            from horovod_tpu.utils.autotune import AutotuneDriver
+
+            rt = get_runtime()
+            hits = []
+            old_wd = rt.stall_watchdog
+            wd = StallWatchdog(
+                warn_seconds=0.05, on_stall=hits.append, poll_seconds=0.02
+            )
+            rt.stall_watchdog = wd
+            # mock a never-ready future: the guarded wait blocks well
+            # past the warn threshold
+            monkeypatch.setattr(
+                _jax, "block_until_ready", lambda v: time.sleep(0.5)
+            )
+            try:
+                AutotuneDriver()._sync(object())
+                assert hits and "TrainStep" in hits[0], hits
+            finally:
+                rt.stall_watchdog = old_wd
+                wd.close()
+        finally:
+            hvd.shutdown()
 
     def test_runtime_owns_watchdog(self):
         hvd.init()
