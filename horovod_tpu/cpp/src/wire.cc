@@ -75,10 +75,10 @@ int64_t hvd_wire_encode_response(int32_t rtype, const char* names,
   *p++ = (uint8_t)rtype;
   *p++ = uint8_t(names_len >> 8);
   *p++ = uint8_t(names_len);
-  memcpy(p, names, names_len);
+  if (names_len) memcpy(p, names, names_len);  // NULL src is UB even for n=0
   p += names_len;
   w32(p, (uint32_t)err_len);
-  memcpy(p, error, err_len);
+  if (err_len) memcpy(p, error, err_len);
   p += err_len;
   *p++ = uint8_t(nsizes >> 8);
   *p++ = uint8_t(nsizes);

@@ -189,10 +189,13 @@ def broadcast_variables(variables, root_rank: int = 0):
     object broadcast like the torch bridge."""
     if _is_single_process():
         return
+    # Array payload rides the chunked device broadcast path (no pickle
+    # of variable data) — broadcast_parameters treats the list as a
+    # pytree of numpy leaves.
     payload = [v.numpy() for v in variables]
-    synced = _functions.broadcast_object(payload, root_rank=root_rank)
+    synced = _functions.broadcast_parameters(payload, root_rank=root_rank)
     for v, val in zip(variables, synced):
-        v.assign(val)
+        v.assign(np.asarray(val))
 
 
 # ---- gradient reduction (DistributedGradientTape / DistributedOptimizer)
@@ -248,16 +251,154 @@ def _reduce_grads(tf, grads: List[Any], average: bool,
     return out
 
 
+class _GradAggregationHelper:
+    """Local gradient aggregation (the eager
+    ``LocalGradientAggregationHelper`` contract, reference
+    ``tensorflow/gradient_aggregation_eager.py:1-155``): gradients
+    accumulate into local numpy buffers; every ``backward_passes_per_
+    step``-th call reduces the aggregate across processes (divided by k
+    when ``average_aggregated_gradients``) and clears; other calls
+    return the running local aggregate untouched by the wire."""
+
+    def __init__(self, k: int, reduce_fn, average_aggregated: bool):
+        if k < 1:
+            raise ValueError("backward_passes_per_step must be >= 1")
+        self._k = int(k)
+        self._reduce = reduce_fn
+        self._avg_agg = average_aggregated
+        self._buf: Optional[List[Optional[np.ndarray]]] = None
+        self._counter = 0
+        # graph-mode state (tf.function-traced keras fit)
+        self._tf_counter = None
+        self._tf_bufs: Optional[list] = None
+
+    def step(self, tf, grads: List[Any]):
+        """Returns ``(grads_out, is_boundary)``."""
+        if self._k == 1:
+            return self._reduce(grads), True
+        for g in grads:
+            if isinstance(g, tf.IndexedSlices):
+                raise ValueError(
+                    "IndexedSlices are not supported with "
+                    "backward_passes_per_step > 1 unless sparse_as_dense "
+                    "is set (reference gradient_aggregation_eager.py)"
+                )
+        if self._buf is None:
+            self._buf = [None] * len(grads)
+        for i, g in enumerate(grads):
+            if g is None:
+                continue
+            a = np.asarray(g)
+            self._buf[i] = a if self._buf[i] is None else self._buf[i] + a
+        self._counter += 1
+        if self._counter < self._k:
+            # Aggregation-only pass: both callers skip the apply, so
+            # never materialize tensor copies of the running buffers.
+            return [None] * len(grads), False
+        agg = [None if b is None else tf.constant(b) for b in self._buf]
+        reduced = self._reduce(agg)
+        if self._avg_agg:
+            reduced = [
+                None if g is None else g / self._k for g in reduced
+            ]
+        self._counter = 0
+        self._buf = None
+        return reduced, True
+
+    def graph_apply(self, tf, optimizer, pairs, parent_apply):
+        """Aggregation under a traced keras fit (symbolic gradients):
+        tf.Variable buffers + ``tf.cond`` like the reference's
+        ``LocalGradientAggregationHelperEager.apply_gradients``
+        (``gradient_aggregation_eager.py:126-155``).
+
+        Only the single-process world can run traced — the bridge's
+        cross-process reduction is host-side by design, so there it is
+        an identity and the k-step aggregation is pure TF state.
+        """
+        if not _is_single_process():
+            raise NotImplementedError(
+                "backward_passes_per_step inside a tf.function "
+                "(compiled keras fit) is single-process only: the TPU "
+                "bridge reduces host-side. Compile the model with "
+                "run_eagerly=True for multi-process aggregation."
+            )
+        grads = [g for g, _ in pairs]
+        tvars = [v for _, v in pairs]
+        for g in grads:
+            if isinstance(g, tf.IndexedSlices):
+                raise ValueError(
+                    "IndexedSlices are not supported with "
+                    "backward_passes_per_step > 1 unless sparse_as_dense "
+                    "is set (reference gradient_aggregation_eager.py)"
+                )
+        if self._tf_bufs is None:
+            self._tf_counter = tf.Variable(
+                0, dtype=tf.int64, trainable=False, name="hvd_agg_counter"
+            )
+            self._tf_bufs = [
+                None if g is None else tf.Variable(
+                    tf.zeros_like(g), trainable=False,
+                )
+                for g in grads
+            ]
+        # assign_add return values give explicit read-after-write order
+        new_vals = [
+            None if b is None else
+            (b.assign_add(g) if g is not None else b.read_value())
+            for b, g in zip(self._tf_bufs, grads)
+        ]
+        count = self._tf_counter.assign_add(1)
+
+        def boundary():
+            scale = 1.0 / self._k if self._avg_agg else 1.0
+            agg = [
+                None if v is None else v * scale for v in new_vals
+            ]
+            parent_apply(list(zip(agg, tvars)))
+            clears = [
+                b.assign(tf.zeros_like(b))
+                for b in self._tf_bufs if b is not None
+            ]
+            with tf.control_dependencies(clears):
+                return tf.identity(count)
+
+        def skip():
+            it = getattr(optimizer, "iterations", None)
+            if it is not None:
+                it.assign_add(1)
+            return tf.identity(count)
+
+        return tf.cond(
+            tf.equal(count % self._k, 0), boundary, skip
+        )
+
+
 class DistributedGradientTape:
     """Wraps ``tf.GradientTape``: ``gradient()`` returns cross-process
-    reduced gradients (reference ``tensorflow/__init__.py:759``)."""
+    reduced gradients (reference ``tensorflow/__init__.py:759``).
+
+    ``backward_passes_per_step=k`` aggregates locally and reduces only
+    every k-th ``gradient()`` call.  Non-boundary calls return ``None``
+    for every gradient — apply only when gradients are present
+    (``tf.keras`` raises on an all-``None`` apply, so accidentally
+    stepping every call fails loudly instead of double-counting early
+    microbatches).  The reference puts this helper on the optimizer
+    (``gradient_aggregation_eager.py``), where apply-skipping is
+    automatic; :func:`DistributedOptimizer` here does the same."""
 
     def __init__(self, tape, average: bool = True, process_set=None,
-                 sparse_as_dense: bool = False):
+                 sparse_as_dense: bool = False,
+                 backward_passes_per_step: int = 1,
+                 average_aggregated_gradients: bool = False):
         self._tape = tape
         self._average = average
         self._process_set = process_set
         self._sparse_as_dense = sparse_as_dense
+        self._agg = _GradAggregationHelper(
+            backward_passes_per_step,
+            lambda gs: _reduce_grads(_tf(), gs, average, process_set),
+            average_aggregated_gradients,
+        ) if backward_passes_per_step > 1 else None
 
     def __getattr__(self, name):
         if name == "_tape":
@@ -274,14 +415,21 @@ class DistributedGradientTape:
                 if isinstance(g, tf.IndexedSlices) else g
                 for g in flat
             ]
-        return tf.nest.pack_sequence_as(
-            grads,
-            _reduce_grads(tf, flat, self._average, self._process_set),
-        )
+        if self._agg is not None:
+            # Non-boundary calls yield all-None gradients (the running
+            # aggregate lives in the helper; handing it out would be
+            # applied on top of the boundary reduction, double-counting
+            # g1 in g1, g1+g2, ...).
+            out, _ = self._agg.step(tf, flat)
+        else:
+            out = _reduce_grads(tf, flat, self._average, self._process_set)
+        return tf.nest.pack_sequence_as(grads, out)
 
 
 def DistributedOptimizer(optimizer, average: bool = True,
-                         sparse_as_dense: bool = False, process_set=None):
+                         sparse_as_dense: bool = False, process_set=None,
+                         backward_passes_per_step: int = 1,
+                         average_aggregated_gradients: bool = False):
     """Wrap a ``tf.keras`` optimizer so ``apply_gradients`` reduces
     first (reference ``tensorflow/__init__.py:627``).
 
@@ -290,10 +438,20 @@ def DistributedOptimizer(optimizer, average: bool = True,
     serialization, so callers cannot reliably detect wrapping
     themselves).  ``process_set`` scopes the reduction to the member
     PROCESSES of the chip-rank set (non-members apply local grads —
-    the torch bridge's mapping)."""
+    the torch bridge's mapping).
+
+    ``backward_passes_per_step=k`` keeps the reference's local
+    aggregation contract (keras knob, ``keras/__init__.py:36``):
+    gradients accumulate locally and only every k-th
+    ``apply_gradients`` reduces and steps the underlying optimizer;
+    skipped calls still advance ``iterations`` (the reference's
+    ``increment_optimizer_iteration``)."""
     if getattr(optimizer, "_hvd_wrapped", False):
         want = {"average": average, "sparse_as_dense": sparse_as_dense,
-                "process_set": process_set}
+                "process_set": process_set,
+                "backward_passes_per_step": backward_passes_per_step,
+                "average_aggregated_gradients":
+                    average_aggregated_gradients}
         if getattr(optimizer, "_hvd_wrap_config", None) != want:
             raise ValueError(
                 "optimizer is already wrapped with different settings "
@@ -302,6 +460,11 @@ def DistributedOptimizer(optimizer, average: bool = True,
             )
         return optimizer
     tf = _tf()
+    agg = _GradAggregationHelper(
+        backward_passes_per_step,
+        lambda gs: _reduce_grads(tf, gs, average, process_set),
+        average_aggregated_gradients,
+    ) if backward_passes_per_step > 1 else None
 
     class _Wrapped(optimizer.__class__):
         _hvd_wrapped = True
@@ -315,7 +478,28 @@ def DistributedOptimizer(optimizer, average: bool = True,
                     if isinstance(g, tf.IndexedSlices) else g
                     for g in grads
                 ]
-            reduced = _reduce_grads(tf, grads, average, process_set)
+            if agg is not None:
+                if not tf.executing_eagerly():
+                    # keras compiled fit traces apply_gradients: use the
+                    # TF-native buffer/cond path (symbolic tensors can't
+                    # cross into numpy).
+                    return agg.graph_apply(
+                        tf, self_w, pairs,
+                        lambda gv: super(_Wrapped, self_w).apply_gradients(
+                            gv, **kwargs
+                        ),
+                    )
+                reduced, boundary = agg.step(tf, grads)
+                if not boundary:
+                    # No optimizer step, but the iteration counter
+                    # advances like the reference's
+                    # non_aggregation_step.
+                    it = getattr(self_w, "iterations", None)
+                    if it is not None:
+                        it.assign_add(1)
+                    return None
+            else:
+                reduced = _reduce_grads(tf, grads, average, process_set)
             return super().apply_gradients(
                 zip(reduced, [v for _, v in pairs]), **kwargs
             )
@@ -331,8 +515,45 @@ def DistributedOptimizer(optimizer, average: bool = True,
     obj.__class__ = _Wrapped
     obj._hvd_wrap_config = {"average": average,
                             "sparse_as_dense": sparse_as_dense,
-                            "process_set": process_set}
+                            "process_set": process_set,
+                            "backward_passes_per_step":
+                                backward_passes_per_step,
+                            "average_aggregated_gradients":
+                                average_aggregated_gradients}
     return obj
+
+
+def BroadcastGlobalVariablesCallback(root_rank: int = 0):
+    """A real ``tf.keras.callbacks.Callback`` for ``model.fit`` that
+    broadcasts model + optimizer variables from ``root_rank`` after the
+    FIRST batch (reference ``_keras/callbacks.py:23-47``
+    ``BroadcastGlobalVariablesCallbackImpl`` — batch-end, not
+    train-begin, because optimizer slot variables are created lazily by
+    the first ``apply_gradients``)."""
+    tf = _tf()
+
+    class _BroadcastCallback(tf.keras.callbacks.Callback):
+        def __init__(self):
+            super().__init__()
+            self.root_rank = root_rank
+            self.broadcast_done = False
+
+        def on_batch_end(self, batch, logs=None):
+            if self.broadcast_done:
+                return
+            broadcast_variables(self.model.variables,
+                                root_rank=self.root_rank)
+            opt = getattr(self.model, "optimizer", None)
+            if opt is not None:
+                opt_vars = getattr(opt, "variables", None)
+                if callable(opt_vars):
+                    opt_vars = opt_vars()
+                if opt_vars:
+                    broadcast_variables(opt_vars,
+                                        root_rank=self.root_rank)
+            self.broadcast_done = True
+
+    return _BroadcastCallback()
 
 
 def load_model(path, custom_objects=None, average: bool = True,
@@ -351,6 +572,17 @@ def load_model(path, custom_objects=None, average: bool = True,
     model = tf.keras.models.load_model(path, custom_objects=custom_objects)
     opt = getattr(model, "optimizer", None)
     if opt is not None:
+        # Make the effective wrap visible: a silent average/sparse
+        # mismatch vs training time changes gradient scaling.
+        from ..utils.logging import get_logger
+
+        get_logger().info(
+            "load_model: re-wrapping optimizer with average=%s "
+            "sparse_as_dense=%s process_set=%s (not serialized — must "
+            "match the values used at training time)",
+            average, sparse_as_dense,
+            getattr(process_set, "id", process_set),
+        )
         DistributedOptimizer(opt, average=average,
                              sparse_as_dense=sparse_as_dense,
                              process_set=process_set)

@@ -35,13 +35,30 @@ _lib_lock = threading.Lock()
 _build_failed = False
 
 
+def _stale() -> bool:
+    """True when the built lib is missing or older than any source —
+    editing cpp/src must not leave a silently stale libhvd_core.so."""
+    if not os.path.exists(_LIB_PATH):
+        return True
+    built = os.path.getmtime(_LIB_PATH)
+    for sub in ("src", "include"):
+        d = os.path.join(_CPP_DIR, sub)
+        if not os.path.isdir(d):
+            continue
+        for f in os.listdir(d):
+            if f.endswith((".cc", ".h")):
+                if os.path.getmtime(os.path.join(d, f)) > built:
+                    return True
+    return False
+
+
 def load(build: bool = True) -> Optional[ctypes.CDLL]:
     """Load (building if needed) the native core; None if unavailable."""
     global _lib, _build_failed
     with _lib_lock:
         if _lib is not None:
             return _lib
-        if not os.path.exists(_LIB_PATH) and build and not _build_failed:
+        if _stale() and build and not _build_failed:
             try:
                 # Serialize concurrent builds (multiple worker processes
                 # on one host share cpp/build): flock + re-check.
@@ -50,7 +67,7 @@ def load(build: bool = True) -> Optional[ctypes.CDLL]:
                 lock_path = os.path.join(_CPP_DIR, ".build.lock")
                 with open(lock_path, "w") as lock_fh:
                     fcntl.flock(lock_fh, fcntl.LOCK_EX)
-                    if not os.path.exists(_LIB_PATH):
+                    if _stale():
                         subprocess.run(
                             ["make", "-C", _CPP_DIR],
                             check=True,
@@ -59,7 +76,11 @@ def load(build: bool = True) -> Optional[ctypes.CDLL]:
                         )
             except Exception:
                 _build_failed = True
-                return None
+                # A failed REbuild must not abandon a loadable library
+                # (e.g. stale mtimes after checkout on a host with no
+                # toolchain): fall through and load what exists.
+                if not os.path.exists(_LIB_PATH):
+                    return None
         if not os.path.exists(_LIB_PATH):
             return None
         lib = ctypes.CDLL(_LIB_PATH)

@@ -107,6 +107,17 @@ def get_compute_hosts(environ=None) -> List[str]:
             and counts[hosts[0]] == 1
             and all(counts[h] > 1 for h in hosts[1:])
             and e.get("HVD_TPU_LSF_INCLUDE_LAUNCH_HOST", "") != "1"):
+        # A genuinely heterogeneous allocation with a 1-core compute
+        # host matches this signature too — say what was dropped so a
+        # misclassification is diagnosable, and name the override.
+        from ..utils.logging import get_logger
+
+        get_logger().warning(
+            "LSF: dropping first allocated host %s (1 slot while all "
+            "others have more — launch-node signature). If it is a real "
+            "compute host, set HVD_TPU_LSF_INCLUDE_LAUNCH_HOST=1.",
+            hosts[0],
+        )
         return hosts[1:]
     return hosts
 

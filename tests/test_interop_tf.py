@@ -103,6 +103,7 @@ class TestDistributedOptimizer:
         )
 
 
+@pytest.mark.integration
 def test_multiprocess_tape_averages():
     """Two processes, different grads: DistributedGradientTape must hand
     both the mean (reference DistributedGradientTape contract)."""
@@ -134,6 +135,7 @@ def test_multiprocess_tape_averages():
     np.testing.assert_allclose(results, [[1.5, 1.5], [1.5, 1.5]])
 
 
+@pytest.mark.integration
 def test_multiprocess_tape_process_set_subset():
     """Two processes, a set containing only rank 0: process 0 reduces
     over itself, process 1 keeps local grads (masked pass-through)."""
@@ -168,6 +170,7 @@ def test_multiprocess_tape_process_set_subset():
     np.testing.assert_allclose(results[1], [2.0, 2.0])  # non-member: local
 
 
+@pytest.mark.slow
 def test_keras_model_end_to_end(hvd_module):
     """Full reference-style TF training recipe: broadcast_variables +
     DistributedGradientTape + DistributedOptimizer on a keras Model."""
@@ -308,3 +311,154 @@ class TestLoadModel:
         (g,) = dtape.gradient(loss, [w])
         np.testing.assert_allclose(g.numpy(), [[1.0], [1.0]])
         hvd.remove_process_set(ps)
+
+
+class TestGradientAggregation:
+    """LocalGradientAggregationHelper semantics (reference
+    ``gradient_aggregation_eager.py:1-155`` + the aggregation checks of
+    ``test/parallel/test_tensorflow2_keras.py``)."""
+
+    def test_optimizer_applies_every_kth_step(self, hvd_module):
+        import tensorflow as tf
+
+        import horovod_tpu.interop.tf as hvd_tf
+
+        k = 3
+        w = tf.Variable([1.0, 2.0])
+        opt = hvd_tf.DistributedOptimizer(
+            tf.keras.optimizers.SGD(1.0), backward_passes_per_step=k
+        )
+        g = tf.constant([0.5, 0.5])
+        before = w.numpy().copy()
+        for i in range(k - 1):
+            opt.apply_gradients([(g, w)])
+            np.testing.assert_allclose(
+                w.numpy(), before, err_msg=f"step {i} must not apply"
+            )
+        opt.apply_gradients([(g, w)])  # k-th: aggregate (k*g) applies
+        np.testing.assert_allclose(w.numpy(), before - 1.0 * k * 0.5)
+
+    def test_average_aggregated_gradients_divides_by_k(self, hvd_module):
+        import tensorflow as tf
+
+        import horovod_tpu.interop.tf as hvd_tf
+
+        k = 4
+        w = tf.Variable([2.0])
+        opt = hvd_tf.DistributedOptimizer(
+            tf.keras.optimizers.SGD(1.0), backward_passes_per_step=k,
+            average_aggregated_gradients=True,
+        )
+        g = tf.constant([1.0])
+        for _ in range(k):
+            opt.apply_gradients([(g, w)])
+        # aggregate k*g averaged back by /k -> one unit step
+        np.testing.assert_allclose(w.numpy(), [1.0])
+
+    def test_iterations_advance_on_skipped_steps(self, hvd_module):
+        import tensorflow as tf
+
+        import horovod_tpu.interop.tf as hvd_tf
+
+        opt = hvd_tf.DistributedOptimizer(
+            tf.keras.optimizers.SGD(0.1), backward_passes_per_step=2
+        )
+        w = tf.Variable([1.0])
+        g = tf.constant([1.0])
+        opt.apply_gradients([(g, w)])  # skipped step
+        assert int(opt.iterations.numpy()) == 1
+
+    def test_tape_yields_none_until_boundary(self, hvd_module):
+        """Non-boundary tape calls hand back None gradients (applying
+        the running aggregate every step would double-count
+        microbatches); the boundary call returns the reduced k-step
+        aggregate."""
+        import tensorflow as tf
+
+        import horovod_tpu.interop.tf as hvd_tf
+
+        w = tf.Variable([3.0])
+
+        def grads_once(d):
+            with tf.GradientTape() as tape:
+                loss = tf.reduce_sum(w * w)
+            if d is None:
+                d = hvd_tf.DistributedGradientTape(
+                    tape, backward_passes_per_step=2
+                )
+            d._tape = tape
+            return d, d.gradient(loss, [w])[0]
+
+        d, g1 = grads_once(None)
+        assert g1 is None  # aggregation-only pass
+        d, g2 = grads_once(d)
+        # boundary: aggregate 2*grad reduced (single process: identity)
+        np.testing.assert_allclose(g2.numpy(), [12.0])
+
+    def test_indexed_slices_rejected_when_aggregating(self, hvd_module):
+        import tensorflow as tf
+
+        import horovod_tpu.interop.tf as hvd_tf
+
+        opt = hvd_tf.DistributedOptimizer(
+            tf.keras.optimizers.SGD(0.1), backward_passes_per_step=2
+        )
+        w = tf.Variable([[1.0], [2.0]])
+        sl = tf.IndexedSlices(values=tf.constant([[1.0]]),
+                              indices=tf.constant([0]),
+                              dense_shape=tf.constant([2, 1]))
+        with pytest.raises(ValueError, match="IndexedSlices"):
+            opt.apply_gradients([(sl, w)])
+
+    def test_compiled_keras_fit_aggregates(self, hvd_module):
+        """model.fit traces apply_gradients into a tf.function — the
+        aggregation helper must run graph-side (tf.Variable buffers +
+        tf.cond, reference gradient_aggregation_eager.py:126-155), not
+        crash converting symbolic tensors to numpy."""
+        import tensorflow as tf
+
+        import horovod_tpu.interop.tf as hvd_tf
+
+        model = tf.keras.Sequential([tf.keras.layers.Dense(1)])
+        opt = hvd_tf.DistributedOptimizer(
+            tf.keras.optimizers.SGD(0.05), backward_passes_per_step=2,
+            average_aggregated_gradients=True,
+        )
+        model.compile(optimizer=opt, loss="mse")  # traced by default
+        rng = np.random.RandomState(0)
+        X = rng.randn(64, 4).astype(np.float32)
+        Y = X @ rng.randn(4, 1).astype(np.float32)
+        h = model.fit(X, Y, batch_size=8, epochs=6, verbose=0)
+        losses = h.history["loss"]
+        assert losses[-1] < losses[0] * 0.5, losses
+
+    def test_rewrap_checks_aggregation_settings(self, hvd_module):
+        import tensorflow as tf
+
+        import horovod_tpu.interop.tf as hvd_tf
+
+        opt = hvd_tf.DistributedOptimizer(
+            tf.keras.optimizers.SGD(0.1), backward_passes_per_step=2
+        )
+        with pytest.raises(ValueError, match="different settings"):
+            hvd_tf.DistributedOptimizer(opt, backward_passes_per_step=3)
+
+
+class TestBroadcastCallback:
+    def test_fit_with_broadcast_callback(self, hvd_module):
+        """The callback must plug into keras fit and fire exactly once
+        (single process: the broadcast itself is the documented no-op)."""
+        import tensorflow as tf
+
+        import horovod_tpu.interop.tf as hvd_tf
+
+        model = tf.keras.Sequential(
+            [tf.keras.layers.Dense(2, input_shape=(3,))]
+        )
+        model.compile(optimizer=tf.keras.optimizers.SGD(0.01), loss="mse")
+        cb = hvd_tf.BroadcastGlobalVariablesCallback(root_rank=0)
+        x = np.random.RandomState(0).randn(8, 3).astype(np.float32)
+        y = np.zeros((8, 2), np.float32)
+        model.fit(x, y, batch_size=4, epochs=1, verbose=0, callbacks=[cb])
+        assert cb.broadcast_done
+        assert isinstance(cb, tf.keras.callbacks.Callback)
