@@ -205,18 +205,74 @@ class Runtime:
             self._owns_distributed = False
 
 
+def _comm_world_ranks(comm) -> List[int]:
+    """Global ranks described by ``comm`` (reference ``basics.py:48``):
+    a sequence of world ranks, or an mpi4py(-like) communicator whose
+    group is translated into MPI_COMM_WORLD ranks."""
+    if isinstance(comm, (list, tuple, range)):
+        return [int(r) for r in comm]
+    size = int(comm.Get_size())
+    group = getattr(comm, "group", None)
+    if group is not None:
+        # Duck-typed communicators (tests / alternative MPI shims) take
+        # priority: their hook must work whether or not mpi4py happens
+        # to be installed.
+        translate = getattr(group, "translate_ranks", None)
+        if callable(translate):
+            return [int(r) for r in translate(list(range(size)))]
+        try:
+            from mpi4py import MPI
+        except ImportError:
+            MPI = None
+        if MPI is not None and isinstance(group, MPI.Group):
+            world = MPI.COMM_WORLD.group
+            return [
+                int(r) for r in
+                MPI.Group.Translate_ranks(group, list(range(size)), world)
+            ]
+    return list(range(size))
+
+
 def init(
-    process_sets: Optional[Sequence[ProcessSet]] = None,
+    process_sets=None,
     devices: Optional[Sequence[jax.Device]] = None,
+    comm=None,
 ) -> None:
     """Initialize the runtime (reference ``horovod_init``,
     ``operations.cc:869`` / ``InitializeHorovodOnce`` ``:791``).
 
     Idempotent like the reference.  ``process_sets`` registers rank
     subsets up front (reference ``horovod_init_multi_comm``,
-    ``operations.cc:881``).
+    ``operations.cc:881``) — or the string ``"dynamic"``, which enables
+    ``add_process_set`` later (reference ``basics.py:79-82``).
+
+    ``comm`` accepts a list of global ranks or an mpi4py communicator
+    (reference ``basics.py:48``): the world is restricted to the chips
+    whose ranks the communicator covers — comm rank i maps onto mesh
+    rank ``ranks[i]``.  Mutually exclusive with ``devices``.
     """
     global _runtime
+    if isinstance(process_sets, str):
+        if process_sets.lower() != "dynamic":
+            raise ValueError(
+                f"process_sets={process_sets!r}: only 'dynamic' or a "
+                "sequence of ProcessSet is accepted"
+            )
+        env.set_env(env.DYNAMIC_PROCESS_SETS, "1")
+        process_sets = None
+    if comm is not None:
+        if devices is not None:
+            raise ValueError("pass either comm= or devices=, not both")
+        ranks = _comm_world_ranks(comm)
+        world = jax.devices()
+        bad = [r for r in ranks if r < 0 or r >= len(world)]
+        if bad:
+            raise ValueError(
+                f"comm ranks {bad} out of range for {len(world)} devices"
+            )
+        if len(set(ranks)) != len(ranks):
+            raise ValueError(f"comm ranks contain duplicates: {ranks}")
+        devices = [world[r] for r in ranks]
     with _runtime_lock:
         if _runtime is None:
             _runtime = Runtime(process_sets=process_sets, devices=devices)

@@ -95,3 +95,83 @@ def test_process_sets_from_env(monkeypatch):
         assert np.isfinite(y).all()
     finally:
         hvd.shutdown()
+
+
+# ---- init(comm=...) parity (reference basics.py:48) ---------------------
+
+
+class _FakeGroup:
+    def __init__(self, world_ranks):
+        self._ranks = world_ranks
+
+    def translate_ranks(self, comm_ranks):
+        return [self._ranks[i] for i in comm_ranks]
+
+
+class _FakeComm:
+    """mpi4py-shaped communicator covering a subset of world ranks."""
+
+    def __init__(self, world_ranks):
+        self.group = _FakeGroup(world_ranks)
+        self._n = len(world_ranks)
+
+    def Get_size(self):
+        return self._n
+
+    def Get_rank(self):
+        return 0
+
+
+def test_init_comm_rank_list():
+    hvd.init(comm=[0, 2, 5])
+    try:
+        assert hvd.size() == 3
+        import jax
+
+        world = jax.devices()
+        from horovod_tpu.runtime import get_runtime
+
+        assert get_runtime().devices == [world[0], world[2], world[5]]
+    finally:
+        hvd.shutdown()
+
+
+def test_init_comm_mpi4py_like_object():
+    """comm rank i maps onto the translated world rank (the reference's
+    MPI group translation, duck-typed so no MPI install is needed)."""
+    hvd.init(comm=_FakeComm([1, 3, 4, 6]))
+    try:
+        assert hvd.size() == 4
+        import jax
+
+        world = jax.devices()
+        from horovod_tpu.runtime import get_runtime
+
+        assert get_runtime().devices == [world[r] for r in (1, 3, 4, 6)]
+    finally:
+        hvd.shutdown()
+
+
+def test_init_comm_validation():
+    import pytest
+
+    with pytest.raises(ValueError, match="out of range"):
+        hvd.init(comm=[0, 99])
+    with pytest.raises(ValueError, match="duplicates"):
+        hvd.init(comm=[0, 0, 1])
+    with pytest.raises(ValueError, match="not both"):
+        import jax
+
+        hvd.init(comm=[0, 1], devices=jax.devices()[:2])
+    assert not hvd.is_initialized()
+
+
+def test_init_process_sets_dynamic_string(monkeypatch):
+    monkeypatch.delenv("HVD_TPU_DYNAMIC_PROCESS_SETS", raising=False)
+    hvd.init(process_sets="dynamic")
+    try:
+        ps = hvd.add_process_set([0, 1])  # no env preset needed
+        hvd.remove_process_set(ps)
+    finally:
+        hvd.shutdown()
+        monkeypatch.delenv("HVD_TPU_DYNAMIC_PROCESS_SETS", raising=False)
