@@ -15,13 +15,48 @@ from __future__ import annotations
 
 import io
 import pickle
-from typing import Any, Optional
+from typing import Any, List, Optional
 
 import jax
 import numpy as np
 
 from . import runtime
 from .process_sets import ProcessSet
+from .utils import env as _env
+
+# Payloads at or above the pickle threshold ride the chunked device
+# path: the flat buffer broadcasts through the mesh in bounded chunks
+# (no pickle of array data, no single giant transfer), only small
+# metadata ever pickles.  A 124M-param fp32 state dict is ~500 MB —
+# pickling it and shipping one monolithic u8 array both doubles peak
+# host memory and serializes the wire behind a full host-side copy;
+# 64 MB chunks keep peak overhead ~13% while each chunk is still far
+# past the bandwidth-saturation size.
+_PICKLE_THRESHOLD = 1 << 20  # bytes; knob HVD_TPU_BCAST_PICKLE_THRESHOLD
+_CHUNK_BYTES = 1 << 26       # bytes; knob HVD_TPU_BCAST_CHUNK_BYTES
+
+
+def _pickle_threshold() -> int:
+    return _env.get_int("BCAST_PICKLE_THRESHOLD", _PICKLE_THRESHOLD)
+
+
+def _chunk_bytes() -> int:
+    return max(1 << 16, _env.get_int("BCAST_CHUNK_BYTES", _CHUNK_BYTES))
+
+
+def _broadcast_flat_chunked(buf: np.ndarray, is_source: bool) -> np.ndarray:
+    """Broadcast a flat 1-D numpy buffer from the source process in
+    bounded chunks (every process iterates identical boundaries)."""
+    from jax.experimental import multihost_utils
+
+    step = _chunk_bytes() // max(1, buf.dtype.itemsize)
+    out = np.empty_like(buf)
+    for lo in range(0, buf.size, step):
+        hi = min(lo + step, buf.size)
+        out[lo:hi] = np.asarray(multihost_utils.broadcast_one_to_all(
+            buf[lo:hi], is_source=is_source
+        ))
+    return out
 
 
 def broadcast_parameters(params: Any, root_rank: int = 0) -> Any:
@@ -31,16 +66,52 @@ def broadcast_parameters(params: Any, root_rank: int = 0) -> Any:
     Single-process: params are already the single source of truth —
     returned as-is (devices receive replicas when the train step shards
     them).  Multi-process: host values are synchronized from the root
-    process over the mesh.
+    process over the mesh — small trees as one call, large trees as
+    per-dtype flat buffers in chunked device broadcasts (array data
+    never pickles; see the chunking note above).
     """
     rt = runtime.get_runtime()
     if rt.process_count == 1:
         return params
     from jax.experimental import multihost_utils
 
-    return multihost_utils.broadcast_one_to_all(
-        params, is_source=rt.process_rank == _root_process(root_rank)
-    )
+    is_source = rt.process_rank == _root_process(root_rank)
+    leaves, treedef = jax.tree.flatten(params)
+    arrs = [np.asarray(l) for l in leaves]
+    total = sum(a.nbytes for a in arrs)
+    if total < _pickle_threshold():
+        return multihost_utils.broadcast_one_to_all(
+            params, is_source=is_source
+        )
+    # Chunked device path: one flat buffer per dtype (params share a
+    # tree structure on every process, so shapes/dtypes agree locally).
+    # 64-bit leaves stay on the pickle path: JAX's default x64-disabled
+    # mode would canonicalize them to 32 bits in flight and the final
+    # reshape would silently mask the truncation (same refusal as
+    # interop/torch._to_jax).
+    by_dtype: dict = {}
+    wide_idx: List[int] = []
+    for i, a in enumerate(arrs):
+        if a.dtype.itemsize > 4:
+            wide_idx.append(i)
+        else:
+            by_dtype.setdefault(a.dtype.str, []).append(i)
+    out = list(arrs)
+    for _, idxs in sorted(by_dtype.items()):
+        flat = np.concatenate([arrs[i].reshape(-1) for i in idxs])
+        flat = _broadcast_flat_chunked(flat, is_source)
+        off = 0
+        for i in idxs:
+            n = arrs[i].size
+            out[i] = flat[off:off + n].reshape(arrs[i].shape)
+            off += n
+    if wide_idx:
+        synced = broadcast_object(
+            {i: arrs[i] for i in wide_idx}, root_rank=root_rank
+        )
+        for i in wide_idx:
+            out[i] = synced[i]
+    return jax.tree.unflatten(treedef, out)
 
 
 def broadcast_variables(params: Any, root_rank: int = 0) -> Any:
@@ -84,7 +155,12 @@ def broadcast_object(
     buf = np.zeros((length,), dtype=np.uint8)
     if is_source:
         buf[: payload.size] = payload
-    buf = multihost_utils.broadcast_one_to_all(buf, is_source=is_source)
+    # Large pickles ride the chunked path (bounded per-transfer memory);
+    # small ones in one call.
+    if length >= _pickle_threshold():
+        buf = _broadcast_flat_chunked(buf, is_source)
+    else:
+        buf = multihost_utils.broadcast_one_to_all(buf, is_source=is_source)
     return pickle.loads(np.asarray(buf).tobytes())
 
 

@@ -344,17 +344,27 @@ def broadcast_parameters(state_dict: Dict[str, Any], root_rank: int = 0):
     if _is_single_process():
         return state_dict  # nothing to sync; skip the encode/copy pass
     torch = _torch()
-    payload = {
-        k: _tensor_to_numpy(torch, v) if torch.is_tensor(v) else v
-        for k, v in state_dict.items()
+    # Tensor payload rides the chunked device broadcast (no pickling of
+    # array data — a 124M-param model is ~500 MB); only non-tensor
+    # metadata pickles.
+    tensors = {
+        k: _tensor_to_numpy(torch, v)
+        for k, v in state_dict.items() if torch.is_tensor(v)
     }
-    synced = _functions.broadcast_object(payload, root_rank=root_rank)
+    other = {
+        k: v for k, v in state_dict.items() if not torch.is_tensor(v)
+    }
+    synced = _functions.broadcast_parameters(tensors, root_rank=root_rank)
+    synced_other = (
+        _functions.broadcast_object(other, root_rank=root_rank)
+        if other else {}
+    )
     for k, v in state_dict.items():
         if torch.is_tensor(v):
             with torch.no_grad():
-                v.copy_(_to_torch(synced[k], v))
+                v.copy_(_to_torch(np.asarray(synced[k]), v))
         else:
-            state_dict[k] = synced[k]
+            state_dict[k] = synced_other[k]
     return state_dict
 
 

@@ -174,3 +174,105 @@ def test_broadcast_optimizer_state_and_variables_aliases(hvd_module):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b))
     v = hvd.broadcast_variables({"w": jnp.full((3,), 7.0)}, root_rank=0)
     np.testing.assert_allclose(np.asarray(v["w"]), 7.0)
+
+
+class TestChunkedBroadcast:
+    """Size-boundary contract (VERDICT r3 item 6): large payloads ride
+    chunked flat-buffer device broadcasts, small ones the single-call
+    path; array data never pickles on the large path."""
+
+    @staticmethod
+    def _spy(monkeypatch):
+        from jax.experimental import multihost_utils
+
+        calls = []
+
+        def fake_bcast(x, is_source):
+            calls.append(x)
+            return x
+
+        monkeypatch.setattr(
+            multihost_utils, "broadcast_one_to_all", fake_bcast
+        )
+        return calls
+
+    def test_small_tree_single_call(self, hvd_module, monkeypatch):
+        from horovod_tpu import functions
+        from horovod_tpu.runtime import get_runtime
+
+        calls = self._spy(monkeypatch)
+        monkeypatch.setattr(get_runtime(), "process_count", 2)
+        params = {"w": np.ones((4, 4), np.float32)}
+        out = functions.broadcast_parameters(params, root_rank=0)
+        assert len(calls) == 1  # whole tree, one call
+        np.testing.assert_allclose(out["w"], params["w"])
+
+    def test_large_tree_chunks_and_never_pickles(self, hvd_module,
+                                                 monkeypatch):
+        from horovod_tpu import functions
+        from horovod_tpu.runtime import get_runtime
+
+        calls = self._spy(monkeypatch)
+        monkeypatch.setattr(get_runtime(), "process_count", 2)
+        monkeypatch.setenv("HVD_TPU_BCAST_PICKLE_THRESHOLD", "1024")
+        monkeypatch.setenv("HVD_TPU_BCAST_CHUNK_BYTES", "65536")
+
+        def no_pickle(*a, **k):
+            raise AssertionError("array payload must not pickle")
+
+        monkeypatch.setattr(functions.pickle, "dumps", no_pickle)
+        params = {
+            "w": np.arange(40_000, dtype=np.float32).reshape(200, 200),
+            "b": np.ones((7,), np.int32),
+        }
+        out = functions.broadcast_parameters(params, root_rank=0)
+        # 160_000 B f32 at 65536 B chunks -> 3, + 1 i32 chunk
+        assert len(calls) == 4, [np.asarray(c).nbytes for c in calls]
+        assert all(np.asarray(c).ndim == 1 for c in calls)
+        np.testing.assert_allclose(out["w"], params["w"])
+        np.testing.assert_allclose(out["b"], params["b"])
+
+    def test_wide_dtypes_stay_bit_exact_via_pickle(self, hvd_module,
+                                                   monkeypatch):
+        """64-bit leaves must NOT ride the device path (x64-disabled JAX
+        would truncate them in flight); they pickle bit-exactly."""
+        from horovod_tpu import functions
+        from horovod_tpu.runtime import get_runtime
+
+        calls = self._spy(monkeypatch)
+        monkeypatch.setattr(get_runtime(), "process_count", 2)
+        monkeypatch.setenv("HVD_TPU_BCAST_PICKLE_THRESHOLD", "1024")
+        big = np.array([2**40 + 3, -(2**35)], np.int64)
+        params = {
+            "w": np.arange(64_000, dtype=np.float32),
+            "wide": big,
+            "dbl": np.array([1.0 + 2**-40], np.float64),
+        }
+        out = functions.broadcast_parameters(params, root_rank=0)
+        assert out["wide"].dtype == np.int64
+        np.testing.assert_array_equal(out["wide"], big)
+        assert out["dbl"].dtype == np.float64
+        assert out["dbl"][0] == params["dbl"][0]  # bit-exact
+        np.testing.assert_allclose(out["w"], params["w"])
+        # wide leaves went via pickled broadcast_object (u8 buffers),
+        # never as raw 64-bit device arrays
+        for c in calls:
+            leaves = np.asarray(c) if not isinstance(c, dict) else None
+            if leaves is not None and leaves.dtype.itemsize > 4:
+                # the int64 length scalar of broadcast_object is the
+                # only allowed 8-byte item, and it is 0-d
+                assert leaves.ndim == 0, leaves.dtype
+
+    def test_large_object_buffer_chunks(self, hvd_module, monkeypatch):
+        from horovod_tpu import functions
+        from horovod_tpu.runtime import get_runtime
+
+        calls = self._spy(monkeypatch)
+        monkeypatch.setattr(get_runtime(), "process_count", 2)
+        monkeypatch.setenv("HVD_TPU_BCAST_PICKLE_THRESHOLD", "1024")
+        monkeypatch.setenv("HVD_TPU_BCAST_CHUNK_BYTES", "65536")
+        blob = {"x": b"q" * 200_000}
+        out = functions.broadcast_object(blob, root_rank=0)
+        assert out == blob
+        # 1 length call + ceil(~200k/65536)=4 buffer chunks
+        assert len(calls) == 5, [np.asarray(c).size for c in calls]
