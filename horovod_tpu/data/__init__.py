@@ -12,4 +12,8 @@ from .data_loader_base import (  # noqa: F401
     ArrayDataLoader,
     AsyncArrayDataLoader,
 )
+from .parquet_loader import (  # noqa: F401
+    AsyncParquetStreamLoader,
+    ParquetStreamLoader,
+)
 from .sampler import ElasticSampler  # noqa: F401

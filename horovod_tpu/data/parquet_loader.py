@@ -1,0 +1,162 @@
+"""Streaming columnar-shard loader: row-group-batched parquet reads.
+
+Reference: the petastorm-backed estimator loaders
+(``horovod/spark/data_loaders/pytorch_data_loaders.py`` feeding
+``BatchedDataLoader`` from a petastorm reader) — estimator epochs
+stream windows of rows through a bounded buffer instead of
+materializing a whole shard in memory.  The TPU-native shape: parquet
+part files read via ``pyarrow.parquet.ParquetFile.iter_batches`` (the
+row-group reader), npz parts read lazily per column window, a carry
+buffer re-slicing windows into exact training batches, and
+``AsyncDataLoaderMixin`` layering background prefetch on top.
+
+Shuffling is windowed (petastorm's model): part order reshuffles per
+epoch and rows permute inside each window, all from ``seed`` + epoch so
+every process agrees.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from .data_loader_base import AsyncDataLoaderMixin, BaseDataLoader
+
+_DEFAULT_WINDOW_ROWS = 4096
+
+
+def _part_num_rows(path: str) -> int:
+    """Row count without reading data (parquet metadata / npz header)."""
+    if path.endswith(".parquet"):
+        import pyarrow.parquet as pq
+
+        return pq.ParquetFile(path).metadata.num_rows
+    with np.load(path) as z:
+        first = z.files[0]
+        return int(z[first].shape[0])
+
+
+def _parquet_windows(path: str, columns: Sequence[str],
+                     window_rows: int) -> Iterator[List[np.ndarray]]:
+    """Stream one parquet part as bounded column windows, reshaping
+    multi-dim columns via the writer's ``shape:<col>`` metadata
+    (spark/store.py write convention)."""
+    import pyarrow.parquet as pq
+
+    f = pq.ParquetFile(path)
+    meta = {
+        k.decode(): v.decode()
+        for k, v in (f.schema_arrow.metadata or {}).items()
+    }
+    shapes = {
+        c: tuple(json.loads(meta[f"shape:{c}"]))
+        for c in columns if f"shape:{c}" in meta
+    }
+    for rb in f.iter_batches(batch_size=window_rows, columns=list(columns)):
+        out = []
+        for c in columns:
+            col = rb.column(c)
+            if c in shapes:
+                flat = np.asarray(col.flatten())
+                out.append(flat.reshape((len(col),) + shapes[c]))
+            else:
+                out.append(np.asarray(col))
+        yield out
+
+
+def _npz_windows(path: str, columns: Sequence[str],
+                 window_rows: int) -> Iterator[List[np.ndarray]]:
+    """npz has no row groups; slice the lazily-loaded arrays into
+    bounded windows (peak memory is one full column set per part —
+    npz parts are small by construction, parquet is the scale path)."""
+    with np.load(path) as z:
+        arrays = [z[c] for c in columns]
+        n = len(arrays[0])
+        for lo in range(0, n, window_rows):
+            yield [a[lo:lo + window_rows] for a in arrays]
+
+
+class ParquetStreamLoader(BaseDataLoader):
+    """Batches streamed from columnar part files, never materializing a
+    shard: a carry buffer merges row-group windows into exact
+    ``batch_size`` batches of the requested columns (tuple per batch,
+    column order preserved)."""
+
+    def __init__(
+        self,
+        parts: Sequence[str],
+        columns: Sequence[str],
+        batch_size: int,
+        shuffle: bool = True,
+        seed: int = 0,
+        window_rows: Optional[int] = None,
+        drop_last: bool = True,
+    ):
+        if not parts:
+            raise ValueError("need at least one part file")
+        if batch_size < 1:
+            raise ValueError("batch_size must be >= 1")
+        self.parts = list(parts)
+        self.columns = list(columns)
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.epoch = 0
+        self.window_rows = max(batch_size, window_rows or _DEFAULT_WINDOW_ROWS)
+        self._num_rows = sum(_part_num_rows(p) for p in self.parts)
+
+    def set_epoch(self, epoch: int) -> None:
+        self.epoch = epoch
+
+    def __len__(self) -> int:
+        if self.drop_last:
+            return self._num_rows // self.batch_size
+        return (self._num_rows + self.batch_size - 1) // self.batch_size
+
+    def _windows(self, path: str) -> Iterator[List[np.ndarray]]:
+        if path.endswith(".parquet"):
+            return _parquet_windows(path, self.columns, self.window_rows)
+        return _npz_windows(path, self.columns, self.window_rows)
+
+    def _iterate(self) -> Iterator[Any]:
+        rng = np.random.RandomState(self.seed + self.epoch)
+        order = (
+            rng.permutation(len(self.parts)) if self.shuffle
+            else np.arange(len(self.parts))
+        )
+        carry: Optional[List[np.ndarray]] = None
+        emitted = 0
+        limit = len(self)
+        for pi in order:
+            for window in self._windows(self.parts[pi]):
+                if self.shuffle:
+                    perm = rng.permutation(len(window[0]))
+                    window = [w[perm] for w in window]
+                if carry is not None:
+                    window = [
+                        np.concatenate([c, w]) for c, w in zip(carry, window)
+                    ]
+                    carry = None
+                n = len(window[0])
+                nb = n // self.batch_size
+                for b in range(nb):
+                    if emitted >= limit:
+                        return
+                    lo = b * self.batch_size
+                    yield tuple(
+                        w[lo:lo + self.batch_size] for w in window
+                    )
+                    emitted += 1
+                rest = n - nb * self.batch_size
+                if rest:
+                    carry = [w[n - rest:] for w in window]
+        if carry is not None and not self.drop_last and emitted < limit:
+            yield tuple(carry)
+
+
+class AsyncParquetStreamLoader(AsyncDataLoaderMixin, ParquetStreamLoader):
+    """ParquetStreamLoader with background prefetch (the petastorm
+    async loader analog)."""

@@ -200,8 +200,8 @@ class TpuEstimator:
                         feature_cols=self.feature_cols)
 
 
-def _load_columns(data_path, feature_cols, label_cols, *, partitioned=True):
-    """Read the columnar shards back into (features, labels).
+def _list_parts(data_path, *, partitioned=True):
+    """Part files this process should read, in order.
 
     Partitioned reads (reference: petastorm hands each worker its own
     row-groups, ``spark/common/store.py:89-105``): with multiple
@@ -214,8 +214,6 @@ def _load_columns(data_path, feature_cols, label_cols, *, partitioned=True):
 
     import horovod_tpu as hvd
 
-    from .store import read_shard
-
     parts = sorted(
         glob.glob(os.path.join(data_path, "part-*.npz"))
         + glob.glob(os.path.join(data_path, "part-*.parquet"))
@@ -226,6 +224,21 @@ def _load_columns(data_path, feature_cols, label_cols, *, partitioned=True):
     did_partition = partitioned and pc > 1 and len(parts) >= pc
     if did_partition:
         parts = parts[hvd.process_rank()::pc]
+    return parts, did_partition
+
+
+def _load_columns(data_path, feature_cols, label_cols, *, partitioned=True):
+    """Read the columnar shards back into (features, labels) in memory
+    (the non-streaming path; see ``_make_loader``)."""
+    parts, did_partition = _list_parts(data_path, partitioned=partitioned)
+    feats, labs = _read_parts(parts, feature_cols, label_cols)
+    return feats, labs, did_partition
+
+
+def _read_parts(parts, feature_cols, label_cols):
+    """Materialize already-listed part files into (features, labels)."""
+    from .store import read_shard
+
     blobs = [read_shard(p) for p in parts]
 
     def column(c):
@@ -242,7 +255,71 @@ def _load_columns(data_path, feature_cols, label_cols, *, partitioned=True):
                  for c in feature_cols]
         features = np.concatenate(feats, axis=-1)
     labels = column(label_cols[0])
-    return features, labels, did_partition
+    return features, labels
+
+
+class _FeatureComposingLoader:
+    """Adapts a per-column streaming loader to (features, label)
+    batches, joining multiple feature columns along the last axis (the
+    dense-assembler convention)."""
+
+    def __init__(self, base, n_features: int):
+        self._base = base
+        self._n = n_features
+
+    def __len__(self) -> int:
+        return len(self._base)
+
+    def set_epoch(self, epoch: int) -> None:
+        self._base.set_epoch(epoch)
+
+    def __iter__(self):
+        for cols in self._base:
+            if self._n == 1:
+                yield cols[0], cols[-1]
+            else:
+                feats = [
+                    np.atleast_2d(np.asarray(c).T).T.astype(np.float32)
+                    for c in cols[:self._n]
+                ]
+                yield np.concatenate(feats, axis=-1), cols[-1]
+
+
+def _make_loader(data_path, feature_cols, label_cols, batch_size):
+    """Build the epoch loader: streaming row-group reads when this
+    process owns disjoint parts (reference petastorm loaders,
+    ``spark/data_loaders/pytorch_data_loaders.py`` — epochs never
+    materialize a full shard), in-memory + index sharding otherwise.
+    ``HVD_TPU_STREAMING_READS=0`` forces the in-memory path.
+    """
+    import horovod_tpu as hvd
+
+    from ..utils import env as _env
+
+    if len(label_cols) != 1:
+        raise ValueError("exactly one label column is supported")
+    parts, did_partition = _list_parts(data_path)
+    pc = hvd.process_count()
+    # Index sharding (the pc>1, unpartitioned case) needs global random
+    # access — streaming would feed every process identical batches.
+    can_stream = did_partition or pc == 1
+    if _env.get_bool("STREAMING_READS", True) and can_stream:
+        from ..data import ParquetStreamLoader
+
+        base = ParquetStreamLoader(
+            parts, list(feature_cols) + list(label_cols),
+            batch_size=batch_size,
+            window_rows=_env.get_int("STREAM_WINDOW_ROWS", 4096),
+        )
+        return _FeatureComposingLoader(base, len(feature_cols)), did_partition
+    feats, labs = _read_parts(parts, feature_cols, label_cols)
+    from ..data import ArrayDataLoader
+
+    loader = ArrayDataLoader(
+        [np.asarray(feats), np.asarray(labs)],
+        batch_size=batch_size, shard=not did_partition,
+    )
+    return loader, did_partition
 
 
 def _sync_steps_per_epoch(loader, did_partition) -> Optional[int]:
@@ -280,13 +357,22 @@ def _train_worker(model_blob, opt_blob, loss_blob, data_path, feature_cols,
     store = FilesystemStore(store_prefix)
 
     hvd.init()
-    feats, labs, did_partition = _load_columns(
-        data_path, feature_cols, label_cols
+    loader, did_partition = _make_loader(
+        data_path, feature_cols, label_cols, batch_size
     )
-    features = [feats]
-    labels = [labs]
 
-    x0 = jnp.asarray(features[0][:1], jnp.float32)
+    # Agree on steps/epoch BEFORE touching data: a rank whose shard is
+    # smaller than one batch must hit the collective diagnostic below
+    # (and every rank must reach that collective), not a bare
+    # StopIteration on the init probe.
+    steps_per_epoch = _sync_steps_per_epoch(loader, did_partition)
+    if len(loader) == 0:
+        raise ValueError(
+            "data shard smaller than one batch (steps/epoch = 0); "
+            "reduce batch_size or provide more rows"
+        )
+    x0_batch = next(iter(loader))
+    x0 = jnp.asarray(np.asarray(x0_batch[0])[:1], jnp.float32)
     params = model.init(jax.random.PRNGKey(0), x0)
     # resume from a prior run's checkpoint if present
     ckpt = store.load_checkpoint(run_id)
@@ -304,16 +390,9 @@ def _train_worker(model_blob, opt_blob, loss_blob, data_path, feature_cols,
     step = hvd.distributed_train_step(loss_fn, tx)
     opt_state = step.init(params)
 
-    from ..data import ArrayDataLoader
-
     # Partitioned reads already gave this process disjoint rows; index
-    # sharding on top would skip data.  Collectives are per-step, so all
-    # processes must agree on steps/epoch: take the min across ranks.
-    loader = ArrayDataLoader(
-        [np.asarray(features[0]), np.asarray(labels[0])],
-        batch_size=batch_size, shard=not did_partition,
-    )
-    steps_per_epoch = _sync_steps_per_epoch(loader, did_partition)
+    # sharding on top would skip data.  Collectives are per-step, so
+    # all processes agreed on steps/epoch above (min across ranks).
     for epoch in range(epochs):
         loader.set_epoch(epoch)
         for i, (xb, yb) in enumerate(loader):

@@ -263,6 +263,7 @@ class TestTorchDistributedOptimizer:
             )
 
 
+@pytest.mark.integration
 def test_multiprocess_torch_optimizer_averages():
     """Two processes with different grads must converge to the mean
     (the reference's allreduce-in-step contract)."""
@@ -361,3 +362,73 @@ class TestParquetStore:
                 loss=lambda p, t: jnp.mean(p),
                 store=LocalStore(str(tmp_path / "s")), store_format="csv",
             )
+
+
+class TestStreamingEstimatorReads:
+    """VERDICT r3 item 9 gate: estimator epochs stream row-group
+    windows (shard >> window) with fit results as good as the
+    in-memory loader's."""
+
+    def _fit(self, tmp_path, run_id, monkeypatch, streaming: bool):
+        import optax
+
+        from horovod_tpu.spark import LocalStore, TpuEstimator
+
+        monkeypatch.setenv("HVD_TPU_STREAMING_READS",
+                           "1" if streaming else "0")
+        # 512-row shard vs a 64-row window: 8 windows per epoch
+        monkeypatch.setenv("HVD_TPU_STREAM_WINDOW_ROWS", "64")
+        rng = np.random.RandomState(3)
+        X = rng.randn(512, 4).astype(np.float32)
+        w = rng.randn(4, 1).astype(np.float32)
+        y = (X @ w).squeeze(-1)
+        import flax.linen as nn
+
+        class Linear(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(1)(x)
+
+        est = TpuEstimator(
+            model=Linear(), optimizer=optax.adam(0.05),
+            loss=lambda p, t: jnp.mean((p.squeeze(-1) - t) ** 2),
+            batch_size=32, epochs=6, store_format="parquet",
+            store=LocalStore(str(tmp_path / f"store_{run_id}")),
+            run_id=run_id,
+        )
+        model = est.fit_on_arrays(features=X, label=y)
+        pred = model.predict(X)
+        return float(np.mean((pred.squeeze(-1) - y) ** 2)), float(np.var(y))
+
+    def test_streaming_fit_matches_in_memory_quality(self, hvd_module,
+                                                     tmp_path, monkeypatch):
+        mse_stream, var = self._fit(tmp_path, "stream", monkeypatch, True)
+        mse_mem, _ = self._fit(tmp_path, "mem", monkeypatch, False)
+        assert mse_stream < var * 0.05, (mse_stream, var)
+        # same convergence band as the materializing loader
+        assert mse_stream < max(mse_mem * 3.0, var * 0.05)
+
+    def test_streaming_loader_selected(self, hvd_module, tmp_path,
+                                       monkeypatch):
+        """The parquet path must actually pick the streaming loader."""
+        from horovod_tpu.data import ParquetStreamLoader
+        from horovod_tpu.spark.estimator import (
+            _FeatureComposingLoader,
+            _make_loader,
+        )
+        from horovod_tpu.spark.store import write_shard
+
+        monkeypatch.setenv("HVD_TPU_STREAMING_READS", "1")
+        rng = np.random.RandomState(0)
+        write_shard(str(tmp_path / "part-00000"),
+                    {"features": rng.randn(64, 4).astype(np.float32),
+                     "label": rng.randn(64).astype(np.float32)},
+                    fmt="parquet")
+        loader, did_partition = _make_loader(
+            str(tmp_path), ["features"], ["label"], batch_size=16
+        )
+        assert isinstance(loader, _FeatureComposingLoader)
+        assert isinstance(loader._base, ParquetStreamLoader)
+        assert not did_partition
+        xb, yb = next(iter(loader))
+        assert xb.shape == (16, 4) and yb.shape == (16,)
