@@ -13,8 +13,11 @@ import sys
 
 import cloudpickle
 import numpy as np
+import pytest
 
 import horovod_tpu.runner as runner
+
+pytestmark = pytest.mark.integration
 
 N_OPS = 24
 

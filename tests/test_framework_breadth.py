@@ -44,6 +44,7 @@ def test_haiku_model_trains(hvd_module):
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_transformers_flax_gpt2_trains(hvd_module):
     transformers = pytest.importorskip("transformers")
     from transformers import FlaxGPT2LMHeadModel, GPT2Config

@@ -201,6 +201,7 @@ class TestJsrunCommand:
                                "--", "echo"])
 
 
+@pytest.mark.integration
 def test_js_run_end_to_end_with_fake_jsrun(tmp_path, monkeypatch):
     """A fake ``jsrun`` on PATH execs the worker shim locally once per
     requested rank with PMIX env, proving the full launch path: env

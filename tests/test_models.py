@@ -77,6 +77,7 @@ def test_tiny_resnet_stateful_training(hvd_module):
     assert any(jax.tree.leaves(changed))
 
 
+@pytest.mark.slow
 def test_vgg16_forward_and_param_count(hvd_module):
     from horovod_tpu.models import VGG16
 
@@ -89,6 +90,7 @@ def test_vgg16_forward_and_param_count(hvd_module):
     assert n_conv_stages == 13  # VGG-16 = 13 convs + 3 FC
 
 
+@pytest.mark.slow
 def test_inception_v3_forward(hvd_module):
     from horovod_tpu.models import InceptionV3
 

@@ -166,6 +166,7 @@ def test_shard_local_attention_on_sp_mesh_raises():
         jax.jit(f)(params, toks)
 
 
+@pytest.mark.slow
 def test_remat_matches_no_remat():
     """cfg.remat must change memory behavior only — identical logits
     and gradients (jax.checkpoint semantics)."""
