@@ -50,19 +50,13 @@ def _is_single_process() -> bool:
 
 def _process_reduce(arr: np.ndarray, average: bool,
                     member_procs=None) -> np.ndarray:
-    """Process-level mean/sum (the torch-bridge lowering: one flat
-    gather across controllers, reduced locally).  ``member_procs``
-    limits the reduction rows to a process subset — the allgather is
-    still collective (every process calls it), matching the masked
-    pass-through contract."""
-    import jax.numpy as jnp
-    from jax.experimental import multihost_utils
+    """Process-level mean/sum: a true device-mesh allreduce for the
+    global set (~2V wire), gather + local reduce for subsets (masked
+    pass-through needs the rows).  Collective either way — every
+    process must call it."""
+    from ._common import process_reduce
 
-    gathered = multihost_utils.process_allgather(jnp.asarray(arr))
-    if member_procs is not None:
-        gathered = gathered[jnp.asarray(member_procs)]
-    red = gathered.mean(axis=0) if average else gathered.sum(axis=0)
-    return np.asarray(red)
+    return process_reduce(arr, average, member_procs)
 
 
 # ---- collectives (reference tensorflow/mpi_ops.py surface) --------------
@@ -180,6 +174,87 @@ def process_set_included_op(process_set_id: int = 0,
     return _tf().constant(int(rank() in ps.ranks), name=name)
 
 
+# ---- gradient compression (reference tensorflow/compression.py) ---------
+
+class _NoneCompressor:
+    """No-op compression (reference ``NoneCompressor``)."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class _FP16Compressor:
+    """Cast floating gradients to fp16 for the wire (reference
+    ``FP16Compressor``) — halves the host-side gather bytes."""
+
+    @staticmethod
+    def compress(tensor):
+        tf = _tf()
+        if tensor.dtype.is_floating:
+            return tf.cast(tensor, tf.float16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is None:
+            return tensor
+        return _tf().cast(tensor, ctx)
+
+
+class Compression:
+    """Optional wire compression for the TF bridge (reference
+    ``horovod.tensorflow.Compression``)."""
+
+    none = _NoneCompressor
+    fp16 = _FP16Compressor
+
+
+# ---- SyncBatchNormalization (reference tensorflow/sync_batch_norm.py:65) -
+
+def SyncBatchNormalization(**kwargs):
+    """A keras BatchNormalization whose training statistics average
+    across ALL processes (reference ``SyncBatchNormalization._moments``
+    override: group mean/variance via ``Var[X] = E[X^2] - E[X]^2`` and
+    one stacked allreduce).
+
+    Returned as an instance from a factory (the bridge imports TF
+    lazily).  Single-process worlds degenerate to plain BatchNorm; the
+    cross-process path is eager-only like the rest of the bridge —
+    compile the model with ``run_eagerly=True`` for multi-process
+    training.  For JAX/flax models use ``horovod_tpu.SyncBatchNorm``.
+    """
+    tf = _tf()
+
+    class _SyncBatchNormalization(tf.keras.layers.BatchNormalization):
+        def _moments(self, inputs, mask):
+            mean, variance = super()._moments(inputs, mask)
+            if _is_single_process():
+                return mean, variance
+            if not tf.executing_eagerly():
+                raise NotImplementedError(
+                    "multi-process SyncBatchNormalization requires eager "
+                    "execution (the TPU bridge reduces host-side); "
+                    "compile with run_eagerly=True"
+                )
+            # Var[X] = E[X^2] - E[X]^2 over the global batch
+            mean_sq = variance + tf.math.square(mean)
+            stacked = tf.stack([mean, mean_sq]).numpy()
+            red = _process_reduce(stacked, average=True)
+            g_mean = tf.constant(red[0], dtype=mean.dtype)
+            g_mean_sq = tf.constant(red[1], dtype=variance.dtype)
+            return g_mean, g_mean_sq - tf.math.square(g_mean)
+
+    # No fixed default name: keras must auto-uniquify so models with
+    # several sync-BN layers build (the reference's fixed name predates
+    # keras-3 unique-name enforcement).
+    return _SyncBatchNormalization(**kwargs)
+
+
 # ---- variable plumbing (reference tensorflow/__init__.py:276) -----------
 
 def broadcast_variables(variables, root_rank: int = 0):
@@ -201,11 +276,13 @@ def broadcast_variables(variables, root_rank: int = 0):
 # ---- gradient reduction (DistributedGradientTape / DistributedOptimizer)
 
 def _reduce_grads(tf, grads: List[Any], average: bool,
-                  process_set=None) -> List[Any]:
+                  process_set=None, compression=None) -> List[Any]:
     """Fused process-level reduction of a gradient list; IndexedSlices
     entries reduce as gathered slices (never densified on the wire).
     With ``process_set``, only member processes' rows reduce and
-    non-members keep their local gradients (masked pass-through)."""
+    non-members keep their local gradients (masked pass-through).
+    ``compression`` (interop.tf.Compression) shrinks the dense wire
+    payload (e.g. fp16 halves it); sparse entries ship uncompressed."""
     if _is_single_process():
         return list(grads)
     member_procs, included = _member_processes(process_set)
@@ -214,21 +291,29 @@ def _reduce_grads(tf, grads: List[Any], average: bool,
         i for i, g in enumerate(grads)
         if g is not None and not isinstance(g, tf.IndexedSlices)
     ]
+    # wire compression before bucketing, so compressed tensors fuse
+    # into their own (e.g. fp16) buffers
+    comp = compression or _NoneCompressor
+    wire: Dict[int, Any] = {}
+    ctxs: Dict[int, Any] = {}
+    for i in dense_idx:
+        wire[i], ctxs[i] = comp.compress(grads[i])
     # one flat buffer per dtype (fusion-buffer behavior)
     by_dtype: Dict[str, List[int]] = {}
     for i in dense_idx:
-        by_dtype.setdefault(grads[i].dtype.name, []).append(i)
+        by_dtype.setdefault(wire[i].dtype.name, []).append(i)
     for dtype_name, idxs in by_dtype.items():
-        flats = [np.asarray(grads[i]).reshape(-1) for i in idxs]
+        flats = [np.asarray(wire[i]).reshape(-1) for i in idxs]
         splits = np.cumsum([f.size for f in flats])[:-1]
         red = _process_reduce(np.concatenate(flats), average,
                               member_procs)
         if not included:
             continue  # non-member: keep local grads (pass-through)
         for i, piece in zip(idxs, np.split(red, splits)):
-            out[i] = tf.constant(
-                piece.reshape(np.asarray(grads[i]).shape), grads[i].dtype
+            t = tf.constant(
+                piece.reshape(np.asarray(wire[i]).shape), wire[i].dtype
             )
+            out[i] = comp.decompress(t, ctxs[i])
     for i, g in enumerate(grads):
         if isinstance(g, tf.IndexedSlices):
             # allgather-of-slices across processes (reference :123-162)
@@ -389,14 +474,17 @@ class DistributedGradientTape:
     def __init__(self, tape, average: bool = True, process_set=None,
                  sparse_as_dense: bool = False,
                  backward_passes_per_step: int = 1,
-                 average_aggregated_gradients: bool = False):
+                 average_aggregated_gradients: bool = False,
+                 compression=None):
         self._tape = tape
         self._average = average
         self._process_set = process_set
         self._sparse_as_dense = sparse_as_dense
+        self._compression = compression
         self._agg = _GradAggregationHelper(
             backward_passes_per_step,
-            lambda gs: _reduce_grads(_tf(), gs, average, process_set),
+            lambda gs: _reduce_grads(_tf(), gs, average, process_set,
+                                     compression),
             average_aggregated_gradients,
         ) if backward_passes_per_step > 1 else None
 
@@ -422,14 +510,16 @@ class DistributedGradientTape:
             # g1 in g1, g1+g2, ...).
             out, _ = self._agg.step(tf, flat)
         else:
-            out = _reduce_grads(tf, flat, self._average, self._process_set)
+            out = _reduce_grads(tf, flat, self._average,
+                                self._process_set, self._compression)
         return tf.nest.pack_sequence_as(grads, out)
 
 
 def DistributedOptimizer(optimizer, average: bool = True,
                          sparse_as_dense: bool = False, process_set=None,
                          backward_passes_per_step: int = 1,
-                         average_aggregated_gradients: bool = False):
+                         average_aggregated_gradients: bool = False,
+                         compression=None):
     """Wrap a ``tf.keras`` optimizer so ``apply_gradients`` reduces
     first (reference ``tensorflow/__init__.py:627``).
 
@@ -451,7 +541,8 @@ def DistributedOptimizer(optimizer, average: bool = True,
                 "process_set": process_set,
                 "backward_passes_per_step": backward_passes_per_step,
                 "average_aggregated_gradients":
-                    average_aggregated_gradients}
+                    average_aggregated_gradients,
+                "compression": compression}
         if getattr(optimizer, "_hvd_wrap_config", None) != want:
             raise ValueError(
                 "optimizer is already wrapped with different settings "
@@ -462,7 +553,7 @@ def DistributedOptimizer(optimizer, average: bool = True,
     tf = _tf()
     agg = _GradAggregationHelper(
         backward_passes_per_step,
-        lambda gs: _reduce_grads(tf, gs, average, process_set),
+        lambda gs: _reduce_grads(tf, gs, average, process_set, compression),
         average_aggregated_gradients,
     ) if backward_passes_per_step > 1 else None
 
@@ -499,7 +590,8 @@ def DistributedOptimizer(optimizer, average: bool = True,
                         it.assign_add(1)
                     return None
             else:
-                reduced = _reduce_grads(tf, grads, average, process_set)
+                reduced = _reduce_grads(tf, grads, average, process_set,
+                                        compression)
             return super().apply_gradients(
                 zip(reduced, [v for _, v in pairs]), **kwargs
             )
@@ -519,7 +611,8 @@ def DistributedOptimizer(optimizer, average: bool = True,
                             "backward_passes_per_step":
                                 backward_passes_per_step,
                             "average_aggregated_gradients":
-                                average_aggregated_gradients}
+                                average_aggregated_gradients,
+                            "compression": compression}
     return obj
 
 

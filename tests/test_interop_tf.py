@@ -462,3 +462,128 @@ class TestBroadcastCallback:
         model.fit(x, y, batch_size=4, epochs=1, verbose=0, callbacks=[cb])
         assert cb.broadcast_done
         assert isinstance(cb, tf.keras.callbacks.Callback)
+
+
+class TestTFCompression:
+    def test_fp16_roundtrip(self, hvd_module):
+        import tensorflow as tf
+
+        import horovod_tpu.interop.tf as hvd_tf
+
+        t = tf.constant([1.5, -2.25, 3.0])
+        wire, ctx = hvd_tf.Compression.fp16.compress(t)
+        assert wire.dtype == tf.float16
+        back = hvd_tf.Compression.fp16.decompress(wire, ctx)
+        assert back.dtype == tf.float32
+        np.testing.assert_allclose(back.numpy(), t.numpy())
+        # ints pass through untouched
+        i = tf.constant([1, 2])
+        wire, ctx = hvd_tf.Compression.fp16.compress(i)
+        assert wire.dtype == tf.int32 and ctx is None
+
+    def test_wire_is_fp16_in_reduction(self, hvd_module, monkeypatch):
+        """With Compression.fp16 the gather payload must be half
+        precision (the reference FP16Compressor wire contract)."""
+        import tensorflow as tf
+
+        import horovod_tpu.interop.tf as hvd_tf
+        from horovod_tpu.runtime import get_runtime
+
+        seen = []
+
+        def spy_reduce(arr, average, member_procs=None):
+            seen.append(arr.dtype)
+            return arr  # identity: shapes preserved
+
+        monkeypatch.setattr(hvd_tf, "_process_reduce", spy_reduce)
+        monkeypatch.setattr(get_runtime(), "process_count", 2)
+        g = tf.constant(np.random.RandomState(0).randn(64).astype(np.float32))
+        out = hvd_tf._reduce_grads(
+            tf, [g], average=True, compression=hvd_tf.Compression.fp16
+        )
+        assert seen == [np.dtype(np.float16)]
+        assert out[0].dtype == tf.float32  # decompressed for the user
+
+    def test_optimizer_accepts_compression(self, hvd_module):
+        import tensorflow as tf
+
+        import horovod_tpu.interop.tf as hvd_tf
+
+        opt = hvd_tf.DistributedOptimizer(
+            tf.keras.optimizers.SGD(0.1),
+            compression=hvd_tf.Compression.fp16,
+        )
+        w = tf.Variable([1.0])
+        opt.apply_gradients([(tf.constant([0.5]), w)])
+        np.testing.assert_allclose(w.numpy(), [0.95])
+
+
+class TestTFSyncBatchNorm:
+    def test_single_process_matches_plain_bn(self, hvd_module):
+        import tensorflow as tf
+
+        import horovod_tpu.interop.tf as hvd_tf
+
+        rng = np.random.RandomState(0)
+        x = rng.randn(16, 4).astype(np.float32)
+        sync = hvd_tf.SyncBatchNormalization()
+        plain = tf.keras.layers.BatchNormalization()
+        y_s = sync(tf.constant(x), training=True)
+        y_p = plain(tf.constant(x), training=True)
+        np.testing.assert_allclose(y_s.numpy(), y_p.numpy(), rtol=1e-5)
+        assert isinstance(sync, tf.keras.layers.BatchNormalization)
+
+    def test_fit_with_sync_bn(self, hvd_module):
+        import tensorflow as tf
+
+        import horovod_tpu.interop.tf as hvd_tf
+
+        model = tf.keras.Sequential([
+            tf.keras.layers.Dense(8),
+            hvd_tf.SyncBatchNormalization(),
+            tf.keras.layers.Dense(1),
+        ])
+        model.compile(optimizer="sgd", loss="mse")
+        rng = np.random.RandomState(1)
+        X = rng.randn(32, 4).astype(np.float32)
+        Y = rng.randn(32, 1).astype(np.float32)
+        model.fit(X, Y, batch_size=8, epochs=1, verbose=0)
+
+
+@pytest.mark.integration
+def test_multiprocess_sync_bn_averages_stats():
+    """Two processes with different data: SyncBatchNormalization must
+    normalize with the GLOBAL batch moments (reference
+    tensorflow/sync_batch_norm.py:65 semantics), so both processes map
+    identical inputs to identical outputs."""
+    import sys
+
+    import cloudpickle
+
+    import horovod_tpu.runner as runner
+
+    def worker():
+        import numpy as np
+        import tensorflow as tf
+
+        import horovod_tpu as hvd
+        import horovod_tpu.interop.tf as hvd_tf
+
+        hvd.init()
+        r = hvd.process_rank()
+        # disjoint per-process batches with different means
+        x = np.full((4, 2), float(r * 10), np.float32)
+        bn = hvd_tf.SyncBatchNormalization(momentum=0.0, epsilon=1e-5)
+        y = bn(tf.constant(x), training=True)
+        # global batch = rows of 0 and 10 -> mean 5, var 25
+        return [float(y.numpy()[0, 0]), float(bn.moving_mean.numpy()[0]),
+                float(bn.moving_variance.numpy()[0])]
+
+    cloudpickle.register_pickle_by_value(sys.modules[__name__])
+    results = runner.run(worker, np=2, use_cpu_devices=True)
+    # rank0 input 0 -> (0-5)/sqrt(25) = -1; rank1 input 10 -> +1
+    np.testing.assert_allclose(results[0][0], -1.0, rtol=1e-3)
+    np.testing.assert_allclose(results[1][0], 1.0, rtol=1e-3)
+    for r in results:  # moving stats hold the synced moments
+        np.testing.assert_allclose(r[1], 5.0, rtol=1e-4)
+        np.testing.assert_allclose(r[2], 25.0, rtol=1e-3)
