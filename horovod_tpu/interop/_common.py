@@ -2,6 +2,87 @@
 
 from __future__ import annotations
 
+import functools
+from typing import Optional
+
+import numpy as np
+
+
+def process_reduce(arr: np.ndarray, average: bool,
+                   member_procs=None, op_sum: Optional[bool] = None
+                   ) -> np.ndarray:
+    """Cross-process reduction of a per-process host array.
+
+    Global set: a true device-mesh allreduce — each process contributes
+    one row of a (P, n) global array sharded one-row-per-process, and a
+    jitted sum/mean over the sharded axis makes XLA insert a real
+    all-reduce (~2V wire per link), replacing the O(P·V)
+    ``process_allgather`` the bridges used before (reference contract:
+    gradients ride allreduce, ``torch/mpi_ops.py`` ``synchronize``).
+
+    Subsets fall back to the gather path: the masked pass-through
+    semantics need per-row access, and subset reductions are the rare
+    case.  ``member_procs`` limits the reduction rows to those process
+    indices (still collective: every process must call this).
+    """
+    from .. import runtime
+
+    rt = runtime.get_runtime()
+    pc = rt.process_count
+    if pc == 1:
+        return np.asarray(arr)
+    if member_procs is not None and list(member_procs) != list(range(pc)):
+        return _gather_reduce(arr, average, member_procs)
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    by_proc: dict = {}
+    for d in jax.devices():
+        by_proc.setdefault(d.process_index, d)
+    if len(by_proc) != pc:
+        return _gather_reduce(arr, average, member_procs)
+    firsts = tuple(by_proc[p] for p in sorted(by_proc))
+    mesh = Mesh(np.asarray(firsts, dtype=object), ("p",))
+    arr = np.asarray(arr)
+    row = jax.device_put(arr[None], firsts[rt.process_rank])
+    garr = jax.make_array_from_single_device_arrays(
+        (pc,) + arr.shape, NamedSharding(mesh, P("p")), [row]
+    )
+    red = _jitted_row_reduce(average, firsts)(garr)
+    return np.asarray(red.addressable_data(0))
+
+
+@functools.lru_cache(maxsize=16)
+def _jitted_row_reduce(average: bool, firsts: tuple):
+    """One cached jitted reducer per (op, device set) — a fresh
+    jax.jit per call would retrace/recompile on every training step."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh, NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    mesh = Mesh(np.asarray(firsts, dtype=object), ("p",))
+    fn = (
+        (lambda a: jnp.mean(a, axis=0)) if average
+        else (lambda a: jnp.sum(a, axis=0))
+    )
+    return jax.jit(fn, out_shardings=NamedSharding(mesh, P()))
+
+
+def _gather_reduce(arr: np.ndarray, average: bool,
+                   member_procs=None) -> np.ndarray:
+    """Gather-based fallback (subset masking needs every row)."""
+    import jax.numpy as jnp
+    from jax.experimental import multihost_utils
+
+    gathered = multihost_utils.process_allgather(jnp.asarray(arr))
+    if member_procs is not None:
+        gathered = gathered[jnp.asarray(list(member_procs))]
+    red = gathered.mean(axis=0) if average else gathered.sum(axis=0)
+    return np.asarray(red)
+
 
 def member_processes(process_set):
     """Chip-rank process set -> (sorted member PROCESS indices, whether

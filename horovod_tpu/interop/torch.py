@@ -513,35 +513,30 @@ class _DistributedOptimizer:
         self._synchronized = True  # reduced (or nothing to reduce)
         if not params or _is_single_process():
             return
-        import jax.numpy as jnp
-        from jax.experimental import multihost_utils
-
         from ..ops.traced import Average, Sum
 
         if self._op not in (Average, Sum):
             raise ValueError(
                 "torch DistributedOptimizer supports op=Average or Sum"
             )
-        from ._common import member_processes
+        from ._common import member_processes, process_reduce
 
-        # process_allgather is collective: every process must call it;
+        # The reduction is collective: every process must call it;
         # non-members just discard the result and keep their local
-        # grads (the masked pass-through contract).
+        # grads (the masked pass-through contract).  Global-set
+        # reductions ride a true device-mesh allreduce (~2V wire);
+        # subsets gather (see _common.process_reduce).
         member_procs, apply_result = member_processes(self._process_set)
         by_dtype: Dict[Any, list] = {}
         for p in params:
             by_dtype.setdefault(p.grad.dtype, []).append(p)
         for dtype, ps in by_dtype.items():
             flat = torch.cat([p.grad.reshape(-1) for p in ps])
-            wire = jnp.asarray(_tensor_to_numpy(torch, flat))
+            wire = _tensor_to_numpy(torch, flat)
             if self._prescale != 1.0:
                 wire = wire * self._prescale
-            gathered = multihost_utils.process_allgather(wire)  # (P, n)
-            if member_procs is not None:
-                gathered = gathered[jnp.asarray(member_procs)]
-            red = (
-                gathered.mean(axis=0) if self._op == Average
-                else gathered.sum(axis=0)
+            red = process_reduce(
+                wire, self._op == Average, member_procs
             )
             if self._postscale != 1.0:
                 red = red * self._postscale
