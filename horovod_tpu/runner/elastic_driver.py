@@ -210,8 +210,12 @@ class ElasticDriver:
                 )
                 coordinator_addr = f"{coordinator_host}:{free_port()}"
                 # The rendezvous KV runs in this driver process: remote
-                # workers must dial our routable address, not loopback.
-                rendezvous_addr = exec_utils.routable_addr(assignments)
+                # workers must dial our routable address, not loopback —
+                # mutually verified via the NIC probe on multi-NIC hosts.
+                rendezvous_addr = exec_utils.probe_routable_addr(
+                    assignments, ssh_port=ssh_port,
+                    ssh_identity_file=ssh_identity_file,
+                )
                 workers = []
                 for slot in assignments:
                     env = make_worker_env(

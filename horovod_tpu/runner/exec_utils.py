@@ -16,6 +16,9 @@ from typing import Dict, List, Optional
 
 LOCAL_HOSTNAMES = {"localhost", "127.0.0.1", "::1"}
 
+# NIC-probe results per remote-host set (see probe_routable_addr).
+_probe_cache: Dict[tuple, str] = {}
+
 
 def is_local(hostname: str) -> bool:
     return hostname in LOCAL_HOSTNAMES or hostname == socket.gethostname()
@@ -26,11 +29,186 @@ def routable_addr(assignments) -> str:
     this (driver) process: loopback when every slot is local, else this
     host's resolvable address.  Shared by the static, elastic, and jsrun
     launch paths so they cannot diverge.  Accepts SlotInfo-likes (with a
-    ``hostname`` attr) or plain hostname strings."""
+    ``hostname`` attr) or plain hostname strings.
+
+    This is the zero-cost heuristic; :func:`probe_routable_addr` runs
+    the reference-style mutual-interface check on top of it."""
     names = [getattr(a, "hostname", a) for a in assignments]
     if all(is_local(h) for h in names):
         return "127.0.0.1"
     return socket.gethostbyname(socket.gethostname())
+
+
+def _local_candidate_addrs(remote_hosts) -> List[str]:
+    """Candidate local addresses remote hosts might reach us on.
+
+    Per-destination outbound interfaces via the UDP-connect trick
+    (kernel routing decides, nothing is sent), plus the resolved
+    hostname; loopback excluded, order preserved."""
+    cands: List[str] = []
+    for h in remote_hosts:
+        try:
+            s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+            try:
+                s.connect((h, 9))
+                cands.append(s.getsockname()[0])
+            finally:
+                s.close()
+        except OSError:
+            continue
+    try:
+        cands.append(socket.gethostbyname(socket.gethostname()))
+    except OSError:
+        pass
+    out: List[str] = []
+    for c in cands:
+        if c and not c.startswith("127.") and c != "::1" and c not in out:
+            out.append(c)
+    return out
+
+
+def _ssh_dial(host, addrs, port, token, ssh_port, ssh_identity_file,
+              timeout_s):
+    """Run a one-shot dial script ON ``host`` (via ssh) that tries every
+    candidate address and prints the ones whose echo handshake worked."""
+    script = (
+        "import socket,sys\n"
+        "ok=[]\n"
+        f"for a in {list(addrs)!r}:\n"
+        "    try:\n"
+        "        s=socket.create_connection((a, %d), timeout=3)\n"
+        "        s.sendall(%r.encode()+b'\\n')\n"
+        "        if s.recv(64).strip()==%r.encode(): ok.append(a)\n"
+        "        s.close()\n"
+        "    except OSError: pass\n"
+        "print(','.join(ok))\n" % (port, token, token)
+    )
+    # Own ssh argv (host is always remote here): BatchMode forbids
+    # password prompts and ConnectTimeout bounds a firewalled port —
+    # a hung probe must never stall the launch.
+    ssh = ["ssh", "-o", "StrictHostKeyChecking=no",
+           "-o", "BatchMode=yes", "-o", "ConnectTimeout=5"]
+    if ssh_port:
+        ssh += ["-p", str(ssh_port)]
+    if ssh_identity_file:
+        ssh += ["-i", ssh_identity_file]
+    argv = ssh + [host, f"{shlex.quote(sys.executable)} -c "
+                        f"{shlex.quote(script)}"]
+    try:
+        res = subprocess.run(argv, capture_output=True, text=True,
+                             timeout=timeout_s)
+        if res.returncode != 0:
+            return set()
+        return {a for a in res.stdout.strip().split(",") if a}
+    except Exception:
+        return set()
+
+
+def probe_routable_addr(assignments, ssh_port=None, ssh_identity_file=None,
+                        timeout_s: float = 20.0, _dial=None) -> str:
+    """Mutually-verified driver address (the reference NIC-probe
+    protocol, ``runner/driver/driver_service.py`` ``_run_probe`` +
+    ``task_service.py:383`` recast): the launch host listens with a
+    token echo, every REMOTE host dials back each candidate local
+    address, and the first address reachable from ALL remote hosts
+    wins — a multi-NIC launch host can no longer hand workers an
+    interface they cannot route to.
+
+    Falls back to :func:`routable_addr` (with a warning naming the
+    per-host results) when no candidate is mutually reachable or
+    probing is disabled via ``HVD_TPU_NIC_PROBE=0``."""
+    from ..utils.env import get_bool
+    from ..utils.logging import get_logger
+
+    names = [getattr(a, "hostname", a) for a in assignments]
+    remotes = sorted({h for h in names if not is_local(h)})
+    if not remotes:
+        return "127.0.0.1"
+    if not get_bool("NIC_PROBE", True):
+        return routable_addr(assignments)
+    # One ssh round-trip per remote host is fine at launch but not per
+    # elastic round: cache per remote-host set.
+    cache_key = (tuple(remotes), ssh_port, ssh_identity_file)
+    if _dial is None and cache_key in _probe_cache:
+        return _probe_cache[cache_key]
+    cands = _local_candidate_addrs(remotes)
+    if not cands:
+        get_logger().warning(
+            "NIC probe: no candidate local addresses for remotes %s; "
+            "falling back to the resolver heuristic", remotes,
+        )
+        return routable_addr(assignments)
+
+    import secrets as _secrets
+    import threading as _threading
+
+    token = _secrets.token_hex(8)
+    srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    srv.bind(("", 0))
+    srv.listen(64)
+    srv.settimeout(0.5)
+    port = srv.getsockname()[1]
+    stop = _threading.Event()
+
+    def echo_loop():
+        while not stop.is_set():
+            try:
+                conn, _ = srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            try:
+                line = conn.recv(64)
+                if line.strip() == token.encode():
+                    conn.sendall(token.encode() + b"\n")
+            except OSError:
+                pass
+            finally:
+                conn.close()
+
+    t = _threading.Thread(target=echo_loop, daemon=True)
+    t.start()
+    dial = _dial or (lambda h: _ssh_dial(
+        h, cands, port, token, ssh_port, ssh_identity_file, timeout_s
+    ))
+    try:
+        # Dial hosts concurrently: each probe is an independent ssh, so
+        # an unreachable cluster costs one timeout, not hosts x timeout.
+        reachable: Dict[str, set] = {}
+        dial_threads = []
+        for h in remotes:
+            def run(h=h):
+                reachable[h] = dial(h)
+
+            th = threading.Thread(target=run, daemon=True)
+            th.start()
+            dial_threads.append(th)
+        for th in dial_threads:
+            th.join(timeout=timeout_s + 5)
+        for h in remotes:
+            reachable.setdefault(h, set())
+    finally:
+        stop.set()
+        srv.close()
+        t.join(timeout=2)
+    common = [c for c in cands if all(c in reachable[h] for h in remotes)]
+    if common:
+        addr = common[0]
+    else:
+        get_logger().warning(
+            "NIC probe: no local address reachable from every remote "
+            "host (candidates %s, per-host results %s); falling back to "
+            "the resolver heuristic — set the driver address explicitly "
+            "if workers fail to connect", cands, reachable,
+        )
+        addr = routable_addr(assignments)
+    if _dial is None:
+        # Cache fallbacks too: elastic respawns must not repay the
+        # probe timeout every recovery round.
+        _probe_cache[cache_key] = addr
+    return addr
 
 
 def build_command(

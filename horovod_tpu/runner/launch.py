@@ -38,7 +38,14 @@ def free_port() -> int:
     return port
 
 
-def start_job_services(np_: int, worker_hosts: List[str]) -> Tuple[object, Dict[str, str]]:
+def start_job_services(
+    np_: int,
+    worker_hosts: List[str],
+    *,
+    ssh_port: Optional[int] = None,
+    ssh_identity_file: Optional[str] = None,
+    nic_probe: bool = True,
+) -> Tuple[object, Dict[str, str]]:
     """Start the KV/rendezvous controller in this (launcher) process and
     build the service env every launch path exports — one implementation
     shared by the static, mpirun, and jsrun paths so they cannot drift.
@@ -52,18 +59,32 @@ def start_job_services(np_: int, worker_hosts: List[str]) -> Tuple[object, Dict[
     secret = pysecrets.token_hex(16)
     server = controller_py.make_server(secret, np_)
     all_local = all(exec_utils.is_local(h) for h in worker_hosts)
+    # Mutually-verified launcher address (the reference NIC-probe
+    # protocol): one probe covers both the rendezvous KV and a
+    # launcher-local coordinator.  Launchers that do not reach workers
+    # over ssh (mpirun/jsrun own the remote exec) pass nic_probe=False
+    # and keep the heuristic.
+    if all_local:
+        launcher_addr = "127.0.0.1"
+    elif nic_probe:
+        launcher_addr = exec_utils.probe_routable_addr(
+            worker_hosts, ssh_port=ssh_port,
+            ssh_identity_file=ssh_identity_file,
+        )
+    else:
+        launcher_addr = exec_utils.routable_addr(worker_hosts)
     if all_local:
         coordinator_host = "127.0.0.1"
     elif exec_utils.is_local(worker_hosts[0]):
         # worker 0 runs on this launcher host but peers are remote: they
         # must dial a routable name, not the literal "localhost".
-        coordinator_host = exec_utils.routable_addr(worker_hosts)
+        coordinator_host = launcher_addr
     else:
         coordinator_host = worker_hosts[0]
     env = {
         "HVD_TPU_COORDINATOR_ADDR": f"{coordinator_host}:{free_port()}",
         "HVD_TPU_CROSS_SIZE": str(np_),
-        "HVD_TPU_RENDEZVOUS_ADDR": exec_utils.routable_addr(worker_hosts),
+        "HVD_TPU_RENDEZVOUS_ADDR": launcher_addr,
         "HVD_TPU_RENDEZVOUS_PORT": str(server.port),
         "HVD_TPU_SECRET": secret,
     }
@@ -117,7 +138,8 @@ def launch_static(
     """
     assignments = hosts_mod.get_host_assignments(host_list, np_)
     server, service_env = start_job_services(
-        np_, [a.hostname for a in assignments]
+        np_, [a.hostname for a in assignments],
+        ssh_port=ssh_port, ssh_identity_file=ssh_identity_file,
     )
     if verbose:
         get_logger().warning(

@@ -327,7 +327,7 @@ def js_run(
     # Worker 0 (the jax.distributed coordinator) runs on the first
     # rankfile host; the shared helper points the coordinator addr
     # there and the rendezvous addr at this launcher process.
-    server, service_env = start_job_services(np_, list(worker_slots))
+    server, service_env = start_job_services(np_, list(worker_slots), nic_probe=False)
     env = dict(os.environ)
     env.update(service_env)
     if extra_env:

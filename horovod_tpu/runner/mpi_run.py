@@ -94,7 +94,7 @@ def mpi_run(
     )
     assignments = hosts_mod.get_host_assignments(host_list, np_)
     server, service_env = start_job_services(
-        np_, [a.hostname for a in assignments]
+        np_, [a.hostname for a in assignments], nic_probe=False
     )
     env = dict(os.environ)
     env.update(service_env)
