@@ -61,13 +61,47 @@ def _process_reduce(arr: np.ndarray, average: bool,
 
 # ---- collectives (reference tensorflow/mpi_ops.py surface) --------------
 
+def _in_graph(tf, tensor) -> bool:
+    """True when called from inside a traced tf.function with a
+    symbolic tensor — the case the reference serves with its registered
+    AsyncOpKernels (``tensorflow/mpi_ops.cc:409-880``)."""
+    return (not tf.executing_eagerly()) and tf.is_tensor(tensor)
+
+
+def _graph_wrap(tf, fn, tensor, out_shape=None, out_dtype=None):
+    """Make a host-side collective usable INSIDE tf.function graphs:
+    ``tf.py_function`` re-enters the eager bridge at graph-execution
+    time (the in-graph analog of the reference's C++ kernels — the
+    payload still crosses through the host, which is this bridge's
+    documented lowering).  Static shape is restored when known;
+    ``out_dtype`` overrides the declared output dtype when the eager
+    lowering changes it (e.g. integer Average returns float)."""
+    out = tf.py_function(fn, [tensor], out_dtype or tensor.dtype)
+    if out_shape is not None:
+        out.set_shape(out_shape)
+    return out
+
+
 def allreduce(tensor, average: Optional[bool] = None, op: Optional[int] = None,
               name: Optional[str] = None, process_set=None,
               prescale_factor: float = 1.0, postscale_factor: float = 1.0):
     """``hvd.allreduce`` on a tf.Tensor (stacked ``(size, ...)``
     convention like the JAX eager API).  ``tf.IndexedSlices`` reduce as
-    allgather-of-slices (reference ``tensorflow/__init__.py:95-162``)."""
+    allgather-of-slices (reference ``tensorflow/__init__.py:95-162``).
+    Callable inside ``tf.function`` graphs (py_function lowering)."""
     tf = _tf()
+    if _in_graph(tf, tensor) and not isinstance(tensor, tf.IndexedSlices):
+        # The eager lowering is dtype-preserving (int Average truncates
+        # like the reference), so Tout == input dtype is exact.
+        return _graph_wrap(
+            tf,
+            lambda t: allreduce(
+                t, average=average, op=op, name=name,
+                process_set=process_set, prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+            ),
+            tensor, out_shape=tensor.shape,
+        )
     if isinstance(tensor, tf.IndexedSlices):
         avg = (
             average if average is not None
@@ -97,6 +131,22 @@ def allreduce(tensor, average: Optional[bool] = None, op: Optional[int] = None,
 
 def allgather(tensor, name: Optional[str] = None, process_set=None):
     tf = _tf()
+    if _in_graph(tf, tensor):
+        # Stacked (size, ...) inputs keep their rank and leading dim;
+        # only the gathered dim is dynamic — restore what is static so
+        # rank-sensitive downstream graph ops still build.
+        out_shape = None
+        shape = tensor.shape
+        if shape.rank is not None and shape.rank >= 2:
+            from .. import size as _size
+
+            if shape[0] is not None and int(shape[0]) == _size():
+                out_shape = [shape[0]] + [None] * (shape.rank - 1)
+        return _graph_wrap(
+            tf,
+            lambda t: allgather(t, name=name, process_set=process_set),
+            tensor, out_shape=out_shape,
+        )
     return tf.constant(np.asarray(_eager.allgather(
         _to_np(tensor), name=name, process_set=process_set
     )))
@@ -105,6 +155,13 @@ def allgather(tensor, name: Optional[str] = None, process_set=None):
 def broadcast(tensor, root_rank: int, name: Optional[str] = None,
               process_set=None):
     tf = _tf()
+    if _in_graph(tf, tensor):
+        return _graph_wrap(
+            tf,
+            lambda t: broadcast(t, root_rank, name=name,
+                                process_set=process_set),
+            tensor, out_shape=tensor.shape,
+        )
     return tf.constant(np.asarray(_eager.broadcast(
         _to_np(tensor), root_rank, name=name, process_set=process_set
     )))
@@ -113,6 +170,18 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None,
 def alltoall(tensor, splits=None, name: Optional[str] = None,
              process_set=None):
     tf = _tf()
+    if _in_graph(tf, tensor):
+        if splits is not None:
+            raise NotImplementedError(
+                "alltoall with explicit splits inside tf.function is not "
+                "supported (recv counts are a second negotiated output); "
+                "call it eagerly"
+            )
+        return _graph_wrap(
+            tf,
+            lambda t: alltoall(t, name=name, process_set=process_set),
+            tensor, out_shape=tensor.shape,
+        )
     out = _eager.alltoall(
         _to_np(tensor), splits, name=name, process_set=process_set
     )

@@ -587,3 +587,94 @@ def test_multiprocess_sync_bn_averages_stats():
     for r in results:  # moving stats hold the synced moments
         np.testing.assert_allclose(r[1], 5.0, rtol=1e-4)
         np.testing.assert_allclose(r[2], 25.0, rtol=1e-3)
+
+
+class TestInGraphCollectives:
+    """Collectives inside tf.function graphs (the reference registers
+    AsyncOpKernels for exactly this, ``tensorflow/mpi_ops.cc:409-880``;
+    here a py_function re-enters the eager bridge at execution time)."""
+
+    def test_allreduce_in_tf_function(self, hvd_module):
+        x = tf.constant(np.arange(N * 4, dtype=np.float32).reshape(N, 4))
+
+        @tf.function
+        def fn(t):
+            return hvd_tf.allreduce(t, op=hvd.Sum) * 2.0
+
+        y = fn(x)
+        np.testing.assert_allclose(
+            y.numpy()[0], np.asarray(x).sum(axis=0) * 2.0, rtol=1e-6
+        )
+        # static shape preserved for downstream graph ops
+        assert fn.get_concrete_function(x).output_shapes.as_list() == [N, 4]
+
+    def test_broadcast_and_allgather_in_tf_function(self, hvd_module):
+        x = tf.constant(np.random.RandomState(0).randn(N, 3), tf.float32)
+
+        @tf.function
+        def fn(t):
+            b = hvd_tf.broadcast(t, root_rank=2)
+            g = hvd_tf.allgather(t)
+            return b, g
+
+        b, g = fn(x)
+        for r in range(N):
+            np.testing.assert_allclose(b.numpy()[r], x.numpy()[2])
+        # stacked convention: every rank holds the (N*3,) concatenation
+        assert g.numpy().shape == (N, N * 3)
+
+    def test_alltoall_in_tf_function(self, hvd_module):
+        x = tf.constant(np.random.RandomState(1).randn(N, N), tf.float32)
+
+        @tf.function
+        def fn(t):
+            return hvd_tf.alltoall(t)
+
+        y = fn(x)
+        assert y.numpy().shape == (N, N)
+
+        @tf.function
+        def bad(t):
+            return hvd_tf.alltoall(t, splits=np.ones((N, N), np.int32))
+
+        with pytest.raises(Exception, match="splits inside tf.function"):
+            bad(x)
+
+    def test_scalar_query_ops_in_graph(self, hvd_module):
+        @tf.function
+        def fn():
+            return hvd_tf.size_op() + hvd_tf.rank_op()
+
+        assert int(fn().numpy()) == N + 0
+
+
+def test_in_graph_int_average_preserves_dtype(hvd_module):
+    """The eager lowering is dtype-preserving (int Average truncates,
+    reference semantics) — the in-graph path must declare the same Tout
+    and agree numerically with the eager call."""
+    x = tf.constant(np.arange(N * 2, dtype=np.int32).reshape(N, 2))
+
+    @tf.function
+    def fn(t):
+        return hvd_tf.allreduce(t)  # default Average
+
+    y = fn(x)
+    eager = hvd_tf.allreduce(x)
+    assert y.dtype == eager.dtype == tf.int32
+    np.testing.assert_array_equal(y.numpy(), eager.numpy())
+
+
+def test_in_graph_allgather_keeps_static_rank(hvd_module):
+    """Downstream rank-sensitive graph ops must still build: only the
+    gathered dim may be dynamic (review regression)."""
+    x = tf.constant(np.random.RandomState(0).randn(N, 2, 3), tf.float32)
+
+    @tf.function
+    def fn(t):
+        g = hvd_tf.allgather(t)
+        return tf.linalg.matmul(g, tf.ones((3, 1)))  # needs known rank
+
+    y = fn(x)
+    assert y.numpy().shape == (N, N * 2, 1)
+    cf = fn.get_concrete_function(x)
+    assert cf.output_shapes.rank == 3
