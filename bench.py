@@ -30,6 +30,10 @@ import time
 
 BASELINE_IMG_PER_SEC_PER_ACCEL = 1656.82 / 16  # docs/benchmarks.rst:32-43
 
+# Best completed sweep result so far: emitted instead of a bare error
+# when a later config (or the GPT workload) hangs past the deadline.
+_PARTIAL = None
+
 # Peak dense bf16 TFLOP/s per chip by device_kind substring (public
 # cloud.google.com/tpu/docs system-architecture figures).
 _PEAK_BF16_TFLOPS = [
@@ -171,8 +175,11 @@ def main():
         "device_kind": device.device_kind,
         "peak_bf16_tflops": _chip_peak_tflops(device),
     }
-    # MLPerf-style space-to-depth stem (models/resnet.py): flip via env
-    # until measured-on-hardware default is recorded.
+    # Config sweep (HVD_BENCH_SWEEP=0 pins the single explicit config):
+    # the stem and batch winners were prepared in round 3 but never
+    # measured on hardware, so the bench explores them itself within
+    # the deadline — each config is guarded, earlier results survive a
+    # late failure, and the primary metric is the best completed config.
     stem = os.environ.get("HVD_BENCH_STEM", "conv7")
     if stem not in ("conv7", "space_to_depth"):
         # fail before paying any compile: the __main__ wrapper turns
@@ -181,21 +188,71 @@ def main():
             f"HVD_BENCH_STEM must be 'conv7' or 'space_to_depth', "
             f"got {stem!r}"
         )
-    resnet = bench_resnet(hvd, jnp, batch_per_chip=256, stem=stem)
-    result.update(
-        value=resnet["images_per_sec_per_chip"],
-        vs_baseline=round(
-            resnet["images_per_sec_per_chip"] / BASELINE_IMG_PER_SEC_PER_ACCEL, 3
-        ),
-        step_time_ms=resnet["step_time_ms"],
-        batch_per_chip=resnet["batch_per_chip"],
-        mfu=resnet["mfu"],
-        achieved_tflops=resnet["achieved_tflops"],
-        stem=stem,
-    )
+    sweep = os.environ.get("HVD_BENCH_SWEEP", "1") != "0"
+    deadline_s = int(os.environ.get("HVD_BENCH_DEADLINE_S", "480"))
+    t_start = time.monotonic()
+    configs = [(stem, 256)]
+    if sweep:
+        for cfg in (("space_to_depth", 256), ("space_to_depth", 512),
+                    ("conv7", 512)):
+            if cfg not in configs:
+                configs.append(cfg)
+    runs = []
+    hit_deadline = False
+    for i, (s, b) in enumerate(configs):
+        # budget check: a config costs ~60s (compile+timed run); always
+        # run the first, keep ~120s for the GPT workload afterwards
+        remaining = deadline_s - (time.monotonic() - t_start)
+        if i > 0 and remaining < 180:
+            break
+        try:
+            r = bench_resnet(hvd, jnp, batch_per_chip=b, stem=s)
+            r["stem"] = s
+            runs.append(r)
+        except TimeoutError as e:
+            # The one-shot SIGALRM fired: the device is wedged and the
+            # alarm is disarmed — no further device calls, ever.
+            runs.append({"stem": s, "batch_per_chip": b,
+                         "error": f"TimeoutError: {e}"})
+            hit_deadline = True
+        except Exception as e:  # OOM at 512 etc: keep earlier results
+            runs.append({"stem": s, "batch_per_chip": b,
+                         "error": f"{type(e).__name__}: {e}"})
+        ok = [r for r in runs if "error" not in r]
+        if ok:
+            best = max(ok, key=lambda r: r["images_per_sec_per_chip"])
+            result.update(
+                value=best["images_per_sec_per_chip"],
+                vs_baseline=round(
+                    best["images_per_sec_per_chip"]
+                    / BASELINE_IMG_PER_SEC_PER_ACCEL, 3
+                ),
+                step_time_ms=best["step_time_ms"],
+                batch_per_chip=best["batch_per_chip"],
+                mfu=best["mfu"],
+                achieved_tflops=best["achieved_tflops"],
+                stem=best["stem"],
+                sweep=runs if sweep else None,
+            )
+            # a mid-sweep device hang must not discard finished configs
+            global _PARTIAL
+            _PARTIAL = dict(result)
+        if hit_deadline:
+            break
+    if not any("error" not in r for r in runs):
+        raise RuntimeError(f"all resnet configs failed: {runs}")
+    if hit_deadline:
+        # alarm already fired (and is one-shot): emit what we have
+        # rather than touching the wedged device again
+        result["sweep_note"] = "deadline hit during sweep; gpt skipped"
+        print(json.dumps(result))
+        return
     try:
         gpt = bench_gpt(hvd, jnp)
         result["gpt2_small"] = gpt
+    except TimeoutError as e:
+        # no retry on a disarmed alarm: the device is gone
+        result["gpt2_small"] = {"error": f"TimeoutError: {e}"}
     except Exception:  # e.g. OOM at batch 16: retry the known-good size
         try:
             result["gpt2_small"] = bench_gpt(hvd, jnp, batch_per_chip=8)
@@ -234,11 +291,20 @@ if __name__ == "__main__":
             )
         main()
     except Exception as e:  # TimeoutError from the alarm lands here too
-        print(json.dumps({
-            "metric": "resnet50_synthetic_train_throughput",
-            "value": 0.0,
-            "unit": "images/sec/chip",
-            "vs_baseline": 0.0,
-            "error": f"{type(e).__name__}: {e}",
-        }))
+        if _PARTIAL is not None:
+            # A later sweep config or the GPT workload died, but a full
+            # primary measurement finished: report it (with a note, not
+            # an "error" field — the number is real).
+            _PARTIAL["sweep_note"] = (
+                f"later config aborted: {type(e).__name__}: {e}"
+            )
+            print(json.dumps(_PARTIAL))
+        else:
+            print(json.dumps({
+                "metric": "resnet50_synthetic_train_throughput",
+                "value": 0.0,
+                "unit": "images/sec/chip",
+                "vs_baseline": 0.0,
+                "error": f"{type(e).__name__}: {e}",
+            }))
         sys.exit(0)
