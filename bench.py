@@ -34,6 +34,11 @@ BASELINE_IMG_PER_SEC_PER_ACCEL = 1656.82 / 16  # docs/benchmarks.rst:32-43
 # when a later config (or the GPT workload) hangs past the deadline.
 _PARTIAL = None
 
+# When the SIGALRM was armed (__main__): the sweep's remaining-budget
+# guards must measure against the real deadline, not main()'s start —
+# the device probe + init can eat minutes before main() runs.
+_ALARM_ARMED_AT = None
+
 # Peak dense bf16 TFLOP/s per chip by device_kind substring (public
 # cloud.google.com/tpu/docs system-architecture figures).
 _PEAK_BF16_TFLOPS = [
@@ -160,6 +165,8 @@ def bench_gpt(hvd, jnp, batch_per_chip: int = 16, seq_len: int = 1024,
 
 
 def main():
+    global _PARTIAL
+
     import jax
     import jax.numpy as jnp
 
@@ -190,7 +197,9 @@ def main():
         )
     sweep = os.environ.get("HVD_BENCH_SWEEP", "1") != "0"
     deadline_s = int(os.environ.get("HVD_BENCH_DEADLINE_S", "480"))
-    t_start = time.monotonic()
+    t_start = _ALARM_ARMED_AT if _ALARM_ARMED_AT is not None else (
+        time.monotonic()
+    )
     configs = [(stem, 256)]
     if sweep:
         for cfg in (("space_to_depth", 256), ("space_to_depth", 512),
@@ -235,7 +244,6 @@ def main():
                 sweep=runs if sweep else None,
             )
             # a mid-sweep device hang must not discard finished configs
-            global _PARTIAL
             _PARTIAL = dict(result)
         if hit_deadline:
             break
@@ -250,6 +258,28 @@ def main():
     try:
         gpt = bench_gpt(hvd, jnp)
         result["gpt2_small"] = gpt
+        _PARTIAL = dict(result)
+        # batch 32 halves the per-token overhead if it fits — measure
+        # it when budget remains, keep whichever clocks faster
+        if sweep and deadline_s - (time.monotonic() - t_start) > 120:
+            try:
+                gpt32 = bench_gpt(hvd, jnp, batch_per_chip=32)
+                if (gpt32["tokens_per_sec_per_chip"]
+                        > gpt["tokens_per_sec_per_chip"]):
+                    result["gpt2_small"] = gpt32
+                result["gpt2_small"]["sweep"] = [
+                    {k: r[k] for k in
+                     ("batch_per_chip", "tokens_per_sec_per_chip", "mfu")}
+                    for r in (gpt, gpt32)
+                ]
+            except TimeoutError as e:
+                result["gpt2_small"]["sweep_note"] = (
+                    f"batch-32 probe aborted: {e}"
+                )
+            except Exception as e:  # OOM at 32: batch-16 result stands
+                result["gpt2_small"]["sweep_note"] = (
+                    f"batch-32 probe failed: {type(e).__name__}: {e}"
+                )
     except TimeoutError as e:
         # no retry on a disarmed alarm: the device is gone
         result["gpt2_small"] = {"error": f"TimeoutError: {e}"}
@@ -272,6 +302,7 @@ if __name__ == "__main__":
         )
 
     signal.signal(signal.SIGALRM, _deadline)
+    _ALARM_ARMED_AT = time.monotonic()
     signal.alarm(int(os.environ.get("HVD_BENCH_DEADLINE_S", "480")))
     try:
         # Fail fast on a wedged device tunnel: probe device liveness in

@@ -33,6 +33,16 @@ from ..runtime import WORLD_AXIS, get_runtime
 
 Axis = Union[str, Sequence[str]]
 
+# Trace-time override for the hierarchical-allreduce lowering choice —
+# the autotune driver's second knob (mirrors fusion.set_threshold_
+# override): None defers to the env default.
+_hierarchical_override: Optional[bool] = None
+
+
+def set_hierarchical_override(value: Optional[bool]) -> None:
+    global _hierarchical_override
+    _hierarchical_override = value
+
 # Reduction op ids — match the reference's ReduceOp values exposed as
 # hvd.Average / hvd.Sum / hvd.Adasum (horovod/torch/mpi_ops.py,
 # operations.cc:1396-1410), extended with Min/Max/Product.
@@ -337,7 +347,10 @@ def allreduce(
         op = Sum
 
     if hierarchical is None:
-        hierarchical = env.get_bool(env.HIERARCHICAL_ALLREDUCE, False)
+        hierarchical = (
+            _hierarchical_override if _hierarchical_override is not None
+            else env.get_bool(env.HIERARCHICAL_ALLREDUCE, False)
+        )
 
     if op == Sum:
         if mask is None:

@@ -535,15 +535,17 @@ class TrainStep:
             model_state = None
         specs = self._state_specs(opt_state)
         threshold = None
+        hier = None
         if self._autotune is not None:
             threshold = self._autotune.threshold_bytes()
+            hier = self._autotune.hierarchical()
             if self._autotune.converged and len(self._step_cache) > 1:
                 # Exploration over: drop the losing compiled variants
                 # (each is a full XLA executable holding device code).
                 frozen_key = (
                     jax.tree.structure(opt_state),
                     jax.tree.structure(model_state),
-                    threshold,
+                    threshold, hier,
                 )
                 self._step_cache = {
                     k: v for k, v in self._step_cache.items()
@@ -552,7 +554,7 @@ class TrainStep:
         key = (
             jax.tree.structure(opt_state),
             jax.tree.structure(model_state),
-            threshold,
+            threshold, hier,
         )
         fn = self._step_cache.get(key)
         if fn is None:
@@ -565,12 +567,15 @@ class TrainStep:
             tl.begin("TrainStep", "STEP")
         try:
             # Tracing for a new cache entry happens inside this call, so
-            # the candidate threshold must be visible to bucket_plan now.
+            # the candidate threshold (and lowering choice) must be
+            # visible to bucket_plan / traced.allreduce now.
             fusion.set_threshold_override(threshold)
+            traced.set_hierarchical_override(hier)
             with jax.profiler.TraceAnnotation("hvd_train_step"):
                 out = fn(params, model_state, opt_state, batch)
         finally:
             fusion.set_threshold_override(None)
+            traced.set_hierarchical_override(None)
             if tl is not None:
                 tl.end("TrainStep", "STEP")
                 if self._mark_cycles:

@@ -147,13 +147,78 @@ class AutotuneDriver:
         )
         self._steps_in_window = 0
         self._t0: Optional[float] = None
+        # Second knob (the reference tunes several parameters jointly,
+        # parameter_manager.h:42-105): after the threshold freezes, the
+        # hierarchical-allreduce lowering is probed at the winning
+        # threshold and kept only if it scores better.  Categorical,
+        # numerics-neutral — exactly the class of knob the reference
+        # explores.  Skipped when the user pinned the env knob or the
+        # world has a single host (the lowering would no-op).
+        self._hier_state = "pending"   # pending -> probing -> frozen
+        self._hier_value: Optional[bool] = None
+        self._hier_scores: list = []
+        self._hier_windows = max(2, env.get_int("AUTOTUNE_HIER_WINDOWS", 2))
+        self._flat_scores: list = []
 
     def threshold_bytes(self) -> int:
         return self.tuner.threshold_bytes()
 
+    def hierarchical(self) -> Optional[bool]:
+        """Current hierarchical-lowering suggestion for the step build
+        (None until the threshold knob has converged)."""
+        if self._hier_state == "probing":
+            return True
+        if self._hier_state == "frozen":
+            return self._hier_value
+        return None
+
+    def _hier_explorable(self) -> bool:
+        if env.get_env(env.HIERARCHICAL_ALLREDUCE) is not None:
+            return False  # user pinned the knob: honor it
+        try:
+            from ..runtime import get_runtime
+
+            rt = get_runtime()
+            return rt.cross_size > 1 and rt.local_size > 1
+        except Exception:
+            return False
+
+    def _advance_hier(self, score: float) -> None:
+        """Feed a closed window's score to the hierarchical knob state
+        machine (runs only after the threshold tuner froze)."""
+        if self._hier_state == "pending":
+            if not self._hier_explorable():
+                self._hier_state = "frozen"
+                self._hier_value = None
+                return
+            # frozen-flat baseline: same window count as the probe so
+            # the comparison is noise-symmetric (mean vs mean)
+            self._flat_scores.append(score)
+            if len(self._flat_scores) >= self._hier_windows:
+                self._hier_state = "probing"
+            return
+        if self._hier_state == "probing":
+            self._hier_scores.append(score)
+            if len(self._hier_scores) >= self._hier_windows:
+                flat = sum(self._flat_scores) / len(self._flat_scores)
+                hier = sum(self._hier_scores) / len(self._hier_scores)
+                kept = hier > flat
+                # A rejected probe freezes to None, NOT False: the flat
+                # baseline's compiled variant is keyed on None, and the
+                # eviction must keep it rather than force a redundant
+                # recompile of an identical program.
+                self._hier_value = True if kept else None
+                self._hier_state = "frozen"
+                get_logger().info(
+                    "autotune: hierarchical allreduce %s (flat %.3g vs "
+                    "hierarchical %.3g steps/s, %d windows each)",
+                    "kept" if kept else "rejected", flat, hier,
+                    self._hier_windows,
+                )
+
     @property
     def converged(self) -> bool:
-        return self.tuner.converged
+        return self.tuner.converged and self._hier_state == "frozen"
 
     @staticmethod
     def _sync(out) -> None:
@@ -178,7 +243,7 @@ class AutotuneDriver:
 
     def after_step(self, out) -> None:
         """Advance the window; ``out`` is any step output to sync on."""
-        if self.tuner.converged:
+        if self.converged:
             return
         self._steps_in_window += 1
         if self._steps_in_window == 1:
@@ -193,7 +258,10 @@ class AutotuneDriver:
             timed_steps = self._steps_in_window - 1
             score = timed_steps / max(dt, 1e-9)
             threshold = self.tuner.threshold_bytes()
-            self.tuner.observe(score)
+            if not self.tuner.converged:
+                self.tuner.observe(score)
+            else:
+                self._advance_hier(score)
             self._record_window(threshold, score)
             self._steps_in_window = 0
             self._t0 = None

@@ -306,3 +306,116 @@ class TestStallWatchdog:
             assert get_runtime().stall_watchdog is None
         finally:
             hvd.shutdown()
+
+
+class TestHierarchicalKnobExploration:
+    """Second autotune knob (reference ParameterManager tunes several
+    parameters jointly): after the threshold freezes, the hierarchical
+    lowering is probed at the winner and kept only if faster."""
+
+    def test_state_machine_keeps_winner(self):
+        from horovod_tpu.utils.autotune import AutotuneDriver
+
+        hvd.init()
+        try:
+            from horovod_tpu.runtime import get_runtime
+
+            rt = get_runtime()
+            old = rt.local_size, rt.cross_size
+            rt.local_size, rt.cross_size = 2, 4  # multi-host overlay
+            try:
+                drv = AutotuneDriver(window_steps=2,
+                                     warmup_windows=1)
+                drv.tuner._frozen = 4096  # threshold already converged
+                assert drv.hierarchical() is None
+                drv._advance_hier(10.0)          # flat baseline windows
+                drv._advance_hier(10.5)          # (same count as probe)
+                assert drv.hierarchical() is True  # probing
+                drv._advance_hier(12.0)
+                drv._advance_hier(13.0)          # hier mean wins
+                assert drv.converged
+                assert drv.hierarchical() is True
+            finally:
+                rt.local_size, rt.cross_size = old
+        finally:
+            hvd.shutdown()
+
+    def test_state_machine_rejects_loser_and_single_host_skips(self):
+        from horovod_tpu.utils.autotune import AutotuneDriver
+
+        hvd.init()
+        try:
+            from horovod_tpu.runtime import get_runtime
+
+            rt = get_runtime()
+            old = rt.local_size, rt.cross_size
+            rt.local_size, rt.cross_size = 2, 4
+            try:
+                drv = AutotuneDriver(window_steps=2, warmup_windows=1)
+                drv.tuner._frozen = 4096
+                drv._advance_hier(10.0)
+                drv._advance_hier(10.0)
+                drv._advance_hier(8.0)
+                drv._advance_hier(7.0)
+                # rejected probe freezes to None so the flat baseline's
+                # compiled variant (keyed on None) is reused, not
+                # recompiled
+                assert drv.converged and drv.hierarchical() is None
+            finally:
+                rt.local_size, rt.cross_size = old
+            # single-host world: exploration skipped entirely
+            drv2 = AutotuneDriver(window_steps=2, warmup_windows=1)
+            drv2.tuner._frozen = 4096
+            drv2._advance_hier(10.0)
+            assert drv2.converged and drv2.hierarchical() is None
+        finally:
+            hvd.shutdown()
+
+    def test_user_pinned_env_is_honored(self, monkeypatch):
+        from horovod_tpu.utils.autotune import AutotuneDriver
+
+        monkeypatch.setenv("HVD_TPU_HIERARCHICAL_ALLREDUCE", "1")
+        hvd.init()
+        try:
+            from horovod_tpu.runtime import get_runtime
+
+            rt = get_runtime()
+            rt.local_size, rt.cross_size = 2, 4
+            drv = AutotuneDriver(window_steps=2, warmup_windows=1)
+            drv.tuner._frozen = 4096
+            drv._advance_hier(10.0)
+            # pinned: never probes, lowering comes from the env default
+            assert drv.converged and drv.hierarchical() is None
+        finally:
+            hvd.shutdown()
+
+    def test_trainstep_explores_hier_variants(self, monkeypatch):
+        """End to end: with autotune on and a multi-host overlay, the
+        step cache gains a hierarchical variant during probing and the
+        eviction keeps exactly the winning (threshold, hier) entry."""
+        monkeypatch.setenv("HVD_TPU_AUTOTUNE", "1")
+        monkeypatch.setenv("HVD_TPU_AUTOTUNE_WINDOW", "2")
+        monkeypatch.setenv("HVD_TPU_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", "2")
+        monkeypatch.setenv("HVD_TPU_AUTOTUNE_HIER_WINDOWS", "2")
+        hvd.init()
+        try:
+            from horovod_tpu.runtime import get_runtime
+
+            rt = get_runtime()
+            rt.local_size, rt.cross_size = 2, 4
+            step, params, opt_state, batch = _tiny_step(hvd)
+            seen_hier = set()
+            for _ in range(40):
+                params, opt_state, loss = step(params, opt_state, batch)
+                seen_hier.add(step._autotune.hierarchical())
+                if step._autotune.converged:
+                    break
+            float(loss)
+            assert step._autotune.converged
+            assert True in seen_hier  # the hier lowering really probed
+            params, opt_state, loss = step(params, opt_state, batch)
+            assert len(step._step_cache) == 1  # losers evicted
+            (key,) = step._step_cache
+            assert key[3] in (True, False, None)
+        finally:
+            hvd.shutdown()
