@@ -157,7 +157,7 @@ class AutotuneDriver:
         self._hier_state = "pending"   # pending -> probing -> frozen
         self._hier_value: Optional[bool] = None
         self._hier_scores: list = []
-        self._hier_windows = max(2, env.get_int("AUTOTUNE_HIER_WINDOWS", 2))
+        self._hier_windows = max(1, env.get_int("AUTOTUNE_HIER_WINDOWS", 2))
         self._flat_scores: list = []
 
     def threshold_bytes(self) -> int:
@@ -173,7 +173,8 @@ class AutotuneDriver:
         return None
 
     def _hier_explorable(self) -> bool:
-        if env.get_env(env.HIERARCHICAL_ALLREDUCE) is not None:
+        # empty string == unset (get_bool's semantics everywhere else)
+        if env.get_env(env.HIERARCHICAL_ALLREDUCE) not in (None, ""):
             return False  # user pinned the knob: honor it
         try:
             from ..runtime import get_runtime
@@ -258,19 +259,27 @@ class AutotuneDriver:
             timed_steps = self._steps_in_window - 1
             score = timed_steps / max(dt, 1e-9)
             threshold = self.tuner.threshold_bytes()
+            hier = self.hierarchical()
             if not self.tuner.converged:
                 self.tuner.observe(score)
+                if self.tuner.converged and not self._hier_explorable():
+                    # static check: don't burn a window discovering it
+                    self._hier_state = "frozen"
+                    self._hier_value = None
             else:
                 self._advance_hier(score)
-            self._record_window(threshold, score)
+            self._record_window(threshold, score, hier)
             self._steps_in_window = 0
             self._t0 = None
 
     @staticmethod
-    def _record_window(threshold: int, score: float) -> None:
+    def _record_window(threshold: int, score: float,
+                       hier: Optional[bool] = None) -> None:
         """Window records land on the timeline (reference
         ParameterManager's cycle records): one event per closed window
-        with the explored threshold and its steps/s score."""
+        with the explored threshold, lowering choice, and steps/s
+        score — flat-baseline vs hier-probe windows must be tellable
+        apart in the trace."""
         try:
             from ..runtime import get_runtime_or_none
 
@@ -279,7 +288,9 @@ class AutotuneDriver:
         except Exception:
             tl = None
         if tl is not None:
+            lowering = "hier" if hier else "flat"
             tl.record_op(
-                f"autotune threshold={threshold} score={score:.2f}steps/s",
+                f"autotune threshold={threshold} lowering={lowering} "
+                f"score={score:.2f}steps/s",
                 "AUTOTUNE_WINDOW", threshold,
             )
