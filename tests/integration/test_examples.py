@@ -72,6 +72,17 @@ def test_tf2_keras_mnist_example():
     assert "loss" in out.lower()
 
 
+def test_tf2_keras_mnist_fit_mode_example():
+    """model.fit + DistributedOptimizer(backward_passes_per_step=2) +
+    BroadcastGlobalVariablesCallback — the reference keras recipe,
+    exercising the compiled-fit (tf.cond) aggregation path."""
+    pytest.importorskip("tensorflow")
+    out = _run_example("tf2_keras_mnist.py", "--use-fit", "--epochs", "1",
+                       "--batch-size", "16", "--num-samples", "256",
+                       "--backward-passes-per-step", "2", timeout=600)
+    assert "final loss" in out
+
+
 def test_process_sets_example():
     out = _run_example("process_sets.py")
     assert "even-team avg: 3.0" in out
