@@ -276,3 +276,39 @@ class TestChunkedBroadcast:
         assert out == blob
         # 1 length call + ceil(~200k/65536)=4 buffer chunks
         assert len(calls) == 5, [np.asarray(c).size for c in calls]
+
+
+@pytest.mark.integration
+def test_multiprocess_chunked_broadcast_parameters():
+    """Two real processes: a large (above-threshold) pytree must reach
+    rank 1 bit-correct through the chunked device path, 64-bit leaves
+    through the pickle path."""
+    import sys
+
+    import cloudpickle
+
+    import horovod_tpu.runner as runner
+
+    def worker():
+        import numpy as np
+
+        import horovod_tpu as hvd
+        from horovod_tpu import functions
+
+        hvd.init()
+        rng = np.random.RandomState(0)  # same seed: root value known
+        big = rng.randn(300_000).astype(np.float32)   # 1.2 MB > 1 MB
+        wide = np.array([2**40 + 7, -(2**33)], np.int64)
+        if hvd.process_rank() == 0:
+            params = {"big": big, "wide": wide}
+        else:
+            params = {"big": np.zeros_like(big),
+                      "wide": np.zeros_like(wide)}
+        out = functions.broadcast_parameters(params, root_rank=0)
+        ok_big = bool(np.allclose(np.asarray(out["big"]), big))
+        ok_wide = bool((np.asarray(out["wide"]) == wide).all())
+        return [ok_big, ok_wide]
+
+    cloudpickle.register_pickle_by_value(sys.modules[__name__])
+    results = runner.run(worker, np=2, use_cpu_devices=True)
+    assert results == [[True, True], [True, True]], results

@@ -678,3 +678,37 @@ def test_in_graph_allgather_keeps_static_rank(hvd_module):
     assert y.numpy().shape == (N, N * 2, 1)
     cf = fn.get_concrete_function(x)
     assert cf.output_shapes.rank == 3
+
+
+@pytest.mark.integration
+def test_multiprocess_in_graph_allreduce():
+    """Collectives inside tf.function across two REAL processes: the
+    py_function lowering must re-enter the eager bridge and average
+    across ranks at graph-execution time."""
+    import sys
+
+    import cloudpickle
+
+    import horovod_tpu.runner as runner
+
+    def worker():
+        import numpy as np
+        import tensorflow as tf
+
+        import horovod_tpu as hvd
+        import horovod_tpu.interop.tf as hvd_tf
+
+        hvd.init()
+        scale = float(hvd.process_rank() + 1)
+
+        @tf.function
+        def fn(t):
+            return hvd_tf.allreduce(t, op=hvd.Average) + 1.0
+
+        x = tf.constant(np.full((1, 4), scale, np.float32))
+        return fn(x).numpy().reshape(-1).tolist()
+
+    cloudpickle.register_pickle_by_value(sys.modules[__name__])
+    results = runner.run(worker, np=2, use_cpu_devices=True)
+    # mean(1, 2) + 1 = 2.5 on both processes
+    np.testing.assert_allclose(results, [[2.5] * 4, [2.5] * 4])
