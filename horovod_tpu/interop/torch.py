@@ -432,7 +432,7 @@ class _DistributedOptimizer:
                  backward_passes_per_step: int = 1,
                  average_aggregated_gradients: bool = True,
                  gradient_predivide_factor: float = 1.0,
-                 process_set=None):
+                 process_set=None, compression=None):
         if gradient_predivide_factor != 1.0 and op != _eager.Average:
             raise ValueError(
                 "gradient_predivide_factor requires op=Average "
@@ -440,6 +440,7 @@ class _DistributedOptimizer:
             )
         self._opt = optimizer
         self._op = op
+        self._compression = compression
         self._k = int(backward_passes_per_step)
         if self._k < 1:
             raise ValueError("backward_passes_per_step must be >= 1")
@@ -530,9 +531,11 @@ class _DistributedOptimizer:
         by_dtype: Dict[Any, list] = {}
         for p in params:
             by_dtype.setdefault(p.grad.dtype, []).append(p)
+        comp = self._compression or _NoneCompressor
         for dtype, ps in by_dtype.items():
             flat = torch.cat([p.grad.reshape(-1) for p in ps])
-            wire = _tensor_to_numpy(torch, flat)
+            flat_wire, cctx = comp.compress(flat)
+            wire = _tensor_to_numpy(torch, flat_wire)
             if self._prescale != 1.0:
                 wire = wire * self._prescale
             red = process_reduce(
@@ -542,7 +545,8 @@ class _DistributedOptimizer:
                 red = red * self._postscale
             if not apply_result:
                 continue
-            reduced = _to_torch(red, flat)
+            reduced = comp.decompress(_to_torch(red, flat_wire), cctx)
+            reduced = reduced.to(flat.dtype)
             offset = 0
             with torch.no_grad():
                 for p in ps:
@@ -578,7 +582,7 @@ def DistributedOptimizer(optimizer, named_parameters=None,
                          backward_passes_per_step: int = 1,
                          average_aggregated_gradients: bool = True,
                          gradient_predivide_factor: float = 1.0,
-                         process_set=None):
+                         process_set=None, compression=None):
     """Reference-named constructor (``hvd.DistributedOptimizer``);
     ``named_parameters`` is accepted for API parity but unused — the
     fused flat reduction needs no per-parameter names.
@@ -601,6 +605,211 @@ def DistributedOptimizer(optimizer, named_parameters=None,
         backward_passes_per_step=backward_passes_per_step,
         average_aggregated_gradients=average_aggregated_gradients,
         gradient_predivide_factor=gradient_predivide_factor,
-        process_set=process_set,
+        process_set=process_set, compression=compression,
     )
     return obj
+
+
+# ---- gradient compression (reference torch/compression.py) ---------------
+
+class _NoneCompressor:
+    """No-op compression (reference ``NoneCompressor``)."""
+
+    @staticmethod
+    def compress(tensor):
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
+class _FP16Compressor:
+    """Cast floating gradients to fp16 for the wire (reference
+    ``FP16Compressor``) — halves the cross-process payload."""
+
+    @staticmethod
+    def compress(tensor):
+        torch = _torch()
+        if tensor.dtype.is_floating_point:
+            return tensor.to(torch.float16), tensor.dtype
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        if ctx is None:
+            return tensor
+        return tensor.to(ctx)
+
+
+class Compression:
+    """Optional wire compression for the torch bridge (reference
+    ``horovod.torch.Compression``)."""
+
+    none = _NoneCompressor
+    fp16 = _FP16Compressor
+
+
+# ---- SyncBatchNorm (reference torch/sync_batch_norm.py) ------------------
+
+_SYNC_BN_CLS = None
+
+
+def _per_channel(x, v):
+    return v.reshape([1, -1] + [1] * (x.dim() - 2))
+
+
+def _sync_bn_cls():
+    """Build (once) the module-registered SyncBatchNorm class: a
+    module-level binding with a matching __qualname__ keeps instances
+    picklable (torch.save of a containing model stores the class by
+    reference)."""
+    global _SYNC_BN_CLS
+    if _SYNC_BN_CLS is not None:
+        return _SYNC_BN_CLS
+    torch = _torch()
+    import torch.nn.functional as F  # noqa: F401 (parent forward uses it)
+    from torch.nn.modules.batchnorm import _BatchNorm
+
+    from ._common import member_processes, process_reduce
+
+    def sum_stats(vec, process_set):
+        """Cross-process SUM of a flat per-channel stat vector."""
+        member_procs, included = member_processes(process_set)
+        red = process_reduce(
+            _tensor_to_numpy(torch, vec), average=False,
+            member_procs=member_procs,
+        )
+        if not included:
+            return vec  # non-member: keep local statistics
+        return _to_torch(np.asarray(red), vec)
+
+    class _SyncNormalize(torch.autograd.Function):
+        @staticmethod
+        def forward(ctx, x, weight, bias, mean, var, count, eps,
+                    process_set):
+            # all normalization math in fp32 (half inputs overflow
+            # sum-of-squares; native BN accumulates in fp32 too)
+            x32 = x.to(torch.float32)
+            rstd = torch.rsqrt(var + eps)
+            xhat = (x32 - _per_channel(x, mean)) * _per_channel(x, rstd)
+            ctx.save_for_backward(xhat, weight, rstd, count)
+            ctx.hvd_process_set = process_set
+            ctx.in_dtype = x.dtype
+            y = xhat
+            if weight is not None:
+                y = y * _per_channel(x, weight.to(torch.float32)) \
+                    + _per_channel(x, bias.to(torch.float32))
+            return y.to(x.dtype)
+
+        @staticmethod
+        def backward(ctx, dy):
+            xhat, weight, rstd, count = ctx.saved_tensors
+            dy32 = dy.to(torch.float32)
+            dims = [0] + list(range(2, dy.dim()))
+            dyhat = dy32 if weight is None else dy32 * _per_channel(
+                dy, weight.to(torch.float32)
+            )
+            # global dy statistics: one fused stat reduction, exactly
+            # the reference's sum_dy/sum_dy_xmu allreduce
+            sum_dy = dyhat.sum(dims)
+            sum_dy_xhat = (dyhat * xhat).sum(dims)
+            stats = sum_stats(
+                torch.cat([sum_dy, sum_dy_xhat]), ctx.hvd_process_set
+            )
+            c = sum_dy.numel()
+            g_dy, g_dy_xhat = stats[:c], stats[c:]
+            m = count.item()
+            dx = _per_channel(dy, rstd) * (
+                dyhat
+                - _per_channel(dy, g_dy / m)
+                - xhat * _per_channel(dy, g_dy_xhat / m)
+            )
+            dweight = dbias = None
+            if weight is not None:
+                dweight = (dy32 * xhat).sum(dims).to(weight.dtype)
+                dbias = dy32.sum(dims).to(weight.dtype)
+            return (dx.to(ctx.in_dtype), dweight, dbias,
+                    None, None, None, None, None)
+
+    class _TorchSyncBatchNorm(_BatchNorm):
+        """See :func:`SyncBatchNorm` (the user-facing factory)."""
+
+        hvd_process_set = None  # overridden per instance by the factory
+
+        def _check_input_dim(self, input):
+            if input.dim() < 2:
+                raise ValueError(
+                    f"expected at least 2D input, got {input.dim()}D"
+                )
+
+        def forward(self, x):
+            self._check_input_dim(x)
+            training = self.training or not self.track_running_stats
+            if not training or _is_single_process():
+                # plain BatchNorm numerics, including num_batches_
+                # tracked and momentum=None cumulative averaging
+                return super().forward(x)
+            dims = [0] + list(range(2, x.dim()))
+            x32 = x.to(torch.float32)  # fp32 stat accumulation
+            n_local = float(x.numel() // x.shape[1])
+            local = torch.cat([
+                x32.sum(dims), (x32 * x32).sum(dims),
+                torch.tensor([n_local], dtype=torch.float32,
+                             device=x.device),
+            ])
+            stats = sum_stats(local.detach(), self.hvd_process_set)
+            C = x.shape[1]
+            m = stats[-1]
+            mean = stats[:C] / m
+            var = stats[C:2 * C] / m - mean * mean  # biased (normalize)
+            if self.track_running_stats:
+                with torch.no_grad():
+                    self.num_batches_tracked += 1
+                    eaf = (
+                        1.0 / float(self.num_batches_tracked)
+                        if self.momentum is None else self.momentum
+                    )
+                    unbiased = var * (m / (m - 1.0))
+                    self.running_mean.mul_(1 - eaf).add_(
+                        mean.to(self.running_mean.dtype), alpha=eaf
+                    )
+                    self.running_var.mul_(1 - eaf).add_(
+                        unbiased.to(self.running_var.dtype), alpha=eaf
+                    )
+            return _SyncNormalize.apply(
+                x, self.weight, self.bias, mean.detach(), var.detach(),
+                m, self.eps, self.hvd_process_set,
+            )
+
+    _TorchSyncBatchNorm.__module__ = __name__
+    _TorchSyncBatchNorm.__qualname__ = "_TorchSyncBatchNorm"
+    globals()["_TorchSyncBatchNorm"] = _TorchSyncBatchNorm
+    _SYNC_BN_CLS = _TorchSyncBatchNorm
+    return _SYNC_BN_CLS
+
+
+def SyncBatchNorm(num_features: int, eps: float = 1e-5,
+                  momentum=0.1, affine: bool = True,
+                  track_running_stats: bool = True, process_set=None):
+    """N-d batch norm whose training statistics AND backward gradient
+    sums synchronize across all processes (reference
+    ``horovod.torch.SyncBatchNorm`` semantics): the forward normalizes
+    with global-batch mean/variance, and the backward reduces the
+    per-channel dy sums so ``dx`` is the exact global-batch gradient;
+    weight/bias grads stay local (the optimizer's allreduce averages
+    them, the reference's split too).
+
+    Stats accumulate in fp32 regardless of input dtype (half inputs
+    overflow a sum of squares).  Single-process worlds and eval mode
+    run plain BatchNorm numerics via the parent.  Instances pickle
+    (torch.save) — the class is module-registered, the factory only
+    configures it.
+    """
+    cls = _sync_bn_cls()
+    layer = cls(
+        num_features, eps=eps, momentum=momentum, affine=affine,
+        track_running_stats=track_running_stats,
+    )
+    layer.hvd_process_set = process_set
+    return layer

@@ -432,3 +432,194 @@ class TestStreamingEstimatorReads:
         assert not did_partition
         xb, yb = next(iter(loader))
         assert xb.shape == (16, 4) and yb.shape == (16,)
+
+
+class TestTorchSyncBatchNorm:
+    def test_single_process_matches_plain_bn(self, hvd_module):
+        import torch
+
+        import horovod_tpu.interop.torch as hvd_torch
+
+        torch.manual_seed(0)
+        x = torch.randn(8, 4, requires_grad=True)
+        x2 = x.detach().clone().requires_grad_(True)
+        sync = hvd_torch.SyncBatchNorm(4)
+        plain = torch.nn.BatchNorm1d(4)
+        plain.load_state_dict(sync.state_dict())
+        y1 = sync(x)
+        y2 = plain(x2)
+        np.testing.assert_allclose(
+            y1.detach().numpy(), y2.detach().numpy(), rtol=1e-5, atol=1e-6
+        )
+        y1.sum().backward()
+        y2.sum().backward()
+        np.testing.assert_allclose(
+            x.grad.numpy(), x2.grad.numpy(), rtol=1e-4, atol=1e-6
+        )
+        np.testing.assert_allclose(
+            sync.weight.grad.numpy(), plain.weight.grad.numpy(),
+            rtol=1e-4, atol=1e-6,
+        )
+        np.testing.assert_allclose(
+            sync.running_mean.numpy(), plain.running_mean.numpy(),
+            rtol=1e-5, atol=1e-6,
+        )
+
+    def test_eval_mode_uses_running_stats(self, hvd_module):
+        import torch
+
+        import horovod_tpu.interop.torch as hvd_torch
+
+        bn = hvd_torch.SyncBatchNorm(3)
+        bn(torch.randn(16, 3))  # one training pass to move stats
+        bn.eval()
+        x = torch.randn(4, 3)
+        y = bn(x)
+        expect = (x - bn.running_mean) / torch.sqrt(
+            bn.running_var + bn.eps
+        ) * bn.weight + bn.bias
+        np.testing.assert_allclose(
+            y.detach().numpy(), expect.detach().numpy(),
+            rtol=1e-5, atol=1e-6,
+        )
+
+
+class TestTorchCompression:
+    def test_fp16_roundtrip(self, hvd_module):
+        import torch
+
+        import horovod_tpu.interop.torch as hvd_torch
+
+        t = torch.tensor([1.5, -2.25, 3.0])
+        wire, ctx = hvd_torch.Compression.fp16.compress(t)
+        assert wire.dtype == torch.float16
+        back = hvd_torch.Compression.fp16.decompress(wire, ctx)
+        assert back.dtype == torch.float32
+        np.testing.assert_allclose(back.numpy(), t.numpy())
+        i = torch.tensor([1, 2])
+        wire, ctx = hvd_torch.Compression.fp16.compress(i)
+        assert wire.dtype == torch.int64 and ctx is None
+
+    def test_optimizer_accepts_compression(self, hvd_module):
+        import torch
+
+        import horovod_tpu.interop.torch as hvd_torch
+
+        m = torch.nn.Linear(4, 1)
+        opt = hvd_torch.DistributedOptimizer(
+            torch.optim.SGD(m.parameters(), lr=0.1),
+            compression=hvd_torch.Compression.fp16,
+        )
+        loss = m(torch.ones(2, 4)).sum()
+        loss.backward()
+        opt.step()  # single process: reduction short-circuits
+
+
+@pytest.mark.integration
+def test_multiprocess_torch_sync_bn_global_moments():
+    """Two processes, disjoint batches: torch SyncBatchNorm must
+    normalize with GLOBAL moments and produce the global-batch dx
+    (reference torch/sync_batch_norm.py semantics)."""
+    import sys
+
+    import cloudpickle
+
+    import horovod_tpu.runner as runner
+
+    def worker():
+        import numpy as np
+        import torch
+
+        import horovod_tpu as hvd
+        import horovod_tpu.interop.torch as hvd_torch
+
+        hvd.init()
+        r = hvd.process_rank()
+        # global batch: rank0 rows = 0, rank1 rows = 10
+        x = torch.full((4, 2), float(r * 10), requires_grad=True)
+        bn = hvd_torch.SyncBatchNorm(2, momentum=1.0)
+        y = bn(x)
+        # weighted loss makes dx nontrivial and rank-dependent
+        (y * (r + 1.0)).sum().backward()
+        return {
+            "y0": float(y.detach()[0, 0]),
+            "rm": float(bn.running_mean[0]),
+            "rv": float(bn.running_var[0]),
+            "gx": x.grad.numpy().tolist(),
+        }
+
+    cloudpickle.register_pickle_by_value(sys.modules[__name__])
+    results = runner.run(worker, np=2, use_cpu_devices=True)
+    # global mean 5, biased var 25 -> rank0 normalizes to -1, rank1 +1
+    np.testing.assert_allclose(results[0]["y0"], -1.0, rtol=1e-4)
+    np.testing.assert_allclose(results[1]["y0"], 1.0, rtol=1e-4)
+    for r in results:
+        np.testing.assert_allclose(r["rm"], 5.0, rtol=1e-4)
+        np.testing.assert_allclose(r["rv"], 25.0 * 8 / 7, rtol=1e-4)
+
+    # reference: single-process BN over the concatenated batch with the
+    # same weighted loss; dx must match each rank's half
+    import torch
+
+    xa = torch.full((4, 2), 0.0)
+    xb = torch.full((4, 2), 10.0)
+    x_all = torch.cat([xa, xb]).requires_grad_(True)
+    bn_ref = torch.nn.BatchNorm1d(2, momentum=1.0)
+    y_ref = bn_ref(x_all)
+    w = torch.cat([torch.full((4, 2), 1.0), torch.full((4, 2), 2.0)])
+    (y_ref * w).sum().backward()
+    np.testing.assert_allclose(
+        results[0]["gx"], x_all.grad[:4].numpy(), rtol=1e-3, atol=1e-5
+    )
+    np.testing.assert_allclose(
+        results[1]["gx"], x_all.grad[4:].numpy(), rtol=1e-3, atol=1e-5
+    )
+
+
+class TestTorchSyncBatchNormEdgeCases:
+    def test_picklable_via_torch_save(self, hvd_module, tmp_path):
+        import torch
+
+        import horovod_tpu.interop.torch as hvd_torch
+
+        m = torch.nn.Sequential(
+            torch.nn.Linear(4, 4), hvd_torch.SyncBatchNorm(4)
+        )
+        p = tmp_path / "model.pt"
+        torch.save(m, p)
+        m2 = torch.load(p, weights_only=False)
+        x = torch.randn(8, 4)
+        m.eval(), m2.eval()
+        np.testing.assert_allclose(
+            m(x).detach().numpy(), m2(x).detach().numpy(), rtol=1e-6
+        )
+
+    def test_fp16_input_stats_do_not_overflow(self, hvd_module):
+        import torch
+
+        import horovod_tpu.interop.torch as hvd_torch
+
+        bn = hvd_torch.SyncBatchNorm(2)
+        # values whose sum-of-squares overflows fp16 (max 65504)
+        x = torch.full((4096, 2), 10.0, dtype=torch.float16)
+        y = bn(x)
+        assert torch.isfinite(y.float()).all()
+        assert torch.isfinite(bn.running_var).all()
+
+    def test_num_batches_tracked_and_momentum_none(self, hvd_module):
+        import torch
+
+        import horovod_tpu.interop.torch as hvd_torch
+
+        sync = hvd_torch.SyncBatchNorm(3, momentum=None)  # cumulative
+        plain = torch.nn.BatchNorm1d(3, momentum=None)
+        plain.load_state_dict(sync.state_dict())
+        for seed in range(3):
+            torch.manual_seed(seed)
+            x = torch.randn(16, 3)
+            sync(x), plain(x)
+        assert int(sync.num_batches_tracked) == 3
+        np.testing.assert_allclose(
+            sync.running_mean.numpy(), plain.running_mean.numpy(),
+            rtol=1e-5, atol=1e-6,
+        )
