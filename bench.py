@@ -107,7 +107,7 @@ def bench_gpt(hvd, jnp, batch_per_chip: int = 16, seq_len: int = 1024,
     import jax
     import optax
 
-    from horovod_tpu.models.transformer import gpt_small
+    from horovod_tpu.models.transformer import gpt_small, token_cross_entropy
 
     model = gpt_small(max_len=seq_len)
     cfg = model.cfg
@@ -126,9 +126,9 @@ def bench_gpt(hvd, jnp, batch_per_chip: int = 16, seq_len: int = 1024,
     def loss_fn(p, batch):
         logits, aux = model.apply(p, batch)
         tgt = jnp.roll(batch, -1, axis=-1)
-        onehot = jax.nn.one_hot(tgt, cfg.vocab_size)
-        ce = -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * onehot, -1))
-        return ce + 0.01 * aux
+        # gather-form CE: no (B, T, vocab) one-hot temporary (~3 GB at
+        # this config) on the hot path
+        return token_cross_entropy(logits, tgt) + 0.01 * aux
 
     step = hvd.distributed_train_step(loss_fn, tx)
     opt_state = step.init(params)

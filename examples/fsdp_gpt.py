@@ -17,6 +17,7 @@ import optax
 
 import horovod_tpu as hvd
 from horovod_tpu.models import gpt_small, gpt_tiny
+from horovod_tpu.models.transformer import token_cross_entropy
 
 
 def main():
@@ -42,9 +43,8 @@ def main():
     def loss_fn(params, batch):
         toks, tgt = batch[:, :-1], batch[:, 1:]
         logits, aux = model.apply(params, toks)
-        onehot = jax.nn.one_hot(tgt, cfg.vocab_size)
-        ce = -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * onehot, -1))
-        return ce + 0.01 * aux
+        # gather-form CE: no vocab-sized one-hot temporary
+        return token_cross_entropy(logits, tgt) + 0.01 * aux
 
     params = model.init(
         jax.random.PRNGKey(0), jnp.zeros((1, args.seq), jnp.int32)

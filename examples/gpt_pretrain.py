@@ -30,7 +30,10 @@ from jax.sharding import PartitionSpec as P
 
 import horovod_tpu as hvd
 from horovod_tpu.models import gpt_small, gpt_tiny
-from horovod_tpu.models.transformer import param_shard_axes
+from horovod_tpu.models.transformer import (
+    param_shard_axes,
+    token_cross_entropy,
+)
 from horovod_tpu.parallel import make_mesh, sync_gradients
 
 
@@ -90,8 +93,8 @@ def main():
     def train_step(params, opt_state, toks, targets):
         def loss_fn(p):
             logits, aux = model.apply(p, toks)
-            onehot = jax.nn.one_hot(targets, cfg.vocab_size)
-            ce = -jnp.mean(jnp.sum(jax.nn.log_softmax(logits) * onehot, -1))
+            # gather-form CE: no vocab-sized one-hot temporary
+            ce = token_cross_entropy(logits, targets)
             return ce + 0.01 * aux  # aux = MoE load-balance (0 w/o MoE)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)

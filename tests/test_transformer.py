@@ -184,3 +184,33 @@ def test_remat_matches_no_remat():
     np.testing.assert_allclose(float(l1), float(l2), rtol=1e-6)
     for a, b in zip(jax.tree.leaves(g1), jax.tree.leaves(g2)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5)
+
+
+def test_token_cross_entropy_matches_one_hot_form():
+    """Gather-form LM loss == one-hot log-softmax form (value + grad)
+    without materializing a (B, T, vocab) temporary."""
+    import jax
+
+    rng = np.random.RandomState(3)
+    logits = jnp.asarray(rng.randn(2, 7, 131), jnp.float32)
+    tgt = jnp.asarray(rng.randint(0, 131, (2, 7)), jnp.int32)
+
+    from horovod_tpu.models.transformer import token_cross_entropy
+
+    onehot = jax.nn.one_hot(tgt, 131)
+
+    def ref_loss(l):
+        return -jnp.mean(jnp.sum(jax.nn.log_softmax(l) * onehot, -1))
+
+    np.testing.assert_allclose(
+        float(token_cross_entropy(logits, tgt)), float(ref_loss(logits)),
+        rtol=1e-6,
+    )
+    g_ref = jax.grad(ref_loss)(logits)
+    g_new = jax.grad(lambda l: token_cross_entropy(l, tgt))(logits)
+    np.testing.assert_allclose(
+        np.asarray(g_new), np.asarray(g_ref), rtol=1e-5, atol=1e-7
+    )
+    # bf16 logits: loss still accumulates in fp32
+    lb = logits.astype(jnp.bfloat16)
+    assert token_cross_entropy(lb, tgt).dtype == jnp.float32
