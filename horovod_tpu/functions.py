@@ -44,12 +44,38 @@ def _chunk_bytes() -> int:
     return max(1 << 16, _env.get_int("BCAST_CHUNK_BYTES", _CHUNK_BYTES))
 
 
-def _broadcast_flat_chunked(buf: np.ndarray, is_source: bool) -> np.ndarray:
-    """Broadcast a flat 1-D numpy buffer from the source process in
-    bounded chunks (every process iterates identical boundaries)."""
+def _negotiate_plan(
+    use_pickle: int, chunk_bytes: int, is_source: bool
+) -> tuple:
+    """Sync the SOURCE's broadcast plan (path flag + chunk size) to all
+    processes.  Without this, divergent HVD_TPU_BCAST_* env values across
+    workers would pick different collective sequences and deadlock."""
     from jax.experimental import multihost_utils
 
-    step = _chunk_bytes() // max(1, buf.dtype.itemsize)
+    hdr = multihost_utils.broadcast_one_to_all(
+        np.array([use_pickle, chunk_bytes], dtype=np.int64),
+        is_source=is_source,
+    )
+    hdr = np.asarray(hdr)
+    return int(hdr[0]), int(hdr[1])
+
+
+def _broadcast_flat_chunked(
+    buf: np.ndarray, is_source: bool, step: Optional[int] = None
+) -> np.ndarray:
+    """Broadcast a flat 1-D numpy buffer from the source process in
+    bounded chunks (every process iterates identical boundaries).
+
+    ``step`` (element count per chunk) must be identical on every
+    process; callers that derive it from env knobs negotiate the
+    source's value first (see :func:`_negotiate_plan`) so a divergent
+    ``HVD_TPU_BCAST_CHUNK_BYTES`` cannot desynchronize the chunk loop
+    into a deadlock."""
+    from jax.experimental import multihost_utils
+
+    if step is None:
+        step = _chunk_bytes() // max(1, buf.dtype.itemsize)
+    step = max(1, int(step))
     out = np.empty_like(buf)
     for lo in range(0, buf.size, step):
         hi = min(lo + step, buf.size)
@@ -79,7 +105,14 @@ def broadcast_parameters(params: Any, root_rank: int = 0) -> Any:
     leaves, treedef = jax.tree.flatten(params)
     arrs = [np.asarray(l) for l in leaves]
     total = sum(a.nbytes for a in arrs)
-    if total < _pickle_threshold():
+    # Path + chunk size are env-knob driven; the SOURCE's values win so
+    # that divergent HVD_TPU_BCAST_* settings across workers surface as
+    # one consistent plan instead of mismatched collective sequences
+    # (which would deadlock).
+    use_pickle, chunk_bytes = _negotiate_plan(
+        int(total < _pickle_threshold()), _chunk_bytes(), is_source
+    )
+    if use_pickle:
         return multihost_utils.broadcast_one_to_all(
             params, is_source=is_source
         )
@@ -99,7 +132,9 @@ def broadcast_parameters(params: Any, root_rank: int = 0) -> Any:
     out = list(arrs)
     for _, idxs in sorted(by_dtype.items()):
         flat = np.concatenate([arrs[i].reshape(-1) for i in idxs])
-        flat = _broadcast_flat_chunked(flat, is_source)
+        flat = _broadcast_flat_chunked(
+            flat, is_source, step=chunk_bytes // max(1, flat.dtype.itemsize)
+        )
         off = 0
         for i in idxs:
             n = arrs[i].size
@@ -151,14 +186,24 @@ def broadcast_object(
     else:
         payload = None
         length = np.int64(0)
-    length = int(multihost_utils.broadcast_one_to_all(length, is_source=is_source))
+    # One header broadcast carries length AND the source's path/chunk
+    # plan, so per-process HVD_TPU_BCAST_* divergence cannot split the
+    # collective sequence (deadlock) — the source's knobs win.
+    hdr = multihost_utils.broadcast_one_to_all(
+        np.array(
+            [length, int(length >= _pickle_threshold()), _chunk_bytes()],
+            dtype=np.int64,
+        ),
+        is_source=is_source,
+    )
+    length, chunked, chunk_bytes = (int(v) for v in np.asarray(hdr))
     buf = np.zeros((length,), dtype=np.uint8)
     if is_source:
         buf[: payload.size] = payload
     # Large pickles ride the chunked path (bounded per-transfer memory);
     # small ones in one call.
-    if length >= _pickle_threshold():
-        buf = _broadcast_flat_chunked(buf, is_source)
+    if chunked:
+        buf = _broadcast_flat_chunked(buf, is_source, step=chunk_bytes)
     else:
         buf = multihost_utils.broadcast_one_to_all(buf, is_source=is_source)
     return pickle.loads(np.asarray(buf).tobytes())

@@ -299,6 +299,24 @@ def SyncBatchNormalization(**kwargs):
     """
     tf = _tf()
 
+    # The sync hook overrides the private keras `_moments(inputs, mask)`
+    # extension point; if a keras release restructures it the override
+    # would silently become dead code and the layer would degrade to
+    # LOCAL batch norm.  Fail loudly on version drift instead.
+    import inspect
+
+    base_moments = getattr(tf.keras.layers.BatchNormalization, "_moments", None)
+    if base_moments is None or [
+        p for p in inspect.signature(base_moments).parameters
+        if p not in ("self",)
+    ] != ["inputs", "mask"]:
+        raise RuntimeError(
+            "SyncBatchNormalization: this keras version does not expose "
+            "BatchNormalization._moments(inputs, mask); the cross-process "
+            "statistics hook cannot attach. Use horovod_tpu.SyncBatchNorm "
+            "(JAX) or pin a keras version with the _moments hook."
+        )
+
     class _SyncBatchNormalization(tf.keras.layers.BatchNormalization):
         def _moments(self, inputs, mask):
             mean, variance = super()._moments(inputs, mask)

@@ -83,6 +83,14 @@ def load(build: bool = True) -> Optional[ctypes.CDLL]:
                     return None
         if not os.path.exists(_LIB_PATH):
             return None
+        if _build_failed and _stale():
+            import logging
+
+            logging.getLogger("horovod_tpu").warning(
+                "native core rebuild failed; loading stale %s built before "
+                "the latest cpp/src change — native encode/decode may not "
+                "match the Python wire format", _LIB_PATH,
+            )
         lib = ctypes.CDLL(_LIB_PATH)
         _configure(lib)
         _lib = lib

@@ -329,6 +329,12 @@ def allreduce(
     ``HOROVOD_HIERARCHICAL_ALLREDUCE``) stages sum/average as
     intra-host reduce-scatter → cross-host sum → intra-host allgather.
     """
+    if hierarchical is None:
+        hierarchical = (
+            _hierarchical_override if _hierarchical_override is not None
+            else env.get_bool(env.HIERARCHICAL_ALLREDUCE, False)
+        )
+
     if op == Adasum:
         from .adasum import adasum_allreduce
 
@@ -345,12 +351,6 @@ def allreduce(
     if op == Average:
         postscale_factor = postscale_factor / set_size
         op = Sum
-
-    if hierarchical is None:
-        hierarchical = (
-            _hierarchical_override if _hierarchical_override is not None
-            else env.get_bool(env.HIERARCHICAL_ALLREDUCE, False)
-        )
 
     if op == Sum:
         if mask is None:

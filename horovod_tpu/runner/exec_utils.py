@@ -92,8 +92,18 @@ def _ssh_dial(host, addrs, port, token, ssh_port, ssh_identity_file,
         ssh += ["-p", str(ssh_port)]
     if ssh_identity_file:
         ssh += ["-i", ssh_identity_file]
-    argv = ssh + [host, f"{shlex.quote(sys.executable)} -c "
-                        f"{shlex.quote(script)}"]
+    # The launcher's sys.executable may not exist at the same prefix on
+    # a heterogeneous remote; fall back to `python3` there rather than
+    # paying the full probe timeout and caching the heuristic fallback.
+    # Wrapped in `sh -c` because sshd hands the command string to the
+    # remote USER's login shell, which may not parse POSIX syntax.
+    fallback = (
+        f"PY={shlex.quote(sys.executable)}; "
+        f'command -v "$PY" >/dev/null 2>&1 || PY=python3; '
+        f'"$PY" -c {shlex.quote(script)}'
+    )
+    remote_cmd = f"sh -c {shlex.quote(fallback)}"
+    argv = ssh + [host, remote_cmd]
     try:
         res = subprocess.run(argv, capture_output=True, text=True,
                              timeout=timeout_s)

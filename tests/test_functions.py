@@ -204,7 +204,8 @@ class TestChunkedBroadcast:
         monkeypatch.setattr(get_runtime(), "process_count", 2)
         params = {"w": np.ones((4, 4), np.float32)}
         out = functions.broadcast_parameters(params, root_rank=0)
-        assert len(calls) == 1  # whole tree, one call
+        # plan header + whole tree in one call
+        assert len(calls) == 2
         np.testing.assert_allclose(out["w"], params["w"])
 
     def test_large_tree_chunks_and_never_pickles(self, hvd_module,
@@ -226,8 +227,8 @@ class TestChunkedBroadcast:
             "b": np.ones((7,), np.int32),
         }
         out = functions.broadcast_parameters(params, root_rank=0)
-        # 160_000 B f32 at 65536 B chunks -> 3, + 1 i32 chunk
-        assert len(calls) == 4, [np.asarray(c).nbytes for c in calls]
+        # plan header + 160_000 B f32 at 65536 B chunks -> 3, + 1 i32 chunk
+        assert len(calls) == 5, [np.asarray(c).nbytes for c in calls]
         assert all(np.asarray(c).ndim == 1 for c in calls)
         np.testing.assert_allclose(out["w"], params["w"])
         np.testing.assert_allclose(out["b"], params["b"])
@@ -259,9 +260,12 @@ class TestChunkedBroadcast:
         for c in calls:
             leaves = np.asarray(c) if not isinstance(c, dict) else None
             if leaves is not None and leaves.dtype.itemsize > 4:
-                # the int64 length scalar of broadcast_object is the
-                # only allowed 8-byte item, and it is 0-d
-                assert leaves.ndim == 0, leaves.dtype
+                # the only allowed 8-byte items are tiny int64 metadata
+                # headers (plan negotiation / broadcast_object length),
+                # never array payload
+                assert leaves.dtype == np.int64 and leaves.size <= 3, (
+                    leaves.dtype, leaves.shape,
+                )
 
     def test_large_object_buffer_chunks(self, hvd_module, monkeypatch):
         from horovod_tpu import functions
