@@ -1,0 +1,154 @@
+"""Gradient contracts for the raw bridge collectives.
+
+Reference: ``tensorflow/mpi_ops.py:131-356`` (``RegisterGradient`` for
+HorovodAllreduce/Allgather/Broadcast/Alltoall) and
+``torch/mpi_ops.py:176-846`` (``autograd.Function`` wrappers).  The
+contracts:
+
+* allreduce's gradient is an allreduce with the SAME op and scale
+  factors (``_allreduce_grad``);
+* allgather's gradient is the set-Average allreduce of the incoming
+  gradient, sliced back to this rank's rows (``_allgather_grad``);
+* broadcast's gradient is the set-Average allreduce delivered to the
+  root rank, zero on other members (``_broadcast_grad``);
+* alltoall's gradient is the reverse alltoall (``_alltoall_grad`` with
+  the received splits).
+
+The math operates on the stacked row layouts of the eager API — global
+``(size, ...)`` or process-local rows — with numpy in/out so the torch
+``autograd.Function`` wrappers and the TF ``tf.custom_gradient``
+wrappers share one implementation.  Collectives ride the device mesh
+through :mod:`horovod_tpu.ops.eager`; only the slice/placement math is
+host-side.
+
+Set semantics follow this framework's forwards (which differ from the
+reference where non-members "may not call"): set-allgather hands
+non-members zeros, so their gradient is zero; set-broadcast passes
+non-members through, so their gradient is the identity.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+import numpy as np
+
+from ..ops import eager as _eager
+from ..runtime import get_runtime
+
+
+def _row_ranks(nrows: int) -> List[int]:
+    """Global rank of each stacked row: identity for the global
+    ``(size, ...)`` layout, the process's device ranks for local rows."""
+    rt = get_runtime()
+    if nrows == rt.size:
+        return list(range(rt.size))
+    devs = list(rt.devices)
+    return [devs.index(d) for d in rt.local_devices]
+
+
+def _members(process_set) -> List[int]:
+    rt = get_runtime()
+    if process_set is None:
+        return list(range(rt.size))
+    return list(process_set.ranks)
+
+
+def allreduce_grad(dy: np.ndarray, op: int, process_set=None,
+                   prescale_factor: float = 1.0,
+                   postscale_factor: float = 1.0) -> np.ndarray:
+    """Reference ``_allreduce_grad``: same op, same scale factors."""
+    return np.asarray(_eager.allreduce(
+        dy, op=op, process_set=process_set,
+        prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor,
+    ))
+
+
+def allgather_grad(dy: np.ndarray, process_set=None) -> np.ndarray:
+    """Reference ``_allgather_grad``: Average-allreduce the gradient,
+    then keep each rank's own slice of the concatenation."""
+    g = np.asarray(_eager.allreduce(
+        dy, op=_eager.Average, process_set=process_set
+    ))
+    members = _members(process_set)
+    k = len(members)
+    if g.shape[1] % k:
+        raise ValueError(
+            f"allgather gradient: dim 1 ({g.shape[1]}) is not a multiple "
+            f"of the set size ({k})"
+        )
+    d = g.shape[1] // k
+    pos = {r: i for i, r in enumerate(members)}
+    out = np.zeros((g.shape[0], d) + g.shape[2:], g.dtype)
+    for i, r in enumerate(_row_ranks(g.shape[0])):
+        if r in pos:
+            p = pos[r]
+            out[i] = g[i, p * d:(p + 1) * d]
+    return out
+
+
+def broadcast_grad(dy: np.ndarray, root_rank: int,
+                   process_set=None) -> np.ndarray:
+    """Reference ``_broadcast_grad``: Average-allreduce to the root,
+    zero on other members; non-members (identity forward) pass dy
+    through."""
+    g = np.asarray(_eager.allreduce(
+        dy, op=_eager.Average, process_set=process_set
+    ))
+    members = _members(process_set)
+    # root_rank is set-relative for explicit sets (traced.broadcast)
+    global_root = members[root_rank] if process_set is not None else root_rank
+    out = np.array(dy, copy=True)
+    for i, r in enumerate(_row_ranks(dy.shape[0])):
+        if r in members:
+            out[i] = g[i] if r == global_root else 0
+    return out
+
+
+def alltoall_grad(dy: np.ndarray, splits: Optional[np.ndarray] = None,
+                  process_set=None) -> np.ndarray:
+    """Reference ``_alltoall_grad``: route the gradient back with the
+    reverse alltoall.
+
+    Equal splits are their own transpose — one alltoall.  Explicit
+    uneven splits return a PADDED ``(rows, size*max_chunk, ...)``
+    placement from the forward, so the gradient un-routes those
+    segments: a pure host re-placement for the global stacked layout
+    (zero wire traffic — every process already holds all rows), one
+    allgather first for the local-rows layout.
+    """
+    if splits is None:
+        return np.asarray(_eager.alltoall(dy, process_set=process_set))
+    if process_set is not None:
+        raise NotImplementedError(
+            "gradients of uneven-splits alltoall on an explicit process "
+            "set are not supported; use the global set or equal splits"
+        )
+    rt = get_runtime()
+    n = rt.size
+    splits = np.asarray(splits, np.int64)
+    if splits.shape != (n, n):
+        raise ValueError(f"splits must be ({n}, {n}), got {splits.shape}")
+    rows = _row_ranks(dy.shape[0])
+    if dy.shape[0] == n:
+        g_dy = np.asarray(dy)
+    else:
+        # local rows -> global: stacked allgather gives every row the
+        # full concatenation; one row of it is the global dy.
+        gathered = np.asarray(_eager.allgather(dy))
+        g_dy = gathered[0].reshape((n,) + dy.shape[1:])
+    max_chunk = int(splits.max())
+    offs = np.concatenate(
+        [np.zeros((n, 1), np.int64), np.cumsum(splits, axis=1)], axis=1
+    )
+    d0 = int(splits[0].sum())
+    grad = np.zeros((n, d0) + g_dy.shape[2:], g_dy.dtype)
+    for m in range(n):          # original sender (gradient receiver)
+        for j in range(n):      # original receiver
+            c = int(splits[m, j])
+            if c:
+                grad[m, offs[m, j]:offs[m, j] + c] = (
+                    g_dy[j, m * max_chunk:m * max_chunk + c]
+                )
+    return grad[rows] if dy.shape[0] != n else grad

@@ -82,26 +82,29 @@ def _graph_wrap(tf, fn, tensor, out_shape=None, out_dtype=None):
     return out
 
 
+def _host_call(tf, fn_np, tensor, out_shape=None, out_dtype=None):
+    """Run a numpy-in/numpy-out collective on ``tensor`` in the right
+    mode: directly when eager, through the py_function re-entry when
+    symbolic."""
+    if _in_graph(tf, tensor):
+        return _graph_wrap(
+            tf,
+            lambda t: tf.constant(np.asarray(fn_np(_to_np(t)))),
+            tensor, out_shape=out_shape, out_dtype=out_dtype,
+        )
+    return tf.constant(np.asarray(fn_np(_to_np(tensor))))
+
+
 def allreduce(tensor, average: Optional[bool] = None, op: Optional[int] = None,
               name: Optional[str] = None, process_set=None,
               prescale_factor: float = 1.0, postscale_factor: float = 1.0):
     """``hvd.allreduce`` on a tf.Tensor (stacked ``(size, ...)``
     convention like the JAX eager API).  ``tf.IndexedSlices`` reduce as
     allgather-of-slices (reference ``tensorflow/__init__.py:95-162``).
-    Callable inside ``tf.function`` graphs (py_function lowering)."""
+    Callable inside ``tf.function`` graphs (py_function lowering).
+    Differentiable: the gradient is an allreduce with the same op and
+    scale factors (reference ``mpi_ops.py:130-150``)."""
     tf = _tf()
-    if _in_graph(tf, tensor) and not isinstance(tensor, tf.IndexedSlices):
-        # The eager lowering is dtype-preserving (int Average truncates
-        # like the reference), so Tout == input dtype is exact.
-        return _graph_wrap(
-            tf,
-            lambda t: allreduce(
-                t, average=average, op=op, name=name,
-                process_set=process_set, prescale_factor=prescale_factor,
-                postscale_factor=postscale_factor,
-            ),
-            tensor, out_shape=tensor.shape,
-        )
     if isinstance(tensor, tf.IndexedSlices):
         avg = (
             average if average is not None
@@ -110,84 +113,179 @@ def allreduce(tensor, average: Optional[bool] = None, op: Optional[int] = None,
         values = tensor.values
         if prescale_factor != 1.0:
             values = values * prescale_factor
+        # composes differentiably: allgather carries a custom gradient
         values = allgather(values, process_set=process_set)
         indices = allgather(tensor.indices, process_set=process_set)
         if avg:
             from .. import runtime
 
-            values = values / runtime.get_runtime().size
+            # average by the SET size (the dense path's semantics);
+            # non-member rows already hold zeros from the set allgather
+            k = (
+                len(process_set.ranks) if process_set is not None
+                else runtime.get_runtime().size
+            )
+            values = values / k
         if postscale_factor != 1.0:
             values = values * postscale_factor
         return tf.IndexedSlices(
             values=values, indices=indices, dense_shape=tensor.dense_shape
         )
-    y = _eager.allreduce(
-        _to_np(tensor),
-        average=average, op=op, name=name, process_set=process_set,
-        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+    if average is not None and op is not None:
+        raise ValueError("specify either average or op, not both")
+    resolved = (
+        op if op is not None
+        else (_eager.Average if (average is None or average) else _eager.Sum)
     )
-    return tf.constant(np.asarray(y))
+
+    @tf.custom_gradient
+    def _op(t):
+        # The eager lowering is dtype-preserving (int Average truncates
+        # like the reference), so Tout == input dtype is exact.
+        y = _host_call(
+            tf,
+            lambda a: _eager.allreduce(
+                a, op=resolved, name=name, process_set=process_set,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+            ),
+            t, out_shape=t.shape,
+        )
+
+        def grad(dy):
+            return allreduce(
+                dy, op=resolved, process_set=process_set,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+            )
+
+        return y, grad
+
+    return _op(tensor)
 
 
 def allgather(tensor, name: Optional[str] = None, process_set=None):
+    """Differentiable: the gradient is the set-Average allreduce of the
+    incoming gradient sliced back to this rank's rows (reference
+    ``mpi_ops.py:224-252``)."""
     tf = _tf()
-    if _in_graph(tf, tensor):
-        # Stacked (size, ...) inputs keep their rank and leading dim;
-        # only the gathered dim is dynamic — restore what is static so
-        # rank-sensitive downstream graph ops still build.
-        out_shape = None
-        shape = tensor.shape
-        if shape.rank is not None and shape.rank >= 2:
-            from .. import size as _size
+    from . import _grads
 
-            if shape[0] is not None and int(shape[0]) == _size():
-                out_shape = [shape[0]] + [None] * (shape.rank - 1)
-        return _graph_wrap(
+    # Stacked (size, ...) inputs keep their rank and leading dim; only
+    # the gathered dim is dynamic — restore what is static so
+    # rank-sensitive downstream graph ops still build.
+    out_shape = None
+    shape = tensor.shape
+    if (_in_graph(tf, tensor) and shape.rank is not None
+            and shape.rank >= 2):
+        from .. import size as _size
+
+        if shape[0] is not None and int(shape[0]) == _size():
+            out_shape = [shape[0]] + [None] * (shape.rank - 1)
+
+    @tf.custom_gradient
+    def _op(t):
+        y = _host_call(
             tf,
-            lambda t: allgather(t, name=name, process_set=process_set),
-            tensor, out_shape=out_shape,
+            lambda a: _eager.allgather(a, name=name,
+                                       process_set=process_set),
+            t, out_shape=out_shape,
         )
-    return tf.constant(np.asarray(_eager.allgather(
-        _to_np(tensor), name=name, process_set=process_set
-    )))
+
+        def grad(dy):
+            return _host_call(
+                tf,
+                lambda a: _grads.allgather_grad(a, process_set=process_set),
+                dy, out_shape=t.shape,
+            )
+
+        return y, grad
+
+    return _op(tensor)
 
 
 def broadcast(tensor, root_rank: int, name: Optional[str] = None,
               process_set=None):
+    """Differentiable: the gradient is the set-Average allreduce
+    delivered at the root, zero on other members (reference
+    ``mpi_ops.py:275-296``)."""
     tf = _tf()
-    if _in_graph(tf, tensor):
-        return _graph_wrap(
+    from . import _grads
+
+    @tf.custom_gradient
+    def _op(t):
+        y = _host_call(
             tf,
-            lambda t: broadcast(t, root_rank, name=name,
-                                process_set=process_set),
-            tensor, out_shape=tensor.shape,
+            lambda a: _eager.broadcast(a, root_rank, name=name,
+                                       process_set=process_set),
+            t, out_shape=t.shape,
         )
-    return tf.constant(np.asarray(_eager.broadcast(
-        _to_np(tensor), root_rank, name=name, process_set=process_set
-    )))
+
+        def grad(dy):
+            return _host_call(
+                tf,
+                lambda a: _grads.broadcast_grad(a, root_rank,
+                                                process_set=process_set),
+                dy, out_shape=t.shape,
+            )
+
+        return y, grad
+
+    return _op(tensor)
 
 
 def alltoall(tensor, splits=None, name: Optional[str] = None,
              process_set=None):
+    """Differentiable: the gradient is the reverse alltoall (reference
+    ``mpi_ops.py:335-356``)."""
     tf = _tf()
-    if _in_graph(tf, tensor):
-        if splits is not None:
-            raise NotImplementedError(
-                "alltoall with explicit splits inside tf.function is not "
-                "supported (recv counts are a second negotiated output); "
-                "call it eagerly"
-            )
-        return _graph_wrap(
-            tf,
-            lambda t: alltoall(t, name=name, process_set=process_set),
-            tensor, out_shape=tensor.shape,
+    from . import _grads
+
+    if _in_graph(tf, tensor) and splits is not None:
+        raise NotImplementedError(
+            "alltoall with explicit splits inside tf.function is not "
+            "supported (recv counts are a second negotiated output); "
+            "call it eagerly"
         )
-    out = _eager.alltoall(
-        _to_np(tensor), splits, name=name, process_set=process_set
-    )
-    if isinstance(out, tuple):
-        return tf.constant(np.asarray(out[0])), tf.constant(np.asarray(out[1]))
-    return tf.constant(np.asarray(out))
+    splits_np = None if splits is None else np.asarray(splits)
+
+    def grad(dy):
+        if splits_np is None:
+            return alltoall(dy, process_set=process_set)
+        return _host_call(
+            tf,
+            lambda a: _grads.alltoall_grad(a, splits=splits_np,
+                                           process_set=process_set),
+            dy,
+        )
+
+    if splits is None:
+        @tf.custom_gradient
+        def _op(t):
+            y = _host_call(
+                tf,
+                lambda a: _eager.alltoall(a, name=name,
+                                          process_set=process_set),
+                t, out_shape=tensor.shape if _in_graph(tf, tensor) else None,
+            )
+            return y, grad
+
+        return _op(tensor)
+
+    @tf.custom_gradient
+    def _op_uneven(t):
+        out, recv = _eager.alltoall(
+            _to_np(t), splits_np, name=name, process_set=process_set
+        )
+        y = tf.constant(np.asarray(out))
+
+        def grad_pair(dy, d_recv):
+            del d_recv  # integer output: not differentiable
+            return grad(dy)
+
+        return (y, tf.constant(np.asarray(recv))), grad_pair
+
+    return _op_uneven(tensor)
 
 
 def broadcast_object(obj, root_rank: int = 0, name: Optional[str] = None):

@@ -4,11 +4,14 @@ runtime.
 Reference: ``horovod/torch/mpi_ops.py`` + ``mpi_ops_v2.cc`` — sync and
 async collectives on ``torch.Tensor``s with a handle/synchronize model.
 Here tensors cross into JAX via DLPack (zero-copy on CPU), run the same
-eager collectives, and come back as torch tensors.  Gradients do not
-flow through these ops (use the JAX surface for training); they serve
-torch-side data/metric plumbing — ``broadcast_parameters`` of a torch
-``state_dict``, metric averaging, allgather of eval outputs — exactly
-the roles the reference's torch functions play around a training loop.
+eager collectives, and come back as torch tensors.  The sync
+out-of-place collectives are differentiable exactly like the
+reference's ``autograd.Function`` wrappers (``torch/mpi_ops.py:176``):
+an ``hvd.allreduce`` inside a loss graph backpropagates an allreduce of
+the gradient.  The in-place/async variants serve torch-side data and
+metric plumbing — ``broadcast_parameters`` of a torch ``state_dict``,
+metric averaging, allgather of eval outputs — the roles the
+reference's torch functions play around a training loop.
 """
 
 from __future__ import annotations
@@ -75,39 +78,199 @@ def _to_torch(x, like):
 
 
 # ---- collectives (reference torch/mpi_ops.py surface) -------------------
+#
+# Each sync out-of-place collective routes through a torch.autograd
+# Function when its input requires grad, exactly like the reference's
+# wrappers (torch/mpi_ops.py:176-846): hvd.allreduce inside a loss graph
+# backpropagates an allreduce of the gradient, allgather a sliced
+# set-average, broadcast a root-delivered set-average, alltoall the
+# reverse alltoall (shared math: interop/_grads.py).
 
-def allreduce(tensor, op: int = _eager.Average, name: Optional[str] = None,
-              process_set=None, prescale_factor: float = 1.0,
-              postscale_factor: float = 1.0):
-    """Reference ``hvd.allreduce(tensor)`` for torch tensors (stacked
-    (size, ...) convention like the JAX eager API)."""
+_fn_cache: Dict[str, Any] = {}
+
+
+def _autograd_fns() -> Dict[str, Any]:
+    """Build (once) the autograd.Function wrappers; lazy so importing
+    this module never imports torch."""
+    if _fn_cache:
+        return _fn_cache
+    torch = _torch()
+    from . import _grads
+
+    def _np(t):
+        return _tensor_to_numpy(torch, t)
+
+    class _AllreduceFn(torch.autograd.Function):
+        @staticmethod
+        def forward(ctx, tensor, op, name, process_set, pre, post):
+            ctx.meta = (op, process_set, pre, post)
+            return _allreduce_impl(tensor, op=op, name=name,
+                                   process_set=process_set,
+                                   prescale_factor=pre,
+                                   postscale_factor=post)
+
+        @staticmethod
+        def backward(ctx, dy):
+            op, ps, pre, post = ctx.meta
+            g = _grads.allreduce_grad(_np(dy), op, process_set=ps,
+                                      prescale_factor=pre,
+                                      postscale_factor=post)
+            return _to_torch(g, dy), None, None, None, None, None
+
+    class _AllgatherFn(torch.autograd.Function):
+        @staticmethod
+        def forward(ctx, tensor, name, process_set):
+            ctx.ps = process_set
+            return _allgather_impl(tensor, name=name,
+                                   process_set=process_set)
+
+        @staticmethod
+        def backward(ctx, dy):
+            g = _grads.allgather_grad(_np(dy), process_set=ctx.ps)
+            return _to_torch(g, dy), None, None
+
+    class _BroadcastFn(torch.autograd.Function):
+        @staticmethod
+        def forward(ctx, tensor, root_rank, name, process_set):
+            ctx.meta = (root_rank, process_set)
+            return _broadcast_impl(tensor, root_rank, name=name,
+                                   process_set=process_set)
+
+        @staticmethod
+        def backward(ctx, dy):
+            root, ps = ctx.meta
+            g = _grads.broadcast_grad(_np(dy), root, process_set=ps)
+            return _to_torch(g, dy), None, None, None
+
+    class _AlltoallFn(torch.autograd.Function):
+        @staticmethod
+        def forward(ctx, tensor, splits, name, process_set):
+            ctx.meta = (None if splits is None else np.asarray(splits),
+                        process_set)
+            out = _alltoall_impl(tensor, splits, name=name,
+                                 process_set=process_set)
+            if isinstance(out, tuple):
+                ctx.mark_non_differentiable(out[1])
+                return out
+            return out
+
+        @staticmethod
+        def backward(ctx, dy, *dead):
+            splits, ps = ctx.meta
+            g = _grads.alltoall_grad(_np(dy), splits=splits,
+                                     process_set=ps)
+            return _to_torch(g, dy), None, None, None
+
+    class _GroupedAllreduceFn(torch.autograd.Function):
+        """Reference ``HorovodGroupedAllreduce`` (torch/mpi_ops.py:383):
+        ONE fused collective in both directions."""
+
+        @staticmethod
+        def forward(ctx, op, name, process_set, pre, post, *tensors):
+            ctx.meta = (op, process_set, pre, post)
+            ys = _eager.grouped_allreduce(
+                [_to_jax(t) for t in tensors], op=op, name=name,
+                process_set=process_set, prescale_factor=pre,
+                postscale_factor=post,
+            )
+            return tuple(_to_torch(y, t) for y, t in zip(ys, tensors))
+
+        @staticmethod
+        def backward(ctx, *dys):
+            op, ps, pre, post = ctx.meta
+            gs = _eager.grouped_allreduce(
+                [_to_jax(d) for d in dys], op=op, process_set=ps,
+                prescale_factor=pre, postscale_factor=post,
+            )
+            return (None, None, None, None, None) + tuple(
+                _to_torch(g, d) for g, d in zip(gs, dys)
+            )
+
+    _fn_cache.update(
+        allreduce=_AllreduceFn, allgather=_AllgatherFn,
+        broadcast=_BroadcastFn, alltoall=_AlltoallFn,
+        grouped_allreduce=_GroupedAllreduceFn,
+    )
+    return _fn_cache
+
+
+def _wants_grad(tensor) -> bool:
+    torch = _torch()
+    return (torch.is_tensor(tensor) and tensor.requires_grad
+            and torch.is_grad_enabled())
+
+
+def _allreduce_impl(tensor, op, name, process_set, prescale_factor,
+                    postscale_factor):
     y = _eager.allreduce(
-        _to_jax(tensor), op=op, name=name, process_set=process_set,
+        _to_jax(tensor),
+        op=op, name=name, process_set=process_set,
         prescale_factor=prescale_factor, postscale_factor=postscale_factor,
     )
     return _to_torch(y, tensor)
 
 
-def allgather(tensor, name: Optional[str] = None, process_set=None):
+def allreduce(tensor, op: int = _eager.Average, name: Optional[str] = None,
+              process_set=None, prescale_factor: float = 1.0,
+              postscale_factor: float = 1.0):
+    """Reference ``hvd.allreduce(tensor)`` for torch tensors (stacked
+    (size, ...) convention like the JAX eager API).  Differentiable:
+    the gradient is an allreduce with the same op and scale factors
+    (reference ``torch/mpi_ops.py:176-205``)."""
+    if _wants_grad(tensor):
+        return _autograd_fns()["allreduce"].apply(
+            tensor, op, name, process_set, prescale_factor,
+            postscale_factor,
+        )
+    return _allreduce_impl(tensor, op, name, process_set,
+                           prescale_factor, postscale_factor)
+
+
+def _allgather_impl(tensor, name, process_set):
     return _to_torch(
-        _eager.allgather(_to_jax(tensor), name=name, process_set=process_set),
+        _eager.allgather(
+            _to_jax(tensor),
+            name=name, process_set=process_set,
+        ),
+        tensor,
+    )
+
+
+def allgather(tensor, name: Optional[str] = None, process_set=None):
+    """Differentiable: the gradient is the set-Average allreduce sliced
+    back to this rank's rows (reference ``torch/mpi_ops.py:574-593``)."""
+    if _wants_grad(tensor):
+        return _autograd_fns()["allgather"].apply(tensor, name, process_set)
+    return _allgather_impl(tensor, name, process_set)
+
+
+def _broadcast_impl(tensor, root_rank, name, process_set):
+    return _to_torch(
+        _eager.broadcast(
+            _to_jax(tensor),
+            root_rank, name=name, process_set=process_set,
+        ),
         tensor,
     )
 
 
 def broadcast(tensor, root_rank: int, name: Optional[str] = None,
               process_set=None):
-    return _to_torch(
-        _eager.broadcast(_to_jax(tensor), root_rank, name=name,
-                         process_set=process_set),
-        tensor,
+    """Differentiable: the gradient is the set-Average allreduce
+    delivered at the root, zero elsewhere (reference
+    ``torch/mpi_ops.py:659-678``)."""
+    if _wants_grad(tensor):
+        return _autograd_fns()["broadcast"].apply(
+            tensor, root_rank, name, process_set
+        )
+    return _broadcast_impl(tensor, root_rank, name, process_set)
+
+
+def _alltoall_impl(tensor, splits, name, process_set):
+    out = _eager.alltoall(
+        _to_jax(tensor),
+        splits, name=name, process_set=process_set,
     )
-
-
-def alltoall(tensor, splits=None, name: Optional[str] = None,
-             process_set=None):
-    out = _eager.alltoall(_to_jax(tensor), splits, name=name,
-                          process_set=process_set)
     if isinstance(out, tuple):
         # uneven splits: (output, received_splits) like the reference's
         # alltoall return (torch/mpi_ops.py:361)
@@ -115,13 +278,31 @@ def alltoall(tensor, splits=None, name: Optional[str] = None,
     return _to_torch(out, tensor)
 
 
+def alltoall(tensor, splits=None, name: Optional[str] = None,
+             process_set=None):
+    """Differentiable: the gradient is the reverse alltoall (reference
+    ``torch/mpi_ops.py:796-824``)."""
+    if _wants_grad(tensor):
+        return _autograd_fns()["alltoall"].apply(
+            tensor, splits, name, process_set
+        )
+    return _alltoall_impl(tensor, splits, name, process_set)
+
+
 def grouped_allreduce(tensors, op: int = _eager.Average,
                       name: Optional[str] = None, process_set=None,
                       prescale_factor: float = 1.0,
                       postscale_factor: float = 1.0):
     """Reference ``hvd.grouped_allreduce`` (``torch/mpi_ops.py``): one
-    fused collective over a list of tensors."""
+    fused collective over a list of tensors.  Differentiable per tensor
+    like the reference's grouped Function (``torch/mpi_ops.py:383``) —
+    each gradient is an allreduce with the same op."""
     tensors = list(tensors)
+    if any(_wants_grad(t) for t in tensors):
+        return list(_autograd_fns()["grouped_allreduce"].apply(
+            op, name, process_set, prescale_factor, postscale_factor,
+            *tensors,
+        ))
     ys = _eager.grouped_allreduce(
         [_to_jax(t) for t in tensors], op=op, name=name,
         process_set=process_set, prescale_factor=prescale_factor,

@@ -1,0 +1,348 @@
+"""Gradients of the raw bridge collectives.
+
+Mirrors the reference's gradient tests
+(``test/parallel/test_torch.py:558-1460`` test_horovod_*_grad,
+``test/parallel/test_tensorflow.py`` equivalents) on the stacked
+single-controller layout: an ``hvd.allreduce`` inside a loss graph must
+backpropagate an allreduce of the gradient, allgather a sliced
+set-average, broadcast a root-delivered set-average, alltoall the
+reverse alltoall (``interop/_grads.py``).
+"""
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+import horovod_tpu as hvd
+from horovod_tpu.interop import torch as hvd_torch
+
+N = 8
+
+
+@pytest.fixture()
+def dynamic_sets(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_DYNAMIC_PROCESS_SETS", "1")
+    yield
+
+
+# ---- torch (reference torch/mpi_ops.py autograd.Function wrappers) ------
+
+def test_torch_allreduce_grad_sum(hvd_module):
+    x = torch.randn(N, 4, requires_grad=True)
+    w = torch.randn(N, 4)
+    y = hvd_torch.allreduce(x, op=hvd.Sum)
+    y.backward(w)
+    # grad = allreduce(dy, Sum): every row gets the row-sum of w
+    want = np.tile(w.numpy().sum(axis=0), (N, 1))
+    np.testing.assert_allclose(x.grad.numpy(), want, rtol=1e-5)
+
+
+def test_torch_allreduce_grad_average(hvd_module):
+    x = torch.randn(N, 3, requires_grad=True)
+    y = hvd_torch.allreduce(x, op=hvd.Average)
+    y.backward(torch.ones(N, 3))
+    # grad = allreduce(ones, Average) = ones
+    np.testing.assert_allclose(x.grad.numpy(), np.ones((N, 3)), rtol=1e-5)
+
+
+def test_torch_allreduce_grad_scale_factors(hvd_module):
+    x = torch.randn(N, 2, requires_grad=True)
+    y = hvd_torch.allreduce(x, op=hvd.Sum, prescale_factor=2.0,
+                            postscale_factor=0.5)
+    y.backward(torch.ones(N, 2))
+    # same factors on the way back: 2 * 0.5 * sum(ones) = N
+    np.testing.assert_allclose(x.grad.numpy(), np.full((N, 2), float(N)),
+                               rtol=1e-5)
+
+
+def test_torch_allgather_grad(hvd_module):
+    # reference test_horovod_allgather_grad: grad_ys block r = ones * r
+    # (identical on every rank) -> grad on rank r = ones * r
+    d = 2
+    x = torch.ones(N, d, 3, requires_grad=True)
+    blocks = np.concatenate(
+        [np.full((d, 3), float(r), np.float32) for r in range(N)]
+    )
+    dy = torch.tensor(np.tile(blocks, (N, 1, 1)))
+    y = hvd_torch.allgather(x)
+    assert y.shape == (N, N * d, 3)
+    y.backward(dy)
+    want = np.stack(
+        [np.full((d, 3), float(r), np.float32) for r in range(N)]
+    )
+    np.testing.assert_allclose(x.grad.numpy(), want, rtol=1e-5)
+
+
+def test_torch_broadcast_grad(hvd_module):
+    # reference test_horovod_broadcast_grad: root collects the
+    # set-average, everyone else gets zero
+    root = 2
+    x = torch.randn(N, 5, requires_grad=True)
+    dy = torch.randn(N, 5)
+    y = hvd_torch.broadcast(x, root_rank=root)
+    y.backward(dy)
+    want = np.zeros((N, 5), np.float32)
+    want[root] = dy.numpy().mean(axis=0)
+    np.testing.assert_allclose(x.grad.numpy(), want, rtol=1e-5, atol=1e-6)
+
+
+def test_torch_alltoall_grad_even(hvd_module):
+    # even splits: the backward is the reverse alltoall (transpose of
+    # the chunk grid)
+    x = torch.randn(N, N, requires_grad=True)
+    dy = torch.randn(N, N)
+    y = hvd_torch.alltoall(x)
+    y.backward(dy)
+    np.testing.assert_allclose(x.grad.numpy(), dy.numpy().T, rtol=1e-5)
+
+
+def test_torch_alltoall_grad_uneven(hvd_module):
+    # uneven splits: gradient un-routes the padded placement exactly
+    splits = np.ones((N, N), np.int32)
+    splits[0, 1] += 1
+    splits[0, 2] -= 1
+    d0 = int(splits[0].sum())
+    x = torch.randn(N, d0, requires_grad=True)
+    out, recv = hvd_torch.alltoall(x, splits=splits)
+    dy = torch.randn(*out.shape)
+    out.backward(dy)
+    # numpy reference routing
+    max_chunk = int(splits.max())
+    offs = np.concatenate(
+        [np.zeros((N, 1), np.int64), np.cumsum(splits, axis=1)], axis=1
+    )
+    want = np.zeros((N, d0), np.float32)
+    for m in range(N):
+        for j in range(N):
+            c = int(splits[m, j])
+            want[m, offs[m, j]:offs[m, j] + c] = (
+                dy.numpy()[j, m * max_chunk:m * max_chunk + c]
+            )
+    np.testing.assert_allclose(x.grad.numpy(), want, rtol=1e-5)
+
+
+def test_torch_grouped_allreduce_grad(hvd_module):
+    xs = [torch.randn(N, 3, requires_grad=True) for _ in range(3)]
+    ys = hvd_torch.grouped_allreduce(xs, op=hvd.Sum)
+    sum(y.sum() for y in ys).backward()
+    for x in xs:
+        np.testing.assert_allclose(
+            x.grad.numpy(), np.full((N, 3), float(N)), rtol=1e-5
+        )
+
+
+def test_torch_process_set_allreduce_grad(hvd_module, dynamic_sets):
+    members = [0, 2, 5]
+    ps = hvd.add_process_set(members)
+    try:
+        x = torch.randn(N, 4, requires_grad=True)
+        dy = torch.randn(N, 4)
+        y = hvd_torch.allreduce(x, op=hvd.Average, process_set=ps)
+        y.backward(dy)
+        want = np.array(dy.numpy(), copy=True)
+        want[members] = dy.numpy()[members].mean(axis=0)
+        np.testing.assert_allclose(x.grad.numpy(), want, rtol=1e-5)
+    finally:
+        hvd.remove_process_set(ps)
+
+
+def test_torch_process_set_allgather_grad_nonmember_zero(hvd_module,
+                                                         dynamic_sets):
+    members = [1, 4]
+    ps = hvd.add_process_set(members)
+    try:
+        d = 2
+        x = torch.ones(N, d, requires_grad=True)
+        y = hvd_torch.allgather(x, process_set=ps)
+        assert y.shape == (N, len(members) * d)
+        y.backward(torch.ones_like(y))
+        g = x.grad.numpy()
+        for r in range(N):
+            if r in members:
+                np.testing.assert_allclose(g[r], np.ones(d), rtol=1e-5)
+            else:
+                np.testing.assert_allclose(g[r], np.zeros(d))
+    finally:
+        hvd.remove_process_set(ps)
+
+
+def test_torch_no_grad_path_unchanged(hvd_module):
+    # tensors without requires_grad skip the autograd wrapper entirely
+    x = torch.arange(N * 2, dtype=torch.float32).reshape(N, 2)
+    y = hvd_torch.allreduce(x, op=hvd.Sum)
+    assert not y.requires_grad
+    np.testing.assert_allclose(
+        y.numpy(), np.tile(x.numpy().sum(axis=0), (N, 1)), rtol=1e-6
+    )
+
+
+# ---- TF (reference tensorflow/mpi_ops.py RegisterGradient) --------------
+
+tf = pytest.importorskip("tensorflow")
+
+from horovod_tpu.interop import tf as hvd_tf  # noqa: E402
+
+
+def test_tf_allreduce_grad_sum(hvd_module):
+    x = tf.constant(np.random.RandomState(0).randn(N, 4).astype(np.float32))
+    w = np.random.RandomState(1).randn(N, 4).astype(np.float32)
+    with tf.GradientTape() as tape:
+        tape.watch(x)
+        y = hvd_tf.allreduce(x, op=hvd.Sum)
+    g = tape.gradient(y, x, output_gradients=tf.constant(w))
+    np.testing.assert_allclose(
+        g.numpy(), np.tile(w.sum(axis=0), (N, 1)), rtol=1e-5
+    )
+
+
+def test_tf_allreduce_grad_average_through_loss(hvd_module):
+    x = tf.constant(np.ones((N, 3), np.float32))
+    with tf.GradientTape() as tape:
+        tape.watch(x)
+        y = hvd_tf.allreduce(x, op=hvd.Average)
+        loss = tf.reduce_sum(y)
+    g = tape.gradient(loss, x)
+    # d loss / dx = allreduce(ones, Average) = ones
+    np.testing.assert_allclose(g.numpy(), np.ones((N, 3)), rtol=1e-5)
+
+
+def test_tf_allgather_grad(hvd_module):
+    d = 2
+    x = tf.constant(np.ones((N, d), np.float32))
+    blocks = np.concatenate(
+        [np.full((d,), float(r), np.float32) for r in range(N)]
+    )
+    dy = tf.constant(np.tile(blocks, (N, 1)))
+    with tf.GradientTape() as tape:
+        tape.watch(x)
+        y = hvd_tf.allgather(x)
+    g = tape.gradient(y, x, output_gradients=dy)
+    want = np.stack([np.full((d,), float(r)) for r in range(N)])
+    np.testing.assert_allclose(g.numpy(), want, rtol=1e-5)
+
+
+def test_tf_broadcast_grad(hvd_module):
+    root = 3
+    dy = np.random.RandomState(2).randn(N, 4).astype(np.float32)
+    x = tf.constant(np.ones((N, 4), np.float32))
+    with tf.GradientTape() as tape:
+        tape.watch(x)
+        y = hvd_tf.broadcast(x, root_rank=root)
+    g = tape.gradient(y, x, output_gradients=tf.constant(dy))
+    want = np.zeros((N, 4), np.float32)
+    want[root] = dy.mean(axis=0)
+    np.testing.assert_allclose(g.numpy(), want, rtol=1e-5, atol=1e-6)
+
+
+def test_tf_alltoall_grad_even(hvd_module):
+    x = tf.constant(np.random.RandomState(3).randn(N, N).astype(np.float32))
+    dy = np.random.RandomState(4).randn(N, N).astype(np.float32)
+    with tf.GradientTape() as tape:
+        tape.watch(x)
+        y = hvd_tf.alltoall(x)
+    g = tape.gradient(y, x, output_gradients=tf.constant(dy))
+    np.testing.assert_allclose(g.numpy(), dy.T, rtol=1e-5)
+
+
+def test_tf_alltoall_grad_uneven(hvd_module):
+    splits = np.ones((N, N), np.int32)
+    splits[0, 1] += 1
+    splits[0, 2] -= 1
+    d0 = int(splits[0].sum())
+    x = tf.constant(np.random.RandomState(5).randn(N, d0).astype(np.float32))
+    with tf.GradientTape() as tape:
+        tape.watch(x)
+        out, recv = hvd_tf.alltoall(x, splits=splits)
+    dy = np.random.RandomState(6).randn(*out.shape.as_list()).astype(
+        np.float32
+    )
+    g = tape.gradient(out, x, output_gradients=tf.constant(dy))
+    max_chunk = int(splits.max())
+    offs = np.concatenate(
+        [np.zeros((N, 1), np.int64), np.cumsum(splits, axis=1)], axis=1
+    )
+    want = np.zeros((N, d0), np.float32)
+    for m in range(N):
+        for j in range(N):
+            c = int(splits[m, j])
+            want[m, offs[m, j]:offs[m, j] + c] = (
+                dy[j, m * max_chunk:m * max_chunk + c]
+            )
+    np.testing.assert_allclose(g.numpy(), want, rtol=1e-5)
+
+
+def test_tf_allreduce_grad_inside_tf_function(hvd_module):
+    """The in-graph py_function lowering carries the custom gradient."""
+    @tf.function
+    def f(x):
+        with tf.GradientTape() as tape:
+            tape.watch(x)
+            y = hvd_tf.allreduce(x, op=hvd.Sum)
+            loss = tf.reduce_sum(y)
+        return tape.gradient(loss, x)
+
+    g = f(tf.constant(np.ones((N, 2), np.float32)))
+    np.testing.assert_allclose(g.numpy(), np.full((N, 2), float(N)),
+                               rtol=1e-5)
+
+
+def test_tf_indexed_slices_grad_flows(hvd_module):
+    """The IndexedSlices reduce path composes differentiably through
+    the allgather custom gradient."""
+    values = tf.constant(np.ones((N, 2, 3), np.float32))
+    indices = tf.constant(np.tile(np.arange(2), (N, 1)).astype(np.int32))
+    with tf.GradientTape() as tape:
+        tape.watch(values)
+        s = tf.IndexedSlices(values=values, indices=indices,
+                             dense_shape=tf.constant([4, 3]))
+        red = hvd_tf.allreduce(s, op=hvd.Average)
+        loss = tf.reduce_sum(red.values)
+    g = tape.gradient(loss, values)
+    assert g is not None
+    assert g.shape == values.shape
+
+
+def test_tf_indexed_slices_set_average_uses_set_size(hvd_module,
+                                                     dynamic_sets):
+    members = [0, 3, 6]
+    ps = hvd.add_process_set(members)
+    try:
+        values = tf.constant(np.ones((N, 2, 3), np.float32))
+        indices = tf.constant(np.tile(np.arange(2), (N, 1)).astype(np.int32))
+        s = tf.IndexedSlices(values=values, indices=indices,
+                             dense_shape=tf.constant([4, 3]))
+        red = hvd_tf.allreduce(s, op=hvd.Average, process_set=ps)
+        # member rows: gather of k members' ones, each scaled by 1/k
+        # (NOT 1/world) so the scatter-add over the k duplicate indices
+        # reconstructs exactly the member average (= ones)
+        k = len(members)
+        vals = red.values.numpy()
+        for r in members:
+            np.testing.assert_allclose(
+                vals[r], np.full((k * 2, 3), 1.0 / k), rtol=1e-6
+            )
+            # dense reconstruction: accumulate duplicates
+            dense = np.zeros((4, 3), np.float32)
+            np.add.at(dense, red.indices.numpy()[r], vals[r])
+            np.testing.assert_allclose(dense[:2], np.ones((2, 3)),
+                                       rtol=1e-6)
+    finally:
+        hvd.remove_process_set(ps)
+
+
+def test_tf_process_set_allreduce_grad(hvd_module, dynamic_sets):
+    members = [0, 3, 6]
+    ps = hvd.add_process_set(members)
+    try:
+        dy = np.random.RandomState(8).randn(N, 3).astype(np.float32)
+        x = tf.constant(np.ones((N, 3), np.float32))
+        with tf.GradientTape() as tape:
+            tape.watch(x)
+            y = hvd_tf.allreduce(x, op=hvd.Average, process_set=ps)
+        g = tape.gradient(y, x, output_gradients=tf.constant(dy))
+        want = np.array(dy, copy=True)
+        want[members] = dy[members].mean(axis=0)
+        np.testing.assert_allclose(g.numpy(), want, rtol=1e-5)
+    finally:
+        hvd.remove_process_set(ps)
