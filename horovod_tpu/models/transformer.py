@@ -78,7 +78,8 @@ class Attention(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, x: jax.Array) -> jax.Array:
+    def __call__(self, x: jax.Array,
+                 segment_ids: Optional[jax.Array] = None) -> jax.Array:
         cfg = self.cfg
         if cfg.attn_impl not in ("flash", "full", "ring", "ulysses"):
             raise ValueError(
@@ -100,6 +101,11 @@ class Attention(nn.Module):
         qkv = qkv.reshape(b, t, 3, h_local, cfg.head_dim)
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
 
+        if segment_ids is not None and cfg.attn_impl not in ("flash", "full"):
+            raise ValueError(
+                "packed sequences (segment_ids) require attn_impl='flash' "
+                "or 'full'; sequence-parallel impls do not support packing"
+            )
         # With the sp axis absent the sequence is unsharded, so plain
         # full attention is the correct lowering for every impl.
         if cfg.attn_impl == "ring" and _axis_present(cfg.sp_axis):
@@ -121,9 +127,11 @@ class Attention(nn.Module):
                 "use attn_impl='ring' or 'ulysses' for sequence parallelism"
             )
         elif cfg.attn_impl == "flash":
-            out = flash_attention(q, k, v, cfg.causal)
+            out = flash_attention(q, k, v, cfg.causal,
+                                  segment_ids=segment_ids)
         else:
-            out = full_attention(q, k, v, causal=cfg.causal)
+            out = full_attention(q, k, v, causal=cfg.causal,
+                                 segment_ids=segment_ids)
 
         out = out.reshape(b, t, h_local * cfg.head_dim)
         return RowParallelDense(
@@ -139,12 +147,13 @@ class Block(nn.Module):
 
     @nn.compact
     def __call__(
-        self, x: jax.Array
+        self, x: jax.Array, segment_ids: Optional[jax.Array] = None
     ) -> Tuple[jax.Array, jax.Array]:
         cfg = self.cfg
         # LayerNorm in fp32 — the numerically load-bearing reductions.
         h = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
-        x = x + Attention(cfg, name="attn")(h.astype(cfg.dtype))
+        x = x + Attention(cfg, name="attn")(h.astype(cfg.dtype),
+                                            segment_ids)
         h = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
         h = h.astype(cfg.dtype)
         aux = jnp.zeros((), jnp.float32)
@@ -177,7 +186,9 @@ class Transformer(nn.Module):
     cfg: TransformerConfig
 
     @nn.compact
-    def __call__(self, tokens: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    def __call__(self, tokens: jax.Array,
+                 segment_ids: Optional[jax.Array] = None
+                 ) -> Tuple[jax.Array, jax.Array]:
         cfg = self.cfg
         b, t = tokens.shape
         emb = nn.Embed(
@@ -190,6 +201,11 @@ class Transformer(nn.Module):
         pos = jnp.arange(t)
         t_global = t
         if _axis_present(cfg.sp_axis):
+            if segment_ids is not None and lax.axis_size(cfg.sp_axis) > 1:
+                raise ValueError(
+                    "packed sequences cannot be sequence-sharded; drop "
+                    "the sp axis or the segment_ids"
+                )
             t_global = t * lax.axis_size(cfg.sp_axis)
             pos = pos + lax.axis_index(cfg.sp_axis) * t
         if t_global > cfg.max_len:
@@ -200,7 +216,22 @@ class Transformer(nn.Module):
             "wpe", nn.initializers.normal(0.02),
             (cfg.max_len, cfg.model_dim), jnp.float32,
         )
-        x = (x + jnp.take(wpe, pos, axis=0)[None]).astype(cfg.dtype)
+        if segment_ids is not None:
+            # Positions restart at each packed document so every
+            # document sees the positional embeddings it would see
+            # alone in the row.
+            idx = jnp.broadcast_to(jnp.arange(t)[None], (b, t))
+            is_start = jnp.concatenate(
+                [jnp.ones((b, 1), bool),
+                 segment_ids[:, 1:] != segment_ids[:, :-1]], axis=1,
+            )
+            start_idx = lax.cummax(
+                jnp.where(is_start, idx, 0), axis=1
+            )
+            pos2d = idx - start_idx  # [B, T]
+            x = (x + jnp.take(wpe, pos2d, axis=0)).astype(cfg.dtype)
+        else:
+            x = (x + jnp.take(wpe, pos, axis=0)[None]).astype(cfg.dtype)
 
         aux_total = jnp.zeros((), jnp.float32)
         # remat: recompute block activations in backward instead of
@@ -211,7 +242,9 @@ class Transformer(nn.Module):
             use_moe = (
                 cfg.moe_every > 0 and (i + 1) % cfg.moe_every == 0
             )
-            x, aux = block_cls(cfg, use_moe=use_moe, name=f"block_{i}")(x)
+            x, aux = block_cls(cfg, use_moe=use_moe, name=f"block_{i}")(
+                x, segment_ids
+            )
             aux_total = aux_total + aux
 
         x = nn.LayerNorm(dtype=jnp.float32, name="ln_f")(x)
@@ -279,6 +312,28 @@ def gpt_tiny(**overrides) -> Transformer:
     )
     cfg = dataclasses.replace(cfg, **overrides)
     return Transformer(cfg)
+
+
+def packed_token_cross_entropy(
+    logits: jax.Array, tokens: jax.Array, segment_ids: jax.Array
+) -> jax.Array:
+    """Next-token cross-entropy for PACKED rows: position t predicts
+    token t+1 only when both live in the same document (no loss across
+    document boundaries), and padding (segment id 0) is excluded.
+    Mean over valid positions — equal total weight to what the same
+    documents would contribute unpacked.
+    """
+    l32 = logits[:, :-1].astype(jnp.float32)
+    targets = tokens[:, 1:].astype(jnp.int32)
+    valid = jnp.logical_and(
+        segment_ids[:, 1:] == segment_ids[:, :-1],
+        segment_ids[:, 1:] > 0,
+    )
+    import optax
+
+    ce = optax.softmax_cross_entropy_with_integer_labels(l32, targets)
+    w = valid.astype(jnp.float32)
+    return jnp.sum(ce * w) / jnp.maximum(jnp.sum(w), 1.0)
 
 
 def token_cross_entropy(logits: jax.Array, targets: jax.Array) -> jax.Array:

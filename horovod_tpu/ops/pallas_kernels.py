@@ -122,22 +122,22 @@ _scale_buffer_vjp.defvjp(_scale_buffer_fwd, _scale_buffer_bwd)
 
 
 def _flash_fwd_kernel(
-    q_ref,
-    k_ref,
-    v_ref,
-    o_ref,
-    lse_ref,
-    acc_ref,
-    m_ref,
-    l_ref,
-    *,
+    *refs,
     scale: float,
     causal: bool,
+    packed: bool,
     block_q: int,
     block_k: int,
     t_actual: int,
     nk: int,
 ):
+    if packed:
+        (q_ref, k_ref, v_ref, sq_ref, sk_ref,
+         o_ref, lse_ref, acc_ref, m_ref, l_ref) = refs
+    else:
+        (q_ref, k_ref, v_ref,
+         o_ref, lse_ref, acc_ref, m_ref, l_ref) = refs
+        sq_ref = sk_ref = None
     qj = pl.program_id(2)
     kk = pl.program_id(3)
 
@@ -174,6 +174,10 @@ def _flash_fwd_kernel(
         if causal:
             q_pos = qj * block_q + lax.broadcasted_iota(jnp.int32, s.shape, 0)
             mask = jnp.logical_and(mask, q_pos >= k_pos)
+        if packed:
+            # Packed sequences: tokens attend only within their own
+            # segment (sq_ref is [block_q, 1], sk_ref [1, block_k]).
+            mask = jnp.logical_and(mask, sq_ref[:] == sk_ref[:])
         s = jnp.where(mask, s, _NEG_INF)
 
         m_prev = m_ref[:, :1]  # [block_q, 1]
@@ -224,6 +228,15 @@ def _pad_t(x: jax.Array, block: int) -> jax.Array:
     return jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
 
 
+def _pad_seg(seg: jax.Array, block: int) -> jax.Array:
+    """Pad segment ids along T with -1 (matches nothing)."""
+    t = seg.shape[1]
+    pad = -(-t // block) * block - t
+    if pad == 0:
+        return seg
+    return jnp.pad(seg, ((0, 0), (0, pad)), constant_values=-1)
+
+
 def _flash_forward(
     q: jax.Array,
     k: jax.Array,
@@ -232,6 +245,7 @@ def _flash_forward(
     scale: float,
     block_q: int,
     block_k: int,
+    segments: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     b, t, h, d = q.shape
     block_q = min(block_q, max(t, 16))
@@ -248,19 +262,34 @@ def _flash_forward(
         _flash_fwd_kernel,
         scale=scale,
         causal=causal,
+        packed=segments is not None,
         block_q=block_q,
         block_k=block_k,
         t_actual=t,
         nk=nk,
     )
+    in_specs = [
+        pl.BlockSpec((None, None, block_q, d), lambda b_, h_, j, kk: (b_, h_, j, 0)),
+        pl.BlockSpec((None, None, block_k, d), lambda b_, h_, j, kk: (b_, h_, kk, 0)),
+        pl.BlockSpec((None, None, block_k, d), lambda b_, h_, j, kk: (b_, h_, kk, 0)),
+    ]
+    inputs = [qp, kp, vp]
+    if segments is not None:
+        seg = jnp.asarray(segments, jnp.int32)
+        # [B, Tq, 1] / [B, 1, Tk] so the blocks arrive pre-oriented for
+        # the (block_q, block_k) mask broadcast.
+        inputs.append(_pad_seg(seg, block_q)[:, :, None])
+        inputs.append(_pad_seg(seg, block_k)[:, None, :])
+        in_specs.append(pl.BlockSpec(
+            (None, block_q, 1), lambda b_, h_, j, kk: (b_, j, 0)
+        ))
+        in_specs.append(pl.BlockSpec(
+            (None, 1, block_k), lambda b_, h_, j, kk: (b_, 0, kk)
+        ))
     out, lse = pl.pallas_call(
         kernel,
         grid=(b, h, nq, nk),
-        in_specs=[
-            pl.BlockSpec((None, None, block_q, d), lambda b_, h_, j, kk: (b_, h_, j, 0)),
-            pl.BlockSpec((None, None, block_k, d), lambda b_, h_, j, kk: (b_, h_, kk, 0)),
-            pl.BlockSpec((None, None, block_k, d), lambda b_, h_, j, kk: (b_, h_, kk, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((None, None, block_q, d), lambda b_, h_, j, kk: (b_, h_, j, 0)),
             pl.BlockSpec(
@@ -281,7 +310,7 @@ def _flash_forward(
             dimension_semantics=("parallel", "parallel", "parallel", "arbitrary"),
         ),
         interpret=_interpret(),
-    )(qp, kp, vp)
+    )(*inputs)
     return out.transpose(0, 2, 1, 3)[:, :t], lse[:, :, :t, 0]
 
 
@@ -295,6 +324,7 @@ def _flash_bwd_chunked(
     causal: bool,
     scale: float,
     chunk: int,
+    segments: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array, jax.Array]:
     """Blockwise-recompute flash backward (O(T·chunk) score memory).
 
@@ -321,16 +351,28 @@ def _flash_bwd_chunked(
     nchunks = (t + pad) // chunk
     k_chunks = kf.reshape(b, nchunks, chunk, h, d).transpose(1, 0, 2, 3, 4)
     v_chunks = vf.reshape(b, nchunks, chunk, h, d).transpose(1, 0, 2, 3, 4)
+    if segments is not None:
+        segp = _pad_seg(jnp.asarray(segments, jnp.int32), chunk)
+        seg_chunks = segp.reshape(b, nchunks, chunk).transpose(1, 0, 2)
+    else:
+        # dummy carry input keeps one scan structure for both modes
+        seg_chunks = jnp.zeros((nchunks, b, 1), jnp.int32)
 
     q_pos = jnp.arange(t)
 
     def step(dq, inputs):
-        j, kc, vc = inputs
+        j, kc, vc, segc = inputs
         k_pos = j * chunk + jnp.arange(chunk)
         s = jnp.einsum("bqhd,bkhd->bhqk", qf * scale, kc)
         mask = (k_pos < t)[None, :]
         if causal:
             mask = jnp.logical_and(mask, q_pos[:, None] >= k_pos[None, :])
+        if segments is not None:
+            # [b, 1, q, k] segment-match mask joins the [q, k] base
+            mask = jnp.logical_and(
+                mask[None, None],
+                (segments[:, :, None] == segc[:, None, :])[:, None],
+            )
         p = jnp.where(mask, jnp.exp(s - lse[..., None]), 0.0)
         dv_j = jnp.einsum("bhqk,bqhd->bkhd", p, dof)
         dp = jnp.einsum("bqhd,bkhd->bhqk", dof, vc)
@@ -346,7 +388,7 @@ def _flash_bwd_chunked(
     if vma:
         dq0 = lax.pcast(dq0, tuple(vma), to="varying")
     dq, (dk_chunks, dv_chunks) = lax.scan(
-        step, dq0, (jnp.arange(nchunks), k_chunks, v_chunks)
+        step, dq0, (jnp.arange(nchunks), k_chunks, v_chunks, seg_chunks)
     )
     dk = dk_chunks.transpose(1, 0, 2, 3, 4).reshape(b, t + pad, h, d)[:, :t]
     dv = dv_chunks.transpose(1, 0, 2, 3, 4).reshape(b, t + pad, h, d)[:, :t]
@@ -356,36 +398,16 @@ def _flash_bwd_chunked(
 @functools.partial(
     jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
 )
-def flash_attention(
+def _flash_attention_dense(
     q: jax.Array,
     k: jax.Array,
     v: jax.Array,
-    causal: bool = False,
-    scale: Optional[float] = None,
-    block_q: int = 512,
-    block_k: int = 512,
-    bwd_chunk: int = 512,
+    causal: bool,
+    scale: Optional[float],
+    block_q: int,
+    block_k: int,
+    bwd_chunk: int,
 ) -> jax.Array:
-    """Fused flash attention: [B, T, H, D] → [B, T, H, D].
-
-    Forward is a Pallas kernel: the [T,T] score matrix never leaves
-    VMEM — each (q-block, k-block) tile is a pair of MXU matmuls with
-    online softmax carried in VMEM scratch, causal upper blocks skipped.
-    Backward recomputes blockwise from the saved logsumexp (flash
-    identities), so memory stays O(T·chunk).  Numerics match
-    ``parallel.ring_attention.full_attention`` to fp tolerance.
-
-    Requires ``q`` and ``k``/``v`` to share sequence length: the kernel's
-    padding mask and causal diagonal are derived from ``q.shape[1]``.
-    For cross-attention with differing lengths use ``full_attention``
-    (which offsets the diagonal by ``tk - tq``).
-    """
-    if k.shape[1] != q.shape[1] or v.shape[1] != q.shape[1]:
-        raise ValueError(
-            f"flash_attention requires equal q/k/v sequence lengths, got "
-            f"q T={q.shape[1]}, k T={k.shape[1]}, v T={v.shape[1]}; use "
-            "full_attention for unequal lengths"
-        )
     out, _ = _flash_forward(
         q, k, v, causal, scale if scale is not None else q.shape[-1] ** -0.5,
         block_q, block_k,
@@ -408,4 +430,100 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, bwd_chunk, res, do):
     return dq, dk, dv
 
 
-flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+_flash_attention_dense.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8)
+)
+def _flash_attention_packed(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    segment_ids: jax.Array,
+    causal: bool,
+    scale: Optional[float],
+    block_q: int,
+    block_k: int,
+    bwd_chunk: int,
+) -> jax.Array:
+    out, _ = _flash_forward(
+        q, k, v, causal, scale if scale is not None else q.shape[-1] ** -0.5,
+        block_q, block_k, segments=segment_ids,
+    )
+    return out
+
+
+def _flash_packed_fwd_rule(q, k, v, seg, causal, scale, block_q, block_k,
+                           bwd_chunk):
+    scale_val = scale if scale is not None else q.shape[-1] ** -0.5
+    out, lse = _flash_forward(q, k, v, causal, scale_val, block_q, block_k,
+                              segments=seg)
+    return out, (q, k, v, seg, out, lse)
+
+
+def _flash_packed_bwd_rule(causal, scale, block_q, block_k, bwd_chunk,
+                           res, do):
+    q, k, v, seg, out, lse = res
+    scale_val = scale if scale is not None else q.shape[-1] ** -0.5
+    dq, dk, dv = _flash_bwd_chunked(
+        q, k, v, out, lse, do, causal, scale_val, bwd_chunk, segments=seg
+    )
+    # integer segment ids carry a float0 (empty) cotangent
+    return dq, dk, dv, np.zeros(seg.shape, jax.dtypes.float0)
+
+
+_flash_attention_packed.defvjp(_flash_packed_fwd_rule,
+                               _flash_packed_bwd_rule)
+
+
+def flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 512,
+    block_k: int = 512,
+    bwd_chunk: int = 512,
+    segment_ids: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Fused flash attention: [B, T, H, D] → [B, T, H, D].
+
+    Forward is a Pallas kernel: the [T,T] score matrix never leaves
+    VMEM — each (q-block, k-block) tile is a pair of MXU matmuls with
+    online softmax carried in VMEM scratch, causal upper blocks skipped.
+    Backward recomputes blockwise from the saved logsumexp (flash
+    identities), so memory stays O(T·chunk).  Numerics match
+    ``parallel.ring_attention.full_attention`` to fp tolerance.
+
+    ``segment_ids`` ([B, T] int32) enables packed-sequence attention:
+    tokens attend only to keys in the same segment (the standard
+    sequence-packing mask — multiple documents share one row with no
+    cross-document attention).  The reference has no LM/attention story;
+    this is the TPU-native throughput lever for LM pretraining.
+
+    Requires ``q`` and ``k``/``v`` to share sequence length: the kernel's
+    padding mask and causal diagonal are derived from ``q.shape[1]``.
+    For cross-attention with differing lengths use ``full_attention``
+    (which offsets the diagonal by ``tk - tq``).
+    """
+    if k.shape[1] != q.shape[1] or v.shape[1] != q.shape[1]:
+        raise ValueError(
+            f"flash_attention requires equal q/k/v sequence lengths, got "
+            f"q T={q.shape[1]}, k T={k.shape[1]}, v T={v.shape[1]}; use "
+            "full_attention for unequal lengths"
+        )
+    if segment_ids is None:
+        return _flash_attention_dense(
+            q, k, v, causal, scale, block_q, block_k, bwd_chunk
+        )
+    if segment_ids.shape != q.shape[:2]:
+        raise ValueError(
+            f"segment_ids must be [B, T] = {q.shape[:2]}, got "
+            f"{segment_ids.shape}"
+        )
+    return _flash_attention_packed(
+        q, k, v, jnp.asarray(segment_ids, jnp.int32), causal, scale,
+        block_q, block_k, bwd_chunk,
+    )

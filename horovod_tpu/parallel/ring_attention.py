@@ -33,11 +33,14 @@ def full_attention(
     v: jax.Array,
     causal: bool = False,
     scale: Optional[float] = None,
+    segment_ids: Optional[jax.Array] = None,
 ) -> jax.Array:
     """Plain softmax attention, f32 accumulation: [B, T, H, D] → same.
 
     The single-device reference semantics that ``ring_attention`` and
     ``ulysses_attention`` must match bit-for-bit up to fp error.
+    ``segment_ids`` ([B, T]) restricts attention to same-segment keys
+    (packed sequences).
     """
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -48,6 +51,13 @@ def full_attention(
         tq, tk = s.shape[-2], s.shape[-1]
         mask = jnp.tril(jnp.ones((tq, tk), dtype=bool), k=tk - tq)
         s = jnp.where(mask, s, _NEG_INF)
+    if segment_ids is not None:
+        segmask = (
+            segment_ids[:, :, None] == segment_ids[:, None, :]
+        )[:, None]  # [B, 1, Tq, Tk]
+        s = jnp.where(segmask, s, _NEG_INF)
+        # no fully-masked row is possible: q and k share one segment
+        # array, so every query matches at least its own key (diagonal)
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)).astype(q.dtype)
 

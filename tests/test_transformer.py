@@ -214,3 +214,172 @@ def test_token_cross_entropy_matches_one_hot_form():
     # bf16 logits: loss still accumulates in fp32
     lb = logits.astype(jnp.bfloat16)
     assert token_cross_entropy(lb, tgt).dtype == jnp.float32
+
+
+# ---- sequence packing (VERDICT r4 item 3) -------------------------------
+
+class TestSequencePacking:
+    """Packed rows must compute exactly what the same documents would
+    compute unpacked: per-document logits equal, loss equal."""
+
+    @staticmethod
+    def _docs_and_packed(seq_len=32, impl="full"):
+        from horovod_tpu.data.packing import pack_documents
+
+        rng = np.random.RandomState(0)
+        docs = [
+            rng.randint(1, 256, n).astype(np.int32) for n in (12, 9, 7, 20)
+        ]
+        toks, segs = pack_documents(docs, seq_len)
+        model = gpt_tiny(attn_impl=impl, max_len=seq_len)
+        params = model.init(
+            jax.random.PRNGKey(1), jnp.asarray(toks), jnp.asarray(segs)
+        )
+        return docs, toks, segs, model, params
+
+    @pytest.mark.parametrize("impl", ["full", "flash"])
+    def test_packed_logits_match_unpacked_per_document(self, impl):
+        docs, toks, segs, model, params = self._docs_and_packed(impl=impl)
+        packed_logits, _ = model.apply(
+            params, jnp.asarray(toks), jnp.asarray(segs)
+        )
+        packed_logits = np.asarray(packed_logits)
+        for d in docs:
+            # locate this doc's span in the packed rows
+            found = False
+            for r in range(toks.shape[0]):
+                for s in range(1, segs[r].max() + 1):
+                    idx = np.where(segs[r] == s)[0]
+                    if len(idx) == len(d) and (toks[r, idx] == d).all():
+                        solo, _ = model.apply(params, jnp.asarray(d)[None])
+                        np.testing.assert_allclose(
+                            packed_logits[r, idx], np.asarray(solo)[0],
+                            rtol=2e-4, atol=2e-4,
+                        )
+                        found = True
+                        break
+                if found:
+                    break
+            assert found, f"doc of len {len(d)} not located in packed rows"
+
+    def test_packed_loss_matches_unpacked_mean(self):
+        from horovod_tpu.models.transformer import (
+            packed_token_cross_entropy,
+            token_cross_entropy,
+        )
+
+        docs, toks, segs, model, params = self._docs_and_packed()
+        logits, _ = model.apply(params, jnp.asarray(toks), jnp.asarray(segs))
+        packed_loss = float(packed_token_cross_entropy(
+            logits, jnp.asarray(toks), jnp.asarray(segs)
+        ))
+        # unpacked: token-weighted mean of per-document next-token CE
+        tot, cnt = 0.0, 0
+        for d in docs:
+            solo, _ = model.apply(params, jnp.asarray(d)[None])
+            per_tok = float(token_cross_entropy(
+                solo[:, :-1], jnp.asarray(d)[None, 1:]
+            ))
+            tot += per_tok * (len(d) - 1)
+            cnt += len(d) - 1
+        np.testing.assert_allclose(packed_loss, tot / cnt, rtol=1e-4)
+
+    def test_packed_grads_flow(self):
+        from horovod_tpu.models.transformer import packed_token_cross_entropy
+
+        _, toks, segs, model, params = self._docs_and_packed(impl="flash")
+
+        def loss_fn(p):
+            logits, _ = model.apply(p, jnp.asarray(toks), jnp.asarray(segs))
+            return packed_token_cross_entropy(
+                logits, jnp.asarray(toks), jnp.asarray(segs)
+            )
+
+        grads = jax.grad(loss_fn)(params)
+        total = sum(
+            float(jnp.abs(g).sum()) for g in jax.tree.leaves(grads)
+        )
+        assert np.isfinite(total) and total > 0
+
+    def test_packing_utility_first_fit(self):
+        from horovod_tpu.data.packing import (
+            pack_documents,
+            packing_efficiency,
+        )
+
+        docs = [np.arange(1, n + 1, dtype=np.int32) for n in (30, 20, 10, 2)]
+        toks, segs = pack_documents(docs, 32)
+        # first-fit decreasing: [30, 2] and [20, 10] -> exactly 2 rows
+        assert toks.shape == (2, 32)
+        assert packing_efficiency(segs) > 0.9
+        # every document fully present exactly once
+        flat = []
+        for r in range(toks.shape[0]):
+            for s in range(1, segs[r].max() + 1):
+                idx = np.where(segs[r] == s)[0]
+                flat.append(tuple(toks[r, idx]))
+        assert sorted(len(f) for f in flat) == [2, 10, 20, 30]
+
+    def test_long_document_splits_into_chunks(self):
+        from horovod_tpu.data.packing import pack_documents
+
+        toks, segs = pack_documents(
+            [np.arange(1, 71, dtype=np.int32)], 32
+        )
+        got = np.concatenate(
+            [toks[r][segs[r] > 0] for r in range(toks.shape[0])]
+        )
+        assert sorted(got.tolist()) == list(range(1, 71))
+
+    def test_packed_rejects_sequence_parallel(self):
+        from horovod_tpu.parallel import make_mesh
+
+        model = gpt_tiny(attn_impl="ring")
+        mesh = make_mesh(sp=8)
+        toks = jnp.zeros((1, 32), jnp.int32)
+        segs = jnp.ones((1, 32), jnp.int32)
+
+        def run(t, s):
+            return model.init(jax.random.PRNGKey(0), t, s)
+
+        with pytest.raises(ValueError, match="pack"):
+            jax.jit(shard_map(
+                run, mesh=mesh, in_specs=(P(None, "sp"), P(None, "sp")),
+                out_specs=P(), check_vma=False,
+            ))(toks, segs)
+
+    def test_pack_batches_streaming(self):
+        from horovod_tpu.data.packing import pack_batches
+
+        rng = np.random.RandomState(1)
+        docs = [
+            rng.randint(1, 99, rng.randint(5, 30)).astype(np.int32)
+            for _ in range(120)
+        ]
+        batches = list(pack_batches(iter(docs), seq_len=32, batch_size=4))
+        assert len(batches) >= 5
+        seen = []
+        for toks, segs in batches:
+            assert toks.shape == (4, 32) and segs.shape == (4, 32)
+            for r in range(4):
+                for s in range(1, int(segs[r].max()) + 1):
+                    idx = np.where(segs[r] == s)[0]
+                    if len(idx):
+                        seen.append(tuple(toks[r, idx]))
+        # every emitted span is one of the source docs (or a chunk of
+        # one), and most of the stream was emitted
+        doc_set = {tuple(d) for d in docs}
+        assert sum(s in doc_set for s in seen) >= len(seen) * 0.9
+        assert len(seen) >= 100
+
+    def test_pack_batches_remainder_padding(self):
+        from horovod_tpu.data.packing import pack_batches
+
+        docs = [np.arange(1, 11, dtype=np.int32) for _ in range(3)]
+        out = list(pack_batches(iter(docs), seq_len=16, batch_size=4,
+                                drop_remainder=False))
+        assert len(out) == 1
+        toks, segs = out[0]
+        assert toks.shape == (4, 16)
+        # padded rows carry segment 0 everywhere
+        assert (segs[(segs > 0).any(axis=1) == False] == 0).all()  # noqa: E712
