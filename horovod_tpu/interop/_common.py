@@ -13,17 +13,19 @@ def process_reduce(arr: np.ndarray, average: bool,
                    ) -> np.ndarray:
     """Cross-process reduction of a per-process host array.
 
-    Global set: a true device-mesh allreduce — each process contributes
-    one row of a (P, n) global array sharded one-row-per-process, and a
-    jitted sum/mean over the sharded axis makes XLA insert a real
+    A true device-mesh allreduce — each participating process
+    contributes one row of a global array sharded one-row-per-process,
+    and a jitted sum/mean over the sharded axis makes XLA insert a real
     all-reduce (~2V wire per link), replacing the O(P·V)
     ``process_allgather`` the bridges used before (reference contract:
     gradients ride allreduce, ``torch/mpi_ops.py`` ``synchronize``).
 
-    Subsets fall back to the gather path: the masked pass-through
-    semantics need per-row access, and subset reductions are the rare
-    case.  ``member_procs`` limits the reduction rows to those process
-    indices (still collective: every process must call this).
+    ``member_procs`` restricts the reduction to those process indices:
+    MEMBER processes reduce over a member-only submesh (wire rides only
+    member links — the bridge analog of the member-only ring/mesh
+    lowerings in ``ops/traced.py``); non-member processes return their
+    input unchanged without issuing any collective (masked
+    pass-through).
     """
     from .. import runtime
 
@@ -31,24 +33,34 @@ def process_reduce(arr: np.ndarray, average: bool,
     pc = rt.process_count
     if pc == 1:
         return np.asarray(arr)
-    if member_procs is not None and list(member_procs) != list(range(pc)):
-        return _gather_reduce(arr, average, member_procs)
+    members = (
+        sorted(set(member_procs)) if member_procs is not None
+        else list(range(pc))
+    )
+    if rt.process_rank not in members:
+        return np.asarray(arr)
     import jax
-    import jax.numpy as jnp
     from jax.sharding import Mesh, NamedSharding
     from jax.sharding import PartitionSpec as P
 
     by_proc: dict = {}
     for d in jax.devices():
         by_proc.setdefault(d.process_index, d)
-    if len(by_proc) != pc:
+    if any(p not in by_proc for p in members):
+        if len(members) != pc:
+            # the gather fallback is a GLOBAL collective; with
+            # non-members already returned it would deadlock
+            raise RuntimeError(
+                "member-only process reduction requires every member "
+                "process to own an addressable device"
+            )
         return _gather_reduce(arr, average, member_procs)
-    firsts = tuple(by_proc[p] for p in sorted(by_proc))
+    firsts = tuple(by_proc[p] for p in members)
     mesh = Mesh(np.asarray(firsts, dtype=object), ("p",))
     arr = np.asarray(arr)
-    row = jax.device_put(arr[None], firsts[rt.process_rank])
+    row = jax.device_put(arr[None], by_proc[rt.process_rank])
     garr = jax.make_array_from_single_device_arrays(
-        (pc,) + arr.shape, NamedSharding(mesh, P("p")), [row]
+        (len(members),) + arr.shape, NamedSharding(mesh, P("p")), [row]
     )
     red = _jitted_row_reduce(average, firsts)(garr)
     return np.asarray(red.addressable_data(0))
@@ -82,6 +94,95 @@ def _gather_reduce(arr: np.ndarray, average: bool,
         gathered = gathered[jnp.asarray(list(member_procs))]
     red = gathered.mean(axis=0) if average else gathered.sum(axis=0)
     return np.asarray(red)
+
+
+def gather_slices(indices: np.ndarray, values: np.ndarray):
+    """Cross-process gather of ragged (indices, values) slice pairs on
+    the ARRAY wire (reference allgather-of-slices contract,
+    ``tensorflow/__init__.py:123-162``): lengths negotiate via one tiny
+    allgather, rows pad to the max and ride equal-shape device
+    allgathers — no pickling of array payload (the ``allgather_v``
+    pattern at process level).
+
+    Returns ``(lengths [P], indices [P, m], values [P, m, ...])``
+    padded arrays; callers trim row p to ``lengths[p]``.  Callers must
+    downcast 64-bit payloads first (or use the pickled object path) —
+    x64-disabled JAX would truncate them in flight.
+    """
+    from jax.experimental import multihost_utils
+
+    indices = np.asarray(indices)
+    values = np.asarray(values)
+    n = int(indices.shape[0])
+    lens = np.asarray(multihost_utils.process_allgather(
+        np.asarray(n, np.int32)
+    )).reshape(-1)
+    m = max(int(lens.max()), 1)
+    pad_i = np.zeros((m,) + indices.shape[1:], indices.dtype)
+    pad_i[:n] = indices
+    pad_v = np.zeros((m,) + values.shape[1:], values.dtype)
+    pad_v[:n] = values
+    gi = np.asarray(multihost_utils.process_allgather(pad_i))
+    gv = np.asarray(multihost_utils.process_allgather(pad_v))
+    return lens, gi, gv
+
+
+def slices_fit_array_wire(indices: np.ndarray, values: np.ndarray) -> bool:
+    """True when an (indices, values) pair can ride :func:`gather_slices`
+    without 64-bit truncation (int64 indices that fit int32 count as
+    narrowable).  LOCAL verdict only — :func:`gather_slice_pieces`
+    negotiates it globally before branching."""
+    indices = np.asarray(indices)
+    values = np.asarray(values)
+    if values.dtype.itemsize > 4:
+        return False
+    if indices.dtype.itemsize > 4:
+        return not indices.size or (
+            int(indices.max()) < 2 ** 31 and int(indices.min()) >= -(2 ** 31)
+        )
+    return True
+
+
+def gather_slice_pieces(indices: np.ndarray, values: np.ndarray,
+                        member_procs=None):
+    """Cross-process gather of one ragged (indices, values) pair,
+    returned as a list of per-process numpy pairs (rows selected by
+    ``member_procs`` when given) with the caller's index dtype restored.
+
+    The transport verdict — padded array wire vs pickled objects for
+    64-bit payloads — is NEGOTIATED globally (one tiny sum) so every
+    process takes the same collective branch; a per-process local
+    verdict could split the branch (e.g. one rank's batch holds an
+    index >= 2^31) and deadlock mismatched collectives.
+    """
+    from .. import functions as _functions
+    from .. import runtime
+
+    indices = np.asarray(indices)
+    values = np.asarray(values)
+    rt = runtime.get_runtime()
+    fit = slices_fit_array_wire(indices, values)
+    if rt.process_count > 1:
+        votes = process_reduce(
+            np.asarray([1.0 if fit else 0.0], np.float32), average=False
+        )
+        fit = int(round(float(votes[0]))) == rt.process_count
+    if fit:
+        wire_idx = (
+            indices.astype(np.int32)
+            if indices.dtype.itemsize > 4 else indices
+        )
+        lens, gi, gv = gather_slices(wire_idx, values)
+        procs = (
+            member_procs if member_procs is not None else range(len(lens))
+        )
+        return [
+            (np.asarray(gi[p, :lens[p]], indices.dtype), gv[p, :lens[p]])
+            for p in procs
+        ]
+    vals = _functions.allgather_object((indices, values))
+    procs = member_procs if member_procs is not None else range(len(vals))
+    return [(vals[p][0], vals[p][1]) for p in procs]
 
 
 def member_processes(process_set):

@@ -50,10 +50,11 @@ def _is_single_process() -> bool:
 
 def _process_reduce(arr: np.ndarray, average: bool,
                     member_procs=None) -> np.ndarray:
-    """Process-level mean/sum: a true device-mesh allreduce for the
-    global set (~2V wire), gather + local reduce for subsets (masked
-    pass-through needs the rows).  Collective either way — every
-    process must call it."""
+    """Process-level mean/sum: a true device-mesh allreduce — over the
+    full process mesh for the global set, over a member-only submesh
+    for subsets (wire rides member links only).  Member processes must
+    all call it; non-members issue no collective and get their input
+    back unchanged."""
     from ._common import process_reduce
 
     return process_reduce(arr, average, member_procs)
@@ -502,17 +503,20 @@ def _reduce_grads(tf, grads: List[Any], average: bool,
     for i, g in enumerate(grads):
         if isinstance(g, tf.IndexedSlices):
             # allgather-of-slices across processes (reference :123-162)
-            vals = _functions.allgather_object(
-                (np.asarray(g.indices), np.asarray(g.values))
+            # on the ARRAY wire — padded equal-shape device allgathers,
+            # no pickling of gradient payload (64-bit payloads fall back
+            # to pickle, verdict negotiated globally in _common)
+            from ._common import gather_slice_pieces
+
+            pieces = gather_slice_pieces(
+                np.asarray(g.indices), np.asarray(g.values), member_procs
             )
-            if member_procs is not None:
-                vals = [vals[p] for p in member_procs]
             if not included:
                 continue
-            indices = np.concatenate([v[0] for v in vals])
-            values = np.concatenate([v[1] for v in vals])
+            indices = np.concatenate([p[0] for p in pieces])
+            values = np.concatenate([p[1] for p in pieces])
             if average:
-                values = values / len(vals)
+                values = values / len(pieces)
             out[i] = tf.IndexedSlices(
                 values=tf.constant(values),
                 indices=tf.constant(indices),

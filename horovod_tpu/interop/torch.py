@@ -465,27 +465,35 @@ def sparse_allreduce_async(tensor, name: Optional[str] = None,
         )
     t = tensor.coalesce()
     values_like = t.values()
-    payload = (
-        _tensor_to_numpy(torch, t.indices()),
-        _tensor_to_numpy(torch, values_like),  # handles bf16/grad/device
-        tuple(t.shape),
-    )
-    gathered = _functions.allgather_object(payload)
+    idx_np = _tensor_to_numpy(torch, t.indices())  # (ndim, nnz)
+    val_np = _tensor_to_numpy(torch, values_like)  # handles bf16/grad
+    shape = tuple(t.shape)
+    from ._common import gather_slice_pieces
+
+    # Array wire when the payload narrows losslessly (COO indices are
+    # int64 but bounded by the tensor shape); the 64-bit fallback and
+    # the global branch negotiation live in _common.
+    pieces = [
+        (p_idx.T, p_val)
+        for p_idx, p_val in gather_slice_pieces(
+            np.ascontiguousarray(idx_np.T), val_np
+        )
+    ]
 
     class _SparseHandle:
         def done(self):
             return True
 
         def wait(self):
-            idx = np.concatenate([g[0] for g in gathered], axis=1)
-            vals = np.concatenate([g[1] for g in gathered], axis=0)
+            idx = np.concatenate([p[0] for p in pieces], axis=1)
+            vals = np.concatenate([p[1] for p in pieces], axis=0)
             out = torch.sparse_coo_tensor(
                 torch.from_numpy(idx).to(values_like.device),
                 _to_torch(vals, values_like),
-                size=payload[2],
+                size=shape,
             ).coalesce()  # duplicate coordinates sum here
             if op == _eager.Average:
-                out = out / len(gathered)
+                out = out / len(pieces)
             return out
 
     return _SparseHandle()
@@ -703,11 +711,11 @@ class _DistributedOptimizer:
             )
         from ._common import member_processes, process_reduce
 
-        # The reduction is collective: every process must call it;
-        # non-members just discard the result and keep their local
-        # grads (the masked pass-through contract).  Global-set
-        # reductions ride a true device-mesh allreduce (~2V wire);
-        # subsets gather (see _common.process_reduce).
+        # The reduction rides a true device-mesh allreduce (~2V wire):
+        # the full process mesh for the global set, a member-only
+        # submesh for subsets.  Non-members issue no collective and
+        # keep their local grads (the masked pass-through contract) —
+        # see _common.process_reduce.
         member_procs, apply_result = member_processes(self._process_set)
         by_dtype: Dict[Any, list] = {}
         for p in params:

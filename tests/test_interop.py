@@ -183,3 +183,43 @@ def test_torch_alltoall_uneven_splits_returns_received(hvd_module):
     np.testing.assert_allclose(
         out.numpy()[1][0], full[0][int(splits[0, 0])]
     )
+
+
+@pytest.mark.integration
+def test_multiprocess_sparse_allreduce_array_wire():
+    """torch sparse COO allreduce rides the padded array wire (int64
+    coordinates narrow losslessly); the pickle path is patched out."""
+    import sys
+
+    import cloudpickle
+
+    import horovod_tpu.runner as runner
+
+    def worker():
+        import numpy as np
+        import torch
+
+        import horovod_tpu as hvd
+        import horovod_tpu.interop.torch as hvd_torch
+
+        hvd.init()
+
+        def no_pickle(*a, **k):
+            raise AssertionError("COO payload must not pickle")
+
+        hvd_torch._functions.allgather_object = no_pickle
+        r = hvd.process_rank()
+        t = torch.sparse_coo_tensor(
+            torch.tensor([[0, r + 1]]),          # rank-specific coords
+            torch.tensor([1.0, float(r + 1)]),
+            size=(4,),
+        )
+        h = hvd_torch.sparse_allreduce_async(t, op=hvd.Average)
+        out = hvd_torch.synchronize(h).to_dense()
+        return out.numpy().tolist()
+
+    cloudpickle.register_pickle_by_value(sys.modules[__name__])
+    results = runner.run(worker, np=2, use_cpu_devices=True)
+    # coords 0: (1+1)/2 = 1; coord 1: 1/2; coord 2: 2/2
+    for r in results:
+        np.testing.assert_allclose(r, [1.0, 0.5, 1.0, 0.0])
