@@ -160,6 +160,9 @@ class ElasticDriver:
         ssh_port: Optional[int] = None,
         ssh_identity_file: Optional[str] = None,
         publish: Optional[Dict[tuple, bytes]] = None,
+        worker_factory: Optional[Callable] = None,
+        rendezvous_addr: Optional[str] = None,
+        result_collector: Optional[Callable] = None,
     ) -> int:
         """Spawn worker rounds until success, failure beyond limits, or
         reset_limit exhausted.  Returns the job exit code.
@@ -168,6 +171,16 @@ class ElasticDriver:
         rendezvous KV before the first round — how function payloads
         reach workers (e.g. ``task_runner`` fetches ``__run__/func``),
         mirroring ``horovod.run``'s KV-store func delivery.
+
+        ``worker_factory`` replaces the ssh/local exec
+        (``exec_utils.WorkerProcess``) with another transport that
+        spawns ``command`` on a slot's host — e.g. the Spark task-agent
+        dispatch (``spark/elastic.py``).  ``rendezvous_addr`` overrides
+        the NIC probe when the caller already knows the address workers
+        can dial (Spark agents dialed it to register).
+        ``result_collector(control, np, round_id)`` runs on success
+        before the KV server closes — how ``spark.run_elastic`` fetches
+        the winning round's per-rank results.
         """
         # Respawn-per-round makes recompilation the dominant restart
         # cost on TPU; a job-scoped persistent XLA compilation cache
@@ -211,28 +224,62 @@ class ElasticDriver:
                 coordinator_addr = f"{coordinator_host}:{free_port()}"
                 # The rendezvous KV runs in this driver process: remote
                 # workers must dial our routable address, not loopback —
-                # mutually verified via the NIC probe on multi-NIC hosts.
-                rendezvous_addr = exec_utils.probe_routable_addr(
-                    assignments, ssh_port=ssh_port,
-                    ssh_identity_file=ssh_identity_file,
-                )
+                # mutually verified via the NIC probe on multi-NIC hosts
+                # (unless the caller's transport already knows it).
+                round_rdv_addr = rendezvous_addr
+                if round_rdv_addr is None:
+                    round_rdv_addr = exec_utils.probe_routable_addr(
+                        assignments, ssh_port=ssh_port,
+                        ssh_identity_file=ssh_identity_file,
+                    )
+                make_worker = worker_factory or exec_utils.WorkerProcess
+                begin = getattr(make_worker, "begin_round", None)
+                if begin is not None:
+                    begin(round_id)
                 workers = []
+                spawn_failed_host = None
                 for slot in assignments:
                     env = make_worker_env(
-                        slot, coordinator_addr, rendezvous_addr, server.port,
+                        slot, coordinator_addr, round_rdv_addr, server.port,
                         secret, extra_env,
                     )
                     env["HVD_TPU_ELASTIC"] = "1"
                     env["HVD_TPU_ELASTIC_ROUND"] = str(round_id)
-                    workers.append(
-                        exec_utils.WorkerProcess(
-                            slot.rank, slot.hostname, command, env,
-                            ssh_port=ssh_port,
-                            ssh_identity_file=ssh_identity_file,
+                    try:
+                        workers.append(
+                            make_worker(
+                                slot.rank, slot.hostname, command, env,
+                                ssh_port=ssh_port,
+                                ssh_identity_file=ssh_identity_file,
+                            )
                         )
-                    )
+                    except Exception as e:
+                        # A host lost between assignment and spawn (e.g.
+                        # a Spark executor death in the discovery
+                        # staleness window) fails the ROUND, not the
+                        # job: blacklist and go again.
+                        get_logger().warning(
+                            "worker spawn on %s failed: %s",
+                            slot.hostname, e,
+                        )
+                        spawn_failed_host = slot.hostname
+                        break
+                if spawn_failed_host is not None:
+                    for w in workers:
+                        w.terminate()
+                    for w in workers:
+                        w.wait()
+                    self.host_manager.blacklist(spawn_failed_host)
+                    if self.host_manager.available_slots() >= self.min_np:
+                        time.sleep(self.cooldown_s)
+                        continue
+                    return 1
                 rc = self._watch_round(workers, assignments, control, round_id)
                 if rc == 0:
+                    if result_collector is not None:
+                        result_collector(
+                            control, len(assignments), round_id
+                        )
                     return 0
                 if rc == RESTART_CODE:
                     if (

@@ -27,6 +27,11 @@ def main() -> int:
     from . import controller_py
 
     client = controller_py.make_client(addr, port, secret, rank)
+    # Elastic rounds scope the key by round id: an orphaned worker from
+    # a dead round must never collide with the succeeding round's
+    # results.
+    rnd = os.environ.get("HVD_TPU_ELASTIC_ROUND")
+    result_key = f"r{rnd}:{rank}" if rnd else str(rank)
     try:
         blob = client.get("__run__", "func", timeout_ms=30_000)
         if blob is None:
@@ -39,12 +44,13 @@ def main() -> int:
 
             jax.config.update("jax_platforms", "cpu")
         result = func(*args, **kwargs)
-        client.put("__results__", str(rank), pickle.dumps(("ok", result)))
+        client.put("__results__", result_key, pickle.dumps(("ok", result)))
         return 0
     except Exception:
         err = traceback.format_exc()
         try:
-            client.put("__results__", str(rank), pickle.dumps(("error", err)))
+            client.put("__results__", result_key,
+                       pickle.dumps(("error", err)))
         except Exception:
             pass
         sys.stderr.write(err)

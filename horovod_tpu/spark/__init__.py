@@ -19,3 +19,4 @@ from .keras import KerasEstimator  # noqa: F401
 from .torch import TorchEstimator  # noqa: F401
 from .lightning import LightningEstimator  # noqa: F401
 from .runner import run  # noqa: F401
+from .elastic import run_elastic  # noqa: F401

@@ -1,0 +1,488 @@
+"""Elastic Horovod on Spark.
+
+Reference: ``horovod/spark/runner.py:29`` (``run_elastic`` →
+``gloo_run_elastic``), the task/driver service protocol
+(``horovod/spark/driver/driver_service.py``,
+``task_service.py``), and the integration suite
+``test/integration/elastic_spark_common.py``.
+
+Architecture (TPU recast): Spark tasks are long-lived HOST AGENTS, not
+workers.  Each task runs :func:`task_agent_main`: it heartbeats a
+registration into the driver's HMAC KV store and serves exec requests —
+spawn this command with this env, report the exit code, honor
+termination.  The elastic driver (``runner/elastic_driver.py``) then
+runs its membership-round loop exactly as it does over ssh hosts, but
+with :class:`SparkWorkerProcess` dispatching round workers THROUGH the
+agents.  An executor loss drops its agent out of discovery via
+heartbeat expiry (and any in-flight worker reports lost); Spark's task
+retry schedules a fresh agent that re-registers — the reference's
+task-service re-registration recast onto the KV transport the rest of
+this launcher already uses.
+"""
+
+from __future__ import annotations
+
+import os
+import pickle
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..elastic.discovery import HostDiscovery, HostManager
+from ..runner import controller_py
+from ..runner.elastic_driver import ElasticDriver
+from ..utils.logging import get_logger
+
+log = get_logger()
+
+AGENT_SCOPE = "__spark_agents__"
+CMD_SCOPE = "__spark_cmd__"
+RC_SCOPE = "__spark_rc__"
+KILL_SCOPE = "__spark_kill__"
+STOP_SCOPE = "__spark_stop__"
+HEARTBEAT_S = 0.5
+AGENT_STALE_S = 5.0
+
+
+# ---- agent side (runs inside each Spark task) ---------------------------
+
+def _die_with_parent():
+    """preexec hook: a worker must not outlive its agent (Spark kills
+    the whole executor; the local backend mirrors that via Linux
+    PDEATHSIG)."""
+    try:
+        import ctypes
+        import signal as _signal
+
+        libc = ctypes.CDLL("libc.so.6", use_errno=True)
+        libc.prctl(1, _signal.SIGKILL)  # PR_SET_PDEATHSIG
+    except Exception:
+        pass
+
+
+def task_agent_main(index: int, addr: str, port: int, secret: str,
+                    host_label: Optional[str] = None,
+                    heartbeat_s: float = HEARTBEAT_S) -> None:
+    """Serve exec requests until the driver posts the stop flag
+    (reference ``SparkTaskService``: ``run_command`` /
+    ``command_exit_code`` / ``terminate`` RPCs, recast as KV polling).
+
+    Each agent incarnation carries a unique ``attempt`` id (a respawned
+    Spark task attempt): command/rc/kill keys are attempt-scoped, so a
+    fresh attempt can never replay a dead predecessor's commands.
+    """
+    import secrets as pysecrets
+
+    client = controller_py.make_client(addr, port, secret, rank=index)
+    hb_client = controller_py.make_client(addr, port, secret, rank=index)
+    host = host_label or socket.gethostname()
+    attempt = pysecrets.token_hex(4)
+    stop = threading.Event()
+
+    def heartbeat():
+        while not stop.is_set():
+            try:
+                hb_client.put(AGENT_SCOPE, str(index), pickle.dumps({
+                    "host": host, "slots": 1, "pid": os.getpid(),
+                    "attempt": attempt, "ts": time.time(),
+                }))
+            except OSError:
+                return  # driver gone: Spark will retry or tear us down
+            stop.wait(heartbeat_s)
+
+    hb = threading.Thread(target=heartbeat, daemon=True)
+    hb.start()
+    seq = 0
+    try:
+        while True:
+            if client.get(STOP_SCOPE, "all", timeout_ms=0) is not None:
+                return
+            key = f"{index}:{attempt}:{seq}"
+            blob = client.get(CMD_SCOPE, key, timeout_ms=200)
+            if blob is None:
+                continue
+            argv, env = pickle.loads(blob)
+            full_env = dict(os.environ)
+            full_env.update(env)
+            proc = subprocess.Popen(
+                argv, env=full_env, preexec_fn=_die_with_parent
+            )
+            while proc.poll() is None:
+                if client.get(KILL_SCOPE, key, timeout_ms=0) is not None:
+                    proc.terminate()
+                    try:
+                        proc.wait(timeout=10)
+                    except subprocess.TimeoutExpired:
+                        proc.kill()
+                        proc.wait()
+                    break
+                time.sleep(0.1)
+            client.put(RC_SCOPE, key, str(proc.wait()).encode())
+            seq += 1
+    finally:
+        stop.set()
+        client.close()
+        hb_client.close()
+
+
+# ---- driver side --------------------------------------------------------
+
+class _AgentTable:
+    """Driver-side view of registered agents (heartbeat freshness).
+
+    Lookups are cached briefly: ``_watch_round`` polls every pending
+    worker's ``returncode`` at 10 Hz, and an uncached table would issue
+    O(np²)·10 KV round-trips per second against the same server the
+    heartbeats need (starved heartbeats would then report healthy
+    workers as lost)."""
+
+    _CACHE_S = 0.5
+
+    def __init__(self, client, num_agents: int):
+        self._client = client
+        self._n = num_agents
+        self._lock = threading.Lock()
+        self._cached: Dict[int, dict] = {}
+        self._cached_at = 0.0
+
+    def live_agents(self) -> Dict[int, dict]:
+        with self._lock:
+            now = time.time()
+            if now - self._cached_at <= self._CACHE_S:
+                return dict(self._cached)
+            out: Dict[int, dict] = {}
+            for i in range(self._n):
+                blob = self._client.get(AGENT_SCOPE, str(i), timeout_ms=0)
+                if blob is None:
+                    continue
+                info = pickle.loads(blob)
+                if now - info["ts"] <= AGENT_STALE_S:
+                    out[i] = info
+            self._cached, self._cached_at = out, now
+            return dict(out)
+
+
+class SparkTaskDiscovery(HostDiscovery):
+    """Hosts = live registered agents, slots aggregated per host label
+    (reference: the driver service's registered-task view)."""
+
+    def __init__(self, table: _AgentTable):
+        self._table = table
+
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        hosts: Dict[str, int] = {}
+        for info in self._table.live_agents().values():
+            hosts[info["host"]] = hosts.get(info["host"], 0) + info["slots"]
+        return hosts
+
+
+class SparkWorkerProcess:
+    """WorkerProcess-shaped handle over one agent-dispatched command
+    (duck-typed for ``ElasticDriver._watch_round``: ``returncode`` /
+    ``terminate`` / ``wait`` / ``rank`` / ``hostname``)."""
+
+    def __init__(self, rank: int, hostname: str, command: List[str],
+                 env: Dict[str, str], *, client, table: _AgentTable,
+                 agent_index: int, attempt: str, seq: int):
+        self.rank = rank
+        self.hostname = hostname
+        self._client = client
+        self._table = table
+        self._key = f"{agent_index}:{attempt}:{seq}"
+        self._agent = agent_index
+        self._attempt = attempt
+        self._rc: Optional[int] = None
+        client.put(CMD_SCOPE, self._key, pickle.dumps((command, env)))
+
+    @property
+    def returncode(self) -> Optional[int]:
+        if self._rc is not None:
+            return self._rc
+        blob = self._client.get(RC_SCOPE, self._key, timeout_ms=0)
+        if blob is not None:
+            self._rc = int(blob.decode())
+            return self._rc
+        live = self._table.live_agents().get(self._agent)
+        if live is None or live.get("attempt") != self._attempt:
+            # executor died with the worker on it (a respawned attempt
+            # does NOT own this command): report the loss — the
+            # reference sees the same through a dropped task connection
+            self._rc = 1
+            return self._rc
+        return None
+
+    def terminate(self) -> None:
+        self._client.put(KILL_SCOPE, self._key, b"1")
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        deadline = time.monotonic() + timeout if timeout else None
+        while True:
+            rc = self.returncode
+            if rc is not None:
+                return rc
+            if deadline and time.monotonic() >= deadline:
+                raise TimeoutError(f"worker {self.rank} did not exit")
+            time.sleep(0.1)
+
+
+class _AgentWorkerFactory:
+    """Maps (hostname, slot) -> a live agent on that host; allocates one
+    dispatch sequence number per agent."""
+
+    def __init__(self, client, table: _AgentTable):
+        self._client = client
+        self._table = table
+        self._seq: Dict[tuple, int] = {}
+        self._round_claimed: List[int] = []
+
+    def begin_round(self, round_id: int) -> None:
+        """run_rounds calls this before each round's spawn loop (the
+        worker_factory protocol) — reset the per-round agent claims."""
+        self._round_claimed = []
+
+    def __call__(self, rank, hostname, command, env, ssh_port=None,
+                 ssh_identity_file=None) -> SparkWorkerProcess:
+        live = self._table.live_agents()
+        candidates = [
+            i for i, info in sorted(live.items())
+            if info["host"] == hostname and i not in self._round_claimed
+        ]
+        if not candidates:
+            raise RuntimeError(
+                f"no live Spark agent on host {hostname!r} for rank {rank}"
+            )
+        agent = candidates[0]
+        attempt = live[agent]["attempt"]
+        self._round_claimed.append(agent)
+        seq = self._seq.get((agent, attempt), 0)
+        self._seq[(agent, attempt)] = seq + 1
+        return SparkWorkerProcess(
+            rank, hostname, command, env, client=self._client,
+            table=self._table, agent_index=agent, attempt=attempt, seq=seq,
+        )
+
+
+def _driver_addr() -> str:
+    """Address remote executors can dial to reach this driver.
+
+    Spark already knows it (``spark.driver.host`` is what executors use
+    for the driver RPC); fall back to the default-route NIC (UDP
+    connect trick), then the resolver.  Plain
+    ``gethostbyname(gethostname())`` is NOT safe here: Debian-style
+    /etc/hosts maps the hostname to 127.0.1.1 and remote agents would
+    dial their own loopback (cf. ``exec_utils.probe_routable_addr`` —
+    the ssh probe itself has no transport to run over on Spark).
+    """
+    try:
+        import pyspark
+
+        spark = pyspark.sql.SparkSession.builder.getOrCreate()
+        host = spark.sparkContext.getConf().get("spark.driver.host")
+        if host:
+            return host
+    except Exception:
+        pass
+    try:
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect(("8.8.8.8", 80))  # no packet sent: route lookup only
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except OSError:
+        return socket.gethostbyname(socket.gethostname())
+
+
+def _launch_spark_agents(num_proc: int, addr: str, port: int,
+                         secret: str) -> Callable[[], None]:
+    """Start ``num_proc`` long-lived agent tasks as an async Spark job
+    (NON-barrier: tasks are independent hosts, and Spark's per-task
+    retry is exactly the respawn mechanism elastic wants).  Returns a
+    cleanup callable."""
+    import pyspark
+
+    spark = pyspark.sql.SparkSession.builder.getOrCreate()
+    sc = spark.sparkContext
+
+    def agent_partition(split_index, _it):
+        task_agent_main(split_index, addr, port, secret)
+        yield split_index
+
+    rdd = sc.parallelize(range(num_proc), num_proc)
+    # async action: the driver thread continues into the round loop
+    thread = threading.Thread(
+        target=lambda: rdd.mapPartitionsWithIndex(agent_partition).collect(),
+        daemon=True,
+    )
+    thread.start()
+    return lambda: thread.join(timeout=10)
+
+
+class LocalAgentBackend:
+    """Agent backend for environments without pyspark (and for the
+    integration tests): agents are local subprocesses, and a watchdog
+    respawns dead ones exactly as Spark task retry would."""
+
+    def __init__(self, num_proc: int, addr: str, port: int, secret: str,
+                 host_labels: Optional[List[str]] = None):
+        self.num_proc = num_proc
+        self._args = (addr, port, secret)
+        self._labels = host_labels or [
+            f"127.0.0.{i + 1}" for i in range(num_proc)
+        ]
+        self._procs: Dict[int, subprocess.Popen] = {}
+        self._stop = threading.Event()
+        self._watchdog: Optional[threading.Thread] = None
+
+    def _spawn(self, i: int) -> None:
+        addr, port, secret = self._args
+        code = (
+            "import sys; from horovod_tpu.spark.elastic import "
+            "task_agent_main; task_agent_main(int(sys.argv[1]), "
+            "sys.argv[2], int(sys.argv[3]), sys.argv[4], "
+            "host_label=sys.argv[5])"
+        )
+        env = dict(os.environ)
+        env.setdefault("PYTHONPATH", "")
+        self._procs[i] = subprocess.Popen(
+            [sys.executable, "-c", code, str(i), addr, str(port), secret,
+             self._labels[i]],
+            env=env,
+        )
+
+    def start(self) -> None:
+        for i in range(self.num_proc):
+            self._spawn(i)
+
+        def watch():
+            while not self._stop.is_set():
+                for i, p in list(self._procs.items()):
+                    if p.poll() is not None and not self._stop.is_set():
+                        log.warning(
+                            "agent %d died (rc=%s); respawning (the "
+                            "Spark-task-retry analog)", i, p.returncode,
+                        )
+                        self._spawn(i)
+                self._stop.wait(0.5)
+
+        self._watchdog = threading.Thread(target=watch, daemon=True)
+        self._watchdog.start()
+
+    def kill_agent(self, i: int) -> None:
+        """Test hook: simulate an executor loss."""
+        self._procs[i].kill()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._watchdog:
+            self._watchdog.join(timeout=5)
+        for p in self._procs.values():
+            if p.poll() is None:
+                p.terminate()
+        for p in self._procs.values():
+            try:
+                p.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                p.kill()
+
+
+def run_elastic(
+    fn: Callable,
+    args: tuple = (),
+    kwargs: Optional[dict] = None,
+    num_proc: Optional[int] = None,
+    min_np: Optional[int] = None,
+    max_np: Optional[int] = None,
+    extra_env: Optional[Dict[str, str]] = None,
+    reset_limit: Optional[int] = None,
+    verbose: int = 1,
+    _backend: Optional[Any] = None,
+) -> List[Any]:
+    """Run ``fn`` elastically on Spark (reference
+    ``horovod.spark.run_elastic``, ``spark/runner.py:29``): Spark tasks
+    host the workers, worker loss blacklists the host and starts a new
+    round, Spark task retries re-register fresh hosts, and the job
+    completes when a round of workers all exit cleanly.
+
+    Returns the per-rank results of the successful round (rank order).
+    ``_backend`` swaps the Spark task layer for another agent
+    transport: ``"local"`` builds a :class:`LocalAgentBackend`
+    (subprocess agents + respawn watchdog — the pyspark-free test
+    harness and single-machine path).
+    """
+    import cloudpickle
+    import secrets as pysecrets
+
+    kwargs = kwargs or {}
+    if num_proc is None:
+        num_proc = min_np or 1
+    min_np = min_np or num_proc
+    secret = pysecrets.token_hex(16)
+    # Agent-registration KV server (separate from the per-job rendezvous
+    # server run_rounds owns).
+    server = controller_py.make_server(secret, num_proc)
+    addr = "127.0.0.1" if _backend is not None else _driver_addr()
+    client = controller_py.make_client(
+        "127.0.0.1", server.port, secret, rank=-1
+    )
+    table = _AgentTable(client, num_proc)
+
+    backend = _backend
+    if backend == "local":
+        backend = LocalAgentBackend(
+            num_proc, "127.0.0.1", server.port, secret
+        )
+    cleanup: Optional[Callable] = None
+    if backend is None:
+        cleanup = _launch_spark_agents(num_proc, addr, server.port, secret)
+    elif isinstance(backend, LocalAgentBackend):
+        backend.start()
+
+    factory = _AgentWorkerFactory(client, table)
+    driver = ElasticDriver(
+        HostManager(SparkTaskDiscovery(table)),
+        min_np=min_np, max_np=max_np or num_proc, reset_limit=reset_limit,
+    )
+    results: Dict[int, Any] = {}
+
+    def collect(control, np_: int, round_id: int) -> None:
+        for r in range(np_):
+            blob = control.get(
+                "__results__", f"r{round_id}:{r}", timeout_ms=30_000
+            )
+            if blob is None:
+                raise RuntimeError(f"rank {r} published no result")
+            status, payload = pickle.loads(blob)
+            if status != "ok":
+                raise RuntimeError(f"rank {r} failed:\n{payload}")
+            results[r] = payload
+
+    payload = cloudpickle.dumps((fn, args, kwargs))
+    try:
+        driver.start_discovery()
+        rc = driver.run_rounds(
+            [sys.executable, "-m", "horovod_tpu.runner.task_runner"],
+            extra_env=extra_env,
+            publish={("__run__", "func"): payload},
+            worker_factory=factory,
+            rendezvous_addr=addr,
+            result_collector=collect,
+        )
+        if rc != 0:
+            raise RuntimeError(f"elastic Spark job failed with code {rc}")
+        return [results[r] for r in sorted(results)]
+    finally:
+        try:
+            client.put(STOP_SCOPE, "all", b"1")
+            time.sleep(HEARTBEAT_S)
+        except OSError:
+            pass
+        if isinstance(backend, LocalAgentBackend):
+            backend.stop()
+        if cleanup is not None:
+            cleanup()
+        client.close()
+        server.stop()
