@@ -217,7 +217,11 @@ from .compression import Compression  # noqa: F401,E402
 from . import elastic  # noqa: F401,E402
 from .sync_batch_norm import SyncBatchNorm  # noqa: F401,E402
 from .metrics import metric_average  # noqa: F401,E402
-from .utils.timeline import start_timeline, stop_timeline  # noqa: F401,E402
+from .utils.timeline import (  # noqa: F401,E402
+    profile_bucket_step,
+    start_timeline,
+    stop_timeline,
+)
 from . import callbacks  # noqa: F401,E402
 from . import data  # noqa: F401,E402
 from . import checkpoint  # noqa: F401,E402

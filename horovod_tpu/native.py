@@ -277,6 +277,15 @@ class NativeTimeline:
             0, os.getpid(), 0, -1,
         )
 
+    def record_span(self, name: str, activity: str, ts_us: float,
+                    dur_us: float, args: Optional[dict] = None) -> None:
+        """Measured duration event (profiler-extracted ts/dur) on the
+        measured lane (tid 1) — see ``Timeline.record_span``."""
+        self._lib.hvd_timeline_event(
+            self._h, name.encode(), activity.encode(), b"X",
+            int(ts_us), max(int(dur_us), 1), os.getpid(), 1, -1,
+        )
+
     def mark_cycle(self) -> None:
         self._lib.hvd_timeline_event(
             self._h, b"CYCLE", b"CYCLE", b"i", self._now_us(), 0,
