@@ -44,6 +44,16 @@ from ..process_sets import ProcessSet
 from ..runtime import WORLD_AXIS, get_runtime
 
 
+# Trace-time override forcing the quantized wire ON for the autotune
+# probe variant (the fusion-threshold / hierarchical override pattern).
+_quantized_override: Optional[bool] = None
+
+
+def set_quantized_override(value: Optional[bool]) -> None:
+    global _quantized_override
+    _quantized_override = value
+
+
 class DistributedOptimizerState(NamedTuple):
     """State wrapper; ``acc`` holds per-rank gradient accumulators (local
     values, varying over the world axis) and is None when
@@ -81,7 +91,12 @@ def _reduce_gradients(
     # Quantized wire (Compression.int8) validation happens up front so
     # it also covers all-sparse trees and sparse leaves (which would
     # otherwise silently ship fp32 through the identity compressor).
+    # The autotune probe can force the quantized wire on at trace time
+    # (third explored knob, utils/autotune.py) — only ever on, never
+    # off: an explicit Compression.int8 is a user numerics choice.
     quantized = getattr(compression, "quantized_wire", False)
+    if _quantized_override:
+        quantized = True
     if quantized and (
         op not in (Average, Sum)
         or (process_set is not None and process_set.process_set_id != 0)
@@ -363,6 +378,15 @@ def DistributedOptimizer(
     # time override in fusion.bucket_plan is never consulted, so TrainStep
     # must not burn recompiles exploring candidates that change nothing.
     update_fn._hvd_fusion_threshold = fusion_threshold_bytes
+    # Quantized-wire exploration eligibility (third autotune knob): the
+    # probe only makes sense when int8 isn't already the user's wire and
+    # the reduction shape supports it; sparse leaves are discovered at
+    # trace time and rejected there.
+    update_fn._hvd_quant_eligible = (
+        not getattr(compression, "quantized_wire", False)
+        and op in (Average, Sum)
+        and (process_set is None or process_set.process_set_id == 0)
+    )
     return optax.GradientTransformation(init_fn, update_fn)
 
 
@@ -505,7 +529,11 @@ class TrainStep:
         if _env.get_bool(_env.AUTOTUNE) and marker is None:
             from ..utils.autotune import AutotuneDriver
 
-            self._autotune = AutotuneDriver()
+            self._autotune = AutotuneDriver(
+                quant_eligible=getattr(
+                    optimizer.update, "_hvd_quant_eligible", False
+                ),
+            )
         self._mark_cycles = _env.get_bool(_env.TIMELINE_MARK_CYCLES)
 
     def _build_step(self, specs):
@@ -536,16 +564,18 @@ class TrainStep:
         specs = self._state_specs(opt_state)
         threshold = None
         hier = None
+        quant = None
         if self._autotune is not None:
             threshold = self._autotune.threshold_bytes()
             hier = self._autotune.hierarchical()
+            quant = self._autotune.quantized()
             if self._autotune.converged and len(self._step_cache) > 1:
                 # Exploration over: drop the losing compiled variants
                 # (each is a full XLA executable holding device code).
                 frozen_key = (
                     jax.tree.structure(opt_state),
                     jax.tree.structure(model_state),
-                    threshold, hier,
+                    threshold, hier, quant,
                 )
                 self._step_cache = {
                     k: v for k, v in self._step_cache.items()
@@ -554,9 +584,10 @@ class TrainStep:
         key = (
             jax.tree.structure(opt_state),
             jax.tree.structure(model_state),
-            threshold, hier,
+            threshold, hier, quant,
         )
         fn = self._step_cache.get(key)
+        built_here = fn is None
         if fn is None:
             fn = self._build_step(specs)
             self._step_cache[key] = fn
@@ -567,15 +598,35 @@ class TrainStep:
             tl.begin("TrainStep", "STEP")
         try:
             # Tracing for a new cache entry happens inside this call, so
-            # the candidate threshold (and lowering choice) must be
-            # visible to bucket_plan / traced.allreduce now.
+            # the candidate threshold (and lowering/wire choices) must
+            # be visible to bucket_plan / traced.allreduce /
+            # _reduce_pytree now.
             fusion.set_threshold_override(threshold)
             traced.set_hierarchical_override(hier)
+            set_quantized_override(quant)
             with jax.profiler.TraceAnnotation("hvd_train_step"):
                 out = fn(params, model_state, opt_state, batch)
+        except ValueError:
+            if quant and built_here and self._autotune is not None \
+                    and not self._autotune.converged:
+                # The quantized probe variant is unsupportable at trace
+                # time (e.g. sparse gradients): reject the knob and
+                # re-run this step on the unquantized config.  Retrying
+                # is safe ONLY for the call that traced the new variant
+                # (trace errors precede any donation); a ValueError
+                # from a cached step's execution re-raises so a real
+                # error is never masked by a knob flip.
+                self._step_cache.pop(key, None)
+                self._autotune.reject_quantized()
+                fusion.set_threshold_override(None)
+                traced.set_hierarchical_override(None)
+                set_quantized_override(None)
+                return self(params, *args)
+            raise
         finally:
             fusion.set_threshold_override(None)
             traced.set_hierarchical_override(None)
+            set_quantized_override(None)
             if tl is not None:
                 tl.end("TrainStep", "STEP")
                 if self._mark_cycles:

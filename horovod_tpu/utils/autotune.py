@@ -136,7 +136,8 @@ class AutotuneDriver:
     warmup samples the same way).
     """
 
-    def __init__(self, window_steps: Optional[int] = None, **tuner_kwargs):
+    def __init__(self, window_steps: Optional[int] = None,
+                 quant_eligible: bool = False, **tuner_kwargs):
         import time as _time
 
         self._time = _time
@@ -159,6 +160,29 @@ class AutotuneDriver:
         self._hier_scores: list = []
         self._hier_windows = max(1, env.get_int("AUTOTUNE_HIER_WINDOWS", 2))
         self._flat_scores: list = []
+        # Third knob: int8 quantized wire on/off, probed at the frozen
+        # (threshold, hierarchical) winner.  UNLIKE the first two this
+        # changes numerics (lossy wire), so exploration requires the
+        # explicit opt-in HVD_TPU_AUTOTUNE_EXPLORE_QUANTIZED=1 *and* a
+        # build-side eligibility flag (op/compression/set support —
+        # TrainStep passes it; a probe variant whose trace still raises
+        # is rejected via reject_quantized()).
+        self._quant_state = "pending"  # pending -> probing -> frozen
+        self._quant_value: Optional[bool] = None
+        self._quant_eligible = bool(quant_eligible) and env.get_bool(
+            "AUTOTUNE_EXPLORE_QUANTIZED", False
+        )
+        self._quant_base: list = []
+        self._quant_scores: list = []
+        # Joint refinement (the reference explores knobs JOINTLY via one
+        # Bayesian surface; sequential freezing can miss interaction
+        # effects): after the quantized knob lands and CHANGED the
+        # config, the hierarchical knob is re-probed once at the final
+        # quantized setting and flipped if the flip scores better.
+        self._refine_state = "pending"  # pending->baseline->probing->done
+        self._hier_flip: Optional[bool] = None
+        self._refine_base: list = []
+        self._refine_scores: list = []
 
     def threshold_bytes(self) -> int:
         return self.tuner.threshold_bytes()
@@ -169,8 +193,34 @@ class AutotuneDriver:
         if self._hier_state == "probing":
             return True
         if self._hier_state == "frozen":
+            if self._refine_state == "probing":
+                return self._hier_flip
             return self._hier_value
         return None
+
+    def quantized(self) -> Optional[bool]:
+        """Current quantized-wire suggestion for the step build (None
+        until its turn in the schedule; None when frozen-off so the
+        baseline compiled variant is reused, mirroring the hierarchical
+        freeze contract)."""
+        if self._quant_state == "probing":
+            return True
+        if self._quant_state == "frozen":
+            return self._quant_value
+        return None
+
+    def reject_quantized(self) -> None:
+        """Called by the step builder when tracing the quantized probe
+        variant raises (sparse grads, unsupported op discovered at
+        trace time): freeze the knob off and skip refinement."""
+        self._quant_state = "frozen"
+        self._quant_value = None
+        self._quant_eligible = False
+        if self._refine_state != "done":
+            self._refine_state = "done"
+        get_logger().info(
+            "autotune: quantized wire rejected by the step build"
+        )
 
     def _hier_explorable(self) -> bool:
         # empty string == unset (get_bool's semantics everywhere else)
@@ -184,9 +234,31 @@ class AutotuneDriver:
         except Exception:
             return False
 
+    def _collapse_static(self) -> None:
+        """Freeze knobs whose exploration is statically pointless the
+        moment their turn arrives — no window may be burned discovering
+        a knob that cannot move (quant without the opt-in/eligibility,
+        refinement without a kept quant)."""
+        if (self._hier_state == "frozen"
+                and self._quant_state == "pending"
+                and not self._quant_eligible):
+            self._quant_state = "frozen"
+            self._quant_value = None
+        if (self._quant_state == "frozen"
+                and self._refine_state == "pending"
+                and (self._quant_value is not True
+                     or not self._hier_explorable())):
+            self._refine_state = "done"
+
     def _advance_hier(self, score: float) -> None:
         """Feed a closed window's score to the hierarchical knob state
         machine (runs only after the threshold tuner froze)."""
+        try:
+            self._advance_hier_inner(score)
+        finally:
+            self._collapse_static()
+
+    def _advance_hier_inner(self, score: float) -> None:
         if self._hier_state == "pending":
             if not self._hier_explorable():
                 self._hier_state = "frozen"
@@ -217,9 +289,80 @@ class AutotuneDriver:
                     self._hier_windows,
                 )
 
+    def _advance_quant(self, score: float) -> None:
+        """Quantized-wire knob state machine (runs after the
+        hierarchical knob froze)."""
+        try:
+            self._advance_quant_inner(score)
+        finally:
+            self._collapse_static()
+
+    def _advance_quant_inner(self, score: float) -> None:
+        if self._quant_state == "pending":
+            if not self._quant_eligible:
+                self._quant_state = "frozen"
+                self._quant_value = None
+                return
+            self._quant_base.append(score)
+            if len(self._quant_base) >= self._hier_windows:
+                self._quant_state = "probing"
+            return
+        if self._quant_state == "probing":
+            self._quant_scores.append(score)
+            if len(self._quant_scores) >= self._hier_windows:
+                base = sum(self._quant_base) / len(self._quant_base)
+                quant = sum(self._quant_scores) / len(self._quant_scores)
+                kept = quant > base
+                self._quant_value = True if kept else None
+                self._quant_state = "frozen"
+                get_logger().info(
+                    "autotune: quantized wire %s (fp %.3g vs int8 %.3g "
+                    "steps/s, %d windows each)",
+                    "kept" if kept else "rejected", base, quant,
+                    self._hier_windows,
+                )
+
+    def _advance_refine(self, score: float) -> None:
+        """One joint-refinement round-trip: re-probe the hierarchical
+        knob at the FINAL quantized setting (sequential freezing probed
+        it before the quantized knob existed, which misses interaction
+        effects — the reference's joint Bayesian surface would not)."""
+        if self._refine_state == "pending":
+            # only worth a probe when the quantized knob changed the
+            # config and the hierarchical knob is actually explorable
+            if self._quant_value is not True or not self._hier_explorable():
+                self._refine_state = "done"
+                return
+            self._hier_flip = None if self._hier_value else True
+            self._refine_state = "baseline"
+            # fall through: this window already ran the current config
+        if self._refine_state == "baseline":
+            self._refine_base.append(score)
+            if len(self._refine_base) >= self._hier_windows:
+                self._refine_state = "probing"
+            return
+        if self._refine_state == "probing":
+            self._refine_scores.append(score)
+            if len(self._refine_scores) >= self._hier_windows:
+                base = sum(self._refine_base) / len(self._refine_base)
+                flip = sum(self._refine_scores) / len(self._refine_scores)
+                if flip > base:
+                    get_logger().info(
+                        "autotune: joint refinement flipped hierarchical "
+                        "to %s at the quantized winner (%.3g vs %.3g "
+                        "steps/s)", self._hier_flip, flip, base,
+                    )
+                    self._hier_value = self._hier_flip
+                self._refine_state = "done"
+
     @property
     def converged(self) -> bool:
-        return self.tuner.converged and self._hier_state == "frozen"
+        return (
+            self.tuner.converged
+            and self._hier_state == "frozen"
+            and self._quant_state == "frozen"
+            and self._refine_state == "done"
+        )
 
     @staticmethod
     def _sync(out) -> None:
@@ -258,23 +401,37 @@ class AutotuneDriver:
             dt = self._time.perf_counter() - self._t0
             timed_steps = self._steps_in_window - 1
             score = timed_steps / max(dt, 1e-9)
-            threshold = self.tuner.threshold_bytes()
-            hier = self.hierarchical()
-            if not self.tuner.converged:
-                self.tuner.observe(score)
-                if self.tuner.converged and not self._hier_explorable():
-                    # static check: don't burn a window discovering it
-                    self._hier_state = "frozen"
-                    self._hier_value = None
-            else:
-                self._advance_hier(score)
-            self._record_window(threshold, score, hier)
+            self._observe_window(score)
             self._steps_in_window = 0
             self._t0 = None
 
+    def _observe_window(self, score: float) -> None:
+        """Feed one closed window's score to the knob schedule:
+        threshold -> hierarchical -> quantized -> joint refinement.
+        Factored out of :meth:`after_step` so the schedule is testable
+        on synthetic score surfaces."""
+        threshold = self.tuner.threshold_bytes()
+        hier = self.hierarchical()
+        quant = self.quantized()
+        if not self.tuner.converged:
+            self.tuner.observe(score)
+            if self.tuner.converged and not self._hier_explorable():
+                # static check: don't burn a window discovering it
+                self._hier_state = "frozen"
+                self._hier_value = None
+            self._collapse_static()
+        elif self._hier_state != "frozen":
+            self._advance_hier(score)
+        elif self._quant_state != "frozen":
+            self._advance_quant(score)
+        elif self._refine_state != "done":
+            self._advance_refine(score)
+        self._record_window(threshold, score, hier, quant)
+
     @staticmethod
     def _record_window(threshold: int, score: float,
-                       hier: Optional[bool] = None) -> None:
+                       hier: Optional[bool] = None,
+                       quant: Optional[bool] = None) -> None:
         """Window records land on the timeline (reference
         ParameterManager's cycle records): one event per closed window
         with the explored threshold, lowering choice, and steps/s
@@ -289,8 +446,9 @@ class AutotuneDriver:
             tl = None
         if tl is not None:
             lowering = "hier" if hier else "flat"
+            wire = "int8" if quant else "fp"
             tl.record_op(
                 f"autotune threshold={threshold} lowering={lowering} "
-                f"score={score:.2f}steps/s",
+                f"wire={wire} score={score:.2f}steps/s",
                 "AUTOTUNE_WINDOW", threshold,
             )

@@ -66,3 +66,113 @@ def test_autotune_nonpositive_warmup_clamped(monkeypatch):
     t = FusionAutotuner()
     assert t.warmup_windows == 1
     assert t.threshold_bytes() > 0  # no IndexError on the grid path
+
+
+class TestJointKnobSchedule:
+    """Third knob (quantized wire) + joint refinement (VERDICT r5
+    item 8): the schedule threshold -> hier -> quant -> refine must find
+    interaction effects pure sequential freezing misses."""
+
+    @staticmethod
+    def _driver(monkeypatch, surface, quant_eligible=True):
+        from horovod_tpu.utils.autotune import AutotuneDriver
+
+        monkeypatch.setenv("HVD_TPU_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", "1")
+        monkeypatch.setenv("HVD_TPU_AUTOTUNE_HIER_WINDOWS", "1")
+        monkeypatch.setenv("HVD_TPU_AUTOTUNE_EXPLORE_QUANTIZED", "1")
+        d = AutotuneDriver(window_steps=2, quant_eligible=quant_eligible)
+        monkeypatch.setattr(d, "_hier_explorable", lambda: True)
+
+        def run():
+            for _ in range(50):
+                if d.converged:
+                    break
+                cfg = (bool(d.hierarchical()), bool(d.quantized()))
+                d._observe_window(surface[cfg])
+            assert d.converged
+            return (bool(d.hierarchical()), bool(d.quantized()))
+
+        return d, run
+
+    def test_joint_refinement_beats_sequential_freeze(self, monkeypatch):
+        # Interaction surface: hier HURTS alone, quant helps alone, and
+        # hier+quant together is the true optimum.  Sequential freezing
+        # (round-4 behavior: hier probed at quant=off, then frozen
+        # forever) lands on (flat, int8) = 1.2; the refinement
+        # round-trip re-probes hier at the quantized winner and finds
+        # 1.5 — better than the threshold-only (1.0) and
+        # sequential-freeze (1.2) schedules.
+        surface = {
+            (False, False): 1.0,
+            (True, False): 0.9,
+            (False, True): 1.2,
+            (True, True): 1.5,
+        }
+        d, run = self._driver(monkeypatch, surface)
+        final = run()
+        assert final == (True, True), final
+        assert surface[final] > 1.2  # sequential-freeze endpoint
+        assert d.quantized() is True
+        assert d.hierarchical() is True
+
+    def test_refinement_keeps_hier_when_flip_loses(self, monkeypatch):
+        # No interaction: quant helps, hier always hurts -> the refine
+        # probe flips hier, sees a worse score, and keeps it off.
+        surface = {
+            (False, False): 1.0,
+            (True, False): 0.8,
+            (False, True): 1.3,
+            (True, True): 1.1,
+        }
+        d, run = self._driver(monkeypatch, surface)
+        final = run()
+        assert final == (False, True), final
+
+    def test_quant_rejected_when_slower(self, monkeypatch):
+        surface = {
+            (False, False): 1.0,
+            (True, False): 0.8,
+            (False, True): 0.7,
+            (True, True): 0.6,
+        }
+        d, run = self._driver(monkeypatch, surface)
+        final = run()
+        assert final == (False, False), final
+        # frozen-off freezes to None (keeps the baseline variant)
+        assert d.quantized() is None
+
+    def test_quant_skipped_without_opt_in(self, monkeypatch):
+        from horovod_tpu.utils.autotune import AutotuneDriver
+
+        monkeypatch.setenv("HVD_TPU_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", "1")
+        monkeypatch.setenv("HVD_TPU_AUTOTUNE_HIER_WINDOWS", "1")
+        monkeypatch.delenv("HVD_TPU_AUTOTUNE_EXPLORE_QUANTIZED",
+                           raising=False)
+        d = AutotuneDriver(window_steps=2, quant_eligible=True)
+        monkeypatch.setattr(d, "_hier_explorable", lambda: False)
+        for _ in range(10):
+            if d.converged:
+                break
+            d._observe_window(1.0)
+        assert d.converged
+        assert d.quantized() is None  # never probed
+
+    def test_reject_quantized_freezes_off(self, monkeypatch):
+        surface = {
+            (False, False): 1.0,
+            (True, False): 0.8,
+            (False, True): 2.0,
+            (True, True): 2.0,
+        }
+        d, run = self._driver(monkeypatch, surface)
+        # simulate the step builder refusing the probe variant
+        for _ in range(50):
+            if d.converged:
+                break
+            if d.quantized() is True:
+                d.reject_quantized()
+                continue
+            cfg = (bool(d.hierarchical()), bool(d.quantized()))
+            d._observe_window(surface[cfg])
+        assert d.converged
+        assert d.quantized() is None
