@@ -31,6 +31,7 @@ from jax.sharding import PartitionSpec as P
 import horovod_tpu as hvd
 from horovod_tpu.models import gpt_small, gpt_tiny
 from horovod_tpu.models.transformer import (
+    packed_token_cross_entropy,
     param_shard_axes,
     token_cross_entropy,
 )
@@ -53,7 +54,16 @@ def main():
     parser.add_argument("--remat", action="store_true",
                         help="jax.checkpoint each block (long-context "
                         "activation memory)")
+    parser.add_argument("--packed", action="store_true",
+                        help="sequence packing: variable-length documents "
+                        "share fixed rows under segment-id attention "
+                        "masking (requires --attn flash/full, --sp 1)")
     args = parser.parse_args()
+    if args.packed and (args.sp > 1 or args.attn not in ("flash", "full")):
+        raise SystemExit(
+            "--packed requires --sp 1 and --attn flash|full (packed rows "
+            "are whole by construction; see docs/parallelism.md)"
+        )
 
     hvd.init()
     mesh = make_mesh(dp=args.dp, sp=args.sp, tp=args.tp)
@@ -67,6 +77,25 @@ def main():
     rng = np.random.RandomState(0)
     # Synthetic corpus: next-token prediction on structured random data.
     data = rng.randint(0, cfg.vocab_size, (64, t + 1)).astype(np.int32)
+    if args.packed:
+        # Variable-length "documents" packed into fixed rows: every
+        # position does useful work instead of padding.
+        from horovod_tpu.data.packing import (
+            pack_documents,
+            packing_efficiency,
+        )
+
+        docs = [
+            rng.randint(
+                0, cfg.vocab_size,
+                int(np.clip(rng.lognormal(np.log(t / 3.0), 0.7), 8, t)),
+            ).astype(np.int32)
+            for _ in range(256)
+        ]
+        ptoks, psegs = pack_documents(docs, t)
+        if hvd.rank() == 0:
+            print(f"packed {len(docs)} docs into {len(ptoks)} rows, "
+                  f"efficiency {packing_efficiency(psegs):.2f}")
 
     tx = optax.adamw(args.lr, b1=0.9, b2=0.95, weight_decay=0.1)
     shard_axes = None  # filled after init
@@ -90,11 +119,17 @@ def main():
         check_vma=False,
     ))(params)
 
-    def train_step(params, opt_state, toks, targets):
+    def train_step(params, opt_state, toks, aux_in):
+        """One SPMD step; ``aux_in`` is the shifted targets (dense mode)
+        or the segment ids (--packed)."""
         def loss_fn(p):
-            logits, aux = model.apply(p, toks)
-            # gather-form CE: no vocab-sized one-hot temporary
-            ce = token_cross_entropy(logits, targets)
+            if args.packed:
+                logits, aux = model.apply(p, toks, aux_in)
+                ce = packed_token_cross_entropy(logits, toks, aux_in)
+            else:
+                logits, aux = model.apply(p, toks)
+                # gather-form CE: no vocab-sized one-hot temporary
+                ce = token_cross_entropy(logits, aux_in)
             return ce + 0.01 * aux  # aux = MoE load-balance (0 w/o MoE)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
@@ -114,9 +149,14 @@ def main():
     losses = []
     t0 = time.time()
     for i in range(args.steps):
-        rows = rng.randint(0, len(data), b)
-        toks = jnp.asarray(data[rows, :t])
-        targets = jnp.asarray(data[rows, 1:t + 1])
+        if args.packed:
+            rows = rng.randint(0, len(ptoks), b)
+            toks = jnp.asarray(ptoks[rows])
+            targets = jnp.asarray(psegs[rows])  # segment ids
+        else:
+            rows = rng.randint(0, len(data), b)
+            toks = jnp.asarray(data[rows, :t])
+            targets = jnp.asarray(data[rows, 1:t + 1])
         params, opt_state, loss = step_f(params, opt_state, toks, targets)
         losses.append(float(loss))
     jax.block_until_ready(loss)

@@ -145,3 +145,12 @@ def test_gpt_pretrain_example():
         "--steps", "3", "--seq-per-sp", "32",
     )
     assert "mesh dp2/sp2/tp2" in out
+
+
+def test_gpt_pretrain_packed_example():
+    out = _run_example(
+        "gpt_pretrain.py", "--dp", "4", "--tp", "2", "--attn", "flash",
+        "--packed", "--steps", "3", "--seq-per-sp", "64",
+    )
+    assert "efficiency" in out
+    assert "mesh dp4/sp1/tp2" in out
