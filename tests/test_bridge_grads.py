@@ -346,3 +346,52 @@ def test_tf_process_set_allreduce_grad(hvd_module, dynamic_sets):
         np.testing.assert_allclose(g.numpy(), want, rtol=1e-5)
     finally:
         hvd.remove_process_set(ps)
+
+
+@pytest.mark.integration
+def test_torch_grads_multiprocess_local_rows():
+    """The gradient contracts hold in the multi-process LOCAL-ROWS
+    layout too: each process passes its own rows and receives its own
+    rows' gradients (reference per-rank semantics)."""
+    import sys
+
+    import cloudpickle
+
+    import horovod_tpu.runner as runner
+
+    def worker():
+        import numpy as np
+        import torch
+
+        import horovod_tpu as hvd
+        import horovod_tpu.interop.torch as hvd_torch
+
+        hvd.init()
+        r = hvd.process_rank()
+        # local-rows allreduce grad: every rank's grad = sum of dys
+        x = torch.full((1, 3), float(r + 1), requires_grad=True)
+        y = hvd_torch.allreduce(x, op=hvd.Sum)
+        y.backward(torch.full((1, 3), float(r + 1)))
+        g_ar = x.grad.numpy().ravel().tolist()
+
+        # local-rows allgather grad: rank r keeps its own slice of the
+        # Average-allreduced dy
+        x2 = torch.ones((1, 2), requires_grad=True)
+        y2 = hvd_torch.allgather(x2)
+        # dy identical on both ranks: block m = ones * (m+1)
+        dy = torch.tensor(
+            np.concatenate([np.full((1, 2), float(m + 1), np.float32)
+                            for m in range(hvd.size())])
+        ).reshape(y2.shape)
+        y2.backward(dy)
+        g_ag = x2.grad.numpy().ravel().tolist()
+        return [g_ar, g_ag]
+
+    cloudpickle.register_pickle_by_value(sys.modules[__name__])
+    results = runner.run(worker, np=2, use_cpu_devices=True)
+    # allreduce grad = sum over ranks of dy = 1 + 2 = 3 on both ranks
+    np.testing.assert_allclose(results[0][0], [3.0] * 3)
+    np.testing.assert_allclose(results[1][0], [3.0] * 3)
+    # allgather grad: rank r's slice of the averaged dy = ones * (r+1)
+    np.testing.assert_allclose(results[0][1], [1.0, 1.0])
+    np.testing.assert_allclose(results[1][1], [2.0, 2.0])
