@@ -229,7 +229,9 @@ def check_build(out=None) -> None:
     for ok, name in [(True, "process sets"), (True, "elastic"),
                      (True, "timeline"), (True, "autotune"),
                      (True, "Adasum"), (True, "ZeRO/FSDP"),
-                     (True, "TP/PP/SP/MoE")]:
+                     (True, "TP/PP/SP/MoE"),
+                     (True, "sequence packing"),
+                     (True, "differentiable bridge collectives")]:
         lines.append(f"    {flag(ok)} {name}")
     lines += ["", "Available Bindings:"]
     import importlib.util as _ilu
@@ -255,6 +257,12 @@ def check_build(out=None) -> None:
     lines.append(f"    {flag(_lsf.is_jsrun_installed())} jsrun "
                  "(--use-jsrun)")
     lines.append(f"    {flag(True)} elastic (--min-np/--max-np)")
+    try:
+        has_pyspark = _ilu.find_spec("pyspark") is not None
+    except (ImportError, ValueError):
+        has_pyspark = False
+    lines.append(f"    {flag(has_pyspark)} elastic on Spark "
+                 "(spark.run_elastic)")
     print("\n".join(lines), file=out)
 
 
