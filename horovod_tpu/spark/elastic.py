@@ -302,7 +302,9 @@ def _launch_spark_agents(num_proc: int, addr: str, port: int,
     (NON-barrier: tasks are independent hosts, and Spark's per-task
     retry is exactly the respawn mechanism elastic wants).  Returns a
     cleanup callable."""
-    import pyspark
+    from .runner import _pyspark
+
+    pyspark = _pyspark()
 
     spark = pyspark.sql.SparkSession.builder.getOrCreate()
     sc = spark.sparkContext
@@ -420,6 +422,12 @@ def run_elastic(
     if num_proc is None:
         num_proc = min_np or 1
     min_np = min_np or num_proc
+    if _backend is None:
+        # Gate BEFORE binding any server socket: a missing pyspark must
+        # raise cleanly, not leak the registration server.
+        from .runner import _pyspark
+
+        _pyspark()
     secret = pysecrets.token_hex(16)
     # Agent-registration KV server (separate from the per-job rendezvous
     # server run_rounds owns).

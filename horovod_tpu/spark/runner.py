@@ -25,7 +25,7 @@ def _pyspark():
         return pyspark
     except ImportError as e:
         raise ImportError(
-            "horovod_tpu.spark.run requires `pyspark`, which is not "
+            "horovod_tpu.spark requires `pyspark`, which is not "
             "installed in this environment."
         ) from e
 

@@ -259,3 +259,10 @@ def test_spark_elastic_worker_loss_epoch():
         assert r["round"] >= 2
         assert r["world"] == 2
         assert r["sum0"] == 3.0
+
+
+def test_spark_run_elastic_requires_pyspark():
+    from horovod_tpu.spark import run_elastic
+
+    with pytest.raises(ImportError, match="pyspark"):
+        run_elastic(lambda: None, num_proc=1)
