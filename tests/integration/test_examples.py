@@ -147,6 +147,17 @@ def test_gpt_pretrain_example():
     assert "mesh dp2/sp2/tp2" in out
 
 
+def test_spark_elastic_example():
+    out = _run_example(
+        "spark_elastic.py", "--local", "--simulate-loss", "--epochs", "5",
+    )
+    import re
+
+    # round >= 2 (recovery happened); the exact count is timing-dependent
+    assert re.search(r"job finished on round [2-9] with 2 worker\(s\)", out)
+    assert "rank 1:" in out
+
+
 def test_gpt_pretrain_packed_example():
     out = _run_example(
         "gpt_pretrain.py", "--dp", "4", "--tp", "2", "--attn", "flash",
