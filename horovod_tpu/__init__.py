@@ -20,10 +20,14 @@ from .version import __version__  # noqa: F401
 
 from . import runtime as _runtime
 from .exceptions import (  # noqa: F401
+    CheckpointCorruptionError,
+    FaultInjected,
     HorovodInternalError,
     HorovodTpuError,
     HostsUpdatedInterrupt,
     NotInitializedError,
+    QuantizedWireError,
+    RetryTimeoutError,
 )
 from .process_sets import ProcessSet  # noqa: F401
 from .runtime import WORLD_AXIS  # noqa: F401
@@ -216,7 +220,15 @@ from . import compression  # noqa: F401,E402
 from .compression import Compression  # noqa: F401,E402
 from . import elastic  # noqa: F401,E402
 from .sync_batch_norm import SyncBatchNorm  # noqa: F401,E402
-from .metrics import metric_average  # noqa: F401,E402
+from .metrics import (  # noqa: F401,E402
+    get_counter,
+    get_counters,
+    inc_counter,
+    metric_average,
+    reset_counters,
+)
+from . import faults  # noqa: F401,E402
+from .utils.retry import RetryPolicy  # noqa: F401,E402
 from .utils.timeline import (  # noqa: F401,E402
     profile_bucket_step,
     start_timeline,
@@ -226,7 +238,9 @@ from . import callbacks  # noqa: F401,E402
 from . import data  # noqa: F401,E402
 from . import checkpoint  # noqa: F401,E402
 from .checkpoint import (  # noqa: F401,E402
+    latest_good_step,
     load_checkpoint,
     restore_or_init,
     save_checkpoint,
+    verify_checkpoint,
 )

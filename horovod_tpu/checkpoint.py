@@ -12,31 +12,61 @@ otherwise.
 Rank-0-writes / all-read, with a ``broadcast`` on restore so every rank
 starts from identical bytes (the reference's
 ``BroadcastGlobalVariablesCallback``-after-load pattern).
+
+Integrity guarantees (fault-tolerance hardening):
+
+* **Atomic write** — pickle checkpoints are serialized to a temp file
+  in the target directory, fsynced, then ``os.replace``d into place: a
+  crash mid-save leaves either the old checkpoint or the new one, never
+  a torn file under the final name.
+* **Content checksum** — a ``checkpoint.meta.json`` sidecar records the
+  payload's SHA-256; :func:`load_checkpoint` verifies it and raises
+  :class:`~horovod_tpu.exceptions.CheckpointCorruptionError` on
+  mismatch (and on undecodable payloads) instead of restoring garbage.
+* **Automatic fallback** — :func:`restore_or_init` walks ``step_N``
+  directories newest-first and resumes from the newest checkpoint that
+  passes verification, counting skips in ``metrics``
+  (``checkpoint.corrupt_detected`` / ``checkpoint.fallback``).
+
+The ``checkpoint.write`` fault-injection site (``faults.py``,
+kind ``corrupt``) flips bytes after the checksum is recorded — the
+deterministic stand-in for bit rot / torn remote writes used by the
+integrity tests.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import os
 import pickle
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional
 
 import jax
 import numpy as np
 
-from . import functions, runtime
+from . import faults, functions, runtime
+from .exceptions import CheckpointCorruptionError
 from .utils.logging import get_logger
 
 log = get_logger()
 
 _CKPT_FILE = "checkpoint.pkl"
+_META_FILE = "checkpoint.meta.json"
 
 
 class _LoadError:
     """Picklable error sentinel broadcast to all ranks so load failures
     raise everywhere instead of deadlocking non-root ranks."""
 
-    def __init__(self, message: str):
+    def __init__(self, message: str, corrupt: bool = False):
         self.message = message
+        self.corrupt = corrupt
+
+    def raise_(self) -> None:
+        if self.corrupt:
+            raise CheckpointCorruptionError(self.message)
+        raise RuntimeError(self.message)
 
 
 def _has_orbax() -> bool:
@@ -46,6 +76,45 @@ def _has_orbax() -> bool:
         return True
     except ImportError:
         return False
+
+
+def _atomic_write(path: str, data: bytes) -> None:
+    """tmp + fsync + rename in the destination directory (same
+    filesystem, so the rename is atomic)."""
+    tmp = f"{path}.tmp.{os.getpid()}"
+    with open(tmp, "wb") as fh:
+        fh.write(data)
+        fh.flush()
+        os.fsync(fh.fileno())
+    os.replace(tmp, path)
+
+
+def _dir_digest(root: str) -> str:
+    """Deterministic SHA-256 over a directory tree (sorted relative
+    paths + contents) — the integrity fingerprint for orbax
+    checkpoints, whose payload is a directory, not one file."""
+    h = hashlib.sha256()
+    for dirpath, dirnames, filenames in sorted(os.walk(root)):
+        dirnames.sort()
+        for name in sorted(filenames):
+            full = os.path.join(dirpath, name)
+            h.update(os.path.relpath(full, root).encode())
+            with open(full, "rb") as fh:
+                while True:
+                    chunk = fh.read(1 << 20)
+                    if not chunk:
+                        break
+                    h.update(chunk)
+    return h.hexdigest()
+
+
+def _corrupt_file(path: str) -> None:
+    """Scripted bit rot: damage a payload AFTER its checksum was
+    recorded, so verification must catch it."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as fh:
+        fh.seek(max(0, size // 2))
+        fh.write(b"\xde\xad\xbe\xef")
 
 
 def save_checkpoint(
@@ -58,6 +127,8 @@ def save_checkpoint(
     ``path``; only rank 0 writes (reference: checkpoints saved on rank 0,
     e.g. ``examples/pytorch/pytorch_imagenet_resnet50.py``'s
     ``save_checkpoint``).  Returns the checkpoint directory."""
+    from . import metrics
+
     target = path if step is None else os.path.join(path, f"step_{step}")
     rt = runtime.get_runtime_or_none()
     if rt is not None and rt.process_rank != 0:
@@ -69,16 +140,93 @@ def save_checkpoint(
     if use_orbax:
         import orbax.checkpoint as ocp
 
+        orbax_dir = os.path.join(target, "orbax")
         ckptr = ocp.PyTreeCheckpointer()
-        ckptr.save(
-            os.path.join(target, "orbax"), host_state,
-            force=True,
+        ckptr.save(orbax_dir, host_state, force=True)
+        meta = {"format": "orbax", "sha256": _dir_digest(orbax_dir)}
+        _atomic_write(
+            os.path.join(target, _META_FILE), json.dumps(meta).encode()
         )
+        if faults.inject("checkpoint.write", path=target, step=step):
+            files = sorted(
+                (os.path.getsize(os.path.join(dp, f)),
+                 os.path.join(dp, f))
+                for dp, _, fs in os.walk(orbax_dir) for f in fs
+            )
+            if files:
+                _corrupt_file(files[-1][1])
     else:
-        with open(os.path.join(target, _CKPT_FILE), "wb") as fh:
-            pickle.dump(host_state, fh)
+        payload = pickle.dumps(host_state)
+        pkl = os.path.join(target, _CKPT_FILE)
+        _atomic_write(pkl, payload)
+        meta = {
+            "format": "pickle",
+            "sha256": hashlib.sha256(payload).hexdigest(),
+            "size": len(payload),
+        }
+        _atomic_write(
+            os.path.join(target, _META_FILE),
+            json.dumps(meta).encode(),
+        )
+        if faults.inject("checkpoint.write", path=target, step=step):
+            _corrupt_file(pkl)
+    metrics.inc_counter("checkpoint.saved")
     log.info("checkpoint saved to %s", target)
     return target
+
+
+def verify_checkpoint(target: str) -> bool:
+    """True when ``target`` holds a checkpoint whose SHA-256 matches its
+    ``checkpoint.meta.json`` sidecar (payload file for pickle, whole
+    directory tree for orbax).  A pre-hardening checkpoint without a
+    sidecar passes (nothing to check against); a missing checkpoint or
+    checksum mismatch fails."""
+    orbax_dir = os.path.join(target, "orbax")
+    pkl = os.path.join(target, _CKPT_FILE)
+    has_orbax_dir = os.path.isdir(orbax_dir)
+    if not has_orbax_dir and not os.path.exists(pkl):
+        return False
+    meta_path = os.path.join(target, _META_FILE)
+    if not os.path.exists(meta_path):
+        return True  # legacy checkpoint: no sidecar to verify against
+    try:
+        with open(meta_path) as fh:
+            meta = json.load(fh)
+        if meta.get("format") == "orbax" or (
+            has_orbax_dir and "size" not in meta
+        ):
+            return _dir_digest(orbax_dir) == meta["sha256"]
+        with open(pkl, "rb") as fh:
+            payload = fh.read()
+        return (
+            len(payload) == int(meta["size"])
+            and hashlib.sha256(payload).hexdigest() == meta["sha256"]
+        )
+    except Exception as e:
+        log.warning("checkpoint meta unreadable at %s: %s", target, e)
+        return False
+
+
+def _read_pickle_verified(target: str):
+    """Read + integrity-check the pickle payload; returns the state or a
+    corruption ``_LoadError`` (broadcastable to non-root ranks)."""
+    from . import metrics
+
+    pkl = os.path.join(target, _CKPT_FILE)
+    if not verify_checkpoint(target):
+        metrics.inc_counter("checkpoint.corrupt_detected")
+        return _LoadError(
+            f"checkpoint at {target} failed checksum verification "
+            "(truncated or corrupted payload)", corrupt=True,
+        )
+    try:
+        with open(pkl, "rb") as fh:
+            return pickle.load(fh)
+    except Exception as e:
+        metrics.inc_counter("checkpoint.corrupt_detected")
+        return _LoadError(
+            f"checkpoint at {target} is undecodable: {e}", corrupt=True,
+        )
 
 
 def load_checkpoint(
@@ -89,7 +237,9 @@ def load_checkpoint(
     """Load a checkpoint; returns None if absent.  With ``broadcast``
     (default), only rank 0 touches the filesystem and its bytes are
     broadcast, so all ranks restore identically even when local files
-    are divergent, partially written, or missing on non-root ranks."""
+    are divergent, partially written, or missing on non-root ranks.
+    Raises :class:`CheckpointCorruptionError` (on every rank) when the
+    checkpoint exists but fails integrity verification."""
     target = path if step is None else os.path.join(path, f"step_{step}")
     rt = runtime.get_runtime_or_none()
     multi = rt is not None and rt.process_count > 1
@@ -108,24 +258,31 @@ def load_checkpoint(
                     "which is not importable here — install "
                     "orbax-checkpoint to restore it"
                 )
+            elif not verify_checkpoint(target):
+                from . import metrics
+
+                metrics.inc_counter("checkpoint.corrupt_detected")
+                state = _LoadError(
+                    f"checkpoint at {target} failed checksum "
+                    "verification (truncated or corrupted payload)",
+                    corrupt=True,
+                )
             else:
                 import orbax.checkpoint as ocp
 
                 state = ocp.PyTreeCheckpointer().restore(orbax_dir)
         elif os.path.exists(pkl):
-            with open(pkl, "rb") as fh:
-                state = pickle.load(fh)
+            state = _read_pickle_verified(target)
     if broadcast and multi:
         state = functions.broadcast_object(state, root_rank=0)
     if isinstance(state, _LoadError):
-        raise RuntimeError(state.message)
+        state.raise_()
     return state
 
 
-def latest_step(path: str) -> Optional[int]:
-    """Highest ``step_N`` subdirectory under ``path`` (resume point)."""
+def _all_steps(path: str) -> List[int]:
     if not os.path.isdir(path):
-        return None
+        return []
     steps = []
     for name in os.listdir(path):
         if name.startswith("step_"):
@@ -133,17 +290,50 @@ def latest_step(path: str) -> Optional[int]:
                 steps.append(int(name[5:]))
             except ValueError:
                 pass
-    return max(steps) if steps else None
+    return sorted(steps)
+
+
+def latest_step(path: str) -> Optional[int]:
+    """Highest ``step_N`` subdirectory under ``path`` (resume point)."""
+    steps = _all_steps(path)
+    return steps[-1] if steps else None
+
+
+def latest_good_step(path: str) -> Optional[int]:
+    """Highest ``step_N`` that passes :func:`verify_checkpoint` —
+    corrupted newer steps are skipped (and counted) so resume falls
+    back to the last good snapshot instead of dying on bit rot."""
+    from . import metrics
+
+    steps = _all_steps(path)
+    for i, step in enumerate(reversed(steps)):
+        target = os.path.join(path, f"step_{step}")
+        if verify_checkpoint(target):
+            if i > 0:
+                metrics.inc_counter("checkpoint.fallback")
+                log.warning(
+                    "falling back to checkpoint step %d (%d newer "
+                    "step(s) failed verification)", step, i,
+                )
+            return step
+        metrics.inc_counter("checkpoint.corrupt_detected")
+        log.warning(
+            "checkpoint step %d at %s failed verification; trying "
+            "an earlier step", step, target,
+        )
+    return None
 
 
 def restore_or_init(
     path: str,
     init_state: Dict[str, Any],
 ) -> tuple:
-    """Resume from the newest checkpoint under ``path`` or fall back to
-    ``init_state`` broadcast from rank 0.  Returns (state, step) with
-    step == 0 for a fresh start (the reference's resume_from_epoch
-    pattern, ``pytorch_imagenet_resnet50.py``).
+    """Resume from the newest *verified* checkpoint under ``path`` or
+    fall back to ``init_state`` broadcast from rank 0.  Returns
+    (state, step) with step == 0 for a fresh start (the reference's
+    resume_from_epoch pattern, ``pytorch_imagenet_resnet50.py``).
+    Corrupted newer checkpoints are skipped in favor of the last good
+    one (``latest_good_step``).
 
     The resume-vs-init decision is rank 0's, broadcast to all — ranks
     must take the same branch or their collective sequences diverge
@@ -151,7 +341,7 @@ def restore_or_init(
     legitimately not have them).
     """
     rt = runtime.get_runtime_or_none()
-    step = latest_step(path)
+    step = latest_good_step(path)
     if rt is not None and rt.process_count > 1:
         step = functions.broadcast_object(step, root_rank=0)
     if step is not None:

@@ -29,7 +29,10 @@ def pack_documents(
     Documents longer than ``seq_len`` are split into ``seq_len`` chunks
     (standard LM practice — each chunk becomes its own segment).
     Segment ids are unique per (row, document) starting at 1; padding
-    positions carry segment id 0 and ``pad_id`` tokens.
+    positions carry segment id 0 and ``pad_id`` tokens.  No documents
+    (or only zero-length ones) yield empty ``(0, seq_len)`` arrays —
+    never a phantom all-padding row, which would dilute loss masks and
+    batch statistics downstream.
     """
     if seq_len <= 0:
         raise ValueError(f"seq_len must be positive, got {seq_len}")
@@ -54,7 +57,7 @@ def pack_documents(
         else:
             rows.append([piece])
             space.append(seq_len - len(piece))
-    n = max(1, len(rows))
+    n = len(rows)
     tokens = np.full((n, seq_len), pad_id, np.int32)
     segs = np.zeros((n, seq_len), np.int32)
     for r, row in enumerate(rows):

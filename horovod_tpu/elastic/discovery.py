@@ -3,16 +3,41 @@
 Reference: ``horovod/runner/elastic/discovery.py`` — ``HostManager``
 runs a user-supplied discovery script emitting ``host[:slots]`` lines,
 tracks current hosts, and blacklists hosts that failed.
+
+Hardened beyond the reference: discovery-script flakes are absorbed by
+a :class:`~horovod_tpu.utils.retry.RetryPolicy` (the reference re-polls
+a period later, stretching membership staleness by a full discovery
+interval per flake), and blacklisting is *cooldown-based* — a failed
+host is quarantined for an exponentially growing, capped interval
+instead of forever.  Permanent blacklisting turns every transient host
+fault (OOM kill, preemption, network partition) into permanently lost
+capacity; a production elastic job must be able to win hosts back.
+Reference behavior is one env knob away
+(``HVD_TPU_BLACKLIST_COOLDOWN=0`` → permanent).
 """
 
 from __future__ import annotations
 
 import subprocess
 import threading
-from typing import Dict, Set
+import time
+from typing import Callable, Dict, Optional
 
+from .. import faults
 from ..runner import hosts as hosts_mod
+from ..utils import env as hvd_env
 from ..utils.logging import get_logger
+from ..utils.retry import RetryPolicy
+
+# Cooldown before a blacklisted host may return, doubling per repeated
+# failure: min(base * 2**(failures-1), cap).  base <= 0 restores the
+# reference's permanent blacklist.
+BLACKLIST_COOLDOWN = "BLACKLIST_COOLDOWN"          # seconds, default 30
+BLACKLIST_COOLDOWN_MAX = "BLACKLIST_COOLDOWN_MAX"  # seconds, default 600
+DISCOVERY_RETRIES = "DISCOVERY_RETRIES"            # attempts, default 3
+
+DEFAULT_COOLDOWN_S = 30.0
+DEFAULT_COOLDOWN_MAX_S = 600.0
 
 
 class HostDiscovery:
@@ -24,13 +49,24 @@ class HostDiscovery:
 
 class HostDiscoveryScript(HostDiscovery):
     """Runs the user script; each stdout line is ``host[:slots]``
-    (reference ``HostDiscoveryScript``)."""
+    (reference ``HostDiscoveryScript``).  Transient script failures are
+    retried per ``retry`` (default: ``HVD_TPU_DISCOVERY_RETRIES``
+    attempts with short exponential backoff) before the error reaches
+    the driver's discovery loop."""
 
-    def __init__(self, discovery_script: str, default_slots: int = 1):
+    def __init__(self, discovery_script: str, default_slots: int = 1,
+                 retry: Optional[RetryPolicy] = None):
         self.script = discovery_script
         self.default_slots = default_slots
+        self.retry = retry or RetryPolicy(
+            max_attempts=max(1, hvd_env.get_int(DISCOVERY_RETRIES, 3)),
+            base_delay_s=0.2,
+            max_delay_s=2.0,
+            name="discovery",
+        )
 
-    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+    def _run_script(self) -> Dict[str, int]:
+        faults.inject("discovery.script", script=self.script)
         out = subprocess.run(
             self.script, shell=True, capture_output=True, text=True, timeout=60
         )
@@ -49,6 +85,9 @@ class HostDiscoveryScript(HostDiscovery):
             )
         return hosts
 
+    def find_available_hosts_and_slots(self) -> Dict[str, int]:
+        return self.retry.call(self._run_script)
+
 
 class FixedHosts(HostDiscovery):
     """Static host set (used when elastic runs with -H but no script)."""
@@ -60,21 +99,69 @@ class FixedHosts(HostDiscovery):
         return dict(self._hosts)
 
 
-class HostManager:
-    """Current + blacklisted hosts (reference ``HostManager``)."""
+class _BlacklistEntry:
+    __slots__ = ("failures", "until")
 
-    def __init__(self, discovery: HostDiscovery):
+    def __init__(self, failures: int, until: float):
+        self.failures = failures
+        self.until = until  # monotonic deadline; inf = permanent
+
+
+class HostManager:
+    """Current + blacklisted hosts (reference ``HostManager``), with
+    cooldown-based un-blacklisting.
+
+    ``cooldown_s``/``cooldown_max_s`` default from
+    ``HVD_TPU_BLACKLIST_COOLDOWN`` / ``..._MAX``; ``cooldown_s <= 0``
+    means permanent (reference semantics).  ``clock`` is injectable for
+    deterministic tests."""
+
+    def __init__(
+        self,
+        discovery: HostDiscovery,
+        cooldown_s: Optional[float] = None,
+        cooldown_max_s: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
         self._discovery = discovery
         self._lock = threading.Lock()
         self._current: Dict[str, int] = {}
-        self._blacklist: Set[str] = set()
+        self._blacklist: Dict[str, _BlacklistEntry] = {}
+        self._clock = clock
+        if cooldown_s is None:
+            cooldown_s = hvd_env.get_float(
+                BLACKLIST_COOLDOWN, DEFAULT_COOLDOWN_S
+            )
+        if cooldown_max_s is None:
+            cooldown_max_s = hvd_env.get_float(
+                BLACKLIST_COOLDOWN_MAX, DEFAULT_COOLDOWN_MAX_S
+            )
+        self.cooldown_s = cooldown_s
+        self.cooldown_max_s = cooldown_max_s
+
+    def _expire_blacklist_locked(self) -> None:
+        """Lift expired cooldowns.  The failure count survives the lift:
+        a host that flaps fails straight into a doubled cooldown."""
+        now = self._clock()
+        for h, entry in self._blacklist.items():
+            if entry.until != float("-inf") and entry.until <= now:
+                entry.until = float("-inf")  # lifted, history kept
+                get_logger().warning(
+                    "blacklist cooldown expired for host %s "
+                    "(%d prior failure(s))", h, entry.failures,
+                )
+                from .. import metrics
+
+                metrics.inc_counter("elastic.unblacklist")
 
     def update_available_hosts(self) -> bool:
         """Polls discovery; returns True when the usable set changed."""
         found = self._discovery.find_available_hosts_and_slots()
         with self._lock:
+            self._expire_blacklist_locked()
             usable = {
-                h: s for h, s in found.items() if h not in self._blacklist
+                h: s for h, s in found.items()
+                if not self._is_blacklisted_locked(h)
             }
             changed = usable != self._current
             self._current = usable
@@ -82,14 +169,39 @@ class HostManager:
 
     def blacklist(self, hostname: str) -> None:
         with self._lock:
-            if hostname not in self._blacklist:
-                get_logger().warning("blacklisting host %s", hostname)
-            self._blacklist.add(hostname)
+            entry = self._blacklist.get(hostname)
+            failures = (entry.failures if entry else 0) + 1
+            if self.cooldown_s <= 0:
+                until = float("inf")
+                desc = "permanently"
+            else:
+                cooldown = min(
+                    self.cooldown_s * (2.0 ** (failures - 1)),
+                    self.cooldown_max_s,
+                )
+                until = self._clock() + cooldown
+                desc = f"for {cooldown:.1f}s (failure #{failures})"
+            get_logger().warning(
+                "blacklisting host %s %s", hostname, desc
+            )
+            self._blacklist[hostname] = _BlacklistEntry(failures, until)
             self._current.pop(hostname, None)
+        from .. import metrics
+
+        metrics.inc_counter("elastic.blacklist")
+
+    def _is_blacklisted_locked(self, hostname: str) -> bool:
+        entry = self._blacklist.get(hostname)
+        return entry is not None and entry.until > self._clock()
 
     def is_blacklisted(self, hostname: str) -> bool:
         with self._lock:
-            return hostname in self._blacklist
+            return self._is_blacklisted_locked(hostname)
+
+    def failure_count(self, hostname: str) -> int:
+        with self._lock:
+            entry = self._blacklist.get(hostname)
+            return entry.failures if entry else 0
 
     @property
     def current_hosts(self) -> Dict[str, int]:

@@ -39,3 +39,33 @@ class HostsUpdatedInterrupt(HorovodTpuError):
     def __init__(self, skip_sync: bool = False):
         super().__init__("hosts updated")
         self.skip_sync = skip_sync
+
+
+class FaultInjected(HorovodTpuError):
+    """Raised by ``horovod_tpu.faults.inject`` when an ``error``/``flake``
+    fault fires at a call site — the scripted stand-in for a transient
+    infrastructure failure (discovery flake, spawn hiccup, KV blip)."""
+
+    def __init__(self, site: str, message: str = ""):
+        super().__init__(message or f"injected fault at {site!r}")
+        self.site = site
+
+
+class RetryTimeoutError(HorovodTpuError):
+    """A single attempt under ``utils.retry.RetryPolicy`` exceeded its
+    per-attempt timeout (the attempt may still be running in its worker
+    thread; the policy moves on and retries)."""
+
+
+class CheckpointCorruptionError(HorovodTpuError):
+    """A checkpoint failed integrity verification (checksum mismatch,
+    truncated file, or undecodable payload).  ``restore_or_init`` catches
+    this and falls back to the previous good step."""
+
+
+class QuantizedWireError(HorovodTpuError, ValueError):
+    """The int8 quantized-wire path cannot serve this reduction
+    (unsupported op, non-global process set, or IndexedSlices
+    gradients).  Subclasses ``ValueError`` for backward compatibility;
+    the autotune quantized-probe retry catches exactly this type so an
+    unrelated user ``ValueError`` never silently rejects the knob."""

@@ -106,6 +106,20 @@ def broadcast_grad(dy: np.ndarray, root_rank: int,
     return out
 
 
+def ensure_alltoall_differentiable(splits, process_set) -> None:
+    """Validate at the FORWARD call that a gradient for this alltoall
+    exists: uneven splits on an explicit process set have no backward
+    implementation, and discovering that deep in a training loop's
+    backward pass (possibly steps later, from an autograd engine frame)
+    is strictly worse than failing at the call site.  Framework bridges
+    call this when gradients are required."""
+    if splits is not None and process_set is not None:
+        raise NotImplementedError(
+            "gradients of uneven-splits alltoall on an explicit process "
+            "set are not supported; use the global set or equal splits"
+        )
+
+
 def alltoall_grad(dy: np.ndarray, splits: Optional[np.ndarray] = None,
                   process_set=None) -> np.ndarray:
     """Reference ``_alltoall_grad``: route the gradient back with the

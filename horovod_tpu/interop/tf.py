@@ -235,6 +235,18 @@ def broadcast(tensor, root_rank: int, name: Optional[str] = None,
     return _op(tensor)
 
 
+def _tape_recording() -> bool:
+    """True when a GradientTape could record the current op (so a
+    missing backward should surface NOW).  Uses TF's eager-record
+    internals; conservatively False if the import shape changes."""
+    try:
+        from tensorflow.python.eager import record
+
+        return bool(record.could_possibly_record())
+    except Exception:
+        return False
+
+
 def alltoall(tensor, splits=None, name: Optional[str] = None,
              process_set=None):
     """Differentiable: the gradient is the reverse alltoall (reference
@@ -249,6 +261,12 @@ def alltoall(tensor, splits=None, name: Optional[str] = None,
             "call it eagerly"
         )
     splits_np = None if splits is None else np.asarray(splits)
+    if splits_np is not None and process_set is not None \
+            and _tape_recording():
+        # Gradients are being recorded and this combination has no
+        # backward: fail at the forward call instead of from deep
+        # inside tape.gradient().
+        _grads.ensure_alltoall_differentiable(splits_np, process_set)
 
     def grad(dy):
         if splits_np is None:

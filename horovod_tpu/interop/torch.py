@@ -283,6 +283,10 @@ def alltoall(tensor, splits=None, name: Optional[str] = None,
     """Differentiable: the gradient is the reverse alltoall (reference
     ``torch/mpi_ops.py:796-824``)."""
     if _wants_grad(tensor):
+        from . import _grads
+
+        # fail at the forward call, not steps later inside backward
+        _grads.ensure_alltoall_differentiable(splits, process_set)
         return _autograd_fns()["alltoall"].apply(
             tensor, splits, name, process_set
         )

@@ -1,18 +1,64 @@
-"""Metric averaging across ranks.
+"""Metric averaging across ranks + local fault/retry counters.
 
 Reference: ``MetricAverageCallback`` (``horovod/_keras/callbacks.py:49``)
 allreduce-averages epoch metrics so every rank logs the same numbers.
+
+The counter registry is the observability surface for the
+fault-tolerance path (``faults.py`` / ``utils/retry.py`` /
+``elastic/``): retries, blacklist/unblacklist events, worker
+crash-vs-hang verdicts, checkpoint corruption fallbacks.  Counters are
+process-local (the elastic driver and each worker keep their own) and
+deliberately dependency-free so the runner can bump them before any
+mesh exists.
 """
 
 from __future__ import annotations
 
-from typing import Any, Optional
+import threading
+from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
 
 from . import runtime
 from .process_sets import ProcessSet
+
+_counter_lock = threading.Lock()
+_counters: Dict[str, int] = {}
+
+
+def inc_counter(name: str, value: int = 1) -> int:
+    """Bump a process-local named counter; returns the new value.
+    Dotted names namespace by subsystem (``retry.discovery.attempts``,
+    ``elastic.blacklist``, ``checkpoint.fallback``, ...)."""
+    with _counter_lock:
+        _counters[name] = _counters.get(name, 0) + value
+        return _counters[name]
+
+
+def get_counter(name: str) -> int:
+    with _counter_lock:
+        return _counters.get(name, 0)
+
+
+def get_counters(prefix: str = "") -> Dict[str, int]:
+    """Snapshot of all counters (optionally filtered by name prefix)."""
+    with _counter_lock:
+        return {
+            k: v for k, v in sorted(_counters.items())
+            if k.startswith(prefix)
+        }
+
+
+def reset_counters(prefix: str = "") -> None:
+    """Clear counters (optionally only those under ``prefix``) — test
+    isolation hook."""
+    with _counter_lock:
+        if not prefix:
+            _counters.clear()
+        else:
+            for k in [k for k in _counters if k.startswith(prefix)]:
+                del _counters[k]
 
 
 def metric_average(value: Any, process_set: Optional[ProcessSet] = None) -> Any:

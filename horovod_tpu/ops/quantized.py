@@ -32,6 +32,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from ..exceptions import QuantizedWireError
 from ..process_sets import ProcessSet
 from ..runtime import WORLD_AXIS
 from .traced import Average, Sum
@@ -73,9 +74,9 @@ def quantized_allreduce(
     the all_to_all phase needs the set to tile the axis; arbitrary
     subsets fall back to the caller's dense path)."""
     if op not in (Sum, Average):
-        raise ValueError("quantized_allreduce supports Sum/Average")
+        raise QuantizedWireError("quantized_allreduce supports Sum/Average")
     if process_set is not None and process_set.process_set_id != 0:
-        raise ValueError(
+        raise QuantizedWireError(
             "quantized_allreduce runs on the global set; use the dense "
             "path for subsets"
         )

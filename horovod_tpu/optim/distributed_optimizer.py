@@ -38,6 +38,7 @@ from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ..compression import Compression, Compressor
+from ..exceptions import QuantizedWireError
 from ..ops import fusion, traced
 from ..ops.traced import Adasum, Average, Sum
 from ..process_sets import ProcessSet
@@ -101,7 +102,7 @@ def _reduce_gradients(
         op not in (Average, Sum)
         or (process_set is not None and process_set.process_set_id != 0)
     ):
-        raise ValueError(
+        raise QuantizedWireError(
             "Compression.int8 requires op=Average/Sum on the global "
             "process set (ops/quantized.py)"
         )
@@ -118,7 +119,7 @@ def _reduce_gradients(
     sparse_idx = [i for i, g in enumerate(leaves) if is_sparse(g)]
     if sparse_idx:
         if quantized:
-            raise ValueError(
+            raise QuantizedWireError(
                 "Compression.int8 does not support IndexedSlices "
                 "gradients (the quantizer lives inside the dense "
                 "two-phase reduction); use sparse_as_dense=True or a "
@@ -606,16 +607,19 @@ class TrainStep:
             set_quantized_override(quant)
             with jax.profiler.TraceAnnotation("hvd_train_step"):
                 out = fn(params, model_state, opt_state, batch)
-        except ValueError:
+        except QuantizedWireError:
             if quant and built_here and self._autotune is not None \
                     and not self._autotune.converged:
                 # The quantized probe variant is unsupportable at trace
                 # time (e.g. sparse gradients): reject the knob and
                 # re-run this step on the unquantized config.  Retrying
                 # is safe ONLY for the call that traced the new variant
-                # (trace errors precede any donation); a ValueError
-                # from a cached step's execution re-raises so a real
-                # error is never masked by a knob flip.
+                # (trace errors precede any donation), and ONLY for the
+                # dedicated quantized-wire validation error — a user
+                # ValueError must propagate, never silently reject the
+                # knob.  A QuantizedWireError from a cached step's
+                # execution re-raises so a real error is never masked
+                # by a knob flip.
                 self._step_cache.pop(key, None)
                 self._autotune.reject_quantized()
                 fusion.set_threshold_override(None)

@@ -35,8 +35,11 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
+from .. import faults, metrics
 from ..elastic.discovery import HostManager
+from ..utils import env as hvd_env
 from ..utils.logging import get_logger
+from ..utils.retry import RetryPolicy
 from . import controller_py, exec_utils
 from . import hosts as hosts_mod
 from .launch import free_port, make_worker_env
@@ -44,6 +47,18 @@ from .launch import free_port, make_worker_env
 RESTART_CODE = 73
 
 DISCOVERY_PERIOD_S = 1.0  # reference driver.py:30
+
+# Health-monitor knobs (HVD_TPU_/HOROVOD_ prefixed via utils.env):
+# a worker that registered a heartbeat and then went silent this long
+# (while its process is still alive) is declared HUNG — terminated and
+# blacklisted like a crash, but counted separately.  0 disables.
+ELASTIC_HANG_TIMEOUT = "ELASTIC_HANG_TIMEOUT"
+DEFAULT_HANG_TIMEOUT_S = 30.0
+# Watchdog bound on one round's wall clock; 0 (default) disables.
+ELASTIC_ROUND_TIMEOUT = "ELASTIC_ROUND_TIMEOUT"
+# Transient worker-spawn failures (ssh flake, agent staleness) retry
+# this many times before the host is blamed.
+SPAWN_RETRIES = "SPAWN_RETRIES"
 
 
 def _with_compilation_cache(extra_env):
@@ -82,12 +97,29 @@ class ElasticDriver:
         max_np: Optional[int] = None,
         reset_limit: Optional[int] = None,
         cooldown_s: float = 0.5,
+        hang_timeout_s: Optional[float] = None,
+        round_timeout_s: Optional[float] = None,
+        spawn_retry: Optional[RetryPolicy] = None,
     ):
         self.host_manager = host_manager
         self.min_np = min_np
         self.max_np = max_np
         self.reset_limit = reset_limit
         self.cooldown_s = cooldown_s
+        if hang_timeout_s is None:
+            hang_timeout_s = hvd_env.get_float(
+                ELASTIC_HANG_TIMEOUT, DEFAULT_HANG_TIMEOUT_S
+            )
+        self.hang_timeout_s = hang_timeout_s
+        if round_timeout_s is None:
+            round_timeout_s = hvd_env.get_float(ELASTIC_ROUND_TIMEOUT, 0.0)
+        self.round_timeout_s = round_timeout_s
+        self.spawn_retry = spawn_retry or RetryPolicy(
+            max_attempts=max(1, hvd_env.get_int(SPAWN_RETRIES, 2)),
+            base_delay_s=0.2,
+            max_delay_s=2.0,
+            name="elastic.spawn",
+        )
         self.rounds = 0
         self._shutdown = threading.Event()
         self._membership_changed = threading.Event()
@@ -208,6 +240,7 @@ class ElasticDriver:
                     continue
                 self.rounds += 1
                 round_id = self.rounds
+                metrics.inc_counter("elastic.rounds")
                 self._membership_changed.clear()
                 control.put("__elastic__", "round", str(round_id).encode())
                 control.put("__elastic__", f"round_{round_id}_np",
@@ -245,14 +278,22 @@ class ElasticDriver:
                     )
                     env["HVD_TPU_ELASTIC"] = "1"
                     env["HVD_TPU_ELASTIC_ROUND"] = str(round_id)
-                    try:
-                        workers.append(
-                            make_worker(
-                                slot.rank, slot.hostname, command, env,
-                                ssh_port=ssh_port,
-                                ssh_identity_file=ssh_identity_file,
-                            )
+
+                    def spawn(slot=slot, env=env):
+                        faults.inject(
+                            "driver.spawn", host=slot.hostname,
+                            rank=slot.rank, round=round_id,
                         )
+                        return make_worker(
+                            slot.rank, slot.hostname, command, env,
+                            ssh_port=ssh_port,
+                            ssh_identity_file=ssh_identity_file,
+                        )
+
+                    try:
+                        # transient spawn failures (ssh flake, agent
+                        # staleness) retry before the host is blamed
+                        workers.append(self.spawn_retry.call(spawn))
                     except Exception as e:
                         # A host lost between assignment and spawn (e.g.
                         # a Spark executor death in the discovery
@@ -317,9 +358,38 @@ class ElasticDriver:
     ) -> int:
         """Wait for the round to end.  Membership change -> signal workers
         (they exit RESTART_CODE at the next commit); failure -> blacklist
-        and terminate; success of all -> 0."""
+        and terminate; success of all -> 0.
+
+        Health monitoring: workers that run ``hvd.elastic.run`` publish
+        heartbeats into the KV store (``__elastic__/hb_<round>_<rank>``,
+        elastic_worker.py).  A worker whose process is alive but whose
+        heartbeat stopped advancing for ``hang_timeout_s`` is declared
+        HUNG — without this, a wedged worker (deadlocked collective,
+        stuck I/O) stalls the job forever, indistinguishable from slow
+        progress.  Crash and hang are counted separately
+        (``elastic.worker_crash`` / ``elastic.worker_hang``) because
+        they point at different root causes.  Workers that never
+        heartbeat (plain scripts) are exempt from hang detection.
+        ``round_timeout_s`` additionally bounds the whole round.
+        """
         pending = set(range(len(workers)))
         saw_failure = 0
+        t_round_start = time.monotonic()
+        # rank -> (last heartbeat payload, monotonic time it changed)
+        hb_seen: Dict[int, tuple] = {}
+        last_hb_check = t_round_start
+
+        def _fail_worker(i: int, why: str) -> None:
+            nonlocal saw_failure, pending
+            metrics.inc_counter(f"elastic.worker_{why}")
+            self.host_manager.blacklist(assignments[i].hostname)
+            # a dead peer wedges collectives: end the round
+            for j in pending:
+                workers[j].terminate()
+            for j in pending:
+                workers[j].wait()
+            pending = set()
+
         while pending:
             if self._membership_changed.is_set():
                 control.put(
@@ -340,15 +410,47 @@ class ElasticDriver:
                     )
                     saw_failure = saw_failure or RESTART_CODE
                     continue
+                get_logger().warning(
+                    "worker %d on %s crashed (exit %d)",
+                    assignments[i].rank, assignments[i].hostname, rc,
+                )
                 saw_failure = rc
-                self.host_manager.blacklist(assignments[i].hostname)
-                # a dead peer wedges collectives: end the round
+                _fail_worker(i, "crash")
+                break
+            now = time.monotonic()
+            if pending and self.hang_timeout_s > 0 and (
+                now - last_hb_check >= 1.0
+            ):
+                last_hb_check = now
+                hung = self._find_hung_worker(
+                    pending, assignments, control, round_id, hb_seen
+                )
+                if hung is not None:
+                    get_logger().error(
+                        "worker %d on %s is HUNG (no heartbeat for "
+                        "%.1fs, process alive) — terminating",
+                        assignments[hung].rank,
+                        assignments[hung].hostname, self.hang_timeout_s,
+                    )
+                    pending.discard(hung)
+                    workers[hung].terminate()
+                    workers[hung].wait()
+                    saw_failure = saw_failure or 1
+                    _fail_worker(hung, "hang")
+            if pending and self.round_timeout_s > 0 and (
+                time.monotonic() - t_round_start > self.round_timeout_s
+            ):
+                get_logger().error(
+                    "round %d exceeded watchdog timeout %.1fs; "
+                    "restarting", round_id, self.round_timeout_s,
+                )
+                metrics.inc_counter("elastic.round_timeout")
                 for j in pending:
                     workers[j].terminate()
                 for j in pending:
                     workers[j].wait()
                 pending = set()
-                break
+                saw_failure = saw_failure or RESTART_CODE
             time.sleep(0.1)
         for w in workers:
             w.wait()
@@ -357,3 +459,32 @@ class ElasticDriver:
         if saw_failure:
             return RESTART_CODE if self.host_manager.available_slots() >= self.min_np else saw_failure
         return 0
+
+    def _find_hung_worker(
+        self,
+        pending,
+        assignments: List[hosts_mod.SlotInfo],
+        control,
+        round_id: int,
+        hb_seen: Dict[int, tuple],
+    ) -> Optional[int]:
+        """First pending worker whose heartbeat registered and then went
+        silent past ``hang_timeout_s``; updates ``hb_seen`` in place."""
+        now = time.monotonic()
+        for i in sorted(pending):
+            rank = assignments[i].rank
+            try:
+                val = control.get(
+                    "__elastic__", f"hb_{round_id}_{rank}", timeout_ms=0
+                )
+            except Exception:
+                val = None
+            if val is None:
+                continue  # never heartbeat: plain script, exempt
+            prev = hb_seen.get(rank)
+            if prev is None or prev[0] != val:
+                hb_seen[rank] = (val, now)
+                continue
+            if now - prev[1] > self.hang_timeout_s:
+                return i
+        return None

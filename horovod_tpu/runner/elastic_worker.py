@@ -23,6 +23,7 @@ from . import controller_py
 
 RESTART_CODE = 73
 _POLL_PERIOD_S = 0.5
+_HEARTBEAT_PERIOD_S = 1.0
 
 _manager: Optional["WorkerNotificationManager"] = None
 _manager_lock = threading.Lock()
@@ -48,6 +49,7 @@ class WorkerNotificationManager:
         self._lock = threading.Lock()
         self._client = None
         self._thread: Optional[threading.Thread] = None
+        self._hb_thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
         self.round = int(os.environ.get("HVD_TPU_ELASTIC_ROUND", "0"))
         self.rank = int(os.environ.get("HVD_TPU_CROSS_RANK", "0"))
@@ -55,14 +57,51 @@ class WorkerNotificationManager:
     def init(self) -> None:
         if self._client is not None:
             return
-        self._client = controller_py.make_client(
-            os.environ["HVD_TPU_RENDEZVOUS_ADDR"],
-            int(os.environ["HVD_TPU_RENDEZVOUS_PORT"]),
-            os.environ["HVD_TPU_SECRET"],
-            self.rank,
-        )
+        from ..faults import inject
+        from ..utils.retry import RetryPolicy
+
+        def connect():
+            inject("worker.connect", rank=self.rank, round=self.round)
+            return controller_py.make_client(
+                os.environ["HVD_TPU_RENDEZVOUS_ADDR"],
+                int(os.environ["HVD_TPU_RENDEZVOUS_PORT"]),
+                os.environ["HVD_TPU_SECRET"],
+                self.rank,
+            )
+
+        # the KV server may still be mid-bind when an early worker dials
+        self._client = RetryPolicy(
+            max_attempts=3, base_delay_s=0.2, name="worker.connect"
+        ).call(connect)
         self._thread = threading.Thread(target=self._poll, daemon=True)
         self._thread.start()
+        # Heartbeat: the driver's health monitor distinguishes a hung
+        # worker (process alive, heartbeat stalled) from a crashed one
+        # (process gone) — see ElasticDriver._find_hung_worker.
+        self._hb_thread = threading.Thread(target=self._heartbeat,
+                                           daemon=True)
+        self._hb_thread.start()
+
+    def _heartbeat(self) -> None:
+        from ..faults import inject
+
+        seq = 0
+        key = f"hb_{self.round}_{self.rank}"
+        while not self._stop.is_set():
+            seq += 1
+            try:
+                client = self._client
+                if client is None:
+                    return
+                client.put("__elastic__", key, str(seq).encode())
+            except Exception:
+                pass  # KV blips must never kill the worker
+            # a 'hang' fault here freezes the heartbeat AFTER it
+            # registered, without touching the training thread — the
+            # scripted stand-in for a wedged worker the driver's health
+            # monitor must catch
+            inject("worker.heartbeat", rank=self.rank, round=self.round)
+            self._stop.wait(_HEARTBEAT_PERIOD_S)
 
     def _poll(self) -> None:
         key = f"hosts_updated_{self.round}"

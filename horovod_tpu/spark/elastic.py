@@ -218,12 +218,15 @@ class SparkWorkerProcess:
         self._client.put(KILL_SCOPE, self._key, b"1")
 
     def wait(self, timeout: Optional[float] = None) -> int:
-        deadline = time.monotonic() + timeout if timeout else None
+        # timeout=0 is a valid immediate-deadline poll, not "no deadline"
+        deadline = (
+            time.monotonic() + timeout if timeout is not None else None
+        )
         while True:
             rc = self.returncode
             if rc is not None:
                 return rc
-            if deadline and time.monotonic() >= deadline:
+            if deadline is not None and time.monotonic() >= deadline:
                 raise TimeoutError(f"worker {self.rank} did not exit")
             time.sleep(0.1)
 

@@ -15,8 +15,10 @@ import time
 
 import pytest
 
+from horovod_tpu import faults, metrics
 from horovod_tpu.elastic.discovery import HostDiscovery, HostManager
 from horovod_tpu.runner.elastic_driver import ElasticDriver
+from horovod_tpu.utils.retry import RetryPolicy
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 WORKER_ENV = {
@@ -268,3 +270,249 @@ def test_elastic_worker_failure_blacklists_and_continues(tmp_path):
     assert driver.rounds == 2
     marks = sorted(os.listdir(tmp_path))
     assert any("round2" in m for m in marks)
+
+
+# ---- deterministic fault injection (HVD_TPU_FAULT_PLAN) ---------------
+
+# This worker exercises the real worker-side fault-tolerance plumbing
+# (KV rendezvous + heartbeats + host-update notification + cross-round
+# state persistence via elastic_worker) without multi-process jax
+# collectives — the CPU backend in CI cannot run those (see
+# test_elastic_membership_change, which degrades for the same reason),
+# and the subject under test here is the DRIVER's failure handling.
+FAULT_WORKER_SCRIPT = textwrap.dedent(
+    """
+    import os, pickle, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    from horovod_tpu import faults
+    from horovod_tpu.runner import elastic_worker
+
+    round_id = int(os.environ["HVD_TPU_ELASTIC_ROUND"])
+    rank = int(os.environ["HVD_TPU_CROSS_RANK"])
+    size = int(os.environ["HVD_TPU_CROSS_SIZE"])
+    host = os.environ["HVD_TPU_HOSTNAME"]
+
+    class Flag:
+        updated = False
+        def on_hosts_updated(self, ts, res):
+            self.updated = True
+
+    flag = Flag()
+    mgr = elastic_worker.get_notification_manager()
+    mgr.register_listener(flag)
+    mgr.init()  # KV connect (retried) + notification poll + heartbeats
+
+    blob = mgr.load_state_blob()
+    epoch = pickle.loads(blob) if blob else 0
+    out = open(os.environ["RESULTS_FILE"] + f".{rank}", "a")
+    target = int(os.environ.get("TARGET_EPOCHS", "10"))
+    while epoch < target:
+        time.sleep(float(os.environ.get("EPOCH_SECS", "0.5")))
+        # the scripted failure site: the env fault plan decides if,
+        # when, and on which host/round/rank this fires
+        faults.inject("worker.step", rank=rank, round=round_id,
+                      host=host, epoch=epoch)
+        epoch += 1
+        out.write(f"round={round_id} epoch={epoch} size={size}\\n")
+        out.flush()
+        mgr.save_state_blob(pickle.dumps(epoch))
+        if flag.updated:
+            out.write(f"restart round={round_id}\\n")
+            out.close()
+            sys.stdout.flush()
+            os._exit(73)  # RESTART_CODE: ack the membership change
+    out.write(f"done epoch={epoch}\\n")
+    out.close()
+    mgr.close()
+    """
+)
+
+
+@pytest.mark.faults
+def test_injected_crash_blacklist_cooldown_recovery(tmp_path):
+    """The acceptance-criteria scenario: a seeded fault plan crashes the
+    127.0.0.1 worker mid-round-1; the driver blacklists the host and
+    restarts at reduced size; the blacklist cooldown expires while the
+    survivors train on; discovery re-admits the host and the final round
+    runs at full size to completion — with the whole story visible in
+    the metrics counters."""
+    metrics.reset_counters()
+    script = tmp_path / "worker.py"
+    script.write_text(FAULT_WORKER_SCRIPT)
+    results_file = str(tmp_path / "results")
+
+    discovery = ScriptedDiscovery([(1e9, {"localhost": 1, "127.0.0.1": 1})])
+    driver = ElasticDriver(
+        HostManager(discovery, cooldown_s=2.0, cooldown_max_s=8.0),
+        min_np=1, max_np=2,
+    )
+    driver.start_discovery()
+    rc = driver.run_rounds(
+        [sys.executable, str(script)],
+        extra_env={
+            "RESULTS_FILE": results_file,
+            "TARGET_EPOCHS": "10",
+            "EPOCH_SECS": "0.6",
+            "HVD_TPU_FAULT_PLAN":
+                "worker.step:crash:host=127.0.0.1,round=1,nth=1,code=5",
+            **WORKER_ENV,
+        },
+    )
+    assert rc == 0
+    assert driver.rounds >= 3, (
+        "expected crash round + degraded round + recovered round, got "
+        f"{driver.rounds}"
+    )
+
+    lines = []
+    for fn in os.listdir(tmp_path):
+        if fn.startswith("results."):
+            lines += (tmp_path / fn).read_text().splitlines()
+    assert any(l.startswith("done epoch=10") for l in lines)
+    sizes_by_round = {}
+    for l in lines:
+        if l.startswith("round="):
+            parts = dict(kv.split("=") for kv in l.split())
+            sizes_by_round.setdefault(int(parts["round"]), set()).add(
+                int(parts["size"])
+            )
+    # degraded round at size 1 while 127.0.0.1 cooled down, then
+    # recovery back to size 2
+    assert any(s == {1} for s in sizes_by_round.values()), sizes_by_round
+    assert sizes_by_round[max(sizes_by_round)] == {2}, sizes_by_round
+
+    got = metrics.get_counters("elastic.")
+    assert got.get("elastic.worker_crash", 0) >= 1, got
+    assert got.get("elastic.blacklist", 0) >= 1, got
+    assert got.get("elastic.unblacklist", 0) >= 1, got
+    assert not driver.host_manager.is_blacklisted("127.0.0.1")
+    assert driver.host_manager.failure_count("127.0.0.1") == 1
+
+
+@pytest.mark.faults
+def test_injected_hang_detected_by_heartbeat(tmp_path):
+    """A worker whose heartbeat freezes (process alive, no progress
+    signal) is declared hung by the driver's health monitor, terminated,
+    and its host blacklisted — counted as a hang, not a crash."""
+    metrics.reset_counters()
+    script = tmp_path / "worker.py"
+    script.write_text(FAULT_WORKER_SCRIPT)
+    results_file = str(tmp_path / "results")
+
+    discovery = ScriptedDiscovery([(1e9, {"localhost": 1, "127.0.0.1": 1})])
+    driver = ElasticDriver(
+        HostManager(discovery, cooldown_s=300.0),
+        min_np=1, max_np=2, hang_timeout_s=2.5,
+    )
+    driver.start_discovery()
+    rc = driver.run_rounds(
+        [sys.executable, str(script)],
+        extra_env={
+            "RESULTS_FILE": results_file,
+            "TARGET_EPOCHS": "30",
+            "EPOCH_SECS": "0.4",
+            # rank 0 lands on 127.0.0.1 (hosts sort lexically); its
+            # heartbeat thread freezes in round 1 after registering
+            "HVD_TPU_FAULT_PLAN":
+                "worker.heartbeat:hang:rank=0,round=1,secs=120",
+            **WORKER_ENV,
+        },
+    )
+    assert rc == 0
+    got = metrics.get_counters("elastic.")
+    assert got.get("elastic.worker_hang", 0) == 1, got
+    assert got.get("elastic.worker_crash", 0) == 0, got
+    assert driver.host_manager.is_blacklisted("127.0.0.1")
+    lines = []
+    for fn in os.listdir(tmp_path):
+        if fn.startswith("results."):
+            lines += (tmp_path / fn).read_text().splitlines()
+    assert any(l.startswith("done epoch=30") for l in lines)
+
+
+@pytest.mark.faults
+def test_spawn_flake_absorbed_by_retry(tmp_path):
+    """A transient spawn failure (injected in the DRIVER process at the
+    driver.spawn site) is retried instead of blacklisting the host."""
+    metrics.reset_counters()
+    faults.set_plan("driver.spawn:error:nth=1")
+    try:
+        script = tmp_path / "worker.py"
+        script.write_text("import sys; sys.exit(0)\n")
+        discovery = ScriptedDiscovery([(1e9, {"localhost": 1})])
+        driver = ElasticDriver(
+            HostManager(discovery), min_np=1, max_np=1,
+            spawn_retry=RetryPolicy(
+                max_attempts=2, base_delay_s=0.0, name="elastic.spawn"
+            ),
+        )
+        driver.start_discovery()
+        rc = driver.run_rounds([sys.executable, str(script)],
+                               extra_env=dict(WORKER_ENV))
+    finally:
+        faults.set_plan(None)
+    assert rc == 0
+    assert driver.rounds == 1  # the flake cost a retry, not a round
+    assert not driver.host_manager.is_blacklisted("localhost")
+    assert metrics.get_counter("retry.elastic.spawn.retries") == 1
+    assert metrics.get_counter("faults.injected.driver.spawn.error") == 1
+
+
+@pytest.mark.faults
+def test_round_watchdog_restarts_stuck_round(tmp_path):
+    """round_timeout_s bounds a round that makes no progress at all
+    (e.g. every worker stuck before hvd.init); the watchdog restarts it
+    rather than hanging the job forever."""
+    metrics.reset_counters()
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(
+        """
+        import os, sys, time
+        if int(os.environ["HVD_TPU_ELASTIC_ROUND"]) == 1:
+            time.sleep(60)
+        sys.exit(0)
+        """
+    ))
+    discovery = ScriptedDiscovery([(1e9, {"localhost": 1})])
+    driver = ElasticDriver(
+        HostManager(discovery), min_np=1, max_np=1,
+        round_timeout_s=2.0, cooldown_s=0.1,
+    )
+    driver.start_discovery()
+    t0 = time.monotonic()
+    rc = driver.run_rounds([sys.executable, str(script)],
+                           extra_env=dict(WORKER_ENV))
+    assert rc == 0
+    assert time.monotonic() - t0 < 30.0
+    assert driver.rounds == 2
+    assert metrics.get_counter("elastic.round_timeout") == 1
+
+
+@pytest.mark.faults
+def test_corrupt_checkpoint_falls_back_in_elastic_context(tmp_path):
+    """Corruption injected at checkpoint-write time (seeded plan) is
+    detected on restore and resume falls back to the last good step,
+    with the failure counters visible in metrics output."""
+    import horovod_tpu as hvd
+
+    metrics.reset_counters("checkpoint.")
+    hvd.init()
+    try:
+        path = str(tmp_path / "ckpt")
+        for s in (1, 2):
+            hvd.save_checkpoint(path, {"epoch": s}, step=s,
+                                use_orbax=False)
+        faults.set_plan("checkpoint.write:corrupt:nth=1")
+        try:
+            hvd.save_checkpoint(path, {"epoch": 3}, step=3,
+                                use_orbax=False)
+        finally:
+            faults.set_plan(None)
+        state, step = hvd.restore_or_init(path, {"epoch": 0})
+        assert (state["epoch"], step) == (2, 2)
+        got = metrics.get_counters("checkpoint.")
+        assert got["checkpoint.corrupt_detected"] >= 1
+        assert got["checkpoint.fallback"] >= 1
+    finally:
+        hvd.shutdown()

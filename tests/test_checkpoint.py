@@ -1,7 +1,9 @@
 """Durable checkpoint save/restore (the reference's Keras
 ``load_model``-with-hvd-optimizer analog plus the imagenet example's
-resume_from_epoch pattern)."""
+resume_from_epoch pattern), plus the integrity guarantees: atomic
+writes, content checksums, and corruption fallback."""
 
+import json
 import os
 
 import jax
@@ -11,6 +13,9 @@ import optax
 import pytest
 
 import horovod_tpu as hvd
+from horovod_tpu import faults, metrics
+from horovod_tpu.checkpoint import _META_FILE
+from horovod_tpu.exceptions import CheckpointCorruptionError
 
 
 def _state():
@@ -89,3 +94,113 @@ def test_full_training_state_roundtrip(hvd_module, tmp_path):
     np.testing.assert_allclose(np.asarray(pa["w"]), np.asarray(pb["w"]),
                                rtol=1e-6, atol=1e-6)
     assert float(la) == pytest.approx(float(lb))
+
+
+# ---- integrity: atomic write, checksums, corruption fallback ----------
+
+
+@pytest.fixture(autouse=True)
+def _no_fault_plan():
+    faults.set_plan(None)
+    yield
+    faults.set_plan(None)
+
+
+@pytest.mark.faults
+def test_atomic_write_leaves_no_temp_files(hvd_module, tmp_path):
+    path = str(tmp_path / "ckpt")
+    target = hvd.save_checkpoint(path, _state(), use_orbax=False)
+    names = sorted(os.listdir(target))
+    assert names == ["checkpoint.meta.json", "checkpoint.pkl"]
+    meta = json.loads((tmp_path / "ckpt" / _META_FILE).read_text())
+    payload = (tmp_path / "ckpt" / "checkpoint.pkl").read_bytes()
+    import hashlib
+
+    assert meta["sha256"] == hashlib.sha256(payload).hexdigest()
+    assert meta["size"] == len(payload)
+    assert hvd.verify_checkpoint(target)
+
+
+@pytest.mark.faults
+def test_checksum_mismatch_raises_corruption_error(hvd_module, tmp_path):
+    path = str(tmp_path / "ckpt")
+    target = hvd.save_checkpoint(path, _state(), use_orbax=False)
+    pkl = os.path.join(target, "checkpoint.pkl")
+    data = bytearray(open(pkl, "rb").read())
+    data[len(data) // 2] ^= 0xFF
+    open(pkl, "wb").write(bytes(data))
+    assert not hvd.verify_checkpoint(target)
+    with pytest.raises(CheckpointCorruptionError):
+        hvd.load_checkpoint(path)
+
+
+@pytest.mark.faults
+def test_truncated_payload_detected(hvd_module, tmp_path):
+    path = str(tmp_path / "ckpt")
+    target = hvd.save_checkpoint(path, _state(), use_orbax=False)
+    pkl = os.path.join(target, "checkpoint.pkl")
+    open(pkl, "r+b").truncate(os.path.getsize(pkl) // 2)
+    assert not hvd.verify_checkpoint(target)
+    with pytest.raises(CheckpointCorruptionError):
+        hvd.load_checkpoint(path)
+
+
+@pytest.mark.faults
+def test_legacy_checkpoint_without_sidecar_still_loads(
+        hvd_module, tmp_path):
+    path = str(tmp_path / "ckpt")
+    target = hvd.save_checkpoint(path, _state(), use_orbax=False)
+    os.remove(os.path.join(target, _META_FILE))
+    assert hvd.verify_checkpoint(target)  # nothing to check against
+    assert hvd.load_checkpoint(path)["epoch"] == 4
+
+
+@pytest.mark.faults
+def test_restore_falls_back_to_last_good_step(hvd_module, tmp_path):
+    """The acceptance-criteria scenario: the newest checkpoint is
+    corrupted (via the seeded fault plan, not by hand) and resume lands
+    on the previous good step with counters to show for it."""
+    metrics.reset_counters("checkpoint.")
+    path = str(tmp_path / "ckpt")
+    for s in (1, 2):
+        hvd.save_checkpoint(path, {"epoch": s}, step=s, use_orbax=False)
+    faults.set_plan("checkpoint.write:corrupt:nth=1")
+    hvd.save_checkpoint(path, {"epoch": 3}, step=3, use_orbax=False)
+    faults.set_plan(None)
+
+    from horovod_tpu.checkpoint import latest_step
+
+    assert latest_step(path) == 3
+    assert hvd.latest_good_step(path) == 2
+    state, step = hvd.restore_or_init(path, {"epoch": 0})
+    assert (state["epoch"], step) == (2, 2)
+    got = metrics.get_counters("checkpoint.")
+    assert got["checkpoint.corrupt_detected"] >= 1
+    assert got["checkpoint.fallback"] >= 1
+    assert got["checkpoint.saved"] == 3
+
+
+@pytest.mark.faults
+def test_restore_falls_back_with_orbax_format(hvd_module, tmp_path):
+    pytest.importorskip("orbax.checkpoint")
+    metrics.reset_counters("checkpoint.")
+    path = str(tmp_path / "ckpt")
+    hvd.save_checkpoint(path, {"w": jnp.ones((2,))}, step=1)
+    faults.set_plan("checkpoint.write:corrupt:nth=1")
+    hvd.save_checkpoint(path, {"w": jnp.full((2,), 9.0)}, step=2)
+    faults.set_plan(None)
+    assert hvd.latest_good_step(path) == 1
+    state, step = hvd.restore_or_init(path, {"w": jnp.zeros((2,))})
+    assert step == 1
+    np.testing.assert_allclose(np.asarray(state["w"]), 1.0)
+
+
+@pytest.mark.faults
+def test_all_steps_corrupt_falls_back_to_init(hvd_module, tmp_path):
+    path = str(tmp_path / "ckpt")
+    faults.set_plan("checkpoint.write:corrupt:times=0")
+    hvd.save_checkpoint(path, {"epoch": 1}, step=1, use_orbax=False)
+    faults.set_plan(None)
+    assert hvd.latest_good_step(path) is None
+    state, step = hvd.restore_or_init(path, {"epoch": 0})
+    assert (state["epoch"], step) == (0, 0)

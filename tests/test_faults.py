@@ -1,0 +1,364 @@
+"""Fault-injection registry + retry policy + hardened discovery
+(``horovod_tpu/faults.py``, ``horovod_tpu/utils/retry.py``,
+``horovod_tpu/elastic/discovery.py``).
+
+Everything here is deterministic: plans are seeded, jitter comes from a
+seeded RNG, cooldown clocks are injected.  The ``faults`` marker tags
+the suite that guards the injection hooks against bit-rot (see
+``tools/tier1_faultsmoke.sh``).
+"""
+
+import threading
+import time
+
+import pytest
+
+from horovod_tpu import faults, metrics
+from horovod_tpu.elastic.discovery import (
+    FixedHosts,
+    HostDiscoveryScript,
+    HostManager,
+)
+from horovod_tpu.exceptions import FaultInjected, RetryTimeoutError
+from horovod_tpu.utils.retry import RetryPolicy
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_plan():
+    faults.set_plan(None)
+    yield
+    faults.set_plan(None)
+
+
+# ---------------------------------------------------------------- parsing
+
+class TestFaultPlanParsing:
+    def test_parse_sites_kinds_args(self):
+        plan = faults.FaultPlan.parse(
+            "seed=7;discovery.script:error:nth=2;"
+            "worker.step:crash:rank=1,round=2,code=9;"
+            "checkpoint.write:corrupt:nth=1"
+        )
+        assert plan.seed == 7
+        assert plan.sites() == [
+            "checkpoint.write", "discovery.script", "worker.step",
+        ]
+        spec = plan._by_site["worker.step"][0]
+        assert spec.kind == "crash"
+        assert spec.code == 9
+        assert spec.match == {"rank": 1, "round": 2}
+
+    def test_flake_is_error_alias(self):
+        plan = faults.FaultPlan.parse("a.b:flake")
+        assert plan._by_site["a.b"][0].kind == "error"
+
+    @pytest.mark.parametrize("bad", [
+        "justasite", "a.b:nosuchkind", "a.b:error:oops",
+    ])
+    def test_malformed_entries_raise(self, bad):
+        with pytest.raises(ValueError):
+            faults.FaultPlan.parse(bad)
+
+    def test_empty_plan_is_none(self):
+        assert faults.set_plan("") is None
+        assert faults.inject("anything") is False
+
+
+# ----------------------------------------------------------- triggering
+
+class TestDeterministicTriggering:
+    def test_nth_fires_exactly_once(self):
+        faults.set_plan("s:error:nth=2")
+        assert faults.inject("s") is False
+        with pytest.raises(FaultInjected):
+            faults.inject("s")
+        assert faults.inject("s") is False  # 3rd arrival: armed window past
+
+    def test_nth_with_times_window(self):
+        faults.set_plan("s:error:nth=2,times=2")
+        assert faults.inject("s") is False
+        for _ in range(2):
+            with pytest.raises(FaultInjected):
+                faults.inject("s")
+        assert faults.inject("s") is False
+
+    def test_times_without_nth_fires_first_n(self):
+        faults.set_plan("s:error:times=2")
+        for _ in range(2):
+            with pytest.raises(FaultInjected):
+                faults.inject("s")
+        assert faults.inject("s") is False
+
+    def test_context_selectors_gate_arrival_counting(self):
+        faults.set_plan("s:error:rank=1,nth=1")
+        # non-matching context neither fires nor consumes the arrival
+        assert faults.inject("s", rank=0) is False
+        assert faults.inject("s") is False  # missing key: no match
+        with pytest.raises(FaultInjected):
+            faults.inject("s", rank=1)
+
+    def test_seeded_probability_is_reproducible(self):
+        def pattern():
+            plan = faults.FaultPlan.parse("seed=11;s:error:p=0.5,times=0")
+            faults.set_plan(plan)
+            fired = []
+            for _ in range(32):
+                try:
+                    faults.inject("s")
+                    fired.append(0)
+                except FaultInjected:
+                    fired.append(1)
+            return fired
+
+        a, b = pattern(), pattern()
+        assert a == b
+        assert 0 < sum(a) < 32  # actually probabilistic, not all-or-none
+
+    def test_corrupt_returns_true(self):
+        faults.set_plan("s:corrupt:nth=1")
+        assert faults.inject("s") is True
+        assert faults.inject("s") is False
+
+    def test_slow_sleeps(self):
+        faults.set_plan("s:slow:secs=0.05,times=1")
+        t0 = time.perf_counter()
+        assert faults.inject("s") is False
+        assert time.perf_counter() - t0 >= 0.05
+
+    def test_fired_counters_and_metrics(self):
+        metrics.reset_counters("faults.")
+        plan = faults.set_plan("s:error:times=2")
+        for _ in range(2):
+            with pytest.raises(FaultInjected):
+                faults.inject("s")
+        assert plan.counters() == {"s:error": 2}
+        assert metrics.get_counter("faults.injected.s.error") == 2
+
+    def test_env_plan_pickup_and_reset(self, monkeypatch):
+        faults.reset()
+        monkeypatch.setenv(faults.ENV_VAR, "s:corrupt:nth=1")
+        assert faults.inject("s") is True
+        faults.reset()
+        monkeypatch.delenv(faults.ENV_VAR)
+        assert faults.inject("s") is False
+
+
+# -------------------------------------------------------------- retries
+
+class TestRetryPolicy:
+    def test_backoff_math_deterministic(self):
+        pol = RetryPolicy(base_delay_s=0.1, multiplier=2.0,
+                          max_delay_s=0.5, jitter=0.0)
+        assert [pol.delay_s(k) for k in (1, 2, 3, 4, 5)] == \
+            pytest.approx([0.1, 0.2, 0.4, 0.5, 0.5])
+
+    def test_jitter_bounded_and_seeded(self):
+        a = RetryPolicy(base_delay_s=1.0, jitter=0.25, seed=3)
+        b = RetryPolicy(base_delay_s=1.0, jitter=0.25, seed=3)
+        da = [a.delay_s(1) for _ in range(8)]
+        db = [b.delay_s(1) for _ in range(8)]
+        assert da == db
+        assert all(0.75 <= d <= 1.25 for d in da)
+        assert len(set(da)) > 1
+
+    def test_retries_then_succeeds(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise RuntimeError("transient")
+            return "ok"
+
+        slept = []
+        pol = RetryPolicy(max_attempts=5, base_delay_s=0.01, seed=0,
+                          sleep=slept.append, name="t_ok")
+        assert pol.call(flaky) == "ok"
+        assert len(calls) == 3
+        assert len(slept) == 2
+
+    def test_exhaustion_reraises_last_and_counts(self):
+        metrics.reset_counters("retry.t_fail")
+
+        def always():
+            raise RuntimeError("perma")
+
+        pol = RetryPolicy(max_attempts=3, base_delay_s=0.0,
+                          sleep=lambda s: None, name="t_fail")
+        with pytest.raises(RuntimeError, match="perma"):
+            pol.call(always)
+        got = metrics.get_counters("retry.t_fail")
+        assert got == {
+            "retry.t_fail.attempts": 3,
+            "retry.t_fail.retries": 2,
+            "retry.t_fail.exhausted": 1,
+        }
+
+    def test_non_retryable_propagates_immediately(self):
+        calls = []
+
+        def typed():
+            calls.append(1)
+            raise KeyError("nope")
+
+        pol = RetryPolicy(max_attempts=3, retry_on=(RuntimeError,),
+                          sleep=lambda s: None)
+        with pytest.raises(KeyError):
+            pol.call(typed)
+        assert len(calls) == 1
+
+    def test_attempt_timeout_retries_hung_call(self):
+        calls = []
+
+        def hangs_once():
+            calls.append(1)
+            if len(calls) == 1:
+                time.sleep(5.0)
+            return "done"
+
+        pol = RetryPolicy(max_attempts=2, attempt_timeout_s=0.2,
+                          base_delay_s=0.0, sleep=lambda s: None)
+        t0 = time.perf_counter()
+        assert pol.call(hangs_once) == "done"
+        assert time.perf_counter() - t0 < 2.0
+
+    def test_attempt_timeout_exhausts_to_timeout_error(self):
+        pol = RetryPolicy(max_attempts=2, attempt_timeout_s=0.05,
+                          base_delay_s=0.0, sleep=lambda s: None)
+        with pytest.raises(RetryTimeoutError):
+            pol.call(time.sleep, 5.0)
+
+    def test_on_retry_callback(self):
+        seen = []
+
+        def flaky():
+            if not seen:
+                raise RuntimeError("x")
+            return 1
+
+        pol = RetryPolicy(max_attempts=2, base_delay_s=0.0,
+                          sleep=lambda s: None,
+                          on_retry=lambda a, e, d: seen.append((a, str(e))))
+        assert pol.call(flaky) == 1
+        assert seen == [(1, "x")]
+
+
+# ------------------------------------------ discovery retry + injection
+
+class TestDiscoveryFaults:
+    def test_discovery_flake_absorbed_by_retry(self):
+        metrics.reset_counters("retry.discovery")
+        faults.set_plan("discovery.script:flake:nth=1")
+        disc = HostDiscoveryScript(
+            "echo hostA:2; echo hostB", default_slots=3,
+            retry=RetryPolicy(max_attempts=3, base_delay_s=0.0,
+                              sleep=lambda s: None, name="discovery"),
+        )
+        assert disc.find_available_hosts_and_slots() == {
+            "hostA": 2, "hostB": 3,
+        }
+        assert metrics.get_counter("retry.discovery.retries") == 1
+
+    def test_discovery_persistent_failure_propagates(self):
+        faults.set_plan("discovery.script:flake:times=0")
+        disc = HostDiscoveryScript(
+            "echo unused",
+            retry=RetryPolicy(max_attempts=2, base_delay_s=0.0,
+                              sleep=lambda s: None, name="discovery"),
+        )
+        with pytest.raises(FaultInjected):
+            disc.find_available_hosts_and_slots()
+
+    def test_script_nonzero_exit_retried(self):
+        # a script that fails on its first run and succeeds after: model
+        # it with a state file toggled by the script itself
+        import tempfile, os
+        d = tempfile.mkdtemp()
+        flag = os.path.join(d, "flag")
+        script = (
+            f"if [ -e {flag} ]; then echo host1; "
+            f"else touch {flag}; exit 3; fi"
+        )
+        disc = HostDiscoveryScript(
+            script,
+            retry=RetryPolicy(max_attempts=2, base_delay_s=0.0,
+                              sleep=lambda s: None, name="discovery"),
+        )
+        assert disc.find_available_hosts_and_slots() == {"host1": 1}
+
+
+# --------------------------------------------------- blacklist cooldown
+
+class FakeClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+
+class TestBlacklistCooldown:
+    def _manager(self, hosts, cooldown=10.0, cap=40.0):
+        clock = FakeClock()
+        mgr = HostManager(
+            FixedHosts(hosts), cooldown_s=cooldown,
+            cooldown_max_s=cap, clock=clock,
+        )
+        mgr.update_available_hosts()
+        return mgr, clock
+
+    def test_blacklist_and_cooldown_recovery(self):
+        metrics.reset_counters("elastic.")
+        mgr, clock = self._manager({"a": 1, "b": 1})
+        mgr.blacklist("b")
+        assert mgr.is_blacklisted("b")
+        mgr.update_available_hosts()
+        assert mgr.current_hosts == {"a": 1}
+        clock.now += 10.1
+        assert not mgr.is_blacklisted("b")
+        assert mgr.update_available_hosts()  # change: b came back
+        assert mgr.current_hosts == {"a": 1, "b": 1}
+        assert metrics.get_counter("elastic.blacklist") == 1
+        assert metrics.get_counter("elastic.unblacklist") == 1
+
+    def test_repeat_failures_double_cooldown_capped(self):
+        mgr, clock = self._manager({"a": 1}, cooldown=10.0, cap=25.0)
+        for expect in (10.0, 20.0, 25.0, 25.0):  # doubled then capped
+            mgr.blacklist("a")
+            clock.now += expect - 0.1
+            assert mgr.is_blacklisted("a"), expect
+            clock.now += 0.2
+            assert not mgr.is_blacklisted("a"), expect
+            mgr.update_available_hosts()
+        assert mgr.failure_count("a") == 4
+
+    def test_zero_cooldown_is_permanent(self):
+        mgr, clock = self._manager({"a": 1}, cooldown=0.0)
+        mgr.blacklist("a")
+        clock.now += 1e9
+        assert mgr.is_blacklisted("a")
+        mgr.update_available_hosts()
+        assert mgr.current_hosts == {}
+
+
+# ----------------------------------------------------- thread soundness
+
+def test_inject_is_thread_safe_under_contention():
+    faults.set_plan("s:error:nth=50")
+    fired = []
+
+    def worker():
+        for _ in range(25):
+            try:
+                faults.inject("s")
+            except FaultInjected:
+                fired.append(1)
+
+    threads = [threading.Thread(target=worker) for _ in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert len(fired) == 1  # exactly one arrival was the 50th

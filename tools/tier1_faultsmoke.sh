@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Tier-1 suite under a smoke fault plan: one injected discovery flake
+# (absorbed by HostDiscoveryScript's RetryPolicy).  Keeps the
+# HVD_TPU_FAULT_PLAN env path and the injection hooks exercised end to
+# end so they cannot bit-rot — see docs/fault_tolerance.md.
+set -o pipefail
+
+export HVD_TPU_FAULT_PLAN='discovery.script:flake:nth=1'
+export JAX_PLATFORMS=cpu
+
+# 1. Prove the env-driven injection path: the plan must fire exactly one
+#    discovery flake, and the retry policy must absorb it.
+python - <<'EOF'
+from horovod_tpu import faults, metrics
+from horovod_tpu.elastic.discovery import HostDiscoveryScript
+
+disc = HostDiscoveryScript("echo smokehost:2")
+assert disc.find_available_hosts_and_slots() == {"smokehost": 2}
+assert metrics.get_counter("faults.injected.discovery.script.error") == 1, \
+    "env fault plan did not fire"
+assert metrics.get_counter("retry.discovery.retries") == 1, \
+    "retry policy did not absorb the flake"
+print("fault smoke: env plan fired once and was absorbed by retry")
+EOF
+
+# 2. Full tier-1 suite with the plan still armed (any further
+#    discovery-script call sites see an already-spent plan entry).
+exec python -m pytest tests/ -q -m 'not slow' \
+    --continue-on-collection-errors \
+    -p no:cacheprovider "$@"
