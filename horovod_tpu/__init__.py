@@ -18,6 +18,26 @@ Typical use (the reference MNIST pattern, ``examples/pytorch/pytorch_mnist.py``)
 
 from .version import __version__  # noqa: F401
 
+import jax as _jax
+
+if not hasattr(_jax, "shard_map"):
+    # jax < 0.5 ships shard_map under jax.experimental only (with the
+    # replication check spelled check_rep, not check_vma); the op
+    # layers target the stable jax.shard_map spelling.
+    from jax.experimental.shard_map import shard_map as _xp_shard_map
+
+    def _shard_map(f, *args, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        return _xp_shard_map(f, *args, **kwargs)
+
+    _jax.shard_map = _shard_map
+
+if not hasattr(_jax.lax, "axis_size"):
+    # jax < 0.6 spelling: the static named-axis size lives on
+    # jax.core.axis_frame.
+    _jax.lax.axis_size = lambda name: _jax.core.axis_frame(name)
+
 from . import runtime as _runtime
 from .exceptions import (  # noqa: F401
     CheckpointCorruptionError,
@@ -220,16 +240,24 @@ from . import compression  # noqa: F401,E402
 from .compression import Compression  # noqa: F401,E402
 from . import elastic  # noqa: F401,E402
 from .sync_batch_norm import SyncBatchNorm  # noqa: F401,E402
+from . import metrics  # noqa: F401,E402
 from .metrics import (  # noqa: F401,E402
     get_counter,
     get_counters,
+    get_gauge,
+    get_histogram,
     inc_counter,
     metric_average,
+    observe,
+    render_prometheus,
     reset_counters,
+    set_gauge,
 )
+from . import events  # noqa: F401,E402
 from . import faults  # noqa: F401,E402
 from .utils.retry import RetryPolicy  # noqa: F401,E402
 from .utils.timeline import (  # noqa: F401,E402
+    merge_timeline_files,
     profile_bucket_step,
     start_timeline,
     stop_timeline,

@@ -45,7 +45,7 @@ from typing import Any, Dict, List, Optional
 import jax
 import numpy as np
 
-from . import faults, functions, runtime
+from . import events, faults, functions, runtime
 from .exceptions import CheckpointCorruptionError
 from .utils.logging import get_logger
 
@@ -127,12 +127,15 @@ def save_checkpoint(
     ``path``; only rank 0 writes (reference: checkpoints saved on rank 0,
     e.g. ``examples/pytorch/pytorch_imagenet_resnet50.py``'s
     ``save_checkpoint``).  Returns the checkpoint directory."""
+    import time
+
     from . import metrics
 
     target = path if step is None else os.path.join(path, f"step_{step}")
     rt = runtime.get_runtime_or_none()
     if rt is not None and rt.process_rank != 0:
         return target
+    t0 = time.perf_counter()
     os.makedirs(target, exist_ok=True)
     if use_orbax is None:
         use_orbax = _has_orbax()
@@ -171,6 +174,7 @@ def save_checkpoint(
         if faults.inject("checkpoint.write", path=target, step=step):
             _corrupt_file(pkl)
     metrics.inc_counter("checkpoint.saved")
+    metrics.observe("checkpoint.write_seconds", time.perf_counter() - t0)
     log.info("checkpoint saved to %s", target)
     return target
 
@@ -240,6 +244,9 @@ def load_checkpoint(
     are divergent, partially written, or missing on non-root ranks.
     Raises :class:`CheckpointCorruptionError` (on every rank) when the
     checkpoint exists but fails integrity verification."""
+    import time
+
+    t0 = time.perf_counter()
     target = path if step is None else os.path.join(path, f"step_{step}")
     rt = runtime.get_runtime_or_none()
     multi = rt is not None and rt.process_count > 1
@@ -277,6 +284,12 @@ def load_checkpoint(
         state = functions.broadcast_object(state, root_rank=0)
     if isinstance(state, _LoadError):
         state.raise_()
+    if state is not None:
+        from . import metrics
+
+        metrics.observe(
+            "checkpoint.restore_seconds", time.perf_counter() - t0
+        )
     return state
 
 
@@ -311,12 +324,15 @@ def latest_good_step(path: str) -> Optional[int]:
         if verify_checkpoint(target):
             if i > 0:
                 metrics.inc_counter("checkpoint.fallback")
+                events.emit(events.CHECKPOINT_FALLBACK, path=path,
+                            step=step, skipped=i)
                 log.warning(
                     "falling back to checkpoint step %d (%d newer "
                     "step(s) failed verification)", step, i,
                 )
             return step
         metrics.inc_counter("checkpoint.corrupt_detected")
+        events.emit(events.CHECKPOINT_CORRUPT, path=target, step=step)
         log.warning(
             "checkpoint step %d at %s failed verification; trying "
             "an earlier step", step, target,

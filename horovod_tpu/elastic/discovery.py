@@ -150,9 +150,11 @@ class HostManager:
                     "blacklist cooldown expired for host %s "
                     "(%d prior failure(s))", h, entry.failures,
                 )
-                from .. import metrics
+                from .. import events, metrics
 
                 metrics.inc_counter("elastic.unblacklist")
+                events.emit(events.UNBLACKLIST, host=h,
+                            failures=entry.failures)
 
     def update_available_hosts(self) -> bool:
         """Polls discovery; returns True when the usable set changed."""
@@ -186,9 +188,13 @@ class HostManager:
             )
             self._blacklist[hostname] = _BlacklistEntry(failures, until)
             self._current.pop(hostname, None)
-        from .. import metrics
+        from .. import events, metrics
 
         metrics.inc_counter("elastic.blacklist")
+        events.emit(
+            events.BLACKLIST, host=hostname, failures=failures,
+            permanent=(until == float("inf")),
+        )
 
     def _is_blacklisted_locked(self, hostname: str) -> bool:
         entry = self._blacklist.get(hostname)

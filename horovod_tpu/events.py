@@ -1,0 +1,173 @@
+"""Structured JSONL elastic event log.
+
+The reference's elastic story is reconstructed from interleaved stderr
+(driver warnings, worker tracebacks); a fault-injected run leaves no
+machine-readable record of *what happened in what order*.  This module
+gives every lifecycle transition a structured event — discovery change,
+blacklist/unblacklist, round start/end, worker crash-vs-hang verdict,
+round-watchdog timeout, checkpoint corruption fallback — appended as
+one JSON object per line to the file named by
+``HVD_TPU_ELASTIC_EVENT_LOG`` (``HOROVOD_`` prefix accepted, like every
+knob in ``utils/env.py``).
+
+Each event carries **both clocks**:
+
+* ``wall_ts`` — ``time.time()``, merges across processes/hosts (the
+  same epoch base the mergeable timeline uses), and
+* ``mono_ts`` — ``time.monotonic()``, orders events *within* a process
+  immune to NTP steps,
+
+plus ``pid``/``hostname``/``rank`` provenance, so a fault-injection run
+(``HVD_TPU_FAULT_PLAN``, PR 1) produces a replayable postmortem record:
+``read_events(path)`` returns the injected failure sequence in order.
+
+Writes are single ``write()`` calls on an append-mode handle, so
+driver and worker processes may share one log path (POSIX appends of
+one line interleave whole, not torn).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from .utils import env as hvd_env
+from .utils.logging import get_logger
+
+# Known event names (the schema's ``event`` field; emitters may add
+# more — the registry is open like the fault-injection sites).
+DISCOVERY_CHANGE = "discovery_change"
+BLACKLIST = "blacklist"
+UNBLACKLIST = "unblacklist"
+ROUND_START = "round_start"
+ROUND_END = "round_end"
+RESTART = "restart"
+WORKER_CRASH = "worker_crash"
+WORKER_HANG = "worker_hang"
+WATCHDOG_TIMEOUT = "watchdog_timeout"
+SPAWN_FAILED = "spawn_failed"
+CHECKPOINT_CORRUPT = "checkpoint_corrupt"
+CHECKPOINT_FALLBACK = "checkpoint_fallback"
+
+
+class EventLog:
+    """Append-only JSONL writer; one line per event, flushed per line
+    so a crashed process never leaves a torn tail beyond its last
+    complete event."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        self._fh = open(path, "a", buffering=1)
+        self._hostname = socket.gethostname()
+        self._seq = 0
+
+    def emit(self, event: str, **fields: Any) -> Dict[str, Any]:
+        record = {
+            "event": event,
+            "wall_ts": time.time(),
+            "mono_ts": time.monotonic(),
+            "pid": os.getpid(),
+            "hostname": self._hostname,
+            "rank": int(os.environ.get("HVD_TPU_CROSS_RANK", -1)),
+        }
+        record.update(fields)
+        with self._lock:
+            self._seq += 1
+            record["seq"] = self._seq
+            try:
+                self._fh.write(json.dumps(record, default=str) + "\n")
+            except ValueError:
+                pass  # closed under us during interpreter teardown
+        return record
+
+    def close(self) -> None:
+        with self._lock:
+            try:
+                self._fh.close()
+            except Exception:
+                pass
+
+
+_active: Optional[EventLog] = None
+_active_loaded = False
+_lock = threading.Lock()
+
+ELASTIC_EVENT_LOG = "ELASTIC_EVENT_LOG"
+
+
+def get_event_log() -> Optional[EventLog]:
+    """The process-wide log: installed via :func:`set_event_log`, else
+    opened once from ``HVD_TPU_ELASTIC_EVENT_LOG``.  None (the default)
+    makes :func:`emit` a no-op."""
+    global _active, _active_loaded
+    with _lock:
+        if not _active_loaded:
+            path = hvd_env.get_env(ELASTIC_EVENT_LOG)
+            if path:
+                try:
+                    _active = EventLog(path)
+                except OSError as e:
+                    get_logger().warning(
+                        "cannot open elastic event log %s: %s", path, e
+                    )
+                    _active = None
+            _active_loaded = True
+        return _active
+
+
+def set_event_log(log: Optional[EventLog]) -> Optional[EventLog]:
+    """Install (or, with None, disable) the process-wide log — tests
+    use this instead of mutating the environment."""
+    global _active, _active_loaded
+    with _lock:
+        if _active is not None and _active is not log:
+            _active.close()
+        _active = log
+        _active_loaded = True
+        return _active
+
+
+def reset() -> None:
+    """Forget the installed log; the next :func:`emit` re-reads the
+    environment."""
+    global _active, _active_loaded
+    with _lock:
+        if _active is not None:
+            _active.close()
+        _active = None
+        _active_loaded = False
+
+
+def emit(event: str, **fields: Any) -> None:
+    """Emit one structured event to the active log (no-op when no log
+    is configured).  Never raises — observability must not take down
+    the path it observes."""
+    log = get_event_log()
+    if log is None:
+        return
+    try:
+        log.emit(event, **fields)
+    except Exception as e:  # pragma: no cover - defensive
+        get_logger().warning("elastic event emit failed: %s", e)
+
+
+def read_events(path: str) -> List[Dict[str, Any]]:
+    """Parse a JSONL event log back into a list of event dicts,
+    skipping any torn final line (a crashed writer's last partial
+    write) — the postmortem reader."""
+    out: List[Dict[str, Any]] = []
+    with open(path) as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                out.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue
+    return out

@@ -1,21 +1,35 @@
-"""Metric averaging across ranks + local fault/retry counters.
+"""Metric averaging across ranks + the process-local metrics registry.
 
 Reference: ``MetricAverageCallback`` (``horovod/_keras/callbacks.py:49``)
 allreduce-averages epoch metrics so every rank logs the same numbers.
 
-The counter registry is the observability surface for the
-fault-tolerance path (``faults.py`` / ``utils/retry.py`` /
-``elastic/``): retries, blacklist/unblacklist events, worker
-crash-vs-hang verdicts, checkpoint corruption fallbacks.  Counters are
+The registry is the observability surface for the fault-tolerance and
+hot-path instrumentation (``faults.py`` / ``utils/retry.py`` /
+``elastic/`` / ``ops/eager.py``): three metric families, all
 process-local (the elastic driver and each worker keep their own) and
 deliberately dependency-free so the runner can bump them before any
-mesh exists.
+mesh exists:
+
+* **counters** — monotonically increasing (``retry.*.attempts``,
+  ``elastic.blacklist``, ``collective.allreduce.bytes``, ...)
+* **gauges** — last-write-wins values, optionally labeled
+  (``stall.stalled{op="allreduce.grad"}``)
+* **histograms** — fixed-bucket distributions (per-collective dispatch
+  latency, retry attempt latency, checkpoint write/restore time)
+
+Two export renderers: :func:`render_prometheus` (text exposition
+format, ``hvd_tpu_`` family prefix, scraped by the elastic driver's
+``/metrics`` endpoint — ``runner/telemetry_http.py``) and
+:func:`snapshot` / :func:`render_json` (the JSON form workers push
+through the KV store).
 """
 
 from __future__ import annotations
 
+import json
 import threading
-from typing import Any, Dict, Optional
+from bisect import bisect_left
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
@@ -25,6 +39,45 @@ from .process_sets import ProcessSet
 
 _counter_lock = threading.Lock()
 _counters: Dict[str, int] = {}
+# gauge key: (name, tuple(sorted(labels.items()))) -> float
+_gauges: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], float] = {}
+_histograms: Dict[str, "_Histogram"] = {}
+
+# Default bucket ladders (seconds / bytes), Prometheus-conventional.
+LATENCY_BUCKETS: Tuple[float, ...] = (
+    0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+BYTES_BUCKETS: Tuple[float, ...] = (
+    1 << 10, 1 << 14, 1 << 18, 1 << 20, 1 << 22, 1 << 24,
+    1 << 26, 1 << 28, 1 << 30,
+)
+
+
+class _Histogram:
+    """Fixed upper-bound buckets + sum + count (no lock of its own:
+    every mutation happens under the module lock)."""
+
+    __slots__ = ("bounds", "counts", "sum", "count")
+
+    def __init__(self, bounds: Sequence[float]):
+        self.bounds: Tuple[float, ...] = tuple(sorted(bounds))
+        self.counts: List[int] = [0] * (len(self.bounds) + 1)  # +inf slot
+        self.sum = 0.0
+        self.count = 0
+
+    def observe(self, value: float) -> None:
+        self.counts[bisect_left(self.bounds, value)] += 1
+        self.sum += value
+        self.count += 1
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "buckets": list(self.bounds),
+            "counts": list(self.counts),
+            "sum": self.sum,
+            "count": self.count,
+        }
 
 
 def inc_counter(name: str, value: int = 1) -> int:
@@ -52,13 +105,137 @@ def get_counters(prefix: str = "") -> Dict[str, int]:
 
 def reset_counters(prefix: str = "") -> None:
     """Clear counters (optionally only those under ``prefix``) — test
-    isolation hook."""
+    isolation hook.  Gauges and histograms under the prefix clear too
+    (one reset hook covers the whole registry)."""
     with _counter_lock:
-        if not prefix:
-            _counters.clear()
-        else:
-            for k in [k for k in _counters if k.startswith(prefix)]:
-                del _counters[k]
+        for store in (_counters, _histograms):
+            if not prefix:
+                store.clear()
+            else:
+                for k in [k for k in store if k.startswith(prefix)]:
+                    del store[k]
+        for key in [k for k in _gauges if k[0].startswith(prefix)]:
+            del _gauges[key]
+
+
+def set_gauge(name: str, value: float,
+              labels: Optional[Dict[str, str]] = None) -> None:
+    """Set a last-write-wins gauge.  ``labels`` makes one family carry
+    several series (e.g. the stall inspector's currently-stalled op
+    names, one series per op)."""
+    key = (name, tuple(sorted((labels or {}).items())))
+    with _counter_lock:
+        _gauges[key] = float(value)
+
+
+def get_gauge(name: str,
+              labels: Optional[Dict[str, str]] = None) -> Optional[float]:
+    key = (name, tuple(sorted((labels or {}).items())))
+    with _counter_lock:
+        return _gauges.get(key)
+
+
+def clear_gauge(name: str) -> None:
+    """Drop every series of a gauge family (used before re-publishing a
+    membership-style gauge so stale labeled series disappear)."""
+    with _counter_lock:
+        for key in [k for k in _gauges if k[0] == name]:
+            del _gauges[key]
+
+
+def observe(name: str, value: float,
+            buckets: Sequence[float] = LATENCY_BUCKETS) -> None:
+    """Record one observation into the named histogram (created on
+    first touch with ``buckets``; later calls reuse the existing
+    ladder)."""
+    with _counter_lock:
+        hist = _histograms.get(name)
+        if hist is None:
+            hist = _histograms[name] = _Histogram(buckets)
+        hist.observe(float(value))
+
+
+def get_histogram(name: str) -> Optional[Dict[str, Any]]:
+    with _counter_lock:
+        hist = _histograms.get(name)
+        return hist.to_dict() if hist else None
+
+
+def snapshot() -> Dict[str, Any]:
+    """JSON-serializable snapshot of the whole registry — the payload
+    elastic workers push to the driver through the KV store."""
+    with _counter_lock:
+        return {
+            "counters": dict(sorted(_counters.items())),
+            "gauges": [
+                {"name": k[0], "labels": dict(k[1]), "value": v}
+                for k, v in sorted(_gauges.items())
+            ],
+            "histograms": {
+                k: h.to_dict() for k, h in sorted(_histograms.items())
+            },
+        }
+
+
+def render_json() -> str:
+    return json.dumps(snapshot(), sort_keys=True)
+
+
+def _prom_name(name: str) -> str:
+    return "".join(
+        c if c.isalnum() or c == "_" else "_" for c in name
+    )
+
+
+def _prom_labels(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    def esc(v: Any) -> str:
+        return str(v).replace("\\", "\\\\").replace('"', '\\"')
+    inner = ",".join(
+        f'{_prom_name(k)}="{esc(v)}"' for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+def render_prometheus(snap: Optional[Dict[str, Any]] = None,
+                      prefix: str = "hvd_tpu",
+                      extra_labels: Optional[Dict[str, str]] = None) -> str:
+    """Prometheus text exposition of a registry snapshot (this
+    process's by default).  ``extra_labels`` stamps every series — the
+    driver uses ``{"rank": "<r>"}`` to fold worker pushes into one
+    scrape without name collisions."""
+    snap = snap if snap is not None else snapshot()
+    base = dict(extra_labels or {})
+    lines: List[str] = []
+    for name, value in snap.get("counters", {}).items():
+        fam = f"{prefix}_{_prom_name(name)}_total"
+        lines.append(f"# TYPE {fam} counter")
+        lines.append(f"{fam}{_prom_labels(base)} {value}")
+    for g in snap.get("gauges", []):
+        fam = f"{prefix}_{_prom_name(g['name'])}"
+        lines.append(f"# TYPE {fam} gauge")
+        lines.append(
+            f"{fam}{_prom_labels({**base, **g.get('labels', {})})} "
+            f"{g['value']}"
+        )
+    for name, h in snap.get("histograms", {}).items():
+        fam = f"{prefix}_{_prom_name(name)}"
+        lines.append(f"# TYPE {fam} histogram")
+        cumulative = 0
+        for bound, n in zip(h["buckets"], h["counts"]):
+            cumulative += n
+            lines.append(
+                f"{fam}_bucket{_prom_labels({**base, 'le': repr(float(bound))})} "
+                f"{cumulative}"
+            )
+        lines.append(
+            f"{fam}_bucket{_prom_labels({**base, 'le': '+Inf'})} "
+            f"{h['count']}"
+        )
+        lines.append(f"{fam}_sum{_prom_labels(base)} {h['sum']}")
+        lines.append(f"{fam}_count{_prom_labels(base)} {h['count']}")
+    return "\n".join(lines) + "\n"
 
 
 def metric_average(value: Any, process_set: Optional[ProcessSet] = None) -> Any:

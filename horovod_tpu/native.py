@@ -243,7 +243,7 @@ class ResponseCache:
 class NativeTimeline:
     """Native chrome-tracing writer (preferred over the Python one)."""
 
-    def __init__(self, path: str):
+    def __init__(self, path: str, rank: Optional[int] = None):
         self._lib = load()
         if self._lib is None:
             raise RuntimeError("native core unavailable")
@@ -253,6 +253,26 @@ class NativeTimeline:
         import time
 
         self._t0 = time.perf_counter()
+        # Merge metadata (tools/merge_timeline.py): the C writer has a
+        # fixed event ABI with no args payload, so rank + wall-clock
+        # epoch base go to a JSON sidecar instead of an in-band
+        # HVD_PROC_META event (utils/timeline.py writes that form).
+        import json
+        import socket
+
+        from .utils.timeline import _resolve_rank
+
+        self.rank = _resolve_rank() if rank is None else int(rank)
+        try:
+            with open(path + ".hvdmeta.json", "w") as fh:
+                json.dump({
+                    "rank": self.rank,
+                    "hostname": socket.gethostname(),
+                    "pid": os.getpid(),
+                    "epoch_wall_us": time.time() * 1e6,
+                }, fh)
+        except OSError:
+            pass  # merge falls back to positional lanes
 
     def _now_us(self) -> int:
         import time

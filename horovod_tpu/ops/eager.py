@@ -82,6 +82,30 @@ def _record(name: Optional[str], op: str, nbytes: int):
     tl = get_runtime().timeline
     if tl is not None:
         tl.record_op(name or op, op, nbytes)
+    from .. import metrics
+
+    key = op.lower()
+    metrics.inc_counter(f"collective.{key}.dispatches")
+    metrics.inc_counter(f"collective.{key}.bytes", int(nbytes))
+    metrics.observe(f"collective.{key}.bytes_hist", float(nbytes),
+                    buckets=metrics.BYTES_BUCKETS)
+
+
+def _timed(op: str, dispatch, *args):
+    """Run one compiled dispatch, feeding the per-collective latency
+    histogram (host-side enqueue cost: trace/compile on a cache miss,
+    async dispatch on a hit — the number the /metrics scrape exposes)."""
+    import time as _time
+
+    from .. import metrics
+
+    t0 = _time.perf_counter()
+    out = dispatch(*args)
+    metrics.observe(
+        f"collective.{op.lower()}.dispatch_seconds",
+        _time.perf_counter() - t0,
+    )
+    return out
 
 
 # numeric wire ids for dtypes crossing hvd_wire_encode_request's u8 slot
@@ -374,7 +398,8 @@ def allreduce(
         ("postscale_factor", float(postscale_factor)),
         ("process_set_id", _ps_id(process_set)),
     )
-    return _delocalize(_jitted("allreduce", static)(x), was_local)
+    return _delocalize(_timed("ALLREDUCE", _jitted("allreduce", static), x),
+                       was_local)
 
 
 def allreduce_async(*args, name: Optional[str] = None, **kwargs) -> Handle:
@@ -417,7 +442,8 @@ def grouped_allreduce(
         ("process_set_id", _ps_id(process_set)),
         ("n_tensors", len(xs)),
     )
-    outs = _jitted("grouped_allreduce", static)(*xs)
+    outs = _timed("GROUPED_ALLREDUCE", _jitted("grouped_allreduce", static),
+                  *xs)
     return [_delocalize(o, p[1]) for o, p in zip(outs, pairs)]
 
 
@@ -440,7 +466,8 @@ def allgather(
     static = (
         ("process_set_id", _ps_id(process_set)),
     )
-    return _delocalize(_jitted("allgather", static)(x), was_local)
+    return _delocalize(_timed("ALLGATHER", _jitted("allgather", static), x),
+                       was_local)
 
 
 def allgather_async(x, name: Optional[str] = None, **kwargs) -> Handle:
@@ -550,7 +577,8 @@ def broadcast(
         ("root_rank", int(root_rank)),
         ("process_set_id", _ps_id(process_set)),
     )
-    return _delocalize(_jitted("broadcast", static)(x), was_local)
+    return _delocalize(_timed("BROADCAST", _jitted("broadcast", static), x),
+                       was_local)
 
 
 def broadcast_async(x, root_rank, name: Optional[str] = None, **kwargs) -> Handle:
@@ -571,7 +599,9 @@ def reducescatter(
         ("op", op),
         ("process_set_id", _ps_id(process_set)),
     )
-    return _delocalize(_jitted("reducescatter", static)(x), was_local)
+    return _delocalize(
+        _timed("REDUCESCATTER", _jitted("reducescatter", static), x),
+        was_local)
 
 
 def alltoall(
@@ -602,7 +632,8 @@ def alltoall(
         static = (
             ("process_set_id", _ps_id(process_set)),
         )
-        return _delocalize(_jitted("alltoall", static)(x), was_local)
+        return _delocalize(_timed("ALLTOALL", _jitted("alltoall", static), x),
+                           was_local)
 
     # Uneven splits, any process set: the reference negotiates
     # recvsplits through the controller for arbitrary sets
@@ -649,7 +680,8 @@ def alltoall(
     static = (
         ("process_set_id", _ps_id(process_set)),
     )
-    out = _delocalize(_jitted("alltoall", static)(gathered), was_local)
+    out = _delocalize(_timed("ALLTOALL", _jitted("alltoall", static),
+                             gathered), was_local)
     # recv_splits in world-rank rows: member rows get splits.T[m]
     # (rows member m receives from each member), non-members zeros.
     recv_world = np.zeros((n, k), dtype=splits.dtype)

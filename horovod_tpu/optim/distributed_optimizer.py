@@ -597,6 +597,11 @@ class TrainStep:
         tl = rt.timeline
         if tl is not None:
             tl.begin("TrainStep", "STEP")
+        import time as _time
+
+        from .. import metrics as _metrics
+
+        _t0 = _time.perf_counter()
         try:
             # Tracing for a new cache entry happens inside this call, so
             # the candidate threshold (and lowering/wire choices) must
@@ -631,6 +636,12 @@ class TrainStep:
             fusion.set_threshold_override(None)
             traced.set_hierarchical_override(None)
             set_quantized_override(None)
+            # Dispatch latency, not device latency: the step returns
+            # futures (async dispatch); a cache miss shows the compile.
+            _metrics.observe(
+                "train.step_seconds", _time.perf_counter() - _t0
+            )
+            _metrics.inc_counter("train.steps")
             if tl is not None:
                 tl.end("TrainStep", "STEP")
                 if self._mark_cycles:

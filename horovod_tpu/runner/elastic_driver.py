@@ -35,7 +35,7 @@ import threading
 import time
 from typing import Callable, Dict, List, Optional
 
-from .. import faults, metrics
+from .. import events, faults, metrics
 from ..elastic.discovery import HostManager
 from ..utils import env as hvd_env
 from ..utils.logging import get_logger
@@ -47,6 +47,12 @@ from .launch import free_port, make_worker_env
 RESTART_CODE = 73
 
 DISCOVERY_PERIOD_S = 1.0  # reference driver.py:30
+
+# HTTP /metrics + /health endpoint (runner/telemetry_http.py): set
+# HVD_TPU_TELEMETRY_PORT to enable (0 = OS-assigned port); unset
+# disables.  Workers feed it by pushing metric snapshots through the
+# rendezvous KV (__metrics__/rank_<r>, elastic_worker.py heartbeat).
+TELEMETRY_PORT = "TELEMETRY_PORT"
 
 # Health-monitor knobs (HVD_TPU_/HOROVOD_ prefixed via utils.env):
 # a worker that registered a heartbeat and then went silent this long
@@ -100,6 +106,7 @@ class ElasticDriver:
         hang_timeout_s: Optional[float] = None,
         round_timeout_s: Optional[float] = None,
         spawn_retry: Optional[RetryPolicy] = None,
+        telemetry_port: Optional[int] = None,
     ):
         self.host_manager = host_manager
         self.min_np = min_np
@@ -120,10 +127,18 @@ class ElasticDriver:
             max_delay_s=2.0,
             name="elastic.spawn",
         )
+        if telemetry_port is None:
+            raw = hvd_env.get_env(TELEMETRY_PORT)
+            telemetry_port = int(raw) if raw not in (None, "") else None
+        self.telemetry_port = telemetry_port
         self.rounds = 0
         self._shutdown = threading.Event()
         self._membership_changed = threading.Event()
         self._discovery_thread: Optional[threading.Thread] = None
+        self._telemetry = None
+        # round state read by the /health endpoint
+        self._last_assignments: List[hosts_mod.SlotInfo] = []
+        self._round_active = False
 
     # -- discovery loop (reference driver.py:181) ------------------------
     def start_discovery(self) -> None:
@@ -132,8 +147,16 @@ class ElasticDriver:
                 try:
                     if self.host_manager.update_available_hosts():
                         self._membership_changed.set()
+                        events.emit(
+                            events.DISCOVERY_CHANGE,
+                            hosts=self.host_manager.current_hosts,
+                        )
                 except Exception as e:  # discovery script hiccup
                     get_logger().warning("host discovery failed: %s", e)
+                metrics.set_gauge(
+                    "elastic.available_slots",
+                    self.host_manager.available_slots(),
+                )
                 self._shutdown.wait(DISCOVERY_PERIOD_S)
 
         self.host_manager.update_available_hosts()
@@ -228,6 +251,8 @@ class ElasticDriver:
         )
         for (scope, key), blob in (publish or {}).items():
             control.put(scope, key, blob)
+        if self.telemetry_port is not None:
+            self._telemetry = self._start_telemetry(control)
         try:
             while True:
                 if not self.wait_for_available_slots(self.min_np):
@@ -241,6 +266,15 @@ class ElasticDriver:
                 self.rounds += 1
                 round_id = self.rounds
                 metrics.inc_counter("elastic.rounds")
+                metrics.set_gauge("elastic.round", round_id)
+                metrics.set_gauge("elastic.workers", len(assignments))
+                self._last_assignments = assignments
+                self._round_active = True
+                events.emit(
+                    events.ROUND_START, round=round_id,
+                    np=len(assignments),
+                    hosts=sorted({a.hostname for a in assignments}),
+                )
                 self._membership_changed.clear()
                 control.put("__elastic__", "round", str(round_id).encode())
                 control.put("__elastic__", f"round_{round_id}_np",
@@ -303,6 +337,11 @@ class ElasticDriver:
                             "worker spawn on %s failed: %s",
                             slot.hostname, e,
                         )
+                        events.emit(
+                            events.SPAWN_FAILED, round=round_id,
+                            host=slot.hostname, worker_rank=slot.rank,
+                            error=str(e),
+                        )
                         spawn_failed_host = slot.hostname
                         break
                 if spawn_failed_host is not None:
@@ -316,6 +355,11 @@ class ElasticDriver:
                         continue
                     return 1
                 rc = self._watch_round(workers, assignments, control, round_id)
+                self._round_active = False
+                events.emit(
+                    events.ROUND_END, round=round_id, exit_code=rc,
+                    restart=(rc == RESTART_CODE),
+                )
                 if rc == 0:
                     if result_collector is not None:
                         result_collector(
@@ -323,6 +367,7 @@ class ElasticDriver:
                         )
                     return 0
                 if rc == RESTART_CODE:
+                    events.emit(events.RESTART, round=round_id)
                     if (
                         self.reset_limit is not None
                         and self.rounds > self.reset_limit
@@ -339,6 +384,9 @@ class ElasticDriver:
                     continue
                 return rc
         finally:
+            if self._telemetry is not None:
+                self._telemetry.stop()
+                self._telemetry = None
             control.close()
             server.stop()
             self.stop()
@@ -348,6 +396,50 @@ class ElasticDriver:
                 import shutil
 
                 shutil.rmtree(created_cache_dir, ignore_errors=True)
+
+    def _start_telemetry(self, control):
+        """Start the HTTP /metrics + /health endpoint for this job.
+
+        ``/metrics`` folds in the latest snapshot each worker pushed
+        through the KV store; ``/health`` reports round/membership
+        state.  Scrape-time only — zero cost to the driver loop."""
+        import json as _json
+
+        from .telemetry_http import TelemetryServer
+
+        def workers_fn():
+            out = []
+            for slot in list(self._last_assignments):
+                try:
+                    raw = control.get(
+                        "__metrics__", f"rank_{slot.rank}", timeout_ms=0
+                    )
+                except Exception:
+                    raw = None
+                if raw:
+                    try:
+                        out.append((slot.rank, _json.loads(raw)))
+                    except ValueError:
+                        pass
+            return out
+
+        def health_fn():
+            slots = self.host_manager.available_slots()
+            return {
+                "status": "ok" if slots >= self.min_np else "degraded",
+                "round": self.rounds,
+                "round_active": self._round_active,
+                "workers": len(self._last_assignments),
+                "min_np": self.min_np,
+                "max_np": self.max_np,
+                "available_slots": slots,
+                "current_hosts": self.host_manager.current_hosts,
+            }
+
+        return TelemetryServer(
+            port=self.telemetry_port, health_fn=health_fn,
+            workers_fn=workers_fn,
+        )
 
     def _watch_round(
         self,
@@ -382,6 +474,11 @@ class ElasticDriver:
         def _fail_worker(i: int, why: str) -> None:
             nonlocal saw_failure, pending
             metrics.inc_counter(f"elastic.worker_{why}")
+            events.emit(
+                events.WORKER_CRASH if why == "crash" else events.WORKER_HANG,
+                round=round_id, worker_rank=assignments[i].rank,
+                host=assignments[i].hostname, verdict=why,
+            )
             self.host_manager.blacklist(assignments[i].hostname)
             # a dead peer wedges collectives: end the round
             for j in pending:
@@ -445,6 +542,10 @@ class ElasticDriver:
                     "restarting", round_id, self.round_timeout_s,
                 )
                 metrics.inc_counter("elastic.round_timeout")
+                events.emit(
+                    events.WATCHDOG_TIMEOUT, round=round_id,
+                    timeout_s=self.round_timeout_s,
+                )
                 for j in pending:
                     workers[j].terminate()
                 for j in pending:

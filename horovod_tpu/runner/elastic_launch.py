@@ -25,7 +25,8 @@ def launch_elastic(args: argparse.Namespace) -> int:
         )
     min_np = args.min_np or args.np
     driver = ElasticDriver(
-        HostManager(discovery), min_np=min_np, max_np=args.max_np
+        HostManager(discovery), min_np=min_np, max_np=args.max_np,
+        telemetry_port=getattr(args, "telemetry_port", None),
     )
     driver.start_discovery()
     return driver.run_rounds(args.command, extra_env=env_from_args(args))

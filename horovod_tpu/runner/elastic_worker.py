@@ -83,6 +83,7 @@ class WorkerNotificationManager:
         self._hb_thread.start()
 
     def _heartbeat(self) -> None:
+        from .. import metrics
         from ..faults import inject
 
         seq = 0
@@ -94,6 +95,13 @@ class WorkerNotificationManager:
                 if client is None:
                     return
                 client.put("__elastic__", key, str(seq).encode())
+                # Piggyback the telemetry push on the heartbeat: the
+                # driver's /metrics endpoint folds the latest snapshot
+                # per rank into its scrape (telemetry_http.py).
+                client.put(
+                    "__metrics__", f"rank_{self.rank}",
+                    metrics.render_json().encode(),
+                )
             except Exception:
                 pass  # KV blips must never kill the worker
             # a 'hang' fault here freezes the heartbeat AFTER it

@@ -289,6 +289,14 @@ def parse_args(argv: Optional[List[str]] = None) -> argparse.Namespace:
     # knob flags -> env (reference config_parser.py maps flags to env)
     parser.add_argument("--fusion-threshold-mb", type=int)
     parser.add_argument("--timeline-filename")
+    parser.add_argument("--timeline-mark-cycles", action="store_true",
+                        help="mark each train-step cycle on the timeline "
+                        "(reference HOROVOD_TIMELINE_MARK_CYCLES; maps to "
+                        "HVD_TPU_TIMELINE_MARK_CYCLES)")
+    parser.add_argument("--telemetry-port", type=int, default=None,
+                        help="serve HTTP /metrics + /health from the "
+                        "elastic driver on this port (0 = OS-assigned; "
+                        "maps to HVD_TPU_TELEMETRY_PORT)")
     parser.add_argument("--autotune", action="store_true")
     parser.add_argument("--autotune-log-file")
     parser.add_argument("--log-level")
@@ -345,6 +353,8 @@ def env_from_args(args: argparse.Namespace) -> Dict[str, str]:
         env["HVD_TPU_FUSION_THRESHOLD"] = str(args.fusion_threshold_mb << 20)
     if args.timeline_filename:
         env["HVD_TPU_TIMELINE"] = args.timeline_filename
+    if getattr(args, "timeline_mark_cycles", False):
+        env["HVD_TPU_TIMELINE_MARK_CYCLES"] = "1"
     if args.autotune:
         env["HVD_TPU_AUTOTUNE"] = "1"
     if args.autotune_log_file:

@@ -1,0 +1,129 @@
+"""Lightweight HTTP ``/metrics`` + ``/health`` endpoint for the elastic
+driver.
+
+The reference driver has no health or metrics surface at all — the only
+way to know an elastic job's state is to grep its stderr.  This server
+gives the driver process a scrapeable surface:
+
+* ``GET /metrics`` — Prometheus text format: the driver's own registry
+  (``horovod_tpu.metrics``) plus, via ``workers_fn``, the latest
+  snapshot each worker pushed through the existing KV store
+  (``__metrics__/rank_<r>``, pushed by the heartbeat thread in
+  ``elastic_worker.py``), every worker series labeled ``rank="<r>"``.
+* ``GET /health`` — JSON from ``health_fn`` (round number, live
+  workers, blacklist, available slots), HTTP 200/503 by its
+  ``"status"`` field.
+
+Built on ``http.server.ThreadingHTTPServer`` — stdlib only, daemon
+threads, zero hot-path cost (everything is rendered at scrape time).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .. import metrics
+from ..utils.logging import get_logger
+
+PROMETHEUS_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "hvd-tpu-telemetry/1.0"
+
+    def log_message(self, fmt, *args):  # stderr silence: we have logging
+        get_logger().debug("telemetry http: " + fmt, *args)
+
+    def _send(self, code: int, body: bytes, content_type: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        srv: "TelemetryServer" = self.server.telemetry  # type: ignore[attr-defined]
+        try:
+            if self.path.split("?")[0] == "/metrics":
+                self._send(200, srv.render_metrics().encode(),
+                           PROMETHEUS_CONTENT_TYPE)
+            elif self.path.split("?")[0] == "/health":
+                payload = srv.render_health()
+                code = 200 if payload.get("status", "ok") == "ok" else 503
+                self._send(code, json.dumps(payload).encode(),
+                           "application/json")
+            else:
+                self._send(404, b"not found: try /metrics or /health\n",
+                           "text/plain")
+        except Exception as e:  # a scrape must never kill the server
+            self._send(500, f"telemetry error: {e}\n".encode(),
+                       "text/plain")
+
+
+class _QuietHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+
+    def handle_error(self, request, client_address):
+        # A scraper disconnecting mid-response (timeout, page reload)
+        # is routine — log it instead of stack-tracing to stderr.
+        import sys
+
+        get_logger().debug(
+            "telemetry http client error from %s: %s",
+            client_address, sys.exc_info()[1],
+        )
+
+
+class TelemetryServer:
+    """Owns the listening socket; ``health_fn`` and ``workers_fn`` are
+    called per scrape (both optional)."""
+
+    def __init__(
+        self,
+        port: int = 0,
+        bind_host: str = "0.0.0.0",
+        health_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+        workers_fn: Optional[
+            Callable[[], List[Tuple[int, Dict[str, Any]]]]
+        ] = None,
+    ):
+        self.health_fn = health_fn
+        self.workers_fn = workers_fn
+        self._server = _QuietHTTPServer((bind_host, port), _Handler)
+        self._server.telemetry = self  # type: ignore[attr-defined]
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name="hvd_tpu_telemetry_http",
+        )
+        self._thread.start()
+        get_logger().info(
+            "telemetry endpoint on :%d (/metrics, /health)", self.port
+        )
+
+    def render_metrics(self) -> str:
+        parts = [metrics.render_prometheus()]
+        if self.workers_fn is not None:
+            for rank, snap in self.workers_fn():
+                try:
+                    parts.append(metrics.render_prometheus(
+                        snap, extra_labels={"rank": str(rank)}
+                    ))
+                except Exception as e:
+                    get_logger().warning(
+                        "bad worker metrics push from rank %s: %s",
+                        rank, e,
+                    )
+        return "".join(parts)
+
+    def render_health(self) -> Dict[str, Any]:
+        if self.health_fn is None:
+            return {"status": "ok"}
+        return self.health_fn()
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

@@ -82,14 +82,21 @@ class Runtime:
         self.timeline = None
         timeline_path = env.get_env(env.TIMELINE)
         if timeline_path:
+            if self.process_count > 1:
+                # One trace per process: a shared filesystem (or local
+                # multi-worker) must not clobber; the per-rank files
+                # merge with tools/merge_timeline.py.
+                timeline_path = f"{timeline_path}.rank{self.rank}"
             from . import native
 
             if native.available():
-                self.timeline = native.NativeTimeline(timeline_path)
+                self.timeline = native.NativeTimeline(
+                    timeline_path, rank=self.rank
+                )
             else:
                 from .utils.timeline import Timeline
 
-                self.timeline = Timeline(timeline_path)
+                self.timeline = Timeline(timeline_path, rank=self.rank)
         # Stall watchdog over blocking waits (reference stall_inspector.cc,
         # warn default 60 s, stall_inspector.h:78). Disabled like the
         # reference via HOROVOD_STALL_CHECK_DISABLE.

@@ -32,6 +32,11 @@ STALL_CHECK_TIME_SECONDS = "STALL_CHECK_TIME_SECONDS"
 STALL_SHUTDOWN_TIME_SECONDS = "STALL_SHUTDOWN_TIME_SECONDS"
 ELASTIC_ENABLED = "ELASTIC"
 ELASTIC_TIMEOUT = "ELASTIC_TIMEOUT"
+# Structured JSONL elastic event log path (events.py).
+ELASTIC_EVENT_LOG = "ELASTIC_EVENT_LOG"
+# Elastic driver HTTP /metrics + /health port (0 = OS-assigned;
+# unset = disabled) — runner/telemetry_http.py.
+TELEMETRY_PORT = "TELEMETRY_PORT"
 START_TIMEOUT = "START_TIMEOUT"
 DISABLE_GROUP_FUSION = "DISABLE_GROUP_FUSION"
 DYNAMIC_PROCESS_SETS = "DYNAMIC_PROCESS_SETS"

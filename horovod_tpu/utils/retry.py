@@ -103,13 +103,24 @@ class RetryPolicy:
         last: Optional[BaseException] = None
         for attempt in range(1, self.max_attempts + 1):
             metrics.inc_counter(f"retry.{self.name}.attempts")
+            t0 = time.perf_counter()
             try:
                 if self.attempt_timeout_s is not None:
-                    return _run_with_timeout(
+                    result = _run_with_timeout(
                         fn, args, kwargs, self.attempt_timeout_s
                     )
-                return fn(*args, **kwargs)
+                else:
+                    result = fn(*args, **kwargs)
+                metrics.observe(
+                    f"retry.{self.name}.attempt_seconds",
+                    time.perf_counter() - t0,
+                )
+                return result
             except self.retry_on + (RetryTimeoutError,) as e:
+                metrics.observe(
+                    f"retry.{self.name}.attempt_seconds",
+                    time.perf_counter() - t0,
+                )
                 last = e
                 if attempt == self.max_attempts:
                     break

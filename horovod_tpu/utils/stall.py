@@ -120,14 +120,25 @@ class StallWatchdog:
         self._warned.discard(name)
 
     def _loop(self) -> None:
+        from .. import metrics
+
         while not self._stop.wait(self._poll):
             try:
                 stalled, shutdown = self.inspector.report()
             except Exception:
                 return  # inspector closed under us during shutdown
+            # Export the report through the registry so stalls reach
+            # /metrics, not just stderr: a count gauge plus one labeled
+            # series per currently-stalled op name.
+            current = sorted({s.split("#", 1)[0] for s in stalled})
+            metrics.set_gauge("stall.current_stalled", len(current))
+            metrics.clear_gauge("stall.stalled")
+            for op in current:
+                metrics.set_gauge("stall.stalled", 1, labels={"op": op})
             fresh = [s for s in stalled if s not in self._warned]
             if fresh:
                 self._warned.update(fresh)
+                metrics.inc_counter("stall.warnings", len(fresh))
                 display = sorted({s.split("#", 1)[0] for s in fresh})
                 get_logger().warning(
                     "One or more collectives stalled for over %.0fs. "

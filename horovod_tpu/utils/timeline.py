@@ -20,21 +20,75 @@ import time
 from typing import Optional
 
 
-class Timeline:
-    """Background-thread JSON writer, mirroring ``TimelineWriter``."""
+def _resolve_rank() -> int:
+    """Best-effort rank for the process-metadata lane: the runtime's
+    when initialized, the launcher env otherwise (timelines can start
+    before ``hvd.init()``)."""
+    try:
+        from ..runtime import get_runtime_or_none
 
-    def __init__(self, path: str):
+        rt = get_runtime_or_none()
+        if rt is not None:
+            return rt.rank
+    except Exception:
+        pass
+    return int(os.environ.get("HVD_TPU_CROSS_RANK", "0") or 0)
+
+
+class Timeline:
+    """Background-thread JSON writer, mirroring ``TimelineWriter``.
+
+    Mergeable across ranks: the first events are Chrome-trace metadata
+    (process/thread names, sort index) plus one ``HVD_PROC_META``
+    instant carrying this process's **wall-clock epoch base** in
+    microseconds — ``ts`` values stay relative (cheap perf_counter
+    deltas on the hot path) and ``tools/merge_timeline.py`` re-bases N
+    per-rank traces onto the shared wall clock using that epoch.
+    """
+
+    def __init__(self, path: str, rank: Optional[int] = None,
+                 queue_size: int = 1_000_000):
         self.path = path
-        self._queue: "queue.Queue" = queue.Queue(maxsize=1_000_000)
+        self._queue: "queue.Queue" = queue.Queue(maxsize=queue_size)
+        # Two clocks sampled back to back: perf_counter anchors relative
+        # ts, time.time() anchors the merge across processes.
         self._start = time.perf_counter()
+        self._epoch_wall_us = time.time() * 1e6
+        self.rank = _resolve_rank() if rank is None else int(rank)
+        self._drop_logged = False
         self._closed = threading.Event()
-        self._fh = open(path, "w")
+        # Line-buffered: a worker killed mid-round (crash, driver
+        # terminate) leaves every completed event on disk, so the trace
+        # is salvageable for the postmortem merge.
+        self._fh = open(path, "w", buffering=1)
         self._fh.write("[\n")
         self._first = True
         self._thread = threading.Thread(
             target=self._drain, name="hvd_tpu_timeline", daemon=True
         )
         self._thread.start()
+        self._emit_process_metadata()
+
+    def _emit_process_metadata(self) -> None:
+        import socket
+
+        pid = os.getpid()
+        hostname = socket.gethostname()
+        self._put({"name": "process_name", "ph": "M", "pid": pid,
+                   "args": {"name": f"rank {self.rank} ({hostname})"}})
+        self._put({"name": "process_sort_index", "ph": "M", "pid": pid,
+                   "args": {"sort_index": self.rank}})
+        for tid, lane in ((0, "dispatch"), (1, "measured")):
+            self._put({"name": "thread_name", "ph": "M", "pid": pid,
+                       "tid": tid, "args": {"name": lane}})
+        self._put({
+            "name": "HVD_PROC_META", "ph": "i", "ts": 0.0, "s": "p",
+            "pid": pid, "tid": 0,
+            "args": {
+                "rank": self.rank, "hostname": hostname, "pid": pid,
+                "epoch_wall_us": self._epoch_wall_us,
+            },
+        })
 
     def _now_us(self) -> float:
         return (time.perf_counter() - self._start) * 1e6
@@ -98,7 +152,20 @@ class Timeline:
         try:
             self._queue.put_nowait(event)
         except queue.Full:
-            pass  # drop like the reference's bounded lockfree queue
+            # Drop like the reference's bounded lockfree queue — but
+            # visibly: a truncated trace must be diagnosable.
+            from .. import metrics
+
+            metrics.inc_counter("timeline.dropped_events")
+            if not self._drop_logged:
+                self._drop_logged = True
+                from .logging import get_logger
+
+                get_logger().warning(
+                    "timeline writer backlog full; dropping events "
+                    "(see the timeline.dropped_events counter for the "
+                    "total — the trace at %s is incomplete)", self.path,
+                )
 
     def _drain(self) -> None:
         # The writer thread owns the file handle end to end: it drains the
@@ -146,6 +213,98 @@ def stop_timeline() -> None:
     if rt.timeline is not None:
         rt.timeline.close()
         rt.timeline = None
+
+
+# ---- cross-rank merge (tools/merge_timeline.py CLI) ----------------------
+
+
+def _load_trace_events(path: str) -> list:
+    """Read one trace file: a bare JSON array (this writer's format) or
+    a ``{"traceEvents": [...]}`` object (Chrome's).
+
+    A trace whose writer died mid-job (worker crash, driver terminate)
+    has no closing bracket; the Chrome trace format itself permits that
+    for exactly this reason, so fall back to salvaging the complete
+    events line by line (this writer emits one event per line)."""
+    with open(path) as fh:
+        text = fh.read()
+    try:
+        data = json.loads(text)
+    except json.JSONDecodeError:
+        events = []
+        for line in text.splitlines():
+            line = line.strip().rstrip(",").strip()
+            if line in ("[", "]", ""):
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # the torn tail of the last write
+        return events
+    if isinstance(data, dict):
+        return list(data.get("traceEvents", []))
+    return list(data)
+
+
+def merge_timeline_files(paths) -> dict:
+    """Align N per-rank traces into one Chrome trace with per-rank
+    lanes.
+
+    Each file's ``HVD_PROC_META`` event supplies its rank and
+    wall-clock epoch base; every ``ts`` is re-based to the earliest
+    epoch across files so concurrent collectives line up even when the
+    per-process ``perf_counter`` zeros (and wall clocks) are skewed.
+    Lanes: ``pid`` is rewritten to the rank (with matching
+    ``process_sort_index``), so Perfetto orders lanes rank 0..N-1
+    top-down.  Files without metadata (pre-merge traces) fall back to
+    their position in ``paths`` with a zero epoch, and merge with a
+    warning rather than failing the whole postmortem.
+    """
+    from .logging import get_logger
+
+    loaded = []  # (rank, epoch_wall_us, events)
+    for i, path in enumerate(paths):
+        events = _load_trace_events(path)
+        meta = next(
+            (e for e in events if e.get("name") == "HVD_PROC_META"), None
+        )
+        if meta is not None:
+            args = meta["args"]
+        else:
+            # Native-core traces carry the merge metadata in a JSON
+            # sidecar (the C writer's event ABI has no args payload).
+            args = None
+            try:
+                with open(path + ".hvdmeta.json") as fh:
+                    args = json.load(fh)
+            except (OSError, ValueError):
+                pass
+        if args is None:
+            get_logger().warning(
+                "%s has no HVD_PROC_META event or .hvdmeta.json "
+                "sidecar; assuming rank %d with epoch 0 (timestamps "
+                "will not align across files)", path, i,
+            )
+            rank, epoch = i, 0.0
+        else:
+            rank = int(args.get("rank", i))
+            epoch = float(args.get("epoch_wall_us", 0.0))
+        loaded.append((rank, epoch, events))
+
+    base = min((epoch for _, epoch, _ in loaded), default=0.0)
+    merged: list = []
+    for rank, epoch, events in sorted(loaded, key=lambda t: t[0]):
+        offset = epoch - base
+        for e in events:
+            e = dict(e)
+            e["pid"] = rank
+            if e.get("ph") == "M":
+                if e.get("name") == "process_sort_index":
+                    e["args"] = {"sort_index": rank}
+            elif "ts" in e:
+                e["ts"] = float(e["ts"]) + offset
+            merged.append(e)
+    return {"traceEvents": merged, "displayTimeUnit": "ms"}
 
 
 # ---- measured per-bucket durations (reference timeline.cc activity

@@ -516,3 +516,171 @@ def test_corrupt_checkpoint_falls_back_in_elastic_context(tmp_path):
         assert got["checkpoint.fallback"] >= 1
     finally:
         hvd.shutdown()
+
+
+# ---- unified telemetry: the PR-2 acceptance scenario ------------------
+
+TELEMETRY_WORKER_SCRIPT = textwrap.dedent(
+    """
+    import os, pickle, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    from horovod_tpu import faults, metrics
+    from horovod_tpu.runner import elastic_worker
+    from horovod_tpu.utils.timeline import Timeline
+
+    round_id = int(os.environ["HVD_TPU_ELASTIC_ROUND"])
+    rank = int(os.environ["HVD_TPU_CROSS_RANK"])
+    size = int(os.environ["HVD_TPU_CROSS_SIZE"])
+    host = os.environ["HVD_TPU_HOSTNAME"]
+
+    mgr = elastic_worker.get_notification_manager()
+    mgr.init()  # KV connect + heartbeats (which push metric snapshots)
+
+    tl = Timeline(
+        os.environ["TRACE_DIR"] + f"/timeline.rank{rank}.json", rank=rank
+    )
+    blob = mgr.load_state_blob()
+    epoch = pickle.loads(blob) if blob else 0
+    target = int(os.environ.get("TARGET_EPOCHS", "6"))
+    while epoch < target:
+        time.sleep(float(os.environ.get("EPOCH_SECS", "0.4")))
+        faults.inject("worker.step", rank=rank, round=round_id,
+                      host=host, epoch=epoch)
+        epoch += 1
+        metrics.inc_counter("train.steps")
+        metrics.observe("train.step_seconds", 0.4)
+        tl.record_op(f"epoch{epoch}", "STEP", 0)
+        mgr.save_state_blob(pickle.dumps(epoch))
+    tl.close()
+    mgr.close()
+    """
+)
+
+
+@pytest.mark.faults
+def test_fault_injected_run_produces_postmortem_record(tmp_path):
+    """PR-2 acceptance criteria end to end: one fault-injected elastic
+    run (PR 1's HVD_TPU_FAULT_PLAN) yields (1) per-rank timelines that
+    merge into a valid Chrome trace with rank lanes, (2) a live
+    Prometheus scrape from the driver's /metrics endpoint carrying
+    hvd_tpu_ counter/gauge/histogram families (driver-local and
+    worker-pushed), and (3) a JSONL elastic event log that reconstructs
+    the injected failure sequence in order."""
+    import json as _json
+    import urllib.request
+
+    from horovod_tpu import events
+
+    metrics.reset_counters()
+    event_log = str(tmp_path / "elastic_events.jsonl")
+    events.set_event_log(events.EventLog(event_log))
+    trace_dir = tmp_path / "traces"
+    trace_dir.mkdir()
+    script = tmp_path / "worker.py"
+    script.write_text(TELEMETRY_WORKER_SCRIPT)
+
+    discovery = ScriptedDiscovery([(1e9, {"localhost": 1, "127.0.0.1": 1})])
+    driver = ElasticDriver(
+        HostManager(discovery, cooldown_s=1.0, cooldown_max_s=4.0),
+        min_np=1, max_np=2, telemetry_port=0,
+    )
+    driver.start_discovery()
+    scrapes = []
+
+    def run():
+        rc = driver.run_rounds(
+            [sys.executable, str(script)],
+            extra_env={
+                "TRACE_DIR": str(trace_dir),
+                "TARGET_EPOCHS": "6",
+                "EPOCH_SECS": "0.4",
+                "HVD_TPU_ELASTIC_EVENT_LOG": event_log,
+                "HVD_TPU_FAULT_PLAN":
+                    "worker.step:crash:host=127.0.0.1,round=1,nth=1,code=9",
+                **WORKER_ENV,
+            },
+        )
+        scrapes.append(("rc", rc))
+
+    t = threading.Thread(target=run)
+    t.start()
+    try:
+        deadline = time.monotonic() + 60.0
+        got_worker_series = False
+        while t.is_alive() and time.monotonic() < deadline:
+            srv = driver._telemetry
+            if srv is not None:
+                try:
+                    body = urllib.request.urlopen(
+                        f"http://127.0.0.1:{srv.port}/metrics", timeout=2
+                    ).read().decode()
+                    scrapes.append(("metrics", body))
+                    if 'rank="' in body:
+                        got_worker_series = True
+                    health = _json.loads(urllib.request.urlopen(
+                        f"http://127.0.0.1:{srv.port}/health", timeout=2
+                    ).read())
+                    scrapes.append(("health", health))
+                except Exception:
+                    pass  # endpoint races the round teardown
+            time.sleep(0.5)
+    finally:
+        t.join(timeout=60)
+        events.set_event_log(None)
+    assert not t.is_alive(), "elastic run did not finish"
+    assert ("rc", 0) in scrapes
+
+    # (2) Prometheus scrape: hvd_tpu_ families of all three kinds, from
+    # the driver registry and from worker pushes (rank-labeled).
+    bodies = [b for k, b in scrapes if k == "metrics"]
+    assert bodies, "never scraped /metrics"
+    final = bodies[-1]
+    assert "hvd_tpu_elastic_rounds_total" in final          # counter
+    assert "hvd_tpu_elastic_round " in final or \
+        "hvd_tpu_elastic_round{" in final                    # gauge
+    assert got_worker_series, "no worker-pushed rank series ever seen"
+    joined = "\n".join(bodies)
+    assert "hvd_tpu_train_steps_total{rank=" in joined
+    assert "hvd_tpu_train_step_seconds_bucket" in joined     # histogram
+    healths = [h for k, h in scrapes if k == "health"]
+    assert healths and all("round" in h for h in healths)
+
+    # (3) the event log reconstructs the injected failure sequence
+    evs = events.read_events(event_log)
+    names = [e["event"] for e in evs]
+    assert "round_start" in names and "worker_crash" in names
+    assert "blacklist" in names and "round_end" in names
+    i_start = names.index("round_start")
+    i_crash = names.index("worker_crash")
+    i_black = names.index("blacklist")
+    assert i_start < i_crash < i_black, names
+    crash = evs[i_crash]
+    assert crash["host"] == "127.0.0.1" and crash["verdict"] == "crash"
+    assert crash["round"] == 1
+    # both clocks present; driver-side order is monotonic
+    driver_evs = [e for e in evs if e["pid"] == os.getpid()]
+    monos = [e["mono_ts"] for e in driver_evs]
+    assert monos == sorted(monos)
+    # the run recovered: a later round started after the blacklist
+    later_rounds = [e for e in evs[i_black:] if e["event"] == "round_start"]
+    assert later_rounds and later_rounds[-1]["round"] >= 2
+
+    # (1) per-rank timelines merge into one valid Chrome trace
+    traces = sorted(
+        str(trace_dir / f) for f in os.listdir(trace_dir)
+        if f.endswith(".json")
+    )
+    assert len(traces) >= 2, traces
+    merged = hvd_merge(traces)
+    _json.loads(_json.dumps(merged))  # valid JSON (Perfetto-loadable)
+    lanes = {e["pid"] for e in merged["traceEvents"]}
+    assert lanes == {0, 1}, lanes
+    steps = [e for e in merged["traceEvents"] if e.get("cat") == "STEP"]
+    assert steps, "no per-epoch step events in the merged trace"
+
+
+def hvd_merge(paths):
+    from horovod_tpu.utils.timeline import merge_timeline_files
+
+    return merge_timeline_files(paths)

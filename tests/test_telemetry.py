@@ -1,0 +1,486 @@
+"""Unified telemetry: metrics registry + export renderers, mergeable
+cross-rank timelines, the elastic event log, and the driver's HTTP
+``/metrics`` + ``/health`` endpoint."""
+
+import json
+import os
+import queue
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import events, metrics
+from horovod_tpu.utils.timeline import Timeline, merge_timeline_files
+
+
+@pytest.fixture(autouse=True)
+def _fresh_registry():
+    metrics.reset_counters()
+    events.set_event_log(None)
+    yield
+    metrics.reset_counters()
+    events.set_event_log(None)
+
+
+# ---------------------------------------------------------------- registry
+class TestMetricsRegistry:
+    def test_counters_back_compat(self):
+        metrics.inc_counter("retry.x.attempts")
+        metrics.inc_counter("retry.x.attempts", 2)
+        assert metrics.get_counter("retry.x.attempts") == 3
+        assert metrics.get_counters("retry.") == {"retry.x.attempts": 3}
+        metrics.reset_counters("retry.")
+        assert metrics.get_counter("retry.x.attempts") == 0
+
+    def test_gauges_with_labels(self):
+        metrics.set_gauge("stall.current_stalled", 2)
+        metrics.set_gauge("stall.stalled", 1, labels={"op": "allreduce.g"})
+        metrics.set_gauge("stall.stalled", 1, labels={"op": "allgather.e"})
+        assert metrics.get_gauge("stall.current_stalled") == 2
+        assert metrics.get_gauge(
+            "stall.stalled", labels={"op": "allreduce.g"}
+        ) == 1
+        metrics.clear_gauge("stall.stalled")
+        assert metrics.get_gauge(
+            "stall.stalled", labels={"op": "allreduce.g"}
+        ) is None
+        # the other family survives a targeted clear
+        assert metrics.get_gauge("stall.current_stalled") == 2
+
+    def test_histogram_buckets(self):
+        metrics.observe("lat", 0.003)
+        metrics.observe("lat", 0.02)
+        metrics.observe("lat", 999.0)  # lands in +Inf
+        h = metrics.get_histogram("lat")
+        assert h["count"] == 3
+        assert h["sum"] == pytest.approx(999.023)
+        assert sum(h["counts"]) == 3
+        assert h["counts"][-1] == 1  # the +Inf slot
+
+    def test_prometheus_render(self):
+        metrics.inc_counter("elastic.rounds", 4)
+        metrics.set_gauge("elastic.workers", 2)
+        metrics.set_gauge("stall.stalled", 1, labels={"op": "a.b"})
+        metrics.observe("checkpoint.write_seconds", 0.004)
+        text = metrics.render_prometheus()
+        assert "# TYPE hvd_tpu_elastic_rounds_total counter" in text
+        assert "hvd_tpu_elastic_rounds_total 4" in text
+        assert "hvd_tpu_elastic_workers 2" in text
+        assert 'hvd_tpu_stall_stalled{op="a.b"} 1.0' in text
+        assert "# TYPE hvd_tpu_checkpoint_write_seconds histogram" in text
+        assert 'hvd_tpu_checkpoint_write_seconds_bucket{le="0.005"} 1' in text
+        assert 'hvd_tpu_checkpoint_write_seconds_bucket{le="+Inf"} 1' in text
+        assert "hvd_tpu_checkpoint_write_seconds_count 1" in text
+
+    def test_prometheus_bucket_counts_are_cumulative(self):
+        metrics.observe("lat", 0.003)
+        metrics.observe("lat", 0.02)
+        text = metrics.render_prometheus()
+        assert 'hvd_tpu_lat_bucket{le="0.005"} 1' in text
+        assert 'hvd_tpu_lat_bucket{le="0.025"} 2' in text
+        assert 'hvd_tpu_lat_bucket{le="60.0"} 2' in text
+
+    def test_snapshot_roundtrips_through_json_with_rank_label(self):
+        metrics.inc_counter("train.steps", 7)
+        metrics.observe("lat", 0.1)
+        snap = json.loads(metrics.render_json())
+        text = metrics.render_prometheus(snap, extra_labels={"rank": "3"})
+        assert 'hvd_tpu_train_steps_total{rank="3"} 7' in text
+        assert 'hvd_tpu_lat_bucket{le="+Inf",rank="3"} 1' in text
+
+    def test_reset_clears_gauges_and_histograms(self):
+        metrics.set_gauge("g", 1)
+        metrics.observe("h", 1.0)
+        metrics.reset_counters()
+        assert metrics.get_gauge("g") is None
+        assert metrics.get_histogram("h") is None
+
+
+# ------------------------------------------------------- eager hot path
+class TestCollectiveInstrumentation:
+    def test_allreduce_feeds_registry(self):
+        hvd.init()
+        try:
+            x = np.ones((8, 4), np.float32)
+            for _ in range(2):
+                try:
+                    hvd.allreduce(x, name="probe")
+                    dispatched = True
+                except Exception:
+                    # dispatch backends can be broken in CI (e.g. jax
+                    # API drift); _record still runs pre-dispatch, so
+                    # the byte/dispatch accounting is assertable either
+                    # way — only the latency histogram needs a
+                    # completed dispatch.
+                    dispatched = False
+            assert metrics.get_counter("collective.allreduce.dispatches") == 2
+            assert metrics.get_counter("collective.allreduce.bytes") == \
+                2 * x.size * 4
+            hb = metrics.get_histogram("collective.allreduce.bytes_hist")
+            assert hb is not None and hb["count"] == 2
+            if dispatched:
+                h = metrics.get_histogram(
+                    "collective.allreduce.dispatch_seconds"
+                )
+                assert h is not None and h["count"] == 2
+        finally:
+            hvd.shutdown()
+
+    def test_timed_dispatch_observes_latency(self):
+        from horovod_tpu.ops.eager import _timed
+
+        out = _timed("ALLREDUCE", lambda v: v + 1, 41)
+        assert out == 42
+        h = metrics.get_histogram("collective.allreduce.dispatch_seconds")
+        assert h is not None and h["count"] == 1
+
+
+# ------------------------------------------------------------- timelines
+def _write_synthetic_trace(path, rank, epoch_us, ts_list):
+    evts = [
+        {"name": "process_name", "ph": "M", "pid": 4000 + rank,
+         "args": {"name": f"orig {rank}"}},
+        {"name": "process_sort_index", "ph": "M", "pid": 4000 + rank,
+         "args": {"sort_index": 99}},
+        {"name": "HVD_PROC_META", "ph": "i", "ts": 0.0, "s": "p",
+         "pid": 4000 + rank, "tid": 0,
+         "args": {"rank": rank, "hostname": f"host{rank}",
+                  "pid": 4000 + rank, "epoch_wall_us": epoch_us}},
+    ] + [
+        {"name": "allreduce.grad", "cat": "ALLREDUCE", "ph": "X",
+         "ts": ts, "dur": 5, "pid": 4000 + rank, "tid": 0,
+         "args": {"bytes": 1024}}
+        for ts in ts_list
+    ]
+    with open(path, "w") as fh:
+        json.dump(evts, fh)
+
+
+class TestTimelineMerge:
+    def test_skewed_epochs_align_and_lanes_order(self, tmp_path):
+        """Two per-rank traces with skewed wall-clock epochs merge into
+        one Chrome trace: timestamps re-based onto the earliest epoch,
+        pid lanes rewritten to ranks, rank order preserved regardless
+        of argument order."""
+        r0, r1 = tmp_path / "t.rank0.json", tmp_path / "t.rank1.json"
+        _write_synthetic_trace(r0, 0, epoch_us=1_000_000.0,
+                               ts_list=[100.0, 200.0])
+        _write_synthetic_trace(r1, 1, epoch_us=1_500_000.0,
+                               ts_list=[100.0])
+        merged = merge_timeline_files([str(r1), str(r0)])  # reversed order
+        evts = merged["traceEvents"]
+        # valid Chrome trace JSON (round-trips)
+        json.loads(json.dumps(merged))
+        # lanes: pid == rank, rank 0 events come first
+        pid_seq = [e["pid"] for e in evts]
+        assert set(pid_seq) == {0, 1}
+        assert pid_seq == sorted(pid_seq)
+        # sort_index rewritten to the rank lane
+        sort_idx = {e["pid"]: e["args"]["sort_index"] for e in evts
+                    if e.get("name") == "process_sort_index"}
+        assert sort_idx == {0: 0, 1: 1}
+        # epoch skew folded in: rank1's ts=100 lands at 500_100us
+        ops0 = [e["ts"] for e in evts
+                if e["pid"] == 0 and e.get("cat") == "ALLREDUCE"]
+        ops1 = [e["ts"] for e in evts
+                if e["pid"] == 1 and e.get("cat") == "ALLREDUCE"]
+        assert ops0 == [100.0, 200.0]
+        assert ops1 == [500_100.0]
+
+    def test_merge_without_metadata_falls_back(self, tmp_path):
+        p = tmp_path / "legacy.json"
+        with open(p, "w") as fh:
+            json.dump([{"name": "x", "ph": "X", "ts": 1.0, "dur": 1,
+                        "pid": 7, "tid": 0}], fh)
+        merged = merge_timeline_files([str(p)])
+        assert merged["traceEvents"][0]["pid"] == 0  # positional lane
+
+    def test_real_timelines_carry_proc_meta(self, tmp_path):
+        paths = []
+        for rank in (0, 1):
+            p = tmp_path / f"real.rank{rank}.json"
+            tl = Timeline(str(p), rank=rank)
+            tl.record_op("allreduce.w", "ALLREDUCE", 2048)
+            tl.close()
+            paths.append(str(p))
+        for rank, p in enumerate(paths):
+            evts = json.loads(open(p).read())
+            meta = [e for e in evts if e.get("name") == "HVD_PROC_META"]
+            assert len(meta) == 1
+            assert meta[0]["args"]["rank"] == rank
+            assert meta[0]["args"]["epoch_wall_us"] > 0
+            names = [e.get("name") for e in evts]
+            assert "process_name" in names and "thread_name" in names
+        merged = merge_timeline_files(paths)
+        cats = {e.get("cat") for e in merged["traceEvents"]}
+        assert "ALLREDUCE" in cats
+
+    def test_merge_cli(self, tmp_path):
+        import subprocess
+        import sys
+
+        r0, r1 = tmp_path / "a.json", tmp_path / "b.json"
+        _write_synthetic_trace(r0, 0, 0.0, [1.0])
+        _write_synthetic_trace(r1, 1, 10.0, [1.0])
+        out = tmp_path / "merged.json"
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        proc = subprocess.run(
+            [sys.executable, "tools/merge_timeline.py", str(r0), str(r1),
+             "-o", str(out)],
+            capture_output=True, text=True, cwd=repo, env=env,
+        )
+        assert proc.returncode == 0, proc.stderr
+        merged = json.loads(out.read_text())
+        assert {e["pid"] for e in merged["traceEvents"]} == {0, 1}
+
+    def test_close_under_load_is_parseable(self, tmp_path):
+        """Writers hammering record_op while close() runs must still
+        leave a syntactically complete JSON array."""
+        p = tmp_path / "load.json"
+        tl = Timeline(str(p), rank=0)
+        stop = threading.Event()
+
+        def hammer():
+            i = 0
+            while not stop.is_set():
+                tl.record_op(f"op{i % 16}", "ALLREDUCE", 64)
+                i += 1
+
+        threads = [threading.Thread(target=hammer) for _ in range(4)]
+        for t in threads:
+            t.start()
+        tl.close()
+        stop.set()
+        for t in threads:
+            t.join()
+        evts = json.loads(p.read_text())  # parseable or the test fails
+        assert isinstance(evts, list)
+
+    def test_put_counts_drops_and_logs_once(self):
+        """Satellite: a full queue must not silently truncate the
+        trace — the drop is counted and warned about exactly once."""
+        tl = Timeline.__new__(Timeline)  # no writer thread needed
+        tl.path = "<test>"
+        tl._queue = queue.Queue(maxsize=1)
+        tl._queue.put_nowait({"sentinel": True})
+        tl._closed = threading.Event()
+        tl._drop_logged = False
+        before = metrics.get_counter("timeline.dropped_events")
+        tl._put({"name": "x"})
+        tl._put({"name": "y"})
+        assert metrics.get_counter("timeline.dropped_events") == before + 2
+        assert tl._drop_logged
+
+
+# ------------------------------------------------------------ event log
+class TestElasticEventLog:
+    def test_emit_and_read_order(self, tmp_path):
+        p = tmp_path / "elastic.jsonl"
+        events.set_event_log(events.EventLog(str(p)))
+        events.emit(events.ROUND_START, round=1, np=2)
+        events.emit(events.WORKER_CRASH, round=1, worker_rank=1,
+                    host="h1", verdict="crash")
+        events.emit(events.BLACKLIST, host="h1", failures=1)
+        events.emit(events.RESTART, round=1)
+        events.set_event_log(None)
+        evs = events.read_events(str(p))
+        assert [e["event"] for e in evs] == [
+            "round_start", "worker_crash", "blacklist", "restart",
+        ]
+        # both clocks present and monotonic-ordered within the process
+        monos = [e["mono_ts"] for e in evs]
+        assert monos == sorted(monos)
+        assert all(e["wall_ts"] > 0 and "hostname" in e and "pid" in e
+                   for e in evs)
+        seqs = [e["seq"] for e in evs]
+        assert seqs == [1, 2, 3, 4]
+
+    def test_env_driven_log(self, tmp_path, monkeypatch):
+        p = tmp_path / "env.jsonl"
+        monkeypatch.setenv("HVD_TPU_ELASTIC_EVENT_LOG", str(p))
+        events.reset()
+        try:
+            events.emit(events.DISCOVERY_CHANGE, hosts={"a": 2})
+            assert events.read_events(str(p))[0]["event"] == \
+                "discovery_change"
+        finally:
+            events.reset()
+            monkeypatch.delenv("HVD_TPU_ELASTIC_EVENT_LOG")
+
+    def test_no_log_is_noop(self):
+        events.set_event_log(None)
+        events.emit(events.ROUND_START, round=1)  # must not raise
+
+    def test_blacklist_emits_event(self, tmp_path):
+        from horovod_tpu.elastic.discovery import FixedHosts, HostManager
+
+        p = tmp_path / "bl.jsonl"
+        events.set_event_log(events.EventLog(str(p)))
+        mgr = HostManager(FixedHosts({"h1": 2}), cooldown_s=0.01,
+                          clock=lambda: 0.0)
+        mgr.update_available_hosts()
+        mgr.blacklist("h1")
+        events.set_event_log(None)
+        evs = events.read_events(str(p))
+        assert evs and evs[0]["event"] == "blacklist"
+        assert evs[0]["host"] == "h1" and evs[0]["failures"] == 1
+
+    def test_torn_tail_is_skipped(self, tmp_path):
+        p = tmp_path / "torn.jsonl"
+        with open(p, "w") as fh:
+            fh.write(json.dumps({"event": "round_start"}) + "\n")
+            fh.write('{"event": "worker_cra')  # crashed mid-write
+        evs = events.read_events(str(p))
+        assert [e["event"] for e in evs] == ["round_start"]
+
+
+# ------------------------------------------------------------- HTTP
+class TestTelemetryHTTP:
+    def test_metrics_and_health(self):
+        from horovod_tpu.runner.telemetry_http import TelemetryServer
+
+        metrics.inc_counter("elastic.rounds", 2)
+        worker_snap = {"counters": {"train.steps": 5}, "gauges": [],
+                       "histograms": {}}
+        srv = TelemetryServer(
+            port=0,
+            health_fn=lambda: {"status": "ok", "round": 2, "workers": 1},
+            workers_fn=lambda: [(0, worker_snap)],
+        )
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            body = urllib.request.urlopen(f"{base}/metrics").read().decode()
+            assert "hvd_tpu_elastic_rounds_total 2" in body
+            assert 'hvd_tpu_train_steps_total{rank="0"} 5' in body
+            health = json.loads(
+                urllib.request.urlopen(f"{base}/health").read()
+            )
+            assert health["status"] == "ok" and health["round"] == 2
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(f"{base}/nope")
+            assert ei.value.code == 404
+        finally:
+            srv.stop()
+
+    def test_degraded_health_returns_503(self):
+        from horovod_tpu.runner.telemetry_http import TelemetryServer
+
+        srv = TelemetryServer(
+            port=0, health_fn=lambda: {"status": "degraded"}
+        )
+        try:
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}/health"
+                )
+            assert ei.value.code == 503
+            assert json.loads(ei.value.read())["status"] == "degraded"
+        finally:
+            srv.stop()
+
+    def test_driver_wires_worker_pushes(self):
+        """ElasticDriver._start_telemetry folds KV-pushed worker
+        snapshots into the scrape and reports membership health."""
+        from horovod_tpu.elastic.discovery import FixedHosts, HostManager
+        from horovod_tpu.runner import hosts as hosts_mod
+        from horovod_tpu.runner.elastic_driver import ElasticDriver
+
+        pushed = {
+            "rank_0": json.dumps(
+                {"counters": {"train.steps": 9}, "gauges": [],
+                 "histograms": {}}
+            ).encode()
+        }
+
+        class FakeControl:
+            def get(self, scope, key, timeout_ms=0):
+                assert scope == "__metrics__"
+                return pushed.get(key)
+
+        mgr = HostManager(FixedHosts({"localhost": 2}))
+        mgr.update_available_hosts()
+        driver = ElasticDriver(mgr, min_np=1, telemetry_port=0)
+        driver._last_assignments = hosts_mod.get_host_assignments(
+            [hosts_mod.HostInfo("localhost", 1)], 1
+        )
+        srv = driver._start_telemetry(FakeControl())
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            body = urllib.request.urlopen(f"{base}/metrics").read().decode()
+            assert 'hvd_tpu_train_steps_total{rank="0"} 9' in body
+            health = json.loads(
+                urllib.request.urlopen(f"{base}/health").read()
+            )
+            assert health["status"] == "ok"
+            assert health["available_slots"] == 2
+        finally:
+            srv.stop()
+
+    def test_driver_telemetry_port_from_env(self, monkeypatch):
+        from horovod_tpu.elastic.discovery import FixedHosts, HostManager
+        from horovod_tpu.runner.elastic_driver import ElasticDriver
+
+        monkeypatch.setenv("HVD_TPU_TELEMETRY_PORT", "0")
+        d = ElasticDriver(HostManager(FixedHosts({})), min_np=1)
+        assert d.telemetry_port == 0
+        monkeypatch.delenv("HVD_TPU_TELEMETRY_PORT")
+        d2 = ElasticDriver(HostManager(FixedHosts({})), min_np=1)
+        assert d2.telemetry_port is None
+
+
+# ------------------------------------------------------------- stall gauge
+class TestStallExport:
+    def test_stall_surfaces_in_registry(self):
+        from horovod_tpu.utils.stall import StallWatchdog
+
+        wd = StallWatchdog(warn_seconds=0.05, poll_seconds=0.02)
+        try:
+            wd.begin("allreduce.stuck")
+            import time
+
+            deadline = time.monotonic() + 2.0
+            while (metrics.get_counter("stall.warnings") == 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            assert metrics.get_counter("stall.warnings") >= 1
+            assert metrics.get_gauge("stall.current_stalled") >= 1
+            assert metrics.get_gauge(
+                "stall.stalled", labels={"op": "allreduce.stuck"}
+            ) == 1
+            wd.end("allreduce.stuck")
+            deadline = time.monotonic() + 2.0
+            while (metrics.get_gauge("stall.current_stalled") != 0
+                   and time.monotonic() < deadline):
+                time.sleep(0.02)
+            assert metrics.get_gauge("stall.current_stalled") == 0
+            assert metrics.get_gauge(
+                "stall.stalled", labels={"op": "allreduce.stuck"}
+            ) is None
+        finally:
+            wd.close()
+
+
+# ------------------------------------------------------------- launcher
+class TestLauncherFlags:
+    def test_timeline_mark_cycles_flag(self):
+        from horovod_tpu.runner.launch import env_from_args, parse_args
+
+        args = parse_args(["-np", "2", "--timeline-mark-cycles",
+                           "--", "python", "t.py"])
+        env = env_from_args(args)
+        assert env["HVD_TPU_TIMELINE_MARK_CYCLES"] == "1"
+        args = parse_args(["-np", "2", "--", "python", "t.py"])
+        assert "HVD_TPU_TIMELINE_MARK_CYCLES" not in env_from_args(args)
+
+    def test_telemetry_port_flag_parses(self):
+        from horovod_tpu.runner.launch import parse_args
+
+        args = parse_args(["--min-np", "1", "-H", "localhost:2",
+                           "--telemetry-port", "9090",
+                           "--", "python", "t.py"])
+        assert args.telemetry_port == 9090

@@ -1,0 +1,51 @@
+#!/usr/bin/env python
+"""Merge N per-rank timeline traces into one Chrome trace.
+
+Each worker writes its own ``HVD_TPU_TIMELINE`` file with relative
+timestamps; the ``HVD_PROC_META`` event stamped at the head of every
+trace carries the rank and wall-clock epoch base that let this CLI
+re-base them onto one shared clock with per-rank lanes::
+
+    python tools/merge_timeline.py /tmp/timeline.rank*.json -o merged.json
+
+Load ``merged.json`` in Perfetto / chrome://tracing: one lane per rank,
+ordered rank 0..N-1, concurrent collectives aligned.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description="Merge per-rank horovod_tpu timeline traces into "
+        "one Chrome trace with per-rank lanes."
+    )
+    parser.add_argument("traces", nargs="+",
+                        help="per-rank timeline JSON files")
+    parser.add_argument("-o", "--output", default="merged_timeline.json",
+                        help="merged Chrome trace path "
+                        "(default: %(default)s)")
+    parser.add_argument("--indent", type=int, default=None,
+                        help="pretty-print the merged JSON")
+    args = parser.parse_args(argv)
+
+    from horovod_tpu.utils.timeline import merge_timeline_files
+
+    merged = merge_timeline_files(args.traces)
+    with open(args.output, "w") as fh:
+        json.dump(merged, fh, indent=args.indent)
+    ranks = sorted({e.get("pid") for e in merged["traceEvents"]})
+    print(
+        f"merged {len(args.traces)} trace(s), "
+        f"{len(merged['traceEvents'])} events, lanes {ranks} "
+        f"-> {args.output}"
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

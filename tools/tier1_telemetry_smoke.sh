@@ -1,0 +1,84 @@
+#!/usr/bin/env bash
+# Telemetry smoke: one single-process train step with timeline + metrics
+# enabled must produce (1) a parseable Chrome trace that survives the
+# merge CLI, (2) a Prometheus /metrics render with hvd_tpu_ families,
+# and (3) non-empty histogram buckets from the hot-path instrumentation
+# — see docs/observability.md.
+set -euo pipefail
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}"
+
+SMOKE_DIR="$(mktemp -d /tmp/hvd_tpu_telemetry_smoke.XXXXXX)"
+trap 'rm -rf "$SMOKE_DIR"' EXIT
+export HVD_TPU_TIMELINE="$SMOKE_DIR/timeline.json"
+export HVD_TPU_ELASTIC_EVENT_LOG="$SMOKE_DIR/elastic_events.jsonl"
+export SMOKE_DIR
+
+python - <<'EOF'
+import json
+import os
+import urllib.request
+
+import jax.numpy as jnp
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu import events, metrics
+
+smoke_dir = os.environ["SMOKE_DIR"]
+
+# -- 1. train steps with timeline + metrics enabled ---------------------
+hvd.init()
+params = {"w": jnp.ones((8, 8)), "b": jnp.ones((8, 8))}
+tx = hvd.DistributedOptimizer(optax.sgd(0.01))
+
+def loss_fn(p, batch):
+    return jnp.sum((batch @ p["w"] + p["b"]) ** 2)
+
+step = hvd.distributed_train_step(loss_fn, tx)
+opt_state = step.init(params)
+batch = jnp.ones((8, 8))
+for _ in range(3):
+    params, opt_state, loss = step(params, opt_state, batch)
+float(loss)
+hvd.allreduce(jnp.ones((8, 4)), name="smoke.allreduce")
+events.emit(events.ROUND_START, round=1, np=1)  # event-log path
+hvd.shutdown()  # flushes the timeline
+
+# -- 2. the trace parses and merges ------------------------------------
+trace_path = os.environ["HVD_TPU_TIMELINE"]
+trace = json.loads(open(trace_path).read())
+assert any(e.get("name") == "TrainStep" for e in trace), "no step events"
+has_meta = any(e.get("name") == "HVD_PROC_META" for e in trace) \
+    or os.path.exists(trace_path + ".hvdmeta.json")
+assert has_meta, "no merge metadata (in-band event or sidecar)"
+merged = hvd.merge_timeline_files([trace_path])
+assert merged["traceEvents"], "merge produced no events"
+print(f"timeline: {len(trace)} events, merge ok")
+
+# -- 3. /metrics renders with non-empty histogram buckets ---------------
+from horovod_tpu.runner.telemetry_http import TelemetryServer
+
+srv = TelemetryServer(port=0, health_fn=lambda: {"status": "ok"})
+base = f"http://127.0.0.1:{srv.port}"
+body = urllib.request.urlopen(f"{base}/metrics").read().decode()
+srv.stop()
+assert "hvd_tpu_" in body, "no hvd_tpu_ families in /metrics"
+assert "hvd_tpu_train_steps_total 3" in body, body[:400]
+assert "# TYPE hvd_tpu_train_step_seconds histogram" in body
+hist = metrics.get_histogram("train.step_seconds")
+assert hist is not None and hist["count"] == 3 and sum(hist["counts"]) == 3, \
+    "train.step_seconds histogram buckets are empty"
+lat = metrics.get_histogram("collective.allreduce.dispatch_seconds")
+assert lat is not None and lat["count"] >= 1, \
+    "collective dispatch histogram is empty"
+print("metrics: /metrics renders, histogram buckets non-empty")
+
+# -- 4. the elastic event log wrote a structured record -----------------
+evs = events.read_events(os.environ["HVD_TPU_ELASTIC_EVENT_LOG"])
+assert evs and evs[0]["event"] == "round_start"
+assert "wall_ts" in evs[0] and "mono_ts" in evs[0]
+print("event log: structured round_start recorded")
+print("TELEMETRY SMOKE OK")
+EOF
