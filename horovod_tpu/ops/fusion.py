@@ -78,6 +78,7 @@ def bucket_plan(
     sizes_bytes: Sequence[int],
     dtypes: Sequence[str],
     threshold_bytes: int | None = None,
+    look_ahead: int | None = None,
 ) -> List[List[int]]:
     """Greedy in-order bucketing under the fusion threshold.
 
@@ -88,6 +89,15 @@ def bucket_plan(
     look-ahead).  Returns buckets as lists of tensor indices.  A
     threshold of 0 disables fusion (one bucket per tensor), matching
     ``HOROVOD_FUSION_THRESHOLD=0``.
+
+    ``look_ahead`` bounds how far the mixed-precision look-ahead reaches:
+    a dtype's open bucket CLOSES once a different-dtype bucket has been
+    opened more than ``look_ahead`` tensor positions ago (default 3, the
+    ``HVD_TPU_SCHED_LOOK_AHEAD`` knob).  Without the bound a bucket stays
+    joinable forever, so a late same-dtype tensor can land in a
+    long-closed bucket and break reverse-backward exchange ordering in
+    the overlap scheduler (sched/plan.py).  ``look_ahead < 0`` restores
+    the unbounded legacy behavior.
     """
     if threshold_bytes is None:
         if _threshold_override is not None:
@@ -96,29 +106,75 @@ def bucket_plan(
             threshold_bytes = env.get_int(
                 env.FUSION_THRESHOLD, env.DEFAULT_FUSION_THRESHOLD
             )
+    if look_ahead is None:
+        look_ahead = env.get_int(env.SCHED_LOOK_AHEAD, 3)
     if threshold_bytes <= 0:
         return [[i] for i in range(len(sizes_bytes))]
-    # Prefer the native planner (cpp/src/fusion.cc) when built.
+    # Prefer the native planner (cpp/src/fusion.cc) when built — it
+    # predates the look-ahead bound, so its plan is only kept when no
+    # bucket join violates the bound (rare: interleavings longer than
+    # look_ahead positions).
     from .. import native
 
     dtype_ids = {d: i for i, d in enumerate(dict.fromkeys(dtypes))}
     planned = native.fusion_plan(
         list(sizes_bytes), [dtype_ids[d] for d in dtypes], threshold_bytes
     )
-    if planned is not None:
+    if planned is not None and not _violates_look_ahead(
+        planned, dtypes, look_ahead
+    ):
         return planned
-    open_buckets: dict = {}  # dtype -> (bucket, bytes)
+    # dtype -> [bucket, bytes, first_foreign_open_pos]
+    open_buckets: dict = {}
     buckets: List[List[int]] = []
     for i, (sz, dt) in enumerate(zip(sizes_bytes, dtypes)):
         cur = open_buckets.get(dt)
+        if (
+            cur is not None
+            and 0 <= look_ahead
+            and cur[2] is not None
+            and i - cur[2] > look_ahead
+        ):
+            # Stale: a different-dtype bucket opened more than
+            # look_ahead positions ago — this bucket is closed for good.
+            del open_buckets[dt]
+            cur = None
         if cur is not None and cur[1] + sz <= threshold_bytes:
             cur[0].append(i)
-            open_buckets[dt] = (cur[0], cur[1] + sz)
+            cur[1] += sz
         else:
             b = [i]
             buckets.append(b)
-            open_buckets[dt] = (b, sz)
+            for other_dt, entry in open_buckets.items():
+                if other_dt != dt and entry[2] is None:
+                    entry[2] = i
+            open_buckets[dt] = [b, sz, None]
     return buckets
+
+
+def _violates_look_ahead(
+    plan: Sequence[Sequence[int]], dtypes: Sequence[str], look_ahead: int
+) -> bool:
+    """True when any bucket join in ``plan`` reaches across a
+    different-dtype bucket opened more than ``look_ahead`` positions
+    before the joining tensor (greedy in-order plans open buckets at
+    their first member's position)."""
+    if look_ahead < 0:
+        return False
+    opens = sorted(
+        (b[0], dtypes[b[0]]) for b in plan if b
+    )  # (open position, dtype), in open order
+    for b in plan:
+        if len(b) < 2:
+            continue
+        first, dt = b[0], dtypes[b[0]]
+        for i in b[1:]:
+            foreign = [
+                pos for pos, d in opens if first < pos < i and d != dt
+            ]
+            if foreign and i - foreign[0] > look_ahead:
+                return True
+    return False
 
 
 def pad_to_atomic_unit(flat: jax.Array, unit_bytes: int | None = None) -> Tuple[jax.Array, int]:

@@ -5,6 +5,8 @@ from .distributed_optimizer import (  # noqa: F401
     distributed_train_step,
 )
 from .zero import (  # noqa: F401
+    clip_by_global_norm,
+    global_norm,
     sharded_gradient_transformation,
     fsdp_train_step,
     zero_train_step,

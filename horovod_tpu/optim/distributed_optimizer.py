@@ -193,27 +193,24 @@ def _reduce_gradients(
     wire = [c[0] for c in compressed]
     ctxs = [c[1] for c in compressed]
 
+    # Wire bytes per element: 1 on the int8 path (the in-memory
+    # tensors stay fp32 there — compress() is identity), so buckets
+    # fill to the intended wire-size threshold.
+    wire_itemsize = (
+        (lambda t: 1) if quantized else (lambda t: t.dtype.itemsize)
+    )
+    sizes = [w.size * wire_itemsize(w) for w in wire]
+    wire_dtypes = [str(w.dtype) for w in wire]
     if groups is not None:
         # Explicit tensor groups (reference optimizer.py:128-162 `groups`):
         # each listed group fuses atomically; ungrouped tensors bucket by
         # threshold.
         grouped_idx = set(i for g in groups for i in g)
-        buckets = [list(g) for g in groups]
+        pinned = [list(g) for g in groups]
         rest = [i for i in range(len(wire)) if i not in grouped_idx]
     else:
-        buckets = []
+        pinned = []
         rest = list(range(len(wire)))
-    if rest:
-        # Wire bytes per element: 1 on the int8 path (the in-memory
-        # tensors stay fp32 there — compress() is identity), so buckets
-        # fill to the intended wire-size threshold.
-        wire_itemsize = (
-            (lambda t: 1) if quantized else (lambda t: t.dtype.itemsize)
-        )
-        sizes = [wire[i].size * wire_itemsize(wire[i]) for i in rest]
-        dtypes = [str(wire[i].dtype) for i in rest]
-        for b in fusion.bucket_plan(sizes, dtypes, fusion_threshold_bytes):
-            buckets.append([rest[i] for i in b])
 
     # Quantized wire (Compression.int8): the quantization lives inside
     # the two-phase reduction, so the bucket dispatches to
@@ -250,12 +247,63 @@ def _reduce_gradients(
 
     _rt = get_runtime_or_none()
     tl = _rt.timeline if _rt is not None else None
+
+    from .. import sched as _sched
+
+    cfg = _sched.current_config()
+    if cfg.enabled:
+        # Bucketed overlap scheduler (sched/, default engine): plan in
+        # reverse-backward order (observed by the grad-boundary taps
+        # when TrainStep armed them), emit barrier-sequenced per-bucket
+        # collectives XLA can overlap with the remaining backward.
+        import dataclasses as _dc
+
+        if cfg.bucket_bytes is None and fusion_threshold_bytes is not None:
+            cfg = _dc.replace(cfg, bucket_bytes=fusion_threshold_bytes)
+        schedule = _sched.build_schedule(
+            sizes, wire_dtypes, cfg,
+            order=_sched.hooks.consume_order(len(wire)),
+            pinned=pinned,
+        )
+        # reduce_scatter+all_gather exchange (arXiv:2004.13336) needs a
+        # plain sum/average over one whole-world axis; anything else
+        # (Adasum, process sets, quantized wire, multi-axis) keeps the
+        # allreduce lowering per bucket.
+        rs_ok = (
+            cfg.mode == "reduce_scatter"
+            and not quantized
+            and op in (Average, Sum)
+            and (process_set is None or process_set.process_set_id == 0)
+            and isinstance(axis, str)
+        )
+        if rs_ok:
+            def reduce_bucket_flat(f):
+                return _sched.execute.reduce_scatter_flat(
+                    f, axis=axis, average=(op == Average),
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor,
+                )
+        else:
+            reduce_bucket_flat = reduce_flat
+        reduced = _sched.exchange(
+            wire, schedule, reduce_bucket_flat,
+            barriers=cfg.barriers, timeline=tl,
+        )
+        out = [compression.decompress(t, c) for t, c in zip(reduced, ctxs)]
+        return jax.tree.unflatten(treedef, out)
+
+    # Legacy single-pass path (HVD_TPU_SCHED=off): in-order buckets, no
+    # sequencing barriers — one monolithic fused exchange per dtype run.
+    buckets = list(pinned)
+    if rest:
+        for b in fusion.bucket_plan(
+            [sizes[i] for i in rest], [wire_dtypes[i] for i in rest],
+            fusion_threshold_bytes,
+        ):
+            buckets.append([rest[i] for i in b])
     reduced = list(wire)
     for bi, bucket in enumerate(buckets):
-        nbytes = sum(
-            int(wire[i].size) * (1 if quantized else wire[i].dtype.itemsize)
-            for i in bucket
-        )
+        nbytes = sum(sizes[i] for i in bucket)
         if tl is not None:
             tl.record_op(
                 f"bucket{bi}[n={len(bucket)}]", "FUSION_PLAN", nbytes
@@ -449,7 +497,23 @@ class TrainStep:
                 st = st._replace(acc=jax.tree.map(lambda a: a[None], st.acc))
             return st
 
+        # Grad-boundary taps (sched/hooks.py): when the overlap
+        # scheduler drives a DistributedOptimizer (marker present), the
+        # backward trace records per-leaf readiness order so the plan
+        # stage buckets in true reverse-backward order.  Gated on the
+        # marker — a plain optax transform never consumes the capture.
+        _is_hvd_opt = hasattr(optimizer.update, "_hvd_fusion_threshold")
+
+        def _loss_for_trace():
+            from .. import sched as _sched
+
+            _cfg = _sched.current_config()
+            if _is_hvd_opt and _cfg.enabled and _cfg.capture_order:
+                return _sched.hooks.capturing_loss(loss_fn)
+            return loss_fn
+
         def compute_grads(params, model_state, batch):
+            loss_fn = _loss_for_trace()
             if stateful:
                 (loss, out_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                     params, model_state, batch
@@ -630,6 +694,9 @@ class TrainStep:
                 fusion.set_threshold_override(None)
                 traced.set_hierarchical_override(None)
                 set_quantized_override(None)
+                from ..sched import hooks as _sched_hooks
+
+                _sched_hooks.reset()  # drop the aborted trace's capture
                 return self(params, *args)
             raise
         finally:

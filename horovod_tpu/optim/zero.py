@@ -18,7 +18,11 @@ Sharding is over the *flattened* parameter vector, so it is exact for
 elementwise transforms (sgd, momentum, adam(w), rmsprop, lamb's
 elementwise core...).  Transforms that need global-across-parameters
 reductions (e.g. ``optax.clip_by_global_norm``) would see only their
-shard; compose those *outside* via ``pre_update`` hooks or avoid them.
+shard — close that gap with :func:`global_norm` (psum of per-shard
+squared norms over the sync axis) and the ``pre_update`` hook on
+:func:`sharded_gradient_transformation` /
+:func:`zero_train_step`: :func:`clip_by_global_norm` is the ready-made
+hook matching optax semantics on sharded gradients.
 """
 
 from __future__ import annotations
@@ -32,9 +36,42 @@ from jax.flatten_util import ravel_pytree
 from ..runtime import WORLD_AXIS
 
 
+def global_norm(shards, axis=WORLD_AXIS) -> jax.Array:
+    """Global L2 norm of a sharded flat vector (or list/pytree of
+    shards): psum of per-shard squared norms over the sync ``axis``,
+    then sqrt — every rank sees the same *global* norm even though it
+    holds only 1/N of the elements.  Zero-padding in the shards is
+    norm-neutral.  Must run inside ``shard_map`` over ``axis``."""
+    sq = sum(
+        jnp.sum(jnp.square(s)) for s in jax.tree.leaves(shards)
+    )
+    return jnp.sqrt(lax.psum(sq, axis))
+
+
+def clip_by_global_norm(max_norm: float, axis=WORLD_AXIS):
+    """``pre_update`` hook clipping sharded gradients to a global norm
+    (the ``optax.clip_by_global_norm`` semantics the flat-shard layout
+    otherwise breaks): scales every shard by ``max_norm / norm`` when
+    the GLOBAL norm exceeds ``max_norm``.  Accepts one shard or a
+    list of per-bucket shards (``sched.bucketed_zero_step``)."""
+
+    def hook(shards):
+        single = not isinstance(shards, (list, tuple))
+        leaves = [shards] if single else list(shards)
+        norm = global_norm(leaves, axis)
+        scale = jnp.where(
+            norm > max_norm, max_norm / jnp.maximum(norm, 1e-16), 1.0
+        )
+        out = [s * scale.astype(s.dtype) for s in leaves]
+        return out[0] if single else out
+
+    return hook
+
+
 def sharded_gradient_transformation(
     tx: optax.GradientTransformation,
     axis=WORLD_AXIS,
+    pre_update=None,
 ) -> optax.GradientTransformation:
     """Wrap ``tx`` so init/update act on this rank's flat param shard.
 
@@ -42,6 +79,12 @@ def sharded_gradient_transformation(
     state for the local 1/N slice; ``update`` takes *unreduced local
     grads*, reduce-scatters them (average), updates the slice, and
     returns full-size updates assembled by all-gather.
+
+    ``pre_update``: hook on the reduced gradient shard before the inner
+    update — the composition point for global-across-parameters
+    transforms (:func:`clip_by_global_norm`); it runs after the
+    reduce-scatter, so :func:`global_norm`-style psums inside it see
+    every shard.
     """
 
     def _shard_meta(params):
@@ -75,6 +118,8 @@ def sharded_gradient_transformation(
         pshard = lax.dynamic_slice(
             jnp.pad(pflat, (0, padded - n)), (idx * shard_len,), (shard_len,)
         )
+        if pre_update is not None:
+            gshard = pre_update(gshard)
         ushard, state = tx.update(gshard, state, pshard)
         # Assemble the full update vector; params stay replicated.
         uflat = lax.all_gather(ushard, axis, tiled=True)[:n]
@@ -88,6 +133,7 @@ def zero_train_step(
     tx: optax.GradientTransformation,
     *,
     axis=WORLD_AXIS,
+    pre_update=None,
 ):
     """Compiled SPMD step with ZeRO-1 sharded optimizer state.
 
@@ -95,12 +141,15 @@ def zero_train_step(
     ``step.init(params)`` then ``step(params, opt_state, batch) ->
     (params, opt_state, loss)``.  Params are replicated; optimizer state
     leaves live sharded (leading dim padded_n/N per chip).
+    ``pre_update`` hooks the reduced gradient shard before the inner
+    update (global-norm clipping etc. — see
+    :func:`clip_by_global_norm`).
     """
     from jax.sharding import PartitionSpec as P
 
     from .. import runtime as _rt
 
-    stx = sharded_gradient_transformation(tx, axis=axis)
+    stx = sharded_gradient_transformation(tx, axis=axis, pre_update=pre_update)
     rt = _rt.get_runtime()
     mesh = rt.mesh
     param_spec = P()

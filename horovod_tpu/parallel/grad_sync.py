@@ -45,6 +45,7 @@ def sync_gradients(
     grads,
     param_shard_axes=None,
     axes: Sequence[str] = (DP_AXIS, SP_AXIS, TP_AXIS, EP_AXIS),
+    scheduled: bool | None = None,
 ):
     """Synchronize a gradient pytree inside shard_map.
 
@@ -56,7 +57,22 @@ def sync_gradients(
     ``axes``: mesh axes to synchronize over; names not bound in the
     current shard_map are skipped, so one call site works across mesh
     shapes.
+
+    ``scheduled``: route the pmeans through the bucketed overlap
+    scheduler (``sched/``) — per-parameter semantics are unchanged
+    (pmean is elementwise, so bucketing never moves a value), but the
+    exchange becomes reverse-backward ordered fused buckets XLA can
+    overlap with compute.  ``None`` follows the ``HVD_TPU_SCHED`` knob
+    (default on).
     """
+    if scheduled is None:
+        from ..sched import current_config
+
+        scheduled = current_config().enabled
+    if scheduled:
+        from ..sched import sync_gradients_bucketed
+
+        return sync_gradients_bucketed(grads, param_shard_axes, axes)
     present = tuple(a for a in axes if _axis_present(a))
 
     def sync(g, sharded_str):

@@ -1,0 +1,121 @@
+"""``jax.grad``-boundary hooks: observe the backward readiness order.
+
+The reference learns gradient readiness at runtime — each parameter's
+grad-accumulator hook enqueues an allreduce request the moment its
+gradient materializes (``horovod/torch/optimizer.py:506``,
+``tensorflow/__init__.py:759``).  Under XLA there is no runtime hook,
+but the *trace* of the backward pass visits cotangents in backward
+order: wrapping every parameter leaf in a ``custom_vjp`` identity whose
+bwd rule records its leaf index reproduces the reference's readiness
+order at trace time.  The plan stage consumes that order so buckets are
+scheduled reverse-backward — the first bucket's collective can issue
+while the backward for earlier layers is still running.
+
+Trace-time, not run-time: the taps fire once per compile while
+``jax.value_and_grad`` transposes the graph, cost nothing in the
+compiled program (identity is folded away), and leave numerics
+untouched.
+"""
+
+from __future__ import annotations
+
+import threading
+from functools import partial
+from typing import Any, List, Optional
+
+import jax
+
+_state = threading.local()
+
+
+def _orders() -> List[List[int]]:
+    stack = getattr(_state, "stack", None)
+    if stack is None:
+        stack = _state.stack = []
+    return stack
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _tap(x, idx):
+    return x
+
+
+def _tap_fwd(x, idx):
+    return x, None
+
+
+def _tap_bwd(idx, res, ct):
+    # Runs while the backward pass is being traced — in backward order.
+    stack = _orders()
+    if stack:
+        stack[-1].append(idx)
+    return (ct,)
+
+
+_tap.defvjp(_tap_fwd, _tap_bwd)
+
+
+def begin_capture() -> None:
+    """Open a capture frame; nested captures (re-traces inside a trace)
+    stack."""
+    _orders().append([])
+
+
+def end_capture(n_leaves: int) -> Optional[List[int]]:
+    """Close the innermost frame; returns the observed backward order of
+    leaf indices (first recorded = first gradient ready), or ``None``
+    when the observation is incomplete (a leaf's cotangent never flowed
+    through its tap — e.g. an unused parameter)."""
+    stack = _orders()
+    if not stack:
+        return None
+    seen = stack.pop()
+    order = list(dict.fromkeys(seen))
+    if len(order) != n_leaves:
+        return None
+    return order
+
+
+def tap_params(params: Any) -> Any:
+    """Wrap every leaf of ``params`` in an identity whose cotangent
+    records the leaf's flatten index during the backward trace."""
+    leaves, treedef = jax.tree.flatten(params)
+    tapped = [_tap(leaf, i) for i, leaf in enumerate(leaves)]
+    return jax.tree.unflatten(treedef, tapped)
+
+
+def capturing_loss(loss_fn):
+    """Wrap ``loss_fn(params, *rest)`` so a grad of the wrapped function
+    records the backward order of ``params`` leaves.  The recorded order
+    is published via :func:`consume_order` for the plan stage (same
+    trace, later in the step body)."""
+
+    def wrapped(params, *rest):
+        leaves = jax.tree.leaves(params)
+        begin_capture()
+        _state.pending = (len(leaves), True)
+        return loss_fn(tap_params(params), *rest)
+
+    return wrapped
+
+
+def consume_order(n_leaves: int) -> Optional[List[int]]:
+    """Hand the most recent capture to the plan stage (clears it).
+    Returns ``None`` when no capture is pending or it is incomplete /
+    sized for a different pytree."""
+    pending = getattr(_state, "pending", None)
+    if pending is None:
+        return None
+    _state.pending = None
+    expected, _ = pending
+    order = end_capture(expected)
+    if order is None or expected != n_leaves:
+        return None
+    return order
+
+
+def reset() -> None:
+    """Drop any un-consumed capture state (test isolation / aborted
+    traces)."""
+    _state.stack = []
+    _state.pending = None

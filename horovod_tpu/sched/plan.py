@@ -1,0 +1,195 @@
+"""Plan stage: build a :class:`BucketSchedule` from gradient metadata.
+
+The reference's scheduling state lives in the controller loop: tensors
+become ready in backward order, ``FuseResponses`` fuses consecutive
+ready responses (``controller.cc:793``), and the cycle dispatches one
+fused collective per tick.  Under XLA the whole step is one program, so
+the plan is computed host-side at trace time and *is* the schedule: an
+ordered tuple of buckets, each a set of gradient-leaf indices that
+share one wire collective.
+
+Ordering: buckets are emitted in **reverse-backward** order — the order
+gradients become available during the backward pass (last layer first),
+observed by the ``hooks`` module's grad-boundary taps when available,
+else assumed to be the reversed pytree flatten order.  Combined with
+``lax.optimization_barrier`` sequencing in the execute stage, this hands
+XLA's latency-hiding scheduler a chain of collectives it can overlap
+with the remaining backward compute.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional, Sequence, Tuple
+
+from ..ops import fusion
+from ..utils import env
+
+
+@dataclasses.dataclass(frozen=True)
+class SchedConfig:
+    """Knobs of the bucketed overlap scheduler (``HVD_TPU_SCHED*``)."""
+
+    enabled: bool = True
+    mode: str = "allreduce"  # "allreduce" | "reduce_scatter"
+    bucket_bytes: Optional[int] = None  # None -> fusion threshold knob
+    look_ahead: int = 3
+    barriers: bool = True
+    capture_order: bool = True
+
+    def __post_init__(self):
+        if self.mode not in ("allreduce", "reduce_scatter"):
+            raise ValueError(
+                f"HVD_TPU_SCHED_MODE must be 'allreduce' or "
+                f"'reduce_scatter', got {self.mode!r}"
+            )
+
+    @classmethod
+    def from_env(cls) -> "SchedConfig":
+        raw = (env.get_env(env.SCHED, "on") or "on").strip().lower()
+        enabled = raw not in ("off", "0", "false", "no")
+        bucket_bytes = env.get_int(env.SCHED_BUCKET_BYTES, -1)
+        return cls(
+            enabled=enabled,
+            mode=(env.get_env(env.SCHED_MODE, "allreduce") or "allreduce")
+            .strip().lower(),
+            bucket_bytes=None if bucket_bytes < 0 else bucket_bytes,
+            look_ahead=env.get_int(env.SCHED_LOOK_AHEAD, 3),
+            barriers=env.get_bool(env.SCHED_BARRIERS, True),
+            capture_order=env.get_bool(env.SCHED_CAPTURE_ORDER, True),
+        )
+
+
+# Trace-time config override (the fusion-threshold override pattern):
+# tests and probe variants pin a config without touching the env.
+_config_override: Optional[SchedConfig] = None
+
+
+def set_config_override(cfg: Optional[SchedConfig]) -> None:
+    global _config_override
+    _config_override = cfg
+
+
+def current_config() -> SchedConfig:
+    return (
+        _config_override if _config_override is not None
+        else SchedConfig.from_env()
+    )
+
+
+@dataclasses.dataclass(frozen=True)
+class Bucket:
+    """One fused exchange: leaf ``indices`` (original flatten order)
+    sharing a wire collective of ``nbytes`` total."""
+
+    indices: Tuple[int, ...]
+    nbytes: int
+    wire_dtypes: Tuple[str, ...]  # distinct dtypes, flatten order
+    pinned: bool = False  # from an explicit user group
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketSchedule:
+    """Ordered exchange plan for one gradient pytree."""
+
+    buckets: Tuple[Bucket, ...]
+    mode: str
+    total_bytes: int
+
+    def __len__(self) -> int:
+        return len(self.buckets)
+
+    def signature(self) -> Tuple:
+        """Hashable identity: two schedules with equal signatures emit
+        identical exchange programs (determinism tests key on this)."""
+        return (
+            self.mode,
+            tuple((b.indices, b.nbytes, b.wire_dtypes, b.pinned)
+                  for b in self.buckets),
+        )
+
+
+def build_schedule(
+    sizes_bytes: Sequence[int],
+    dtypes: Sequence[str],
+    cfg: Optional[SchedConfig] = None,
+    *,
+    order: Optional[Sequence[int]] = None,
+    pinned: Sequence[Sequence[int]] = (),
+) -> BucketSchedule:
+    """Plan the exchange for leaves of ``sizes_bytes``/``dtypes``.
+
+    ``order`` is the backward-readiness order of leaf indices (first
+    element = first gradient available); ``None`` assumes the reversed
+    flatten order (parameters registered last finish their backward
+    first).  ``pinned`` buckets (explicit user groups,
+    ``DistributedOptimizer(groups=...)``) fuse atomically and are
+    emitted where their *earliest-ready* member falls in the order.
+
+    Pure function of its arguments: same metadata + config -> identical
+    schedule (plan determinism is load-bearing — every SPMD rank must
+    emit the same collectives in the same order).
+    """
+    if cfg is None:
+        cfg = current_config()
+    n = len(sizes_bytes)
+    if order is None:
+        order = range(n - 1, -1, -1)
+    order = [i for i in order if 0 <= i < n]
+    if len(set(order)) != n:
+        # Incomplete / duplicated observation: fall back to the assumed
+        # reverse-backward order rather than dropping leaves.
+        order = list(range(n - 1, -1, -1))
+
+    pinned_set = set()
+    pinned_buckets: List[Tuple[int, Bucket]] = []
+    rank_of = {leaf: pos for pos, leaf in enumerate(order)}
+    for group in pinned:
+        idx = tuple(int(i) for i in group)
+        if not idx:
+            continue
+        pinned_set.update(idx)
+        pinned_buckets.append((
+            min(rank_of[i] for i in idx),
+            _make_bucket(idx, sizes_bytes, dtypes, pinned=True),
+        ))
+
+    free = [i for i in order if i not in pinned_set]
+    planned = fusion.bucket_plan(
+        [sizes_bytes[i] for i in free],
+        [dtypes[i] for i in free],
+        cfg.bucket_bytes,
+        look_ahead=cfg.look_ahead,
+    )
+    planned_buckets: List[Tuple[int, Bucket]] = []
+    for b in planned:
+        idx = tuple(sorted(free[j] for j in b))
+        planned_buckets.append((
+            min(rank_of[i] for i in idx),
+            _make_bucket(idx, sizes_bytes, dtypes),
+        ))
+
+    ordered = [
+        b for _, b in sorted(
+            pinned_buckets + planned_buckets, key=lambda p: p[0]
+        )
+    ]
+    return BucketSchedule(
+        buckets=tuple(ordered),
+        mode=cfg.mode,
+        total_bytes=sum(b.nbytes for b in ordered),
+    )
+
+
+def _make_bucket(
+    indices: Tuple[int, ...],
+    sizes_bytes: Sequence[int],
+    dtypes: Sequence[str],
+    pinned: bool = False,
+) -> Bucket:
+    return Bucket(
+        indices=indices,
+        nbytes=sum(int(sizes_bytes[i]) for i in indices),
+        wire_dtypes=tuple(dict.fromkeys(dtypes[i] for i in indices)),
+        pinned=pinned,
+    )

@@ -1,0 +1,108 @@
+"""Tune stage: drive the bucket-size knob from registry metrics.
+
+``utils/autotune.FusionAutotuner`` owns the suggest/observe search
+(the reference ParameterManager's Bayesian loop); what the scheduler
+adds is the *scoring feed*: instead of a caller hand-timing windows,
+scores are computed from the PR 2 metrics registry — the counters and
+histograms the hot path already maintains (``train.steps``,
+``train.step_seconds``, ``sched.bytes_per_step``) — so any training
+loop that bumps standard metrics gets bucket-size tuning for free.
+
+Usage::
+
+    tuner = ScheduleTuner()
+    while not tuner.converged:
+        cfg = dataclasses.replace(cfg, bucket_bytes=tuner.bucket_bytes())
+        tuner.begin_window()
+        run_steps(window)                 # bumps train.* / sched.*
+        tuner.end_window()
+    cfg = dataclasses.replace(cfg, bucket_bytes=tuner.bucket_bytes())
+
+Under ``HVD_TPU_AUTOTUNE=1`` the plan stage already follows the
+``TrainStep`` autotune driver (``bucket_bytes=None`` defers to the
+fusion-threshold override), so this class is for loops that want
+registry-scored tuning without the wall-clock window driver.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional
+
+from .. import metrics
+from ..utils.autotune import FusionAutotuner
+
+
+def registry_view() -> Dict[str, float]:
+    """Snapshot the registry series the window score derives from."""
+    hist = metrics.get_histogram("train.step_seconds")
+    return {
+        "steps": float(metrics.get_counter("train.steps")),
+        "step_seconds_sum": float(hist["sum"]) if hist else 0.0,
+        "bytes_per_step": float(
+            metrics.get_gauge("sched.bytes_per_step") or 0.0
+        ),
+        "mono": time.monotonic(),
+    }
+
+
+def window_score(
+    before: Dict[str, float], after: Dict[str, float]
+) -> float:
+    """Score one closed window from two registry snapshots.
+
+    Primary: exchanged **bytes/sec** — steps/sec (from the
+    ``train.steps`` counter over the ``train.step_seconds`` histogram
+    sum, falling back to wall clock when the histogram is idle) times
+    the planned ``sched.bytes_per_step`` gauge.  Without a bytes gauge
+    the score degrades to plain steps/sec, which ranks candidates
+    identically for a fixed model.
+    """
+    steps = after["steps"] - before["steps"]
+    if steps <= 0:
+        return 0.0
+    dt = after["step_seconds_sum"] - before["step_seconds_sum"]
+    if dt <= 0:
+        dt = after["mono"] - before["mono"]
+    rate = steps / max(dt, 1e-9)
+    bytes_per_step = after["bytes_per_step"]
+    return rate * bytes_per_step if bytes_per_step > 0 else rate
+
+
+class ScheduleTuner:
+    """FusionAutotuner wired to the scheduler's bucket-size knob with
+    registry-fed window scores."""
+
+    def __init__(self, **tuner_kwargs):
+        self.tuner = FusionAutotuner(**tuner_kwargs)
+        self._baseline: Optional[Dict[str, float]] = None
+
+    def bucket_bytes(self) -> int:
+        """Bucket-size suggestion for the next window (frozen winner
+        after convergence)."""
+        return self.tuner.threshold_bytes()
+
+    def begin_window(self) -> None:
+        # Prime the suggestion: FusionAutotuner only accepts an observe
+        # for a threshold it suggested (suggest-before-observe contract).
+        self.tuner.threshold_bytes()
+        self._baseline = registry_view()
+
+    def end_window(self) -> float:
+        """Close the window: score it from the registry deltas and feed
+        the tuner.  Returns the score (0.0 when no window was open or
+        no steps ran — not observed, so an idle window cannot poison
+        the search)."""
+        if self._baseline is None:
+            return 0.0
+        score = window_score(self._baseline, registry_view())
+        self._baseline = None
+        if score > 0.0:
+            self.tuner.observe(score)
+            metrics.inc_counter("sched.tune_windows")
+            metrics.set_gauge("sched.tune_score", score)
+        return score
+
+    @property
+    def converged(self) -> bool:
+        return self.tuner.converged

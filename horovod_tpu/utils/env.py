@@ -49,6 +49,15 @@ SET_RING_THRESHOLD = "SET_RING_THRESHOLD"
 PROCESS_SETS = "PROCESS_SETS"
 BATCH_D2D_MEMCOPIES = "BATCH_D2D_MEMCOPIES"
 NUM_STREAMS = "NUM_STREAMS"
+# Bucketed overlap scheduler (sched/): the gradient-exchange pipeline
+# behind DistributedOptimizer.  SCHED=off restores the single-fused-
+# exchange legacy path; see docs/scheduler.md.
+SCHED = "SCHED"  # on (default) | off
+SCHED_MODE = "SCHED_MODE"  # allreduce (default) | reduce_scatter
+SCHED_BUCKET_BYTES = "SCHED_BUCKET_BYTES"  # default: fusion threshold
+SCHED_LOOK_AHEAD = "SCHED_LOOK_AHEAD"  # bucket-close look-ahead, default 3
+SCHED_BARRIERS = "SCHED_BARRIERS"  # optimization_barrier sequencing, default on
+SCHED_CAPTURE_ORDER = "SCHED_CAPTURE_ORDER"  # backward-order hooks, default on
 
 # Launcher-provided rendezvous env (analog of reference gloo_run.py:65-103).
 RANK = "RANK"

@@ -318,7 +318,9 @@ def _bucket_re():
     if _BUCKET_RE is None:
         import re
 
-        _BUCKET_RE = re.compile(r"hvd_bucket(\d+)_(\d+)B")
+        # both engines' scopes: hvd_bucket* (legacy HVD_TPU_SCHED=off)
+        # and hvd_sched_bucket* (the bucketed overlap scheduler)
+        _BUCKET_RE = re.compile(r"hvd_(?:sched_)?bucket(\d+)_(\d+)B")
     return _BUCKET_RE
 
 
@@ -341,8 +343,8 @@ def extract_bucket_spans(logdir: str, hlo_text: Optional[str] = None):
         import re
 
         for m in re.finditer(
-            r"(\S+)\s*=\s*[^\n]*op_name=\"([^\"]*hvd_bucket(\d+)_(\d+)B"
-            r"[^\"]*)\"",
+            r"(\S+)\s*=\s*[^\n]*op_name=\"([^\"]*hvd_(?:sched_)?bucket"
+            r"(\d+)_(\d+)B[^\"]*)\"",
             hlo_text,
         ):
             op_to_bucket[m.group(1).lstrip("%")] = (

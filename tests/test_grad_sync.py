@@ -113,6 +113,87 @@ def test_hybrid_dp_tp_training_matches_single_device():
     )
 
 
+class TestRule2x2Mesh:
+    """pmean-vs-divide rule pinned on a 2×2 dp×tp mesh: replicated,
+    tp-sharded, and mixed ``param_shard_axes`` pytrees — and the
+    scheduler-mode exchange must match the reference per-leaf path
+    bit-for-bit in f32."""
+
+    def _mesh(self):
+        return make_mesh(dp=2, tp=2, devices=jax.devices()[:4])
+
+    def _run(self, scheduled):
+        mesh = self._mesh()
+        # distinct per-device blocks: x is sharded over (dp, tp)
+        x = jnp.arange(16.0, dtype=jnp.float32).reshape(4, 4)
+        axes_tree = {"rep": "", "tp": "tp", "mix": ""}
+
+        def fn(x):
+            g = {"rep": x, "tp": x * 2.0, "mix": x + 1.0}
+            return sync_gradients(
+                g, axes_tree, axes=("dp", "tp"), scheduled=scheduled
+            )
+
+        spec = {"rep": P("dp", "tp"), "tp": P("dp", "tp"),
+                "mix": P("dp", "tp")}
+        f = jax.jit(shard_map(
+            fn, mesh=mesh, in_specs=(P("dp", "tp"),), out_specs=spec,
+            check_vma=False,
+        ))
+        return jax.tree.map(np.asarray, f(x))
+
+    @staticmethod
+    def _blocks(arr):
+        """(dp, tp) -> 2x2 block of the 4x4 array."""
+        return {
+            (d, t): arr[2 * d:2 * d + 2, 2 * t:2 * t + 2]
+            for d in range(2) for t in range(2)
+        }
+
+    def _expected(self):
+        x = np.arange(16.0, dtype=np.float32).reshape(4, 4)
+        xb = self._blocks(x)
+        out = {"rep": np.zeros_like(x), "tp": np.zeros_like(x),
+               "mix": np.zeros_like(x)}
+        for d in range(2):
+            for t in range(2):
+                # replicated: pmean over dp AND tp
+                out["rep"][2 * d:2 * d + 2, 2 * t:2 * t + 2] = np.mean(
+                    [xb[(dd, tt)] for dd in range(2) for tt in range(2)],
+                    axis=0,
+                )
+                # tp-sharded: pmean over dp only, then divide by |tp|
+                out["tp"][2 * d:2 * d + 2, 2 * t:2 * t + 2] = (
+                    (xb[(0, t)] * 2 + xb[(1, t)] * 2) / 2 / 2
+                )
+                # replicated again, shifted input
+                out["mix"][2 * d:2 * d + 2, 2 * t:2 * t + 2] = np.mean(
+                    [xb[(dd, tt)] + 1 for dd in range(2)
+                     for tt in range(2)], axis=0,
+                )
+        return out
+
+    def test_rule_replicated_tp_sharded_mixed(self):
+        got = self._run(scheduled=False)
+        want = self._expected()
+        for k in want:
+            np.testing.assert_allclose(got[k], want[k], rtol=1e-6)
+
+    def test_scheduler_mode_bit_for_bit(self):
+        """Scheduler-mode exchange == reference per-leaf path, exact
+        f32 equality (pmean is elementwise; bucketing moves no value)."""
+        ref = self._run(scheduled=False)
+        got = self._run(scheduled=True)
+        for k in ref:
+            np.testing.assert_array_equal(got[k], ref[k])
+
+    def test_scheduler_mode_matches_rule(self):
+        got = self._run(scheduled=True)
+        want = self._expected()
+        for k in want:
+            np.testing.assert_allclose(got[k], want[k], rtol=1e-6)
+
+
 def test_sync_gradients_default_replicated():
     """With no shard-axes tree every grad is pmean'd over the data axes
     (pure-DP semantics, matching DistributedOptimizer)."""
