@@ -431,7 +431,52 @@ if __name__ == "__main__":
         # structured {"status": "skipped"} record instead of an error
         # blob, so BENCH_*.json stays machine-comparable (the r05 bench
         # died with a raw TimeoutExpired here).
+        #
+        # A successful probe is cached to a sidecar file keyed by
+        # interpreter path + jax version: cold JAX imports in the probe
+        # subprocess have eaten a bench's whole 150 s budget before
+        # (BENCH_r05), so within 24 h the budget goes to the actual
+        # measurement instead of re-proving the same runtime boots.
         import subprocess
+
+        def _probe_cache_path() -> str:
+            return os.environ.get(
+                "HVD_BENCH_PROBE_CACHE",
+                os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".bench_probe_cache.json"),
+            )
+
+        def _probe_cache_key() -> str:
+            try:
+                from importlib.metadata import version
+
+                jax_version = version("jax")
+            except Exception:
+                jax_version = "unknown"
+            return f"{sys.executable}:{jax_version}"
+
+        def _probe_cached_ok() -> bool:
+            try:
+                with open(_probe_cache_path()) as f:
+                    rec = json.load(f)
+                return (
+                    rec.get("key") == _probe_cache_key()
+                    and rec.get("ok") is True
+                    and 0 <= time.time() - rec.get("ts", 0) < 24 * 3600
+                )
+            except Exception:
+                return False
+
+        def _probe_cache_store() -> None:
+            try:
+                path = _probe_cache_path()
+                tmp = f"{path}.tmp.{os.getpid()}"
+                with open(tmp, "w") as f:
+                    json.dump({"key": _probe_cache_key(), "ok": True,
+                               "ts": time.time()}, f)
+                os.replace(tmp, path)
+            except Exception:
+                pass  # cache is best-effort; never sink the bench
 
         def _probe():
             probe = subprocess.run(
@@ -450,23 +495,25 @@ if __name__ == "__main__":
 
         from horovod_tpu.utils.retry import RetryPolicy
 
-        try:
-            RetryPolicy(
-                max_attempts=2, base_delay_s=5.0, jitter=0.0,
-                name="bench.probe",
-                retry_on=(RuntimeError, subprocess.TimeoutExpired),
-            ).call(_probe)
-        except Exception as e:
-            print(json.dumps({
-                "metric": "resnet50_synthetic_train_throughput",
-                "value": 0.0,
-                "unit": "images/sec/chip",
-                "vs_baseline": 0.0,
-                "status": "skipped",
-                "reason": f"device probe exhausted retries: "
-                          f"{type(e).__name__}: {e}",
-            }))
-            sys.exit(0)
+        if not _probe_cached_ok():
+            try:
+                RetryPolicy(
+                    max_attempts=2, base_delay_s=5.0, jitter=0.0,
+                    name="bench.probe",
+                    retry_on=(RuntimeError, subprocess.TimeoutExpired),
+                ).call(_probe)
+            except Exception as e:
+                print(json.dumps({
+                    "metric": "resnet50_synthetic_train_throughput",
+                    "value": 0.0,
+                    "unit": "images/sec/chip",
+                    "vs_baseline": 0.0,
+                    "status": "skipped",
+                    "reason": f"device probe exhausted retries: "
+                              f"{type(e).__name__}: {e}",
+                }))
+                sys.exit(0)
+            _probe_cache_store()
         main()
     except Exception as e:  # TimeoutError from the alarm lands here too
         if _PARTIAL is not None:

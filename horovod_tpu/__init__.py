@@ -38,6 +38,24 @@ if not hasattr(_jax.lax, "axis_size"):
     # jax.core.axis_frame.
     _jax.lax.axis_size = lambda name: _jax.core.axis_frame(name)
 
+if not hasattr(_jax, "typeof"):
+    # jax < 0.5 has no jax.typeof; the abstract value carries the same
+    # shape/dtype info (and no .vma attribute — callers that probe
+    # varying-mesh-axes via getattr(..., "vma", None) see None, which is
+    # correct: the vma system doesn't exist under check_rep semantics).
+    _jax.typeof = lambda x: _jax.core.get_aval(x)
+
+if not hasattr(_jax.lax, "pcast"):
+    # jax < 0.5 has no lax.pcast / varying-mesh-axes marking.  Under the
+    # shimmed shard_map (check_rep=False) a loop carry needs no vma
+    # annotation to match device-varying step outputs, so the marking is
+    # an identity.
+    def _pcast(x, axes, to="varying"):
+        del axes, to
+        return x
+
+    _jax.lax.pcast = _pcast
+
 from . import runtime as _runtime
 from .exceptions import (  # noqa: F401
     CheckpointCorruptionError,
@@ -85,7 +103,12 @@ from .ops.sparse import (  # noqa: F401
     sparse_allreduce,
     sparse_allreduce_eager,
 )
-from .ops.quantized import quantized_allreduce  # noqa: F401
+from .ops.quantized import (  # noqa: F401
+    quantized_all_gather,
+    quantized_allreduce,
+    quantized_allreduce_ef,
+    quantized_reduce_scatter,
+)
 
 init = _runtime.init
 shutdown = _runtime.shutdown

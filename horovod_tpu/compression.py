@@ -71,15 +71,17 @@ class BF16Compressor(Compressor):
         return tensor.astype(ctx) if ctx is not None and tensor.dtype != ctx else tensor
 
 
-from .ops.quantized import Int8Compressor  # noqa: E402
+from .ops.quantized import Fp8Compressor, Int8Compressor  # noqa: E402
 
 
 class Compression:
     """Namespace matching ``hvd.Compression`` exactly, extended with the
-    TPU-native ``bf16`` and the EQuARX-style ``int8`` quantized wire
-    (``ops/quantized.py``)."""
+    TPU-native ``bf16`` and the EQuARX-style quantized wires ``int8``
+    and ``fp8`` (float8_e4m3fn — see ``ops/quantized.py`` and
+    docs/quantization.md)."""
 
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
     int8 = Int8Compressor
+    fp8 = Fp8Compressor

@@ -27,6 +27,10 @@ from jax.experimental import pallas as pl
 try:  # pltpu is importable on CPU builds too; guard for safety.
     from jax.experimental.pallas import tpu as pltpu
 
+    if not hasattr(pltpu, "CompilerParams"):
+        # jax < 0.5 spelling of the same dataclass.
+        pltpu.CompilerParams = pltpu.TPUCompilerParams
+
     _HAS_PLTPU = True
 except Exception:  # pragma: no cover
     pltpu = None

@@ -1,32 +1,60 @@
-"""Int8-quantized allreduce (EQuARX-style, XLA-native).
+"""Quantized collective engine (EQuARX-style, XLA-native) — v2.
 
 Technique reference: "EQuARX: Efficient Quantized AllReduce in XLA"
 (arXiv:2506.17615, listed in PAPERS.md) — decompose the allreduce into
 its reduce-scatter + allgather phases and quantize the wire of each
-phase to int8 with per-chunk fp32 scales, accumulating in full
-precision between them.  No reference-framework analog (the reference's
-strongest wire compression is fp16); this is a capability add that
-halves ICI bytes vs bf16 and quarters them vs fp32.
+phase with per-block fp32 scales, accumulating in full precision
+between them.  No reference-framework analog (the reference's strongest
+wire compression is fp16); this halves ICI bytes vs bf16 and quarters
+them vs fp32.
 
-Schedule (global set, n ranks, payload V):
+v2 splits the monolithic allreduce into composable *phase primitives*
+so the bucketed overlap scheduler (``sched/``) and ZeRO-1 can pick up a
+quantized wire per bucket:
 
-  1. split the local vector into n chunks; quantize each with its own
-     ``amax/127`` scale;
-  2. ``all_to_all`` the int8 chunks (+ a tiny fp32 scale vector): rank
-     j receives every rank's chunk j — the reduce-scatter phase wire;
-  3. dequantize and sum in fp32 → rank j holds the exact-summed chunk j
-     (one quantization error per term, no error compounding);
-  4. re-quantize the reduced chunk and ``all_gather`` (+ scales) — the
-     allgather phase wire; dequantize.
+* :func:`quantized_reduce_scatter` — blockwise quantize → ``all_to_all``
+  of wire chunks + fp32 block scales → fp32 dequant-accumulate.  Each
+  rank holds the exact-summed shard of its chunk (one quantization
+  error per term, no error compounding).
+* :func:`quantized_all_gather` — re-quantize a reduced (or updated)
+  shard → tiled ``all_gather`` → dequant.
+* :func:`quantized_allreduce` — the two composed (kept for the
+  ``Compression.int8`` legacy path and eager use).
 
-Per-rank wire ≈ 2V int8 bytes (vs 4V for a bf16 allreduce's two
+Both primitives run over **any single mesh axis** and over non-global
+process sets **where the set tiles the axis** (an equal-size partition,
+``ProcessSetTable.partition_groups``): the phase collectives then carry
+XLA ``replica_groups`` so each group's reduction rides only its own ICI
+links.  Sets that cannot partition the axis raise
+:class:`~horovod_tpu.exceptions.QuantizedWireError` — the quantizer
+never silently degrades to a dense or masked path.
+
+Wire formats (``WIRE_FORMATS``): ``int8`` (symmetric round-to-nearest,
+qmax 127) and ``fp8`` (``float8_e4m3fn``, qmax 448 — keeps a mantissa
+through the cast so small-relative-error regions quantize finer than
+int8's uniform grid).  Block size comes from ``HVD_TPU_QUANT_BLOCK``
+(default 512).
+
+Error feedback (EF14/EF21-style): pass ``ef=True`` to
+:func:`quantized_reduce_scatter` (or a residual into
+:func:`quantized_allreduce_ef`) and the primitive returns the local
+quantization residual ``r ← e − dequant(quantize(e))`` alongside the
+reduced value, where ``e = g + r_prev`` is the caller's
+residual-compensated payload.  Carried in optimizer state across steps,
+the residual re-injects this step's rounding error into the next step's
+wire, so aggressive quantization error accumulates into the *residual*
+instead of the trajectory (see docs/quantization.md).
+
+Per-rank wire ≈ 2V wire-bytes (vs 4V for a bf16 allreduce's two
 phases).  Error: each element sees two independent round-to-nearest
-quantizations, |err| <= 0.5*(amax_in/127) + 0.5*(amax_sum/127).
+quantizations, |err| <= 0.5*(amax_in/qmax) + 0.5*(amax_sum/qmax) per
+contribution (blockwise amax; the property test in
+tests/test_quantized.py pins the elementwise form of this bound).
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -35,6 +63,7 @@ from jax import lax
 from ..exceptions import QuantizedWireError
 from ..process_sets import ProcessSet
 from ..runtime import WORLD_AXIS
+from ..utils import env
 from .traced import Average, Sum
 
 
@@ -42,26 +71,214 @@ from .traced import Average, Sum
 # one large-magnitude layer flush a co-fused small-magnitude layer's
 # gradients to zero inside a fusion bucket; EQuARX uses fine-grained
 # block scales for the same reason.  Overhead: 4/BLOCK bytes/element of
-# fp32 scales (~0.8% at 512).
+# fp32 scales (~0.8% at 512).  ``HVD_TPU_QUANT_BLOCK`` overrides.
 BLOCK = 512
 
+# wire name -> (storage dtype, qmax).  fp8 uses e4m3fn: 448 is its max
+# finite value; the cast itself rounds to nearest representable.
+WIRE_FORMATS = {
+    "int8": (jnp.int8, 127.0),
+    "fp8": (jnp.float8_e4m3fn, 448.0),
+}
 
-def _quantize_blocks(rows: jax.Array):
-    """Blockwise int8 quantization of (r, c) rows, c % BLOCK == 0.
 
-    Returns (q int8 (r, c), scales fp32 (r, c/BLOCK)).  Non-finite
+def quant_block() -> int:
+    """Quantization block size (``HVD_TPU_QUANT_BLOCK``, default 512)."""
+    b = env.get_int("QUANT_BLOCK", BLOCK)
+    return b if b > 0 else BLOCK
+
+
+def wire_itemsize(wire: str) -> int:
+    """Storage bytes per element of a wire format (both are 1 today)."""
+    return jnp.dtype(WIRE_FORMATS[_canon_wire(wire)][0]).itemsize
+
+
+def _canon_wire(wire: str) -> str:
+    w = (wire or "int8").strip().lower()
+    if w == "e4m3":
+        w = "fp8"
+    if w not in WIRE_FORMATS:
+        raise QuantizedWireError(
+            f"unknown quantized wire format {wire!r}; "
+            f"supported: {sorted(WIRE_FORMATS)}"
+        )
+    return w
+
+
+def _quantize_blocks(rows: jax.Array, wire: str = "int8",
+                     block: Optional[int] = None):
+    """Blockwise quantization of (r, c) rows, c % block == 0.
+
+    Returns (q wire-dtype (r, c), scales fp32 (r, c/block)).  Non-finite
     blocks get a NaN scale so the corruption PROPAGATES through
     dequantize (the fp16/bf16 compressors preserve inf/nan; silently
     zeroing them would defeat overflow-skip logic downstream).
     """
+    wire = _canon_wire(wire)
+    qdtype, qmax = WIRE_FORMATS[wire]
+    if block is None:
+        block = quant_block()
     r, c = rows.shape
-    b = rows.reshape(r, c // BLOCK, BLOCK).astype(jnp.float32)
+    b = rows.reshape(r, c // block, block).astype(jnp.float32)
     amax = jnp.max(jnp.abs(b), axis=-1)
     finite = jnp.isfinite(amax)
-    safe = jnp.where(finite & (amax > 0), amax / 127.0, 1.0)
+    safe = jnp.where(finite & (amax > 0), amax / qmax, 1.0)
     scale = jnp.where(finite, safe, jnp.nan).astype(jnp.float32)
-    q = jnp.clip(jnp.round(b / safe[..., None]), -127, 127)
-    return q.astype(jnp.int8).reshape(r, c), scale
+    scaled = b / safe[..., None]
+    if wire == "int8":
+        q = jnp.clip(jnp.round(scaled), -qmax, qmax)
+    else:
+        # fp8 cast rounds to nearest representable; values are <= qmax
+        # by construction so the cast never overflows to inf.
+        q = scaled
+    return q.astype(qdtype).reshape(r, c), scale
+
+
+def _dequantize_blocks(q: jax.Array, s: jax.Array,
+                       block: Optional[int] = None) -> jax.Array:
+    """Inverse of :func:`_quantize_blocks`: (r, c) wire payload + (r,
+    c/block) fp32 scales -> fp32 (r, c)."""
+    if block is None:
+        block = quant_block()
+    r, c = q.shape
+    return (
+        q.reshape(r, c // block, block).astype(jnp.float32) * s[..., None]
+    ).reshape(r, c)
+
+
+def _axis_groups(axis, process_set: Optional[ProcessSet]):
+    """Resolve (replica groups, participant count) for the phase
+    collectives.  Raises :class:`QuantizedWireError` when the reduction
+    shape cannot be served without silently degrading."""
+    if not isinstance(axis, str):
+        raise QuantizedWireError(
+            "quantized collectives run over one named mesh axis (the "
+            "all_to_all phase has no multi-axis form); got "
+            f"axis={axis!r} — use the dense path for multi-axis "
+            "reductions"
+        )
+    n = lax.axis_size(axis)
+    if process_set is None or process_set.process_set_id == 0:
+        return None, n
+    from ..runtime import get_runtime
+
+    groups = get_runtime().process_set_table.partition_groups(process_set)
+    if groups is None:
+        if len(process_set.ranks) == n:
+            return None, n
+        raise QuantizedWireError(
+            f"process set {process_set!r} does not tile the {axis!r} "
+            "axis into equal replica groups; the quantized wire cannot "
+            "serve it — use the dense path for arbitrary subsets"
+        )
+    return groups, len(groups[0])
+
+
+def quantized_reduce_scatter(
+    x: jax.Array,
+    axis: str = WORLD_AXIS,
+    op: int = Average,
+    process_set: Optional[ProcessSet] = None,
+    *,
+    wire: str = "int8",
+    block: Optional[int] = None,
+    ef: bool = False,
+):
+    """Reduce-scatter with a quantized wire: blockwise quantize →
+    ``all_to_all`` of wire chunks + fp32 block scales → fp32
+    dequant-accumulate.
+
+    ``x`` is flattened; rank *j* (within its replica group) returns the
+    fp32 exact-sum (or average) of chunk *j*, length
+    ``ceil(V / (n*block)) * block`` — block-aligned so the shard can be
+    re-quantized by :func:`quantized_all_gather` without repadding.
+
+    ``ef=True`` additionally returns the local error-feedback residual
+    ``x − dequant(quantize(x))`` in ``x``'s shape/dtype — the caller
+    carries it in optimizer state and adds it to the next step's
+    payload (``docs/quantization.md``).
+    """
+    if op not in (Sum, Average):
+        raise QuantizedWireError(
+            "quantized_reduce_scatter supports Sum/Average"
+        )
+    wire = _canon_wire(wire)
+    if block is None:
+        block = quant_block()
+    groups, n = _axis_groups(axis, process_set)
+    shape, dtype = x.shape, x.dtype
+    flat = x.reshape(-1)
+    V = flat.shape[0]
+    c = -(-V // (n * block)) * block  # chunk length, block-aligned
+    if c * n != V:
+        flat = jnp.pad(flat, (0, c * n - V))
+    chunks = flat.reshape(n, c)
+
+    q, s = _quantize_blocks(chunks, wire, block)  # (n, c), (n, c/block)
+    residual = None
+    if ef:
+        residual = (
+            (chunks.astype(jnp.float32) - _dequantize_blocks(q, s, block))
+            .reshape(-1)[:V].reshape(shape).astype(dtype)
+        )
+    qt = lax.all_to_all(
+        q, axis, split_axis=0, concat_axis=0, tiled=True,
+        axis_index_groups=groups,
+    )
+    st = lax.all_to_all(
+        s, axis, split_axis=0, concat_axis=0, tiled=True,
+        axis_index_groups=groups,
+    )
+    # Exact fp32 accumulation of the dequantized contributions.
+    mine = jnp.sum(_dequantize_blocks(qt, st, block), axis=0)  # (c,)
+    if op == Average:
+        mine = mine / n
+    if ef:
+        return mine, residual
+    return mine
+
+
+def quantized_all_gather(
+    shard: jax.Array,
+    axis: str = WORLD_AXIS,
+    process_set: Optional[ProcessSet] = None,
+    *,
+    wire: str = "int8",
+    block: Optional[int] = None,
+) -> jax.Array:
+    """All-gather with a quantized wire: re-quantize this rank's fp32
+    shard (a reduced gradient chunk, or a post-update parameter shard
+    under ZeRO-1) → tiled ``all_gather`` of wire payload + fp32 block
+    scales → fp32 dequant.
+
+    The shard length must be a multiple of ``block`` (true by
+    construction for :func:`quantized_reduce_scatter` output; align
+    your layout when gathering optimizer-update shards).  Returns the
+    fp32 concatenation of every participant's shard, length
+    ``n * len(shard)``.
+    """
+    wire = _canon_wire(wire)
+    if block is None:
+        block = quant_block()
+    groups, n = _axis_groups(axis, process_set)
+    flat = shard.reshape(-1)
+    c = flat.shape[0]
+    if c % block != 0:
+        raise QuantizedWireError(
+            f"quantized_all_gather shard length {c} is not a multiple "
+            f"of the quantization block ({block}); align the shard "
+            "layout (HVD_TPU_QUANT_BLOCK) before gathering"
+        )
+    q, s = _quantize_blocks(flat[None], wire, block)
+    qg = lax.all_gather(
+        q[0], axis, tiled=True, axis_index_groups=groups
+    )  # (n*c,)
+    sg = lax.all_gather(
+        s[0], axis, tiled=True, axis_index_groups=groups
+    )  # (n*c/block,)
+    return _dequantize_blocks(
+        qg.reshape(n, c), sg.reshape(n, c // block), block
+    ).reshape(-1)
 
 
 def quantized_allreduce(
@@ -69,61 +286,72 @@ def quantized_allreduce(
     axis: str = WORLD_AXIS,
     op: int = Average,
     process_set: Optional[ProcessSet] = None,
+    *,
+    wire: str = "int8",
+    block: Optional[int] = None,
 ) -> jax.Array:
-    """In-jit int8-wire allreduce over a mesh axis (global set only:
-    the all_to_all phase needs the set to tile the axis; arbitrary
-    subsets fall back to the caller's dense path)."""
+    """In-jit quantized-wire allreduce over a mesh axis: the two phase
+    primitives composed.  Serves the global set and any process set
+    that tiles the axis; anything else raises
+    :class:`QuantizedWireError` (callers choose the dense path)."""
     if op not in (Sum, Average):
         raise QuantizedWireError("quantized_allreduce supports Sum/Average")
-    if process_set is not None and process_set.process_set_id != 0:
-        raise QuantizedWireError(
-            "quantized_allreduce runs on the global set; use the dense "
-            "path for subsets"
-        )
-    n = lax.axis_size(axis)
     shape, dtype = x.shape, x.dtype
-    flat = x.reshape(-1)
-    V = flat.shape[0]
-    c = -(-V // (n * BLOCK)) * BLOCK  # chunk length, BLOCK-aligned
-    if c * n != V:
-        flat = jnp.pad(flat, (0, c * n - V))
-    chunks = flat.reshape(n, c)
-
-    def dequant(q, s):
-        r = q.shape[0]
-        return (
-            q.reshape(r, c // BLOCK, BLOCK).astype(jnp.float32)
-            * s[..., None]
-        ).reshape(r, c)
-
-    # Phase 1 wire: int8 chunks + fp32 block scales via all_to_all.
-    q, s = _quantize_blocks(chunks)        # (n, c) int8, (n, c/BLOCK)
-    qt = lax.all_to_all(q, axis, split_axis=0, concat_axis=0, tiled=True)
-    st = lax.all_to_all(s, axis, split_axis=0, concat_axis=0, tiled=True)
-    # Exact fp32 accumulation of the dequantized contributions.
-    mine = jnp.sum(dequant(qt, st), axis=0)                  # (c,)
-
-    # Phase 2 wire: re-quantized reduced chunk via all_gather.
-    q2, s2 = _quantize_blocks(mine[None])
-    qg = lax.all_gather(q2[0], axis, tiled=True)             # (n*c,)
-    sg = lax.all_gather(s2[0], axis, tiled=True)             # (n*c/BLOCK,)
-    out = dequant(
-        qg.reshape(n, c), sg.reshape(n, c // BLOCK)
-    ).reshape(-1)[:V]
+    V = x.size
+    shard = quantized_reduce_scatter(
+        x, axis, op=Sum, process_set=process_set, wire=wire, block=block
+    )
+    _, n = _axis_groups(axis, process_set)
+    out = quantized_all_gather(
+        shard, axis, process_set=process_set, wire=wire, block=block
+    )[:V]
     if op == Average:
         out = out / n
     return out.reshape(shape).astype(dtype)
 
 
+def quantized_allreduce_ef(
+    x: jax.Array,
+    residual: jax.Array,
+    axis: str = WORLD_AXIS,
+    op: int = Average,
+    process_set: Optional[ProcessSet] = None,
+    *,
+    wire: str = "int8",
+    block: Optional[int] = None,
+) -> Tuple[jax.Array, jax.Array]:
+    """Error-feedback allreduce: quantize ``e = x + residual`` on the
+    wire, return ``(allreduced(e), e − dequant(quantize(e)))``.  The new
+    residual replaces the old in the caller's optimizer state."""
+    shape, dtype = x.shape, x.dtype
+    V = x.size
+    e = x.astype(jnp.float32) + residual.astype(jnp.float32)
+    shard, r_new = quantized_reduce_scatter(
+        e, axis, op=Sum, process_set=process_set, wire=wire, block=block,
+        ef=True,
+    )
+    _, n = _axis_groups(axis, process_set)
+    out = quantized_all_gather(
+        shard, axis, process_set=process_set, wire=wire, block=block
+    )[:V]
+    if op == Average:
+        out = out / n
+    return (
+        out.reshape(shape).astype(dtype),
+        r_new.reshape(shape).astype(residual.dtype),
+    )
+
+
 class Int8Compressor:
-    """Marker compressor selecting the quantized-allreduce path in
+    """Marker compressor selecting the quantized wire in
     ``DistributedOptimizer`` (``hvd.Compression.int8``).  Unlike
     fp16/bf16 this is not a cast-around-the-collective — the
     quantization lives inside the two-phase reduction — so
     compress/decompress are identity and the optimizer dispatches the
-    bucket to :func:`quantized_allreduce` instead."""
+    bucket to the quantized phase primitives instead."""
 
     quantized_wire = True
+    wire_format = "int8"
 
     @staticmethod
     def compress(tensor):
@@ -132,3 +360,11 @@ class Int8Compressor:
     @staticmethod
     def decompress(tensor, ctx):
         return tensor
+
+
+class Fp8Compressor(Int8Compressor):
+    """``hvd.Compression.fp8``: same marker pattern, ``float8_e4m3fn``
+    wire — identical bytes to int8 with a mantissa-aware grid (better
+    relative error for heavy-tailed gradients)."""
+
+    wire_format = "fp8"

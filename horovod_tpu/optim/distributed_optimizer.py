@@ -58,11 +58,15 @@ def set_quantized_override(value: Optional[bool]) -> None:
 class DistributedOptimizerState(NamedTuple):
     """State wrapper; ``acc`` holds per-rank gradient accumulators (local
     values, varying over the world axis) and is None when
-    backward_passes_per_step == 1."""
+    backward_passes_per_step == 1.  ``residual`` carries the
+    error-feedback residuals of the quantized wire (per-rank local, one
+    fp32 leaf per parameter; None unless a quantized wire with EF was
+    active at init — see docs/quantization.md)."""
 
     counter: jax.Array
     acc: Any
     inner: Any
+    residual: Any = None
 
 
 def _reduce_gradients(
@@ -77,6 +81,7 @@ def _reduce_gradients(
     fusion_threshold_bytes: Optional[int],
     groups: Optional[Sequence[Sequence[int]]] = None,
     sparse_as_dense: bool = False,
+    residuals: Any = None,
 ) -> Any:
     """Bucket, compress, and allreduce a gradient pytree as few fused
     collectives (the FuseResponses + fusion-buffer path, compiled).
@@ -86,26 +91,44 @@ def _reduce_gradients(
     for the inner optimizer; ``sparse_as_dense=True`` densifies *before*
     the reduction instead (reference ``torch/optimizer.py``
     ``sparse_as_dense``), trading wire bytes for one fused collective.
+
+    ``residuals`` (pytree matching ``grads``, fp32 leaves) engages
+    error feedback on quantized-wire buckets; the call then returns
+    ``(reduced, new_residuals)`` instead of just the reduced tree.
     """
     from ..ops.sparse import IndexedSlices, densify, sparse_allreduce
 
-    # Quantized wire (Compression.int8) validation happens up front so
-    # it also covers all-sparse trees and sparse leaves (which would
-    # otherwise silently ship fp32 through the identity compressor).
-    # The autotune probe can force the quantized wire on at trace time
-    # (third explored knob, utils/autotune.py) — only ever on, never
-    # off: an explicit Compression.int8 is a user numerics choice.
+    # Quantized wire (Compression.int8/fp8 or a HVD_TPU_SCHED_WIRE
+    # request) validation happens up front so it also covers all-sparse
+    # trees and sparse leaves (which would otherwise silently ship fp32
+    # through the identity compressor).  The autotune probe can force
+    # the quantized wire on at trace time (third explored knob,
+    # utils/autotune.py) — only ever on, never off: an explicit
+    # Compression.int8 is a user numerics choice.
     quantized = getattr(compression, "quantized_wire", False)
     if _quantized_override:
         quantized = True
-    if quantized and (
-        op not in (Average, Sum)
-        or (process_set is not None and process_set.process_set_id != 0)
-    ):
-        raise QuantizedWireError(
-            "Compression.int8 requires op=Average/Sum on the global "
-            "process set (ops/quantized.py)"
-        )
+    if quantized:
+        if op not in (Average, Sum):
+            raise QuantizedWireError(
+                "the quantized wire requires op=Average/Sum "
+                "(ops/quantized.py)"
+            )
+        if process_set is not None and process_set.process_set_id != 0:
+            # v2 serves sets that tile the axis into equal replica
+            # groups (the phase collectives ride replica_groups);
+            # anything else raises rather than silently going dense.
+            from ..runtime import get_runtime
+
+            table = get_runtime().process_set_table
+            if table.partition_groups(process_set) is None and \
+                    len(process_set.ranks) != table.world_size:
+                raise QuantizedWireError(
+                    f"the quantized wire serves the global set or sets "
+                    f"that tile the axis into equal replica groups; "
+                    f"{process_set!r} does neither — use the dense "
+                    "path for arbitrary subsets"
+                )
 
     is_sparse = lambda x: isinstance(x, IndexedSlices)
     if sparse_as_dense:
@@ -212,9 +235,9 @@ def _reduce_gradients(
         pinned = []
         rest = list(range(len(wire)))
 
-    # Quantized wire (Compression.int8): the quantization lives inside
-    # the two-phase reduction, so the bucket dispatches to
-    # quantized_allreduce instead of cast-allreduce-cast.  Pre/postscale
+    # Quantized wire (Compression.int8/fp8): the quantization lives
+    # inside the two-phase reduction, so the bucket dispatches to the
+    # quantized primitives instead of cast-allreduce-cast.  Pre/postscale
     # fold into the fp32 accumulation outside the quantizer.
     def reduce_flat(f):
         if quantized:
@@ -228,7 +251,10 @@ def _reduce_gradients(
                     process_set=process_set,
                 )
             g = f if prescale_factor == 1.0 else f * prescale_factor
-            g = quantized_allreduce(g, axis=axis, op=op)
+            g = quantized_allreduce(
+                g, axis=axis, op=op, process_set=process_set,
+                wire=getattr(compression, "wire_format", "int8"),
+            )
             return g if postscale_factor == 1.0 else g * postscale_factor
         return traced.allreduce(
             f, axis=axis, op=op,
@@ -260,37 +286,101 @@ def _reduce_gradients(
 
         if cfg.bucket_bytes is None and fusion_threshold_bytes is not None:
             cfg = _dc.replace(cfg, bucket_bytes=fusion_threshold_bytes)
+        # Per-bucket wire request: an explicit quantized compressor
+        # wins; otherwise the HVD_TPU_SCHED_WIRE / tuner choice rides.
+        wire_req = (
+            getattr(compression, "wire_format", "int8") if quantized
+            else cfg.wire
+        )
+        if wire_req in ("int8", "fp8"):
+            # Satellite contract: the quantized wire raises instead of
+            # silently degrading when the reduction shape cannot carry
+            # it (non-Sum/Average ops, multi-axis reductions; process
+            # sets were validated above, non-tiling ones at trace time).
+            if op not in (Average, Sum):
+                raise QuantizedWireError(
+                    f"quantized wire {wire_req!r} requires op=Average/"
+                    "Sum; Adasum and min/max reductions have no "
+                    "quantized lowering — unset HVD_TPU_SCHED_WIRE or "
+                    "use a cast compressor"
+                )
+            if not isinstance(axis, str):
+                raise QuantizedWireError(
+                    f"quantized wire {wire_req!r} needs one named mesh "
+                    f"axis (got {axis!r}); the all_to_all phase has no "
+                    "multi-axis form"
+                )
         schedule = _sched.build_schedule(
             sizes, wire_dtypes, cfg,
             order=_sched.hooks.consume_order(len(wire)),
             pinned=pinned,
+            wire=wire_req,
         )
         # reduce_scatter+all_gather exchange (arXiv:2004.13336) needs a
         # plain sum/average over one whole-world axis; anything else
-        # (Adasum, process sets, quantized wire, multi-axis) keeps the
-        # allreduce lowering per bucket.
+        # (Adasum, process sets, multi-axis) keeps the allreduce
+        # lowering per dense bucket.  Quantized buckets have their own
+        # RS+AG lowering below (for them the decomposition IS the
+        # allreduce), so both sched modes run quantized end-to-end.
         rs_ok = (
             cfg.mode == "reduce_scatter"
-            and not quantized
             and op in (Average, Sum)
             and (process_set is None or process_set.process_set_id == 0)
             and isinstance(axis, str)
         )
-        if rs_ok:
-            def reduce_bucket_flat(f):
+
+        def dense_flat(f):
+            if rs_ok and jnp.issubdtype(f.dtype, jnp.floating):
                 return _sched.execute.reduce_scatter_flat(
                     f, axis=axis, average=(op == Average),
                     prescale_factor=prescale_factor,
                     postscale_factor=postscale_factor,
                 )
-        else:
-            reduce_bucket_flat = reduce_flat
+            return reduce_flat(f)
+
+        res_out = None
+        if residuals is not None:
+            res_out = list(jax.tree.flatten(residuals)[0])
+            if len(res_out) != len(wire):
+                raise ValueError(
+                    "residuals structure does not match gradients"
+                )
+
+        def reduce_bucket_flat(f, bucket):
+            if bucket.wire in ("int8", "fp8"):
+                res_flat, rmeta = None, None
+                if res_out is not None:
+                    rf, rmeta = fusion.flatten_group(
+                        [res_out[i] for i in bucket.indices]
+                    )
+                    res_flat = rf[0]
+                red, r_new = _sched.execute.quantized_exchange_flat(
+                    f, axis=axis, average=(op == Average),
+                    wire=bucket.wire,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor,
+                    residual=res_flat, process_set=process_set,
+                )
+                if r_new is not None:
+                    for i, r in zip(
+                        bucket.indices,
+                        fusion.unflatten_group([r_new], rmeta),
+                    ):
+                        res_out[i] = r.astype(res_out[i].dtype)
+                return red
+            if bucket.wire == "bf16":
+                return _sched.execute.bf16_wire(dense_flat)(f)
+            return dense_flat(f)
+
         reduced = _sched.exchange(
             wire, schedule, reduce_bucket_flat,
             barriers=cfg.barriers, timeline=tl,
         )
         out = [compression.decompress(t, c) for t, c in zip(reduced, ctxs)]
-        return jax.tree.unflatten(treedef, out)
+        tree = jax.tree.unflatten(treedef, out)
+        if residuals is not None:
+            return tree, jax.tree.unflatten(treedef, res_out)
+        return tree
 
     # Legacy single-pass path (HVD_TPU_SCHED=off): in-order buckets, no
     # sequencing barriers — one monolithic fused exchange per dtype run.
@@ -315,7 +405,12 @@ def _reduce_gradients(
             reduced[i] = t
 
     out = [compression.decompress(t, c) for t, c in zip(reduced, ctxs)]
-    return jax.tree.unflatten(treedef, out)
+    tree = jax.tree.unflatten(treedef, out)
+    if residuals is not None:
+        # Legacy engine: EF rides the scheduler; residuals pass through
+        # untouched (zeros behave as plain quantization).
+        return tree, residuals
+    return tree
 
 
 def DistributedOptimizer(
@@ -358,7 +453,7 @@ def DistributedOptimizer(
     if k < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
 
-    def reduce_fn(grads):
+    def reduce_fn(grads, residuals=None):
         return _reduce_gradients(
             grads,
             axis=axis,
@@ -370,24 +465,50 @@ def DistributedOptimizer(
             fusion_threshold_bytes=fusion_threshold_bytes,
             groups=groups,
             sparse_as_dense=sparse_as_dense,
+            residuals=residuals,
         )
+
+    def _ef_active() -> bool:
+        # Error-feedback residuals ride the scheduler engine with a
+        # quantized wire — either an explicit Compression.int8/fp8 or a
+        # HVD_TPU_SCHED_WIRE=int8/fp8 request at init time (the state
+        # must exist before the first trace).
+        from .. import sched as _sched
+
+        cfg = _sched.current_config()
+        if not (cfg.enabled and cfg.wire_ef):
+            return False
+        if getattr(compression, "quantized_wire", False):
+            return True
+        return cfg.wire in ("int8", "fp8")
 
     def init_fn(params):
         acc = None
         if k > 1:
             acc = jax.tree.map(jnp.zeros_like, params)
+        residual = None
+        if _ef_active():
+            residual = jax.tree.map(
+                lambda p: jnp.zeros(jnp.shape(p), jnp.float32), params
+            )
         return DistributedOptimizerState(
             counter=jnp.zeros((), jnp.int32),
             acc=acc,
             inner=optimizer.init(params),
+            residual=residual,
         )
 
     def update_fn(grads, state: DistributedOptimizerState, params=None):
+        residual = getattr(state, "residual", None)
         if k == 1:
-            reduced = reduce_fn(grads)
+            if residual is not None:
+                reduced, residual = reduce_fn(grads, residual)
+            else:
+                reduced = reduce_fn(grads)
             updates, inner = optimizer.update(reduced, state.inner, params)
             return updates, DistributedOptimizerState(
-                counter=state.counter + 1, acc=None, inner=inner
+                counter=state.counter + 1, acc=None, inner=inner,
+                residual=residual,
             )
 
         # Local gradient aggregation (reference
@@ -407,21 +528,28 @@ def DistributedOptimizer(
         boundary = (counter % k) == 0
 
         def do_step(operand):
-            acc_, inner_ = operand
+            acc_, inner_, res_ = operand
             scale = 1.0 / k if average_aggregated_gradients else 1.0
             scaled = jax.tree.map(lambda a: a * scale, acc_)
-            reduced = reduce_fn(scaled)
+            if res_ is not None:
+                reduced, res_ = reduce_fn(scaled, res_)
+            else:
+                reduced = reduce_fn(scaled)
             updates, new_inner = optimizer.update(reduced, inner_, params)
             zeroed = jax.tree.map(jnp.zeros_like, acc_)
-            return updates, zeroed, new_inner
+            return updates, zeroed, new_inner, res_
 
         def no_step(operand):
-            acc_, inner_ = operand
+            acc_, inner_, res_ = operand
             updates = jax.tree.map(jnp.zeros_like, acc_)
-            return updates, acc_, inner_
+            return updates, acc_, inner_, res_
 
-        updates, acc, inner = lax.cond(boundary, do_step, no_step, (acc, state.inner))
-        return updates, DistributedOptimizerState(counter=counter, acc=acc, inner=inner)
+        updates, acc, inner, residual = lax.cond(
+            boundary, do_step, no_step, (acc, state.inner, residual)
+        )
+        return updates, DistributedOptimizerState(
+            counter=counter, acc=acc, inner=inner, residual=residual
+        )
 
     # Autotune eligibility marker: with an explicit threshold the trace-
     # time override in fusion.bucket_plan is never consulted, so TrainStep
@@ -481,21 +609,38 @@ class TrainStep:
         batch_spec = P(axis)  # sharded along leading dim
 
         def state_specs(state):
-            # acc leaves vary per rank -> stacked over the axis; the rest
-            # of the state is replicated.
-            if isinstance(state, DistributedOptimizerState) and state.acc is not None:
+            # acc and EF-residual leaves vary per rank -> stacked over
+            # the axis; the rest of the state is replicated.
+            if isinstance(state, DistributedOptimizerState) and (
+                state.acc is not None or state.residual is not None
+            ):
+                def vary(t):
+                    return jax.tree.map(lambda _: P(axis), t)
+
                 return DistributedOptimizerState(
                     counter=P(),
-                    acc=jax.tree.map(lambda _: P(axis), state.acc),
+                    acc=None if state.acc is None else vary(state.acc),
                     inner=jax.tree.map(lambda _: P(), state.inner),
+                    residual=(
+                        None if state.residual is None
+                        else vary(state.residual)
+                    ),
                 )
             return jax.tree.map(lambda _: P(), state)
 
-        def init_body(params):
-            st = optimizer.init(params)
-            if isinstance(st, DistributedOptimizerState) and st.acc is not None:
-                st = st._replace(acc=jax.tree.map(lambda a: a[None], st.acc))
+        def _stack_local(st, unstack=False):
+            """[None]-stack (or unstack) the per-rank-varying leaves so
+            the P(axis) spec carries them as one global array."""
+            f = (lambda a: a[0]) if unstack else (lambda a: a[None])
+            if isinstance(st, DistributedOptimizerState):
+                if st.acc is not None:
+                    st = st._replace(acc=jax.tree.map(f, st.acc))
+                if st.residual is not None:
+                    st = st._replace(residual=jax.tree.map(f, st.residual))
             return st
+
+        def init_body(params):
+            return _stack_local(optimizer.init(params))
 
         # Grad-boundary taps (sched/hooks.py): when the overlap
         # scheduler drives a DistributedOptimizer (marker present), the
@@ -530,10 +675,7 @@ class TrainStep:
             return loss, None, None, grads
 
         def step_body(params, model_state, opt_state, batch):
-            if isinstance(opt_state, DistributedOptimizerState) and opt_state.acc is not None:
-                opt_state = opt_state._replace(
-                    acc=jax.tree.map(lambda a: a[0], opt_state.acc)
-                )
+            opt_state = _stack_local(opt_state, unstack=True)
             with jax.named_scope("hvd_compute_grads"):
                 loss, model_state, aux, grads = compute_grads(
                     params, model_state, batch
@@ -542,10 +684,7 @@ class TrainStep:
                 updates, opt_state = optimizer.update(grads, opt_state, params)
                 params = optax.apply_updates(params, updates)
             loss = lax.pmean(loss, axis)
-            if isinstance(opt_state, DistributedOptimizerState) and opt_state.acc is not None:
-                opt_state = opt_state._replace(
-                    acc=jax.tree.map(lambda a: a[None], opt_state.acc)
-                )
+            opt_state = _stack_local(opt_state)
             out = (params,)
             if stateful:
                 out += (model_state,)

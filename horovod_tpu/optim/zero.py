@@ -68,10 +68,24 @@ def clip_by_global_norm(max_norm: float, axis=WORLD_AXIS):
     return hook
 
 
+def _resolve_wire(wire):
+    """None ⇒ follow the scheduler's ``HVD_TPU_SCHED_WIRE`` /
+    ``HVD_TPU_SCHED_WIRE_EF`` knobs; explicit values pin it."""
+    from ..sched import current_config
+
+    cfg = current_config()
+    w = cfg.wire if wire is None else wire
+    w = (w or "off").strip().lower()
+    if w in ("none", ""):
+        w = "off"
+    return w, cfg.wire_ef
+
+
 def sharded_gradient_transformation(
     tx: optax.GradientTransformation,
     axis=WORLD_AXIS,
     pre_update=None,
+    wire=None,
 ) -> optax.GradientTransformation:
     """Wrap ``tx`` so init/update act on this rank's flat param shard.
 
@@ -85,13 +99,31 @@ def sharded_gradient_transformation(
     transforms (:func:`clip_by_global_norm`); it runs after the
     reduce-scatter, so :func:`global_norm`-style psums inside it see
     every shard.
+
+    ``wire``: ``"int8"`` / ``"fp8"`` runs both collectives on the
+    quantized wire (``ops/quantized.py`` — the reduce-scatter carries
+    ``quantize(g + r)`` with the error-feedback residual ``r`` folded
+    into the state as ``{"tx": ..., "ef": ...}``; the sharded update
+    consumes the dequantized fp32 shard; the post-update all-gather
+    re-quantizes).  ``None`` follows ``HVD_TPU_SCHED_WIRE``; ``"off"``
+    pins the dense wire (state structure unchanged).
     """
+    wire, wire_ef = _resolve_wire(wire)
+    quantized = wire in ("int8", "fp8")
+    ef = quantized and wire_ef
 
     def _shard_meta(params):
         flat, unravel = ravel_pytree(params)
         n = flat.shape[0]
         world = lax.axis_size(axis)
-        padded = -(-n // world) * world
+        unit = world
+        if quantized:
+            # Shards must stay quantization-block-aligned so the
+            # post-update all_gather re-quantizes without repadding.
+            from ..ops.quantized import quant_block
+
+            unit = world * quant_block()
+        padded = -(-n // unit) * unit
         return flat, unravel, n, world, padded
 
     def init_fn(params):
@@ -100,7 +132,10 @@ def sharded_gradient_transformation(
         shard_len = padded // world
         flat = jnp.pad(flat, (0, padded - n))
         my = lax.dynamic_slice(flat, (idx * shard_len,), (shard_len,))
-        return tx.init(my)
+        state = tx.init(my)
+        if ef:
+            state = {"tx": state, "ef": jnp.zeros((padded,), jnp.float32)}
+        return state
 
     def update_fn(grads, state, params=None):
         if params is None:
@@ -111,18 +146,45 @@ def sharded_gradient_transformation(
         idx = lax.axis_index(axis)
 
         gflat = jnp.pad(gflat, (0, padded - n))
-        # Average-reduce-scatter: each rank gets its 1/N of the mean grad.
-        gshard = lax.psum_scatter(
-            gflat, axis, scatter_dimension=0, tiled=True
-        ) / world
+        residual = None
+        if quantized:
+            from ..ops.quantized import (
+                quantized_all_gather,
+                quantized_reduce_scatter,
+            )
+            from ..ops.traced import Sum
+
+            if ef:
+                e = gflat.astype(jnp.float32) + state["ef"]
+                gshard, residual = quantized_reduce_scatter(
+                    e, axis, op=Sum, wire=wire, ef=True,
+                )
+                state = state["tx"]
+            else:
+                gshard = quantized_reduce_scatter(
+                    gflat, axis, op=Sum, wire=wire,
+                )
+            gshard = gshard / world
+        else:
+            # Average-reduce-scatter: each rank gets its 1/N of the
+            # mean grad.
+            gshard = lax.psum_scatter(
+                gflat, axis, scatter_dimension=0, tiled=True
+            ) / world
         pshard = lax.dynamic_slice(
             jnp.pad(pflat, (0, padded - n)), (idx * shard_len,), (shard_len,)
         )
         if pre_update is not None:
             gshard = pre_update(gshard)
-        ushard, state = tx.update(gshard, state, pshard)
+        ushard, state = tx.update(gshard.astype(pshard.dtype), state, pshard)
         # Assemble the full update vector; params stay replicated.
-        uflat = lax.all_gather(ushard, axis, tiled=True)[:n]
+        if quantized:
+            uflat = quantized_all_gather(ushard, axis, wire=wire)[:n]
+            uflat = uflat.astype(pshard.dtype)
+        else:
+            uflat = lax.all_gather(ushard, axis, tiled=True)[:n]
+        if ef:
+            state = {"tx": state, "ef": residual}
         return unravel(uflat), state
 
     return optax.GradientTransformation(init_fn, update_fn)
@@ -134,6 +196,7 @@ def zero_train_step(
     *,
     axis=WORLD_AXIS,
     pre_update=None,
+    wire=None,
 ):
     """Compiled SPMD step with ZeRO-1 sharded optimizer state.
 
@@ -143,16 +206,22 @@ def zero_train_step(
     leaves live sharded (leading dim padded_n/N per chip).
     ``pre_update`` hooks the reduced gradient shard before the inner
     update (global-norm clipping etc. — see
-    :func:`clip_by_global_norm`).
+    :func:`clip_by_global_norm`).  ``wire`` as in
+    :func:`sharded_gradient_transformation` (quantized RS/AG + error
+    feedback; default follows ``HVD_TPU_SCHED_WIRE``).
     """
     from jax.sharding import PartitionSpec as P
 
     from .. import runtime as _rt
 
-    stx = sharded_gradient_transformation(tx, axis=axis, pre_update=pre_update)
+    stx = sharded_gradient_transformation(
+        tx, axis=axis, pre_update=pre_update, wire=wire
+    )
     rt = _rt.get_runtime()
     mesh = rt.mesh
     param_spec = P()
+    wire_resolved, wire_ef = _resolve_wire(wire)
+    ef = wire_resolved in ("int8", "fp8") and wire_ef
 
     def init_body(params):
         return stx.init(params)
@@ -169,8 +238,19 @@ def zero_train_step(
         def abstract_init(p):
             flat, _ = ravel_pytree(p)
             world = rt.size
-            shard_len = -(-flat.shape[0] // world)
-            return tx.init(jnp.zeros((shard_len,), flat.dtype))
+            unit = world
+            if wire_resolved in ("int8", "fp8"):
+                from ..ops.quantized import quant_block
+
+                unit = world * quant_block()
+            padded = -(-flat.shape[0] // unit) * unit
+            state = tx.init(jnp.zeros((padded // world,), flat.dtype))
+            if ef:
+                state = {
+                    "tx": state,
+                    "ef": jnp.zeros((padded,), jnp.float32),
+                }
+            return state
 
         return _state_spec(jax.eval_shape(abstract_init, params), axis)
 

@@ -26,14 +26,21 @@ docs/scheduler.md.
 """
 
 from . import execute, hooks, plan, tune, zero1  # noqa: F401
-from .execute import exchange, sync_gradients_bucketed  # noqa: F401
+from .execute import (  # noqa: F401
+    exchange,
+    quantized_exchange_flat,
+    sync_gradients_bucketed,
+)
 from .plan import (  # noqa: F401
+    WIRE_CHOICES,
     Bucket,
     BucketSchedule,
     SchedConfig,
     build_schedule,
     current_config,
+    eligible_wire,
     set_config_override,
+    wire_bytes,
 )
 from .tune import ScheduleTuner  # noqa: F401
 from .zero1 import bucketed_zero_step  # noqa: F401

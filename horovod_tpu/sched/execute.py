@@ -21,11 +21,19 @@ import time
 from typing import Any, Callable, List, Optional, Sequence
 
 import jax
+import jax.numpy as jnp
 from jax import lax
 
 from .. import metrics
 from ..ops import fusion
-from .plan import BucketSchedule, SchedConfig, build_schedule, current_config
+from .plan import (
+    Bucket,
+    BucketSchedule,
+    SchedConfig,
+    build_schedule,
+    current_config,
+    wire_bytes,
+)
 
 
 def _chain(tensors: List[jax.Array], token: Optional[jax.Array]):
@@ -37,22 +45,43 @@ def _chain(tensors: List[jax.Array], token: Optional[jax.Array]):
     return list(out[:-1]), out[-1]
 
 
+def record_wire_metrics(schedule: BucketSchedule) -> None:
+    """Publish the per-wire payload gauges for one planned exchange:
+    ``sched.wire_bytes{wire=}`` (bytes/step on each wire format) and
+    ``sched.compression_ratio`` (dense bytes / wire bytes — 1.0 when
+    every bucket is dense)."""
+    per_wire: dict = {}
+    for b in schedule.buckets:
+        per_wire[b.wire] = per_wire.get(b.wire, 0) + wire_bytes(b)
+    total_wire = sum(per_wire.values())
+    for w, nbytes in per_wire.items():
+        metrics.set_gauge("sched.wire_bytes", nbytes, {"wire": w})
+        metrics.inc_counter(f"sched.wire_bytes.{w}", nbytes)
+    if total_wire > 0:
+        metrics.set_gauge(
+            "sched.compression_ratio", schedule.total_bytes / total_wire
+        )
+
+
 def exchange(
     wire: Sequence[jax.Array],
     schedule: BucketSchedule,
-    reduce_flat: Callable[[jax.Array], jax.Array],
+    reduce_flat: Callable[[jax.Array, Bucket], jax.Array],
     *,
     barriers: bool = True,
     timeline: Any = None,
 ) -> List[jax.Array]:
     """Run ``schedule`` over the ``wire`` leaves: per bucket, flatten ->
-    one collective per dtype (via ``reduce_flat``) -> slice back out.
-    Returns the reduced leaves in original flatten order.
+    one collective per dtype (via ``reduce_flat(flat, bucket)``) ->
+    slice back out.  Returns the reduced leaves in original flatten
+    order.
 
     Values are independent of bucketing: XLA collectives are
     elementwise over the buffer, so concat order never changes a sum —
-    the scheduler is numerics-identical to the single-fused-exchange
-    legacy path by construction.
+    with a dense wire the scheduler is numerics-identical to the
+    single-fused-exchange legacy path by construction.  A bucket whose
+    ``wire`` is quantized trades that identity for compressed wire
+    bytes (the reducer routes it through ops/quantized.py).
     """
     t0 = time.perf_counter()
     reduced: List[jax.Array] = list(wire)
@@ -64,14 +93,15 @@ def exchange(
         if timeline is not None:
             timeline.record_op(
                 f"bucket{bi}[n={len(bucket.indices)},"
-                f"dtype={'+'.join(bucket.wire_dtypes)}]",
-                "SCHED_EXCHANGE", bucket.nbytes,
+                f"dtype={'+'.join(bucket.wire_dtypes)},"
+                f"wire={bucket.wire}]",
+                "SCHED_EXCHANGE", wire_bytes(bucket),
             )
         with jax.named_scope(
-            f"hvd_sched_bucket{bi}_{bucket.nbytes}B"
+            f"hvd_sched_bucket{bi}_{bucket.nbytes}B_{bucket.wire}"
         ):
             flats, meta = fusion.flatten_group(ins)
-            outs = [reduce_flat(f) for f in flats]
+            outs = [reduce_flat(f, bucket) for f in flats]
         if barriers:
             # Scalar carried out of this bucket's collective: the next
             # bucket's inputs are barrier-tied to it, enforcing issue
@@ -88,10 +118,83 @@ def exchange(
     metrics.inc_counter("sched.exchange_bytes", schedule.total_bytes)
     metrics.set_gauge("sched.buckets_per_step", len(schedule))
     metrics.set_gauge("sched.bytes_per_step", schedule.total_bytes)
+    record_wire_metrics(schedule)
     # Emission cost of the exchange subgraph (trace-time under jit; the
     # device-side wire time is the profiler's/timeline's to attribute).
     metrics.observe("sched.exchange_seconds", time.perf_counter() - t0)
     return reduced
+
+
+def quantized_exchange_flat(
+    f: jax.Array,
+    *,
+    axis,
+    average: bool,
+    wire: str,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    shard_update: Optional[Callable[[jax.Array], jax.Array]] = None,
+    residual: Optional[jax.Array] = None,
+    process_set=None,
+):
+    """One bucket's quantized ``reduce_scatter + all_gather`` exchange
+    (the ops/quantized.py phase primitives on a flat buffer): blockwise
+    quantize → ``all_to_all`` wire → fp32 dequant-accumulate shard →
+    optional ``shard_update`` (the ZeRO-1 hook, fed **fp32**) →
+    re-quantize → tiled ``all_gather`` → dequant.
+
+    ``residual`` engages error feedback: the wire carries
+    ``quantize(f·prescale + residual)`` and the new residual
+    ``e − dequant(q)`` is returned alongside (None ⇒ no EF, returns
+    ``(out, None)``).  Serves both scheduler modes — for a quantized
+    bucket the RS+AG decomposition *is* the allreduce.
+    """
+    from ..ops.quantized import (
+        quantized_all_gather,
+        quantized_reduce_scatter,
+    )
+    from ..ops.traced import Sum, _scale
+
+    n = f.shape[0]
+    g = _scale(f.astype(jnp.float32), prescale_factor)
+    if residual is not None:
+        g = g + residual.astype(jnp.float32)
+        shard, r_new = quantized_reduce_scatter(
+            g, axis, op=Sum, process_set=process_set, wire=wire, ef=True,
+        )
+    else:
+        shard = quantized_reduce_scatter(
+            g, axis, op=Sum, process_set=process_set, wire=wire,
+        )
+        r_new = None
+    world = lax.axis_size(axis) if process_set is None else None
+    if world is None:
+        from ..ops.quantized import _axis_groups
+
+        world = _axis_groups(axis, process_set)[1]
+    if average:
+        postscale_factor = postscale_factor / world
+    shard = _scale(shard, postscale_factor)
+    if shard_update is not None:
+        shard = shard_update(shard)
+    out = quantized_all_gather(
+        shard, axis, process_set=process_set, wire=wire
+    )[:n]
+    return out.astype(f.dtype), r_new
+
+
+def bf16_wire(reduce_dense: Callable[[jax.Array], jax.Array]):
+    """Wrap a dense flat reducer with a bf16 cast around the wire (the
+    per-bucket ``wire="bf16"`` lowering — same scheme as
+    ``Compression.bf16`` but chosen per bucket by the plan/tuner)."""
+
+    def reduce(f: jax.Array) -> jax.Array:
+        if not jnp.issubdtype(f.dtype, jnp.floating) \
+                or f.dtype == jnp.bfloat16:
+            return reduce_dense(f)
+        return reduce_dense(f.astype(jnp.bfloat16)).astype(f.dtype)
+
+    return reduce
 
 
 def reduce_scatter_flat(
@@ -134,7 +237,9 @@ def sync_gradients_bucketed(
     param_shard_axes: Any = None,
     axes: Sequence[str] = (),
     cfg: Optional[SchedConfig] = None,
-) -> Any:
+    *,
+    residuals: Any = None,
+):
     """Scheduler-mode :func:`~horovod_tpu.parallel.grad_sync.sync_gradients`.
 
     Same per-parameter rule (pmean over every sync axis the parameter is
@@ -145,7 +250,16 @@ def sync_gradients_bucketed(
     reverse-backward buckets, one fused ``pmean`` per bucket.  The
     divide-by-axis-size scaling stays per-leaf and local (no wire
     traffic), so hybrid-mesh semantics are respected exactly —
-    bit-for-bit equal to the per-leaf path (pmean is elementwise).
+    bit-for-bit equal to the per-leaf path (pmean is elementwise) when
+    the wire is dense.
+
+    ``cfg.wire`` (``HVD_TPU_SCHED_WIRE``): quantized buckets whose
+    mean-axes set is a *single* axis route through the quantized RS+AG
+    primitives; multi-axis pmean groups stay dense (the all_to_all
+    phase has no multi-axis form).  ``residuals`` — a pytree matching
+    ``grads`` — engages error feedback on those quantized buckets; the
+    call then returns ``(synced, new_residuals)`` for the caller's
+    state (see docs/quantization.md).
     """
     from ..parallel.grad_sync import _parse
     from ..parallel.tensor import _axis_present
@@ -154,6 +268,11 @@ def sync_gradients_bucketed(
         cfg = current_config()
     present = tuple(a for a in axes if _axis_present(a))
     leaves, treedef = jax.tree.flatten(grads)
+    res_leaves = None
+    if residuals is not None:
+        res_leaves = jax.tree.flatten(residuals)[0]
+        if len(res_leaves) != len(leaves):
+            raise ValueError("residuals structure does not match grads")
     if param_shard_axes is None:
         shard_strs = [""] * len(leaves)
     else:
@@ -164,6 +283,7 @@ def sync_gradients_bucketed(
             )
 
     out = list(leaves)
+    res_out = list(res_leaves) if res_leaves is not None else None
     groups: dict = {}  # mean_over tuple -> [leaf indices]
     for i, s in enumerate(shard_strs):
         sharded = _parse(s)
@@ -176,10 +296,40 @@ def sync_gradients_bucketed(
             int(leaves[i].size) * leaves[i].dtype.itemsize for i in idxs
         ]
         dtypes = [str(leaves[i].dtype) for i in idxs]
-        schedule = build_schedule(sizes, dtypes, cfg)
+        # Quantized wire needs one named axis for its all_to_all phase.
+        wire_req = cfg.wire
+        if wire_req in ("int8", "fp8") and len(mean_over) != 1:
+            wire_req = "off"
+        schedule = build_schedule(sizes, dtypes, cfg, wire=wire_req)
+
+        def reduce_flat(f, bucket, _m=mean_over, _idxs=idxs):
+            # bucket.indices are positions in this group's leaf list;
+            # _idxs maps them back to global flatten indices.
+            if bucket.wire in ("int8", "fp8"):
+                res_flat = None
+                if res_out is not None:
+                    bucket_res = [res_out[_idxs[j]] for j in bucket.indices]
+                    rf, rmeta = fusion.flatten_group(bucket_res)
+                    res_flat = rf[0]
+                red, r_new = quantized_exchange_flat(
+                    f, axis=_m[0], average=True, wire=bucket.wire,
+                    residual=res_flat,
+                )
+                if r_new is not None:
+                    for j, r in zip(
+                        bucket.indices,
+                        fusion.unflatten_group([r_new], rmeta),
+                    ):
+                        res_out[_idxs[j]] = r.astype(
+                            res_out[_idxs[j]].dtype
+                        )
+                return red
+            if bucket.wire == "bf16":
+                return bf16_wire(lambda x: lax.pmean(x, _m))(f)
+            return lax.pmean(f, _m)
+
         reduced = exchange(
-            [leaves[i] for i in idxs], schedule,
-            lambda f, _m=mean_over: lax.pmean(f, _m),
+            [leaves[i] for i in idxs], schedule, reduce_flat,
             barriers=cfg.barriers,
         )
         for i, t in zip(idxs, reduced):
@@ -193,4 +343,7 @@ def sync_gradients_bucketed(
                 scale *= lax.axis_size(a)
         if scale != 1:
             out[i] = out[i] / scale
-    return jax.tree.unflatten(treedef, out)
+    synced = jax.tree.unflatten(treedef, out)
+    if res_out is not None:
+        return synced, jax.tree.unflatten(treedef, res_out)
+    return synced

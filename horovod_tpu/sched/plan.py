@@ -26,6 +26,27 @@ from ..ops import fusion
 from ..utils import env
 
 
+# Per-bucket wire formats the plan stage can assign.  "off" keeps the
+# bucket on the dense (or compressor-cast) wire; "bf16" casts the
+# bucket's flat buffer around the collective; "int8"/"fp8" route the
+# bucket through the quantized phase primitives (ops/quantized.py).
+WIRE_CHOICES = ("off", "bf16", "int8", "fp8")
+
+
+def _canon_wire_choice(wire: str) -> str:
+    w = (wire or "off").strip().lower()
+    if w in ("none", "0", "false", "no", ""):
+        w = "off"
+    if w == "e4m3":
+        w = "fp8"
+    if w not in WIRE_CHOICES:
+        raise ValueError(
+            f"HVD_TPU_SCHED_WIRE must be one of {WIRE_CHOICES}, "
+            f"got {wire!r}"
+        )
+    return w
+
+
 @dataclasses.dataclass(frozen=True)
 class SchedConfig:
     """Knobs of the bucketed overlap scheduler (``HVD_TPU_SCHED*``)."""
@@ -36,6 +57,8 @@ class SchedConfig:
     look_ahead: int = 3
     barriers: bool = True
     capture_order: bool = True
+    wire: str = "off"  # "off" | "bf16" | "int8" | "fp8"
+    wire_ef: bool = True  # error-feedback residuals for quantized wires
 
     def __post_init__(self):
         if self.mode not in ("allreduce", "reduce_scatter"):
@@ -43,6 +66,7 @@ class SchedConfig:
                 f"HVD_TPU_SCHED_MODE must be 'allreduce' or "
                 f"'reduce_scatter', got {self.mode!r}"
             )
+        object.__setattr__(self, "wire", _canon_wire_choice(self.wire))
 
     @classmethod
     def from_env(cls) -> "SchedConfig":
@@ -57,6 +81,8 @@ class SchedConfig:
             look_ahead=env.get_int(env.SCHED_LOOK_AHEAD, 3),
             barriers=env.get_bool(env.SCHED_BARRIERS, True),
             capture_order=env.get_bool(env.SCHED_CAPTURE_ORDER, True),
+            wire=env.get_env(env.SCHED_WIRE, "off") or "off",
+            wire_ef=env.get_bool(env.SCHED_WIRE_EF, True),
         )
 
 
@@ -80,12 +106,16 @@ def current_config() -> SchedConfig:
 @dataclasses.dataclass(frozen=True)
 class Bucket:
     """One fused exchange: leaf ``indices`` (original flatten order)
-    sharing a wire collective of ``nbytes`` total."""
+    sharing a wire collective of ``nbytes`` total.  ``wire`` is the
+    bucket's wire format (``WIRE_CHOICES``): the plan requests it, the
+    execute stage lowers it (quantized formats through the
+    ops/quantized.py phase primitives)."""
 
     indices: Tuple[int, ...]
     nbytes: int
     wire_dtypes: Tuple[str, ...]  # distinct dtypes, flatten order
     pinned: bool = False  # from an explicit user group
+    wire: str = "off"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -104,7 +134,7 @@ class BucketSchedule:
         identical exchange programs (determinism tests key on this)."""
         return (
             self.mode,
-            tuple((b.indices, b.nbytes, b.wire_dtypes, b.pinned)
+            tuple((b.indices, b.nbytes, b.wire_dtypes, b.pinned, b.wire)
                   for b in self.buckets),
         )
 
@@ -116,6 +146,7 @@ def build_schedule(
     *,
     order: Optional[Sequence[int]] = None,
     pinned: Sequence[Sequence[int]] = (),
+    wire: Optional[str] = None,
 ) -> BucketSchedule:
     """Plan the exchange for leaves of ``sizes_bytes``/``dtypes``.
 
@@ -126,12 +157,18 @@ def build_schedule(
     ``DistributedOptimizer(groups=...)``) fuse atomically and are
     emitted where their *earliest-ready* member falls in the order.
 
+    ``wire`` overrides ``cfg.wire`` as the requested per-bucket wire
+    format; each bucket gets it only when eligible
+    (:func:`eligible_wire` — quantized wires need a single floating
+    dtype), else falls back to ``"off"`` for that bucket.
+
     Pure function of its arguments: same metadata + config -> identical
     schedule (plan determinism is load-bearing — every SPMD rank must
     emit the same collectives in the same order).
     """
     if cfg is None:
         cfg = current_config()
+    wire = _canon_wire_choice(cfg.wire if wire is None else wire)
     n = len(sizes_bytes)
     if order is None:
         order = range(n - 1, -1, -1)
@@ -151,7 +188,8 @@ def build_schedule(
         pinned_set.update(idx)
         pinned_buckets.append((
             min(rank_of[i] for i in idx),
-            _make_bucket(idx, sizes_bytes, dtypes, pinned=True),
+            _make_bucket(idx, sizes_bytes, dtypes, pinned=True,
+                         wire=wire),
         ))
 
     free = [i for i in order if i not in pinned_set]
@@ -166,7 +204,7 @@ def build_schedule(
         idx = tuple(sorted(free[j] for j in b))
         planned_buckets.append((
             min(rank_of[i] for i in idx),
-            _make_bucket(idx, sizes_bytes, dtypes),
+            _make_bucket(idx, sizes_bytes, dtypes, wire=wire),
         ))
 
     ordered = [
@@ -181,15 +219,61 @@ def build_schedule(
     )
 
 
+def eligible_wire(wire: str, wire_dtypes: Sequence[str]) -> str:
+    """Downgrade a requested wire format to what the bucket supports.
+
+    Quantized wires (int8/fp8) need one floating dtype per bucket (the
+    residual/scale bookkeeping tracks a single flat buffer); bf16 needs
+    floating leaves.  Ineligible buckets fall back to ``"off"`` — the
+    dense (or compressor) wire — never to a half-applied quantization.
+    """
+    if wire == "off":
+        return wire
+    import jax.numpy as jnp
+
+    floating = all(
+        jnp.issubdtype(jnp.dtype(d), jnp.floating) for d in wire_dtypes
+    )
+    if not floating:
+        return "off"
+    if wire in ("int8", "fp8") and len(set(wire_dtypes)) != 1:
+        return "off"
+    return wire
+
+
 def _make_bucket(
     indices: Tuple[int, ...],
     sizes_bytes: Sequence[int],
     dtypes: Sequence[str],
     pinned: bool = False,
+    wire: str = "off",
 ) -> Bucket:
+    wire_dtypes = tuple(dict.fromkeys(dtypes[i] for i in indices))
     return Bucket(
         indices=indices,
         nbytes=sum(int(sizes_bytes[i]) for i in indices),
-        wire_dtypes=tuple(dict.fromkeys(dtypes[i] for i in indices)),
+        wire_dtypes=wire_dtypes,
         pinned=pinned,
+        wire=eligible_wire(wire, wire_dtypes),
     )
+
+
+def wire_bytes(bucket: Bucket, block: Optional[int] = None) -> int:
+    """One-phase wire payload bytes of a bucket under its wire format
+    (the apples-to-apples number behind ``sched.wire_bytes{wire=}`` and
+    the compression-ratio gauge): dense bytes for ``off``, 2
+    bytes/element for ``bf16``, 1 byte/element + fp32 block scales for
+    the quantized formats."""
+    if bucket.wire == "off":
+        return bucket.nbytes
+    import jax.numpy as jnp
+
+    itemsize = jnp.dtype(bucket.wire_dtypes[0]).itemsize
+    elems = bucket.nbytes // itemsize
+    if bucket.wire == "bf16":
+        return elems * 2
+    if block is None:
+        from ..ops.quantized import quant_block
+
+        block = quant_block()
+    return elems + 4 * (-(-elems // block))

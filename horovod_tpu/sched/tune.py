@@ -71,16 +71,56 @@ def window_score(
 
 class ScheduleTuner:
     """FusionAutotuner wired to the scheduler's bucket-size knob with
-    registry-fed window scores."""
+    registry-fed window scores.
 
-    def __init__(self, **tuner_kwargs):
+    ``explore_wire=True`` adds the quantized-wire dimension: each
+    window runs under one wire candidate (``wire_candidates``, default
+    off → bf16 → int8 → fp8), scored from the same registry deltas;
+    once every candidate has a score the best freezes and bucket-size
+    tuning proceeds under it.  Apply the suggestion per bucket with
+    :meth:`wire` + :func:`~horovod_tpu.sched.plan.build_schedule`'s
+    ``wire=`` argument (small buckets below ``wire_min_bucket_bytes``
+    stay dense — the fp32 scale sidecar dominates tiny payloads)::
+
+        tuner = ScheduleTuner(explore_wire=True)
+        while not tuner.converged:
+            cfg = dataclasses.replace(
+                cfg, bucket_bytes=tuner.bucket_bytes(), wire=tuner.wire())
+            tuner.begin_window(); run_steps(window); tuner.end_window()
+
+    Scores are exchanged-bytes/sec over the *dense* byte gauge, so a
+    wire that trains the same steps/sec wins only via its bucket plan —
+    and a quantized wire that slows convergence shows up as fewer
+    steps (the EF residual keeps trajectories close; see
+    docs/quantization.md).
+    """
+
+    def __init__(self, explore_wire: bool = False,
+                 wire_candidates=("off", "bf16", "int8", "fp8"),
+                 wire_min_bucket_bytes: int = 1 << 16,
+                 **tuner_kwargs):
         self.tuner = FusionAutotuner(**tuner_kwargs)
         self._baseline: Optional[Dict[str, float]] = None
+        self._explore_wire = explore_wire
+        self._wire_candidates = tuple(wire_candidates)
+        self.wire_min_bucket_bytes = wire_min_bucket_bytes
+        self._wire_scores: Dict[str, float] = {}
+        self._wire_frozen: Optional[str] = None if explore_wire else "off"
 
     def bucket_bytes(self) -> int:
         """Bucket-size suggestion for the next window (frozen winner
         after convergence)."""
         return self.tuner.threshold_bytes()
+
+    def wire(self) -> str:
+        """Wire-format suggestion for the next window: the next unscored
+        candidate while exploring, the frozen winner after."""
+        if self._wire_frozen is not None:
+            return self._wire_frozen
+        for w in self._wire_candidates:
+            if w not in self._wire_scores:
+                return w
+        return self._wire_frozen or "off"
 
     def begin_window(self) -> None:
         # Prime the suggestion: FusionAutotuner only accepts an observe
@@ -90,19 +130,59 @@ class ScheduleTuner:
 
     def end_window(self) -> float:
         """Close the window: score it from the registry deltas and feed
-        the tuner.  Returns the score (0.0 when no window was open or
-        no steps ran — not observed, so an idle window cannot poison
-        the search)."""
+        the search.  While wire exploration is open the score lands on
+        the current wire candidate; afterwards it feeds the bucket-size
+        tuner.  Returns the score (0.0 when no window was open or no
+        steps ran — not observed, so an idle window cannot poison the
+        search)."""
         if self._baseline is None:
             return 0.0
         score = window_score(self._baseline, registry_view())
         self._baseline = None
-        if score > 0.0:
+        if score <= 0.0:
+            return score
+        metrics.inc_counter("sched.tune_windows")
+        metrics.set_gauge("sched.tune_score", score)
+        if self._wire_frozen is None:
+            w = self.wire()
+            self._wire_scores[w] = max(self._wire_scores.get(w, 0.0), score)
+            metrics.set_gauge(
+                "sched.tune_wire_score", score, {"wire": w}
+            )
+            if all(c in self._wire_scores for c in self._wire_candidates):
+                self._wire_frozen = max(
+                    self._wire_scores, key=self._wire_scores.get
+                )
+                metrics.set_gauge(
+                    "sched.tune_wire_frozen", 1.0,
+                    {"wire": self._wire_frozen},
+                )
+        else:
             self.tuner.observe(score)
-            metrics.inc_counter("sched.tune_windows")
-            metrics.set_gauge("sched.tune_score", score)
         return score
+
+    def apply(self, schedule):
+        """Stamp the current wire suggestion onto a built schedule,
+        per bucket: buckets below ``wire_min_bucket_bytes`` stay dense
+        under a quantized suggestion (scale-sidecar overhead dominates
+        tiny payloads), ineligible buckets downgrade via
+        :func:`~horovod_tpu.sched.plan.eligible_wire`."""
+        import dataclasses as _dc
+
+        from .plan import eligible_wire
+
+        w = self.wire()
+        buckets = []
+        for b in schedule.buckets:
+            req = w
+            if w in ("int8", "fp8") and \
+                    b.nbytes < self.wire_min_bucket_bytes:
+                req = "off"
+            buckets.append(
+                _dc.replace(b, wire=eligible_wire(req, b.wire_dtypes))
+            )
+        return _dc.replace(schedule, buckets=tuple(buckets))
 
     @property
     def converged(self) -> bool:
-        return self.tuner.converged
+        return self._wire_frozen is not None and self.tuner.converged

@@ -39,6 +39,7 @@ class _BucketLayout:
     n: int  # valid elements
     padded: int  # n rounded up to a world multiple
     shard_len: int
+    wire: str = "off"  # per-bucket wire format (plan.WIRE_CHOICES)
 
 
 def _layouts(
@@ -61,11 +62,18 @@ def _layouts(
             int(leaves[i].size) for i in b.indices
         )
         n = sum(sizes)
-        padded = -(-n // world) * world
+        unit = world
+        if b.wire in ("int8", "fp8"):
+            # Quantized shards must stay block-aligned so the
+            # post-update all_gather can re-quantize without repadding.
+            from ..ops.quantized import quant_block
+
+            unit = world * quant_block()
+        padded = -(-n // unit) * unit
         layouts.append(_BucketLayout(
             indices=b.indices, shapes=shapes, sizes=sizes,
             dtype=jnp.dtype(b.wire_dtypes[0]), n=n, padded=padded,
-            shard_len=padded // world,
+            shard_len=padded // world, wire=b.wire,
         ))
     return layouts, schedule
 
@@ -105,6 +113,15 @@ def bucketed_zero_step(
     ``optim.zero.clip_by_global_norm``) runs on the full list of
     gradient shards before any bucket's optimizer update — global
     reductions see every shard.
+
+    ``cfg.wire`` (``HVD_TPU_SCHED_WIRE``): quantized buckets run the
+    ZeRO pipeline end-to-end on the quantized wire — the per-bucket
+    reduce-scatter quantizes ``g + r`` (EF residual in the bucket's
+    state when ``cfg.wire_ef``), the sharded optimizer update consumes
+    the dequantized **fp32** shard, and only the post-update
+    ``all_gather`` re-quantizes.  A quantized bucket's state entry
+    becomes ``{"tx": <inner state>, "ef": <residual>}``; with
+    ``wire="off"`` the state structure is unchanged from PR 3.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -122,6 +139,9 @@ def bucketed_zero_step(
             params_like, world, cfg
         )
 
+    def _ef_on(lay: _BucketLayout) -> bool:
+        return cfg.wire_ef and lay.wire in ("int8", "fp8")
+
     def init_body(params):
         leaves = jax.tree.leaves(params)
         idx = lax.axis_index(axis)
@@ -131,10 +151,19 @@ def bucketed_zero_step(
             shard = lax.dynamic_slice(
                 flat, (idx * lay.shard_len,), (lay.shard_len,)
             )
-            states.append(tx.init(shard))
+            st = tx.init(shard)
+            if _ef_on(lay):
+                st = {"tx": st, "ef": jnp.zeros((lay.padded,), jnp.float32)}
+            states.append(st)
         return tuple(states)
 
     def step_body(params, opt_states, batch):
+        from ..ops.quantized import (
+            quantized_all_gather,
+            quantized_reduce_scatter,
+        )
+        from ..ops.traced import Sum
+
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         gleaves, treedef = jax.tree.flatten(grads)
         pleaves = jax.tree.leaves(params)
@@ -143,32 +172,65 @@ def bucketed_zero_step(
 
         # Phase 1: per-bucket reduce-scatter, barrier-chained so buckets
         # issue in reverse-backward order and overlap the backward.
+        # Quantized buckets ride the int8/fp8 wire (ops/quantized.py);
+        # the dequant-accumulated shard is fp32 either way, so the
+        # sharded optimizer update below always runs in full precision.
         gshards = []
+        new_residuals = []
         token = None
-        for lay in layouts:
+        for lay, st in zip(layouts, opt_states):
             g = _bucket_flat(gleaves, lay)
             if cfg.barriers and token is not None:
                 g, token = lax.optimization_barrier((g, token))
-            shard = lax.psum_scatter(
-                g, axis, scatter_dimension=0, tiled=True
-            ) / world
+            if lay.wire in ("int8", "fp8"):
+                if _ef_on(lay):
+                    e = g.astype(jnp.float32) + st["ef"]
+                    shard, r_new = quantized_reduce_scatter(
+                        e, axis, op=Sum, wire=lay.wire, ef=True,
+                    )
+                    new_residuals.append(r_new)
+                else:
+                    shard = quantized_reduce_scatter(
+                        g, axis, op=Sum, wire=lay.wire,
+                    )
+                    new_residuals.append(None)
+                shard = shard / world
+            else:
+                shard = lax.psum_scatter(
+                    g, axis, scatter_dimension=0, tiled=True
+                ) / world
+                new_residuals.append(None)
             if cfg.barriers:
                 token = shard.reshape(-1)[0]
             gshards.append(shard)
         if pre_update is not None:
             gshards = pre_update(gshards)
 
-        # Phase 2: shard update + all-gather per bucket.
+        # Phase 2: shard update + all-gather per bucket; only the
+        # post-update gather re-quantizes on a quantized bucket.
         uleaves = [None] * len(gleaves)
         new_states = []
-        for lay, shard, state in zip(layouts, gshards, opt_states):
+        for lay, shard, state, r_new in zip(
+            layouts, gshards, opt_states, new_residuals
+        ):
+            tx_state = state["tx"] if _ef_on(lay) else state
             pflat = _bucket_flat(pleaves, lay)
             pshard = lax.dynamic_slice(
                 pflat, (idx * lay.shard_len,), (lay.shard_len,)
             )
-            ushard, state = tx.update(shard, state, pshard)
-            new_states.append(state)
-            uflat = lax.all_gather(ushard, axis, tiled=True)[:lay.n]
+            ushard, tx_state = tx.update(
+                shard.astype(lay.dtype), tx_state, pshard
+            )
+            if _ef_on(lay):
+                new_states.append({"tx": tx_state, "ef": r_new})
+            else:
+                new_states.append(tx_state)
+            if lay.wire in ("int8", "fp8"):
+                uflat = quantized_all_gather(
+                    ushard, axis, wire=lay.wire
+                )[:lay.n].astype(lay.dtype)
+            else:
+                uflat = lax.all_gather(ushard, axis, tiled=True)[:lay.n]
             for i, u in zip(lay.indices, _bucket_unflat(uflat, lay)):
                 uleaves[i] = u
         updates = jax.tree.unflatten(treedef, uleaves)
@@ -177,18 +239,27 @@ def bucketed_zero_step(
 
     def state_spec():
         def abstract_init():
-            return tuple(
-                tx.init(jnp.zeros((lay.shard_len,), lay.dtype))
-                for lay in meta["layouts"]
-            )
+            states = []
+            for lay in meta["layouts"]:
+                st = tx.init(jnp.zeros((lay.shard_len,), lay.dtype))
+                if _ef_on(lay):
+                    st = {
+                        "tx": st,
+                        "ef": jnp.zeros((lay.padded,), jnp.float32),
+                    }
+                states.append(st)
+            return tuple(states)
 
         return _state_spec(jax.eval_shape(abstract_init), axis)
 
     def _record():
+        from .execute import record_wire_metrics
+
         sched = meta["schedule"]
         metrics.set_gauge("sched.buckets_per_step", len(sched))
         metrics.set_gauge("sched.bytes_per_step", sched.total_bytes)
         metrics.inc_counter("sched.zero_steps_built")
+        record_wire_metrics(sched)
 
     class _Step:
         def __init__(self):

@@ -58,6 +58,15 @@ SCHED_BUCKET_BYTES = "SCHED_BUCKET_BYTES"  # default: fusion threshold
 SCHED_LOOK_AHEAD = "SCHED_LOOK_AHEAD"  # bucket-close look-ahead, default 3
 SCHED_BARRIERS = "SCHED_BARRIERS"  # optimization_barrier sequencing, default on
 SCHED_CAPTURE_ORDER = "SCHED_CAPTURE_ORDER"  # backward-order hooks, default on
+# Quantized wire v2 (ops/quantized.py + sched/): per-bucket wire format
+# for the scheduler's exchange — off (default; dense/compressor wire) |
+# bf16 | int8 | fp8.  See docs/quantization.md.
+SCHED_WIRE = "SCHED_WIRE"
+# Error-feedback residuals for quantized wires (default on): carry
+# r <- (g + r) - dequant(quantize(g + r)) in optimizer state.
+SCHED_WIRE_EF = "SCHED_WIRE_EF"
+# Elements per quantization block (fp32 scale granularity), default 512.
+QUANT_BLOCK = "QUANT_BLOCK"
 
 # Launcher-provided rendezvous env (analog of reference gloo_run.py:65-103).
 RANK = "RANK"
