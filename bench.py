@@ -355,6 +355,7 @@ def main():
         except Exception as e:  # secondary workload must not sink primary
             result["gpt2_small"] = {"error": f"{type(e).__name__}: {e}"}
     _maybe_scaling(result, deadline_s, t_start)
+    _maybe_topo(result, deadline_s, t_start)
     print(json.dumps(result))
 
 
@@ -407,6 +408,50 @@ def _maybe_scaling(result: dict, deadline_s: float,
         )
     except Exception as e:
         result["scaling"] = {"error": f"{type(e).__name__}: {e}"}
+
+
+def _maybe_topo(result: dict, deadline_s: float, t_start: float) -> None:
+    """Append the ``topo_hier_vs_flat`` record (HVD_BENCH_TOPO=0 skips):
+    flat-vs-hierarchical gradient exchange on a simulated 2-slice mesh,
+    run by tools/topo_bench.py on a scrubbed 8-device CPU backend in a
+    subprocess — the structural bytes-over-DCN ratio plus step times,
+    produced unattended regardless of the real chip count (same
+    rationale as the scaling record above)."""
+    import sys
+
+    if os.environ.get("HVD_BENCH_TOPO", "1") == "0":
+        return
+    if deadline_s - (time.monotonic() - t_start) < 75:
+        result["topo_hier_vs_flat"] = {"error": "skipped: deadline too close"}
+        return
+    try:
+        import subprocess as sp
+
+        repo = os.path.dirname(os.path.abspath(__file__))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+        )
+        env["XLA_FLAGS"] = (
+            "--xla_force_host_platform_device_count=8 "
+            + env.get("XLA_FLAGS", "")
+        ).strip()
+        env["JAX_PLATFORMS"] = "cpu"
+        env.setdefault("HVD_TPU_TOPO", "2x4")
+        for key in ("JAX_PLATFORM_NAME", "PJRT_DEVICE",
+                    "TPU_LIBRARY_PATH", "PALLAS_AXON_POOL_IPS"):
+            env.pop(key, None)
+        out = sp.run(
+            [sys.executable, os.path.join(repo, "tools", "topo_bench.py")],
+            capture_output=True, text=True, timeout=300, env=env, cwd=repo,
+        )
+        line = (out.stdout or "").strip().splitlines()
+        result["topo_hier_vs_flat"] = (
+            json.loads(line[-1]) if out.returncode == 0 and line
+            else {"error": f"rc={out.returncode}: {(out.stderr or '')[-300:]}"}
+        )
+    except Exception as e:
+        result["topo_hier_vs_flat"] = {"error": f"{type(e).__name__}: {e}"}
 
 
 if __name__ == "__main__":

@@ -25,10 +25,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax import shard_map
 from jax.sharding import PartitionSpec as P
 
-import horovod_tpu as hvd
+import horovod_tpu as hvd  # installs the jax<0.5 compat shims
+
+shard_map = jax.shard_map
 from horovod_tpu.models import gpt_small, gpt_tiny
 from horovod_tpu.models.transformer import (
     packed_token_cross_entropy,

@@ -69,3 +69,28 @@ class QuantizedWireError(HorovodTpuError, ValueError):
     gradients).  Subclasses ``ValueError`` for backward compatibility;
     the autotune quantized-probe retry catches exactly this type so an
     unrelated user ``ValueError`` never silently rejects the knob."""
+
+
+class ProcessSetTilingError(QuantizedWireError):
+    """A rank subset cannot tile the axis into equal-size XLA replica
+    groups — the one structured error shared by everything that lowers
+    to ``replica_groups``: process-set partitioning
+    (``process_sets.tiling_groups``), the quantized wire's phase
+    collectives (``ops/quantized.py``), and hierarchical ICI/DCN group
+    construction (``topo/``).  Subclasses :class:`QuantizedWireError`
+    so callers that historically caught the quantized type keep
+    working.  Structured fields: ``ranks`` (the offending subset),
+    ``world_size`` (the axis extent), ``context`` (which machinery
+    needed the tiling)."""
+
+    def __init__(self, ranks, world_size: int, context: str = ""):
+        self.ranks = tuple(int(r) for r in ranks)
+        self.world_size = int(world_size)
+        self.context = context
+        where = f" ({context})" if context else ""
+        super().__init__(
+            f"ranks {list(self.ranks)} do not tile the axis of size "
+            f"{self.world_size} into equal replica groups{where}; XLA "
+            "replica_groups require an equal-size partition — use the "
+            "dense/masked path for arbitrary subsets"
+        )

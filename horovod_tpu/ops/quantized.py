@@ -60,8 +60,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..exceptions import QuantizedWireError
-from ..process_sets import ProcessSet
+from ..exceptions import ProcessSetTilingError, QuantizedWireError
+from ..process_sets import ProcessSet, tiling_groups
 from ..runtime import WORLD_AXIS
 from ..utils import env
 from .traced import Average, Sum
@@ -146,10 +146,16 @@ def _dequantize_blocks(q: jax.Array, s: jax.Array,
     ).reshape(r, c)
 
 
-def _axis_groups(axis, process_set: Optional[ProcessSet]):
+def _axis_groups(axis, process_set: Optional[ProcessSet], groups=None):
     """Resolve (replica groups, participant count) for the phase
-    collectives.  Raises :class:`QuantizedWireError` when the reduction
-    shape cannot be served without silently degrading."""
+    collectives.  ``groups`` passes explicit equal-size
+    ``axis_index_groups`` (the hierarchical DCN-hop path, ``topo/``);
+    otherwise the process set resolves through the shared
+    :func:`~horovod_tpu.process_sets.tiling_groups` rule.  Raises
+    :class:`QuantizedWireError` (or its
+    :class:`~horovod_tpu.exceptions.ProcessSetTilingError` subtype for
+    non-tiling subsets) when the reduction shape cannot be served
+    without silently degrading."""
     if not isinstance(axis, str):
         raise QuantizedWireError(
             "quantized collectives run over one named mesh axis (the "
@@ -158,20 +164,37 @@ def _axis_groups(axis, process_set: Optional[ProcessSet]):
             "reductions"
         )
     n = lax.axis_size(axis)
+    if groups is not None:
+        if process_set is not None:
+            raise QuantizedWireError(
+                "pass either groups= or process_set=, not both"
+            )
+        sizes = {len(g) for g in groups}
+        if len(sizes) != 1 or sum(len(g) for g in groups) != n:
+            raise ProcessSetTilingError(
+                groups[0] if groups else (), n,
+                "quantized wire explicit groups",
+            )
+        return [list(g) for g in groups], len(groups[0])
     if process_set is None or process_set.process_set_id == 0:
         return None, n
     from ..runtime import get_runtime
 
-    groups = get_runtime().process_set_table.partition_groups(process_set)
-    if groups is None:
-        if len(process_set.ranks) == n:
-            return None, n
-        raise QuantizedWireError(
-            f"process set {process_set!r} does not tile the {axis!r} "
-            "axis into equal replica groups; the quantized wire cannot "
-            "serve it — use the dense path for arbitrary subsets"
+    world = get_runtime().process_set_table.world_size
+    if len(process_set.ranks) == world:
+        return None, n
+    try:
+        out = tiling_groups(
+            process_set.ranks, world,
+            context=f"quantized wire over the {axis!r} axis",
         )
-    return groups, len(groups[0])
+    except ProcessSetTilingError:
+        if len(process_set.ranks) == n:
+            # Set covers the whole bound axis even though it cannot
+            # tile the world grid: the plain collective serves it.
+            return None, n
+        raise
+    return out, len(out[0])
 
 
 def quantized_reduce_scatter(
@@ -183,10 +206,13 @@ def quantized_reduce_scatter(
     wire: str = "int8",
     block: Optional[int] = None,
     ef: bool = False,
+    groups=None,
 ):
     """Reduce-scatter with a quantized wire: blockwise quantize →
     ``all_to_all`` of wire chunks + fp32 block scales → fp32
-    dequant-accumulate.
+    dequant-accumulate.  ``groups`` passes explicit equal-size
+    ``axis_index_groups`` (the hierarchical DCN hop quantizes only its
+    cross-slice groups this way — ``topo/hierarchical.py``).
 
     ``x`` is flattened; rank *j* (within its replica group) returns the
     fp32 exact-sum (or average) of chunk *j*, length
@@ -205,7 +231,7 @@ def quantized_reduce_scatter(
     wire = _canon_wire(wire)
     if block is None:
         block = quant_block()
-    groups, n = _axis_groups(axis, process_set)
+    groups, n = _axis_groups(axis, process_set, groups)
     shape, dtype = x.shape, x.dtype
     flat = x.reshape(-1)
     V = flat.shape[0]
@@ -245,11 +271,13 @@ def quantized_all_gather(
     *,
     wire: str = "int8",
     block: Optional[int] = None,
+    groups=None,
 ) -> jax.Array:
     """All-gather with a quantized wire: re-quantize this rank's fp32
     shard (a reduced gradient chunk, or a post-update parameter shard
     under ZeRO-1) → tiled ``all_gather`` of wire payload + fp32 block
-    scales → fp32 dequant.
+    scales → fp32 dequant.  ``groups`` passes explicit equal-size
+    ``axis_index_groups`` (the hierarchical cross-slice hop).
 
     The shard length must be a multiple of ``block`` (true by
     construction for :func:`quantized_reduce_scatter` output; align
@@ -260,7 +288,7 @@ def quantized_all_gather(
     wire = _canon_wire(wire)
     if block is None:
         block = quant_block()
-    groups, n = _axis_groups(axis, process_set)
+    groups, n = _axis_groups(axis, process_set, groups)
     flat = shard.reshape(-1)
     c = flat.shape[0]
     if c % block != 0:
@@ -289,21 +317,25 @@ def quantized_allreduce(
     *,
     wire: str = "int8",
     block: Optional[int] = None,
+    groups=None,
 ) -> jax.Array:
     """In-jit quantized-wire allreduce over a mesh axis: the two phase
-    primitives composed.  Serves the global set and any process set
-    that tiles the axis; anything else raises
+    primitives composed.  Serves the global set, any process set that
+    tiles the axis, and explicit equal-size ``groups`` (the
+    hierarchical DCN hop); anything else raises
     :class:`QuantizedWireError` (callers choose the dense path)."""
     if op not in (Sum, Average):
         raise QuantizedWireError("quantized_allreduce supports Sum/Average")
     shape, dtype = x.shape, x.dtype
     V = x.size
     shard = quantized_reduce_scatter(
-        x, axis, op=Sum, process_set=process_set, wire=wire, block=block
+        x, axis, op=Sum, process_set=process_set, wire=wire, block=block,
+        groups=groups,
     )
-    _, n = _axis_groups(axis, process_set)
+    _, n = _axis_groups(axis, process_set, groups)
     out = quantized_all_gather(
-        shard, axis, process_set=process_set, wire=wire, block=block
+        shard, axis, process_set=process_set, wire=wire, block=block,
+        groups=groups,
     )[:V]
     if op == Average:
         out = out / n

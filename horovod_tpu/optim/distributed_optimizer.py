@@ -310,11 +310,27 @@ def _reduce_gradients(
                     f"axis (got {axis!r}); the all_to_all phase has no "
                     "multi-axis form"
                 )
+        # Hierarchical (ICI/DCN) lowering eligibility: one named axis,
+        # plain sum/average, the global set (topology groups factor the
+        # whole axis).  The plan stamps the cost model's per-bucket
+        # choice; ineligible shapes stay flat.
+        from ..parallel.tensor import _axis_present
+
+        hier_ok = (
+            op in (Average, Sum)
+            and isinstance(axis, str)
+            and _axis_present(axis)
+            and (process_set is None or process_set.process_set_id == 0)
+        )
         schedule = _sched.build_schedule(
             sizes, wire_dtypes, cfg,
             order=_sched.hooks.consume_order(len(wire)),
             pinned=pinned,
             wire=wire_req,
+            lowering=cfg.lowering if hier_ok else "flat",
+            axis_size=(
+                jax.lax.axis_size(axis) if hier_ok else None
+            ),
         )
         # reduce_scatter+all_gather exchange (arXiv:2004.13336) needs a
         # plain sum/average over one whole-world axis; anything else
@@ -347,6 +363,25 @@ def _reduce_gradients(
                 )
 
         def reduce_bucket_flat(f, bucket):
+            if bucket.lowering == "hier" and hier_ok:
+                # Two-level ICI/DCN staging (topo/): the bucket's wire
+                # compresses only the cross-slice hop.  EF residuals
+                # don't apply on this lowering (the quantization error
+                # lives on the slice-summed shard, not the gradient) —
+                # hier quantized buckets run EF-free.
+                if rs_ok and jnp.issubdtype(f.dtype, jnp.floating):
+                    return _sched.execute.hier_reduce_scatter_flat(
+                        f, axis=axis, average=(op == Average),
+                        wire=bucket.wire,
+                        prescale_factor=prescale_factor,
+                        postscale_factor=postscale_factor,
+                    )
+                return _sched.execute.hier_allreduce_flat(
+                    f, axis=axis, average=(op == Average),
+                    wire=bucket.wire,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor,
+                )
             if bucket.wire in ("int8", "fp8"):
                 res_flat, rmeta = None, None
                 if res_out is not None:

@@ -27,6 +27,8 @@ from .mesh import (  # noqa: F401
     TP_AXIS,
     ParallelConfig,
     make_mesh,
+    split_axis,
+    sub_axis_names,
 )
 from .ring_attention import ring_attention  # noqa: F401
 from .ulysses import ulysses_attention  # noqa: F401

@@ -63,6 +63,58 @@ class ParallelConfig:
         ] or [DP_AXIS]
 
 
+def sub_axis_names(axis: str) -> Tuple[str, str]:
+    """Canonical ``(outer, inner)`` sub-axis names of a factored axis:
+    ``"dp" -> ("dp_dcn", "dp_ici")``.  The outer (DCN) sub-axis crosses
+    slices, the inner (ICI) one stays inside a slice — matching the
+    outer-to-inner bandwidth ordering of ``AXIS_ORDER``."""
+    return f"{axis}_dcn", f"{axis}_ici"
+
+
+def split_axis(
+    mesh: Mesh,
+    axis: str,
+    inner: int,
+    names: Optional[Tuple[str, str]] = None,
+) -> Mesh:
+    """Factor one mesh axis into ``(outer, inner)`` sub-axes.
+
+    ``split_axis(mesh, "dp", k)`` reshapes the ``dp`` dimension of the
+    device array into ``(dp // k, k)`` and names the halves
+    ``("dp_dcn", "dp_ici")`` (override with ``names``).  Because the
+    reshape keeps device order, consecutive blocks of ``k`` devices
+    along the axis — a slice's worth, under the slice-major device
+    order :mod:`horovod_tpu.topo` documents — land on the inner
+    sub-axis: collectives over ``<axis>_ici`` ride ICI only, and
+    ``<axis>_dcn`` addresses the cross-slice rails.  The hierarchical
+    collectives accept the pair directly
+    (``hierarchical_all_reduce(x, axis=("dp_dcn", "dp_ici"))``)."""
+    if axis not in mesh.axis_names:
+        raise ValueError(
+            f"mesh has no axis {axis!r} (axes: {mesh.axis_names})"
+        )
+    size = mesh.shape[axis]
+    if inner <= 0 or size % inner != 0:
+        raise ValueError(
+            f"axis {axis!r} of size {size} does not factor by "
+            f"inner={inner}"
+        )
+    outer_name, inner_name = names or sub_axis_names(axis)
+    for n in (outer_name, inner_name):
+        if n in mesh.axis_names:
+            raise ValueError(f"sub-axis name {n!r} already in the mesh")
+    pos = mesh.axis_names.index(axis)
+    arr = mesh.devices
+    new_shape = (
+        arr.shape[:pos] + (size // inner, inner) + arr.shape[pos + 1:]
+    )
+    new_names = (
+        mesh.axis_names[:pos] + (outer_name, inner_name)
+        + mesh.axis_names[pos + 1:]
+    )
+    return Mesh(arr.reshape(new_shape), new_names)
+
+
 def make_mesh(
     config: Optional[ParallelConfig] = None,
     devices: Optional[Sequence[jax.Device]] = None,

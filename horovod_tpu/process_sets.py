@@ -15,8 +15,38 @@ from __future__ import annotations
 import threading
 from typing import Dict, List, Optional, Sequence
 
-from .exceptions import HorovodTpuError
+from .exceptions import HorovodTpuError, ProcessSetTilingError
 from .utils import env
+
+
+def tiling_groups(
+    ranks: Sequence[int], world_size: int, *, context: str = ""
+) -> List[List[int]]:
+    """Equal-size XLA replica groups covering ``range(world_size)`` with
+    ``ranks`` as the first group.
+
+    The one shared implementation of the "subset tiles the axis" rule
+    that the process-set fast path, the quantized wire's phase
+    collectives, and hierarchical ICI/DCN group construction all rely
+    on: XLA ``replica_groups`` must partition the axis into groups of
+    one size, so a k-rank subset is servable iff the remaining
+    ``world_size - k`` ranks split into further groups of k.  Raises
+    :class:`~horovod_tpu.exceptions.ProcessSetTilingError` (the same
+    structured error at every call site) when they cannot.
+    """
+    members = sorted(int(r) for r in ranks)
+    k = len(members)
+    if k == 0 or len(set(members)) != k:
+        raise ProcessSetTilingError(ranks, world_size, context)
+    if members[0] < 0 or members[-1] >= world_size:
+        raise ProcessSetTilingError(ranks, world_size, context)
+    rest = [r for r in range(world_size) if r not in set(members)]
+    if len(rest) % k != 0:
+        raise ProcessSetTilingError(ranks, world_size, context)
+    groups = [members]
+    for i in range(0, len(rest), k):
+        groups.append(rest[i : i + k])
+    return groups
 
 
 class ProcessSet:
@@ -136,14 +166,11 @@ class ProcessSetTable:
         If ``ps`` and its complement can't form equal groups, collectives
         fall back to the masked path (see ops.collective_ops).
         """
-        n = self.world_size
-        k = len(ps.ranks)
-        if k == n:
+        if len(ps.ranks) == self.world_size:
             return None  # global set: use plain collectives
-        rest = [r for r in range(n) if r not in ps.ranks]
-        if k and len(rest) % k == 0:
-            groups = [list(ps.ranks)]
-            for i in range(0, len(rest), k):
-                groups.append(rest[i : i + k])
-            return groups
-        return None
+        try:
+            return tiling_groups(
+                ps.ranks, self.world_size, context="process set partition"
+            )
+        except ProcessSetTilingError:
+            return None

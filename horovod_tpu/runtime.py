@@ -199,8 +199,12 @@ class Runtime:
 
     def shutdown(self) -> None:
         from .ops import eager
+        from .topo import model as topo_model
 
         eager.clear_cache()
+        # Drop the topology discovery cache: an elastic restart may come
+        # back with a different device set (slice count included).
+        topo_model.reset()
         if self.stall_watchdog is not None:
             self.stall_watchdog.close()
             self.stall_watchdog = None

@@ -32,6 +32,7 @@ from .execute import (  # noqa: F401
     sync_gradients_bucketed,
 )
 from .plan import (  # noqa: F401
+    LOWER_CHOICES,
     WIRE_CHOICES,
     Bucket,
     BucketSchedule,
@@ -39,6 +40,7 @@ from .plan import (  # noqa: F401
     build_schedule,
     current_config,
     eligible_wire,
+    resolve_lowering,
     set_config_override,
     wire_bytes,
 )

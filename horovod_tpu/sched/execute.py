@@ -61,6 +61,36 @@ def record_wire_metrics(schedule: BucketSchedule) -> None:
         metrics.set_gauge(
             "sched.compression_ratio", schedule.total_bytes / total_wire
         )
+    record_topo_metrics(schedule)
+
+
+def record_topo_metrics(
+    schedule: BucketSchedule, axis_size: Optional[int] = None
+) -> None:
+    """Publish the network-class split of one planned exchange from the
+    topology byte model: ``topo.dcn_bytes`` / ``topo.ici_bytes``
+    (per-rank bytes/step over each network, gauges + running counters)
+    and the per-lowering bucket counts.  A hier bucket's DCN figure is
+    flat's divided by the ICI degree, so the gauge ratio reads the
+    subsystem's savings directly."""
+    from ..topo import model as topo_model
+
+    topo = topo_model.current()
+    dcn = ici = 0
+    per_lower: dict = {}
+    for b in schedule.buckets:
+        by = topo.lowering_bytes(
+            "all_reduce", b.nbytes, b.lowering, axis_size
+        )
+        dcn += by["dcn"]
+        ici += by["ici"]
+        per_lower[b.lowering] = per_lower.get(b.lowering, 0) + 1
+    metrics.set_gauge("topo.dcn_bytes", dcn)
+    metrics.set_gauge("topo.ici_bytes", ici)
+    metrics.inc_counter("topo.dcn_bytes_total", dcn)
+    metrics.inc_counter("topo.ici_bytes_total", ici)
+    for lo, count in per_lower.items():
+        metrics.set_gauge("topo.buckets", count, {"lowering": lo})
 
 
 def exchange(
@@ -94,11 +124,29 @@ def exchange(
             timeline.record_op(
                 f"bucket{bi}[n={len(bucket.indices)},"
                 f"dtype={'+'.join(bucket.wire_dtypes)},"
-                f"wire={bucket.wire}]",
+                f"wire={bucket.wire},lower={bucket.lowering}]",
                 "SCHED_EXCHANGE", wire_bytes(bucket),
             )
+            if bucket.lowering == "hier":
+                # One TOPO_PHASE lane event per hierarchical phase so a
+                # slow hop (almost always the DCN one) is identifiable
+                # without a device profiler trace.
+                from ..topo import model as topo_model
+
+                by = topo_model.current().lowering_bytes(
+                    "all_reduce", bucket.nbytes, "hier"
+                )
+                for phase, nb in (
+                    ("rs_ici", by["ici"] // 2),
+                    ("ar_dcn", by["dcn"]),
+                    ("ag_ici", by["ici"] // 2),
+                ):
+                    timeline.record_op(
+                        f"bucket{bi}.{phase}", "TOPO_PHASE", nb
+                    )
         with jax.named_scope(
             f"hvd_sched_bucket{bi}_{bucket.nbytes}B_{bucket.wire}"
+            f"_{bucket.lowering}"
         ):
             flats, meta = fusion.flatten_group(ins)
             outs = [reduce_flat(f, bucket) for f in flats]
@@ -232,6 +280,68 @@ def reduce_scatter_flat(
     return out[:n] if pad else out
 
 
+def hier_allreduce_flat(
+    f: jax.Array,
+    *,
+    axis,
+    average: bool,
+    wire: str = "off",
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+) -> jax.Array:
+    """One bucket's hierarchical allreduce (the ``lowering="hier"``
+    exchange in ``HVD_TPU_SCHED_MODE=allreduce``): intra-slice
+    reduce_scatter over ICI → cross-slice all_reduce over DCN on the
+    1/k shard → intra-slice all_gather (topo/hierarchical.py).  A
+    quantized/bf16 ``wire`` compresses only the DCN hop."""
+    from ..ops.traced import Sum as _Sum, _scale
+    from ..topo import hierarchical_all_reduce
+
+    n = lax.axis_size(axis)
+    g = _scale(f, prescale_factor)
+    out = hierarchical_all_reduce(g, axis, op=_Sum, wire=wire)
+    if average:
+        postscale_factor = postscale_factor / n
+    return _scale(out, postscale_factor)
+
+
+def hier_reduce_scatter_flat(
+    f: jax.Array,
+    *,
+    axis,
+    average: bool,
+    wire: str = "off",
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    shard_update: Optional[Callable[[jax.Array], jax.Array]] = None,
+) -> jax.Array:
+    """One bucket's hierarchical ``reduce_scatter + all_gather``
+    exchange (``HVD_TPU_SCHED_MODE=reduce_scatter`` under
+    ``lowering="hier"``): both phases stage through the ICI/DCN
+    hierarchy, ``shard_update`` (the ZeRO-1 hook) runs on the
+    1/(s·k) shard between them, and only the cross-slice hops carry a
+    compressed ``wire``.  The shard layout is the hierarchy's own and
+    is inverted exactly by the matching all_gather, so the composed
+    result equals the flat decomposition elementwise."""
+    from ..ops.traced import Sum as _Sum, _scale
+    from ..topo import (
+        hierarchical_all_gather,
+        hierarchical_reduce_scatter,
+    )
+
+    n = f.shape[0]
+    world = lax.axis_size(axis)
+    g = _scale(f, prescale_factor)
+    shard = hierarchical_reduce_scatter(g, axis, op=_Sum, wire=wire)
+    if average:
+        postscale_factor = postscale_factor / world
+    shard = _scale(shard, postscale_factor)
+    if shard_update is not None:
+        shard = shard_update(shard)
+    out = hierarchical_all_gather(shard, axis, wire=wire)
+    return out[:n]
+
+
 def sync_gradients_bucketed(
     grads: Any,
     param_shard_axes: Any = None,
@@ -296,15 +406,36 @@ def sync_gradients_bucketed(
             int(leaves[i].size) * leaves[i].dtype.itemsize for i in idxs
         ]
         dtypes = [str(leaves[i].dtype) for i in idxs]
-        # Quantized wire needs one named axis for its all_to_all phase.
+        # Quantized wire needs one named axis for its all_to_all phase;
+        # so does the hierarchical lowering (its groups factor one
+        # axis) — multi-axis pmean groups stay flat and dense.
         wire_req = cfg.wire
         if wire_req in ("int8", "fp8") and len(mean_over) != 1:
             wire_req = "off"
-        schedule = build_schedule(sizes, dtypes, cfg, wire=wire_req)
+        lower_req = cfg.lowering if len(mean_over) == 1 else "flat"
+        schedule = build_schedule(
+            sizes, dtypes, cfg, wire=wire_req, lowering=lower_req,
+            axis_size=(
+                lax.axis_size(mean_over[0]) if len(mean_over) == 1
+                else None
+            ),
+        )
 
         def reduce_flat(f, bucket, _m=mean_over, _idxs=idxs):
             # bucket.indices are positions in this group's leaf list;
             # _idxs maps them back to global flatten indices.
+            if bucket.lowering == "hier" and len(_m) == 1:
+                # Hierarchical pmean: the ICI/DCN staging with the
+                # bucket's wire on the DCN hop only.  EF residuals do
+                # not apply here — the quantization error lives on the
+                # slice-summed 1/k shard, not the gradient — so hier
+                # quantized buckets run EF-free (docs/topology.md).
+                from ..ops.traced import Average as _Avg
+                from ..topo import hierarchical_all_reduce
+
+                return hierarchical_all_reduce(
+                    f, _m[0], op=_Avg, wire=bucket.wire
+                )
             if bucket.wire in ("int8", "fp8"):
                 res_flat = None
                 if res_out is not None:

@@ -32,6 +32,26 @@ from ..utils import env
 # bucket through the quantized phase primitives (ops/quantized.py).
 WIRE_CHOICES = ("off", "bf16", "int8", "fp8")
 
+# Per-bucket lowerings the plan stage can assign.  "flat" is today's
+# single-collective exchange; "hier" stages it as intra-slice
+# reduce_scatter (ICI) -> cross-slice all_reduce (DCN, 1/k payload) ->
+# intra-slice all_gather (topo/hierarchical.py).  Chosen per bucket by
+# the topology cost model under HVD_TPU_TOPO_LOWER=auto.
+LOWER_CHOICES = ("flat", "hier")
+
+
+def _canon_lowering(lowering: str) -> str:
+    lo = (lowering or "auto").strip().lower()
+    if lo in ("off", "none", "0", "false", "no", ""):
+        lo = "flat"
+    if lo in ("on", "1", "true", "yes", "hierarchical"):
+        lo = "hier"
+    if lo not in LOWER_CHOICES + ("auto",):
+        raise ValueError(
+            f"HVD_TPU_TOPO_LOWER must be auto|flat|hier, got {lowering!r}"
+        )
+    return lo
+
 
 def _canon_wire_choice(wire: str) -> str:
     w = (wire or "off").strip().lower()
@@ -59,6 +79,7 @@ class SchedConfig:
     capture_order: bool = True
     wire: str = "off"  # "off" | "bf16" | "int8" | "fp8"
     wire_ef: bool = True  # error-feedback residuals for quantized wires
+    lowering: str = "auto"  # "auto" | "flat" | "hier" (HVD_TPU_TOPO_LOWER)
 
     def __post_init__(self):
         if self.mode not in ("allreduce", "reduce_scatter"):
@@ -67,6 +88,7 @@ class SchedConfig:
                 f"'reduce_scatter', got {self.mode!r}"
             )
         object.__setattr__(self, "wire", _canon_wire_choice(self.wire))
+        object.__setattr__(self, "lowering", _canon_lowering(self.lowering))
 
     @classmethod
     def from_env(cls) -> "SchedConfig":
@@ -83,6 +105,7 @@ class SchedConfig:
             capture_order=env.get_bool(env.SCHED_CAPTURE_ORDER, True),
             wire=env.get_env(env.SCHED_WIRE, "off") or "off",
             wire_ef=env.get_bool(env.SCHED_WIRE_EF, True),
+            lowering=env.get_env(env.TOPO_LOWER, "auto") or "auto",
         )
 
 
@@ -116,6 +139,11 @@ class Bucket:
     wire_dtypes: Tuple[str, ...]  # distinct dtypes, flatten order
     pinned: bool = False  # from an explicit user group
     wire: str = "off"
+    # Exchange lowering (LOWER_CHOICES): "flat" = one collective,
+    # "hier" = the ICI/DCN two-level staging.  The plan requests it
+    # from the topology cost model; the execute stage lowers it (and
+    # downgrades to flat where the reduction shape cannot factor).
+    lowering: str = "flat"
 
 
 @dataclasses.dataclass(frozen=True)
@@ -134,7 +162,8 @@ class BucketSchedule:
         identical exchange programs (determinism tests key on this)."""
         return (
             self.mode,
-            tuple((b.indices, b.nbytes, b.wire_dtypes, b.pinned, b.wire)
+            tuple((b.indices, b.nbytes, b.wire_dtypes, b.pinned, b.wire,
+                   b.lowering)
                   for b in self.buckets),
         )
 
@@ -147,6 +176,8 @@ def build_schedule(
     order: Optional[Sequence[int]] = None,
     pinned: Sequence[Sequence[int]] = (),
     wire: Optional[str] = None,
+    lowering: Optional[str] = None,
+    axis_size: Optional[int] = None,
 ) -> BucketSchedule:
     """Plan the exchange for leaves of ``sizes_bytes``/``dtypes``.
 
@@ -162,13 +193,26 @@ def build_schedule(
     (:func:`eligible_wire` — quantized wires need a single floating
     dtype), else falls back to ``"off"`` for that bucket.
 
-    Pure function of its arguments: same metadata + config -> identical
+    ``lowering`` overrides ``cfg.lowering`` (``HVD_TPU_TOPO_LOWER``):
+    ``"auto"`` asks the topology cost model per bucket — large buckets
+    on a multi-slice topology go ``"hier"``, sub-threshold ones stay
+    ``"flat"`` (``axis_size`` sizes the reduction axis for the model;
+    None prices the full world).  On a single-slice topology every
+    bucket is ``"flat"``, so the schedule — and the emitted program —
+    is identical to the pre-topology one.
+
+    Pure function of its arguments plus the process-wide topology
+    (identical on every rank — env-forced or discovered from the same
+    ``jax.devices()`` order): same metadata + config -> identical
     schedule (plan determinism is load-bearing — every SPMD rank must
     emit the same collectives in the same order).
     """
     if cfg is None:
         cfg = current_config()
     wire = _canon_wire_choice(cfg.wire if wire is None else wire)
+    lowering = _canon_lowering(
+        cfg.lowering if lowering is None else lowering
+    )
     n = len(sizes_bytes)
     if order is None:
         order = range(n - 1, -1, -1)
@@ -189,7 +233,8 @@ def build_schedule(
         pinned_buckets.append((
             min(rank_of[i] for i in idx),
             _make_bucket(idx, sizes_bytes, dtypes, pinned=True,
-                         wire=wire),
+                         wire=wire, lowering=lowering,
+                         axis_size=axis_size),
         ))
 
     free = [i for i in order if i not in pinned_set]
@@ -204,7 +249,8 @@ def build_schedule(
         idx = tuple(sorted(free[j] for j in b))
         planned_buckets.append((
             min(rank_of[i] for i in idx),
-            _make_bucket(idx, sizes_bytes, dtypes, wire=wire),
+            _make_bucket(idx, sizes_bytes, dtypes, wire=wire,
+                         lowering=lowering, axis_size=axis_size),
         ))
 
     ordered = [
@@ -241,20 +287,45 @@ def eligible_wire(wire: str, wire_dtypes: Sequence[str]) -> str:
     return wire
 
 
+def resolve_lowering(
+    requested: str, nbytes: int, axis_size: Optional[int] = None
+) -> str:
+    """Resolve a requested lowering ("auto"/"flat"/"hier") to the
+    concrete per-bucket choice.  "auto" asks the topology cost model;
+    a single-slice topology (or non-factorable axis) always resolves
+    flat, so the pre-topology schedule is reproduced exactly."""
+    if requested == "flat":
+        return "flat"
+    from ..topo import model as topo_model
+
+    topo = topo_model.current()
+    n = topo.world if axis_size is None else axis_size
+    s, _ = topo.factor_axis(n)
+    if s == 1:
+        return "flat"
+    if requested == "hier":
+        return "hier"
+    return topo.choose_lowering("all_reduce", nbytes, n)
+
+
 def _make_bucket(
     indices: Tuple[int, ...],
     sizes_bytes: Sequence[int],
     dtypes: Sequence[str],
     pinned: bool = False,
     wire: str = "off",
+    lowering: str = "auto",
+    axis_size: Optional[int] = None,
 ) -> Bucket:
     wire_dtypes = tuple(dict.fromkeys(dtypes[i] for i in indices))
+    nbytes = sum(int(sizes_bytes[i]) for i in indices)
     return Bucket(
         indices=indices,
-        nbytes=sum(int(sizes_bytes[i]) for i in indices),
+        nbytes=nbytes,
         wire_dtypes=wire_dtypes,
         pinned=pinned,
         wire=eligible_wire(wire, wire_dtypes),
+        lowering=resolve_lowering(lowering, nbytes, axis_size),
     )
 
 

@@ -98,6 +98,8 @@ class ScheduleTuner:
     def __init__(self, explore_wire: bool = False,
                  wire_candidates=("off", "bf16", "int8", "fp8"),
                  wire_min_bucket_bytes: int = 1 << 16,
+                 explore_lowering: bool = False,
+                 lowering_candidates=("flat", "hier"),
                  **tuner_kwargs):
         self.tuner = FusionAutotuner(**tuner_kwargs)
         self._baseline: Optional[Dict[str, float]] = None
@@ -106,6 +108,27 @@ class ScheduleTuner:
         self.wire_min_bucket_bytes = wire_min_bucket_bytes
         self._wire_scores: Dict[str, float] = {}
         self._wire_frozen: Optional[str] = None if explore_wire else "off"
+        # Lowering exploration (the HVD_TPU_TOPO_LOWER knob as a tuned
+        # dimension): each window runs one candidate, scored from the
+        # same registry deltas; the winner freezes.  On a single-slice
+        # topology "hier" resolves flat anyway, so exploration is
+        # skipped and the knob pins to "flat" immediately.
+        self._explore_lowering = explore_lowering
+        self._lowering_candidates = tuple(lowering_candidates)
+        self._lowering_scores: Dict[str, float] = {}
+        if not explore_lowering:
+            # Not a tuned dimension: defer to the cost model ("auto").
+            self._lowering_frozen: Optional[str] = "auto"
+        elif self._topo_multi_slice():
+            self._lowering_frozen = None
+        else:
+            self._lowering_frozen = "flat"
+
+    @staticmethod
+    def _topo_multi_slice() -> bool:
+        from ..topo import model as topo_model
+
+        return topo_model.current().multi_slice
 
     def bucket_bytes(self) -> int:
         """Bucket-size suggestion for the next window (frozen winner
@@ -121,6 +144,19 @@ class ScheduleTuner:
             if w not in self._wire_scores:
                 return w
         return self._wire_frozen or "off"
+
+    def lowering(self) -> str:
+        """Lowering suggestion for the next window
+        (``build_schedule(..., lowering=...)``): the next unscored
+        candidate while exploring, the frozen winner after — "auto"
+        when lowering is not an explored dimension (the cost model
+        decides per bucket)."""
+        if self._lowering_frozen is not None:
+            return self._lowering_frozen
+        for lo in self._lowering_candidates:
+            if lo not in self._lowering_scores:
+                return lo
+        return self._lowering_frozen or "auto"
 
     def begin_window(self) -> None:
         # Prime the suggestion: FusionAutotuner only accepts an observe
@@ -143,7 +179,24 @@ class ScheduleTuner:
             return score
         metrics.inc_counter("sched.tune_windows")
         metrics.set_gauge("sched.tune_score", score)
-        if self._wire_frozen is None:
+        if self._lowering_frozen is None:
+            lo = self.lowering()
+            self._lowering_scores[lo] = max(
+                self._lowering_scores.get(lo, 0.0), score
+            )
+            metrics.set_gauge(
+                "sched.tune_lowering_score", score, {"lowering": lo}
+            )
+            if all(c in self._lowering_scores
+                   for c in self._lowering_candidates):
+                self._lowering_frozen = max(
+                    self._lowering_scores, key=self._lowering_scores.get
+                )
+                metrics.set_gauge(
+                    "sched.tune_lowering_frozen", 1.0,
+                    {"lowering": self._lowering_frozen},
+                )
+        elif self._wire_frozen is None:
             w = self.wire()
             self._wire_scores[w] = max(self._wire_scores.get(w, 0.0), score)
             metrics.set_gauge(
@@ -162,27 +215,37 @@ class ScheduleTuner:
         return score
 
     def apply(self, schedule):
-        """Stamp the current wire suggestion onto a built schedule,
-        per bucket: buckets below ``wire_min_bucket_bytes`` stay dense
-        under a quantized suggestion (scale-sidecar overhead dominates
-        tiny payloads), ineligible buckets downgrade via
-        :func:`~horovod_tpu.sched.plan.eligible_wire`."""
+        """Stamp the current wire + lowering suggestions onto a built
+        schedule, per bucket: buckets below ``wire_min_bucket_bytes``
+        stay dense under a quantized suggestion (scale-sidecar overhead
+        dominates tiny payloads), ineligible buckets downgrade via
+        :func:`~horovod_tpu.sched.plan.eligible_wire`, and the lowering
+        resolves through
+        :func:`~horovod_tpu.sched.plan.resolve_lowering` (flat on a
+        single-slice topology, cost-model choice under "auto")."""
         import dataclasses as _dc
 
-        from .plan import eligible_wire
+        from .plan import eligible_wire, resolve_lowering
 
         w = self.wire()
+        lo = self.lowering()
         buckets = []
         for b in schedule.buckets:
             req = w
             if w in ("int8", "fp8") and \
                     b.nbytes < self.wire_min_bucket_bytes:
                 req = "off"
-            buckets.append(
-                _dc.replace(b, wire=eligible_wire(req, b.wire_dtypes))
-            )
+            buckets.append(_dc.replace(
+                b,
+                wire=eligible_wire(req, b.wire_dtypes),
+                lowering=resolve_lowering(lo, b.nbytes),
+            ))
         return _dc.replace(schedule, buckets=tuple(buckets))
 
     @property
     def converged(self) -> bool:
-        return self._wire_frozen is not None and self.tuner.converged
+        return (
+            self._wire_frozen is not None
+            and self._lowering_frozen is not None
+            and self.tuner.converged
+        )

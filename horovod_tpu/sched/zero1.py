@@ -37,9 +37,15 @@ class _BucketLayout:
     sizes: Tuple[int, ...]  # elements per member leaf
     dtype: jnp.dtype
     n: int  # valid elements
-    padded: int  # n rounded up to a world multiple
+    padded: int  # n rounded up to a shard-count multiple
     shard_len: int
     wire: str = "off"  # per-bucket wire format (plan.WIRE_CHOICES)
+    # per-bucket lowering (plan.LOWER_CHOICES): "hier" shards over the
+    # ICI sub-axis only — k = slice_size shards, replicated across
+    # slices — so the optimizer update and its all_gather never cross
+    # DCN; only the 1/k gradient reduction does.
+    lowering: str = "flat"
+    shards: int = 0  # world (flat) or slice_size (hier)
 
 
 def _layouts(
@@ -48,7 +54,10 @@ def _layouts(
     leaves = jax.tree.leaves(params)
     sizes_bytes = [int(l.size) * jnp.dtype(l.dtype).itemsize for l in leaves]
     dtypes = [str(jnp.dtype(l.dtype)) for l in leaves]
-    schedule = build_schedule(sizes_bytes, dtypes, cfg)
+    schedule = build_schedule(sizes_bytes, dtypes, cfg, axis_size=world)
+    from ..topo import model as topo_model
+
+    s_dcn, k_ici = topo_model.current().factor_axis(world)
     layouts = []
     for b in schedule.buckets:
         if len(b.wire_dtypes) != 1:
@@ -62,18 +71,24 @@ def _layouts(
             int(leaves[i].size) for i in b.indices
         )
         n = sum(sizes)
-        unit = world
+        lowering = b.lowering if s_dcn > 1 else "flat"
+        # Hier buckets shard over the ICI sub-axis only: k shards per
+        # slice, the shard replicated across slices, so the optimizer
+        # update and its all_gather stay on ICI.
+        shards = k_ici if lowering == "hier" else world
+        unit = shards
         if b.wire in ("int8", "fp8"):
             # Quantized shards must stay block-aligned so the
             # post-update all_gather can re-quantize without repadding.
             from ..ops.quantized import quant_block
 
-            unit = world * quant_block()
+            unit = shards * quant_block()
         padded = -(-n // unit) * unit
         layouts.append(_BucketLayout(
             indices=b.indices, shapes=shapes, sizes=sizes,
             dtype=jnp.dtype(b.wire_dtypes[0]), n=n, padded=padded,
-            shard_len=padded // world, wire=b.wire,
+            shard_len=padded // shards, wire=b.wire,
+            lowering=lowering, shards=shards,
         ))
     return layouts, schedule
 
@@ -122,6 +137,16 @@ def bucketed_zero_step(
     ``all_gather`` re-quantizes.  A quantized bucket's state entry
     becomes ``{"tx": <inner state>, "ef": <residual>}``; with
     ``wire="off"`` the state structure is unchanged from PR 3.
+
+    ``cfg.lowering`` (``HVD_TPU_TOPO_LOWER``): on a multi-slice
+    topology, ``hier`` buckets shard over the **ICI sub-axis** — k =
+    slice_size shards, replicated across slices — so the optimizer
+    update and its all_gather never cross DCN; only the slice-local
+    gradient shard's cross-slice sum does (and only that hop carries a
+    compressed wire).  Optimizer state shrinks k-fold instead of
+    N-fold: the slice-vs-world sharding trade documented in
+    docs/topology.md.  Single-slice topologies resolve every bucket
+    flat and reproduce the PR 3/4 behavior exactly.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -140,7 +165,26 @@ def bucketed_zero_step(
         )
 
     def _ef_on(lay: _BucketLayout) -> bool:
-        return cfg.wire_ef and lay.wire in ("int8", "fp8")
+        # Hier buckets run EF-free: their quantization (if any) lives on
+        # the cross-slice hop of the slice-summed shard, not on the
+        # gradient, so a gradient-shaped residual has nothing to absorb.
+        return (
+            cfg.wire_ef and lay.wire in ("int8", "fp8")
+            and lay.lowering != "hier"
+        )
+
+    def _shard_index(lay: _BucketLayout, idx):
+        # Hier buckets shard over the ICI sub-axis: position within the
+        # slice (slice-major device order, topo/ contract).
+        if lay.lowering == "hier":
+            return lax.rem(idx, lay.shards)
+        return idx
+
+    def _intra_groups():
+        from ..topo import model as topo_model
+
+        intra, _ = topo_model.current().axis_groups(world)
+        return intra
 
     def init_body(params):
         leaves = jax.tree.leaves(params)
@@ -149,7 +193,8 @@ def bucketed_zero_step(
         for lay in meta["layouts"]:
             flat = _bucket_flat(leaves, lay)
             shard = lax.dynamic_slice(
-                flat, (idx * lay.shard_len,), (lay.shard_len,)
+                flat, (_shard_index(lay, idx) * lay.shard_len,),
+                (lay.shard_len,),
             )
             st = tx.init(shard)
             if _ef_on(lay):
@@ -178,11 +223,28 @@ def bucketed_zero_step(
         gshards = []
         new_residuals = []
         token = None
+        intra = (
+            _intra_groups()
+            if any(lay.lowering == "hier" for lay in layouts) else None
+        )
         for lay, st in zip(layouts, opt_states):
             g = _bucket_flat(gleaves, lay)
             if cfg.barriers and token is not None:
                 g, token = lax.optimization_barrier((g, token))
-            if lay.wire in ("int8", "fp8"):
+            if lay.lowering == "hier":
+                # ICI reduce_scatter to the slice-local 1/k shard, then
+                # the cross-slice sum over DCN — the only slow-network
+                # hop, and the only one the bucket's wire compresses.
+                from ..topo import dcn_all_reduce
+
+                shard = lax.psum_scatter(
+                    g, axis, scatter_dimension=0, tiled=True,
+                    axis_index_groups=intra,
+                )
+                shard = dcn_all_reduce(shard, axis, wire=lay.wire)
+                shard = shard / world
+                new_residuals.append(None)
+            elif lay.wire in ("int8", "fp8"):
                 if _ef_on(lay):
                     e = g.astype(jnp.float32) + st["ef"]
                     shard, r_new = quantized_reduce_scatter(
@@ -216,7 +278,8 @@ def bucketed_zero_step(
             tx_state = state["tx"] if _ef_on(lay) else state
             pflat = _bucket_flat(pleaves, lay)
             pshard = lax.dynamic_slice(
-                pflat, (idx * lay.shard_len,), (lay.shard_len,)
+                pflat, (_shard_index(lay, idx) * lay.shard_len,),
+                (lay.shard_len,),
             )
             ushard, tx_state = tx.update(
                 shard.astype(lay.dtype), tx_state, pshard
@@ -225,7 +288,15 @@ def bucketed_zero_step(
                 new_states.append({"tx": tx_state, "ef": r_new})
             else:
                 new_states.append(tx_state)
-            if lay.wire in ("int8", "fp8"):
+            if lay.lowering == "hier":
+                # ICI-only gather: every slice holds the full shard
+                # set, so the updated parameters reassemble without
+                # touching DCN (dense — the wire compressed only the
+                # gradient's cross-slice hop).
+                uflat = lax.all_gather(
+                    ushard, axis, tiled=True, axis_index_groups=intra
+                )[:lay.n]
+            elif lay.wire in ("int8", "fp8"):
                 uflat = quantized_all_gather(
                     ushard, axis, wire=lay.wire
                 )[:lay.n].astype(lay.dtype)

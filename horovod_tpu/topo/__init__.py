@@ -1,0 +1,53 @@
+"""Topology-aware hierarchical collectives.
+
+The reference engine's signature scaling trick is two-level reduction —
+NCCL ring inside a node, MPI across nodes (``NCCLHierarchicalAllreduce``,
+``nccl_operations.cc:234``; the regime characterized in
+arXiv:1810.11112) — because a data-parallel axis almost never lives on
+one network: on multi-slice TPU it straddles fast ICI inside a slice
+and ~10x-slower DCN between slices.  This package gives the stack a
+first-class model of that fact:
+
+* ``model``        — :class:`~horovod_tpu.topo.model.Topology`:
+                     slices, per-slice ICI mesh shape, and DCN links,
+                     discovered from ``jax.devices()``
+                     (``device.slice_index`` / ``coords``) or forced
+                     via ``HVD_TPU_TOPO`` for CPU tests; plus the
+                     bandwidth/latency cost model
+                     (:meth:`~horovod_tpu.topo.model.Topology.estimate_cost`)
+                     that prices flat vs hierarchical lowerings.
+* ``hierarchical`` — phase-primitive collectives over a factored axis:
+                     :func:`hierarchical_all_reduce` (intra-slice
+                     reduce_scatter over ICI → cross-slice all_reduce
+                     over DCN on the 1/k shard → intra-slice
+                     all_gather), :func:`hierarchical_reduce_scatter` /
+                     :func:`hierarchical_all_gather`; DCN traffic drops
+                     to ``1/slice_size`` of the flat cost, and the PR 4
+                     quantized wire composes so only the DCN hop
+                     quantizes.
+
+The bucketed overlap scheduler (``sched/``) consumes both: each bucket
+carries a ``lowering ∈ {flat, hier}`` chosen by the cost model
+(``HVD_TPU_TOPO_LOWER=auto``), ZeRO-1 shards land on the ICI sub-axis
+so the optimizer update never crosses DCN, and ``topo.dcn_bytes`` /
+``topo.ici_bytes`` flow into the telemetry registry.  A single-slice
+topology degenerates to the existing flat path bitwise-identically.
+See docs/topology.md.
+"""
+
+from . import hierarchical, model  # noqa: F401
+from .hierarchical import (  # noqa: F401
+    dcn_all_reduce,
+    hierarchical_all_gather,
+    hierarchical_all_reduce,
+    hierarchical_reduce_scatter,
+)
+from .model import (  # noqa: F401
+    LOWER_CHOICES,
+    Topology,
+    current,
+    discover,
+    lower_mode,
+    reset,
+    set_topology_override,
+)

@@ -1,0 +1,290 @@
+"""Phase-primitive hierarchical collectives over a factored axis.
+
+The two-level decomposition (reference ``NCCLHierarchicalAllreduce``,
+``nccl_operations.cc:234``; arXiv:1810.11112's NCCL-ring-inside /
+MPI-across regime):
+
+    intra-slice reduce_scatter (ICI)          1/k shard, slice-summed
+    cross-slice all_reduce     (DCN, on 1/k)  the only slow-network hop
+    intra-slice all_gather     (ICI)          full buffer back
+
+Each DCN link carries ``1/k`` of the flat lowering's payload (k =
+devices per slice).  Two addressing modes:
+
+* **single axis + topology** — the axis stays one named mesh axis
+  (``"hvd"``, ``"dp"``); slice structure comes from a
+  :class:`~horovod_tpu.topo.model.Topology` and lowers to XLA
+  ``axis_index_groups`` built by the shared
+  :func:`~horovod_tpu.process_sets.tiling_groups` rule.  This is what
+  the scheduler uses — it composes with any existing ``shard_map``.
+* **factored sub-axes** — pass ``axis=("dp_dcn", "dp_ici")`` when the
+  mesh itself was built with the sub-axes (``parallel.mesh.split_axis``);
+  the phases then address the named sub-axes directly, no groups.
+
+The PR 4 quantized wire composes per hop: ``wire="int8"|"fp8"``
+quantizes **only the cross-slice DCN collective** (the intra-slice ICI
+phases stay dense — bandwidth there is cheap, and the quantizer's
+all_to_all rides the same replica groups); ``wire="bf16"`` casts just
+the DCN hop.  A single-slice topology (or an axis that cannot factor)
+degenerates to the flat collective — bitwise-identical to today's path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..exceptions import HorovodTpuError
+from ..ops.traced import Average, Sum
+from ..runtime import WORLD_AXIS
+from . import model
+
+Axis = Union[str, Tuple[str, str], Sequence[str]]
+
+
+def _hier_ctx(axis: Axis, topo: Optional[model.Topology]):
+    """Resolve the hierarchy for ``axis``: a dict of phase addressing
+    (sub-axis names or replica groups), or ``None`` when the axis does
+    not factor (single slice / indivisible) and callers must lower
+    flat."""
+    if isinstance(axis, (tuple, list)):
+        names = tuple(axis)
+        if len(names) != 2 or not all(isinstance(a, str) for a in names):
+            raise HorovodTpuError(
+                "factored-axis hierarchical collectives take exactly "
+                f"two sub-axis names (outer=DCN, inner=ICI); got {axis!r}"
+            )
+        outer, inner = names
+        s, k = lax.axis_size(outer), lax.axis_size(inner)
+        if s == 1 or k == 1:
+            return None
+        return {"mode": "axes", "outer": outer, "inner": inner,
+                "s": s, "k": k}
+    topo = topo if topo is not None else model.current()
+    n = lax.axis_size(axis)
+    s, k = topo.factor_axis(n)
+    if s == 1 or k == 1:
+        return None
+    intra, cross = topo.axis_groups(n)
+    return {"mode": "groups", "axis": axis, "s": s, "k": k,
+            "intra": intra, "cross": cross}
+
+
+def _ici_reduce_scatter(flat: jax.Array, ctx) -> jax.Array:
+    if ctx["mode"] == "axes":
+        return lax.psum_scatter(
+            flat, ctx["inner"], scatter_dimension=0, tiled=True
+        )
+    return lax.psum_scatter(
+        flat, ctx["axis"], scatter_dimension=0,
+        axis_index_groups=ctx["intra"], tiled=True,
+    )
+
+
+def _ici_all_gather(shard: jax.Array, ctx) -> jax.Array:
+    if ctx["mode"] == "axes":
+        return lax.all_gather(shard, ctx["inner"], tiled=True)
+    return lax.all_gather(
+        shard, ctx["axis"], axis_index_groups=ctx["intra"], tiled=True
+    )
+
+
+def _dcn_sum_dense(shard: jax.Array, ctx) -> jax.Array:
+    if ctx["mode"] == "axes":
+        return lax.psum(shard, ctx["outer"])
+    # shard_map's psum takes no axis_index_groups; the RS+AG pair does
+    # (the process-set fast path's _grouped_sum, reused here).
+    from ..ops.traced import _grouped_sum
+
+    return _grouped_sum(shard, ctx["axis"], ctx["cross"], ctx["s"])
+
+
+def dcn_all_reduce(
+    shard: jax.Array,
+    axis: Axis = WORLD_AXIS,
+    topo: Optional[model.Topology] = None,
+    *,
+    wire: str = "off",
+) -> jax.Array:
+    """Sum ``shard`` across slices only (the DCN hop on its own — the
+    ZeRO-1 path reduces its ICI-resident shard with this so the
+    optimizer update never crosses DCN).  ``wire`` quantizes/casts just
+    this hop; identity on a single-slice topology."""
+    ctx = _hier_ctx(axis, topo)
+    if ctx is None:
+        return shard
+    return _dcn_sum(shard, ctx, wire)
+
+
+def _dcn_sum(shard: jax.Array, ctx, wire: str) -> jax.Array:
+    wire = (wire or "off").lower()
+    floating = jnp.issubdtype(shard.dtype, jnp.floating)
+    if wire in ("int8", "fp8") and floating:
+        from ..ops.quantized import quantized_allreduce
+
+        if ctx["mode"] == "axes":
+            return quantized_allreduce(
+                shard, ctx["outer"], op=Sum, wire=wire
+            ).astype(shard.dtype)
+        return quantized_allreduce(
+            shard, ctx["axis"], op=Sum, wire=wire, groups=ctx["cross"]
+        ).astype(shard.dtype)
+    if wire == "bf16" and floating and shard.dtype != jnp.bfloat16:
+        return _dcn_sum_dense(
+            shard.astype(jnp.bfloat16), ctx
+        ).astype(shard.dtype)
+    return _dcn_sum_dense(shard, ctx)
+
+
+def hierarchical_all_reduce(
+    x: jax.Array,
+    axis: Axis = WORLD_AXIS,
+    op: int = Average,
+    topo: Optional[model.Topology] = None,
+    *,
+    wire: str = "off",
+) -> jax.Array:
+    """Two-level allreduce: ICI reduce_scatter → DCN all_reduce on the
+    1/k shard → ICI all_gather.  Values equal the flat ``psum`` up to
+    floating-point summation order (bitwise for exactly-representable
+    sums); DCN wire bytes drop to ``1/k`` of flat.  Degenerates to the
+    flat collective when the axis does not factor."""
+    if op not in (Sum, Average):
+        raise HorovodTpuError(
+            "hierarchical_all_reduce supports Sum/Average (min/max "
+            "gain nothing from staging — use the flat collective)"
+        )
+    ctx = _hier_ctx(axis, topo)
+    if ctx is None:
+        y = lax.psum(x, axis)
+        if op == Average:
+            n = lax.axis_size(axis) if isinstance(axis, str) else (
+                lax.axis_size(axis[0]) * lax.axis_size(axis[1])
+            )
+            y = y / n
+        return y
+    shape, dtype, V = x.shape, x.dtype, x.size
+    k, s = ctx["k"], ctx["s"]
+    flat = x.reshape(-1)
+    pad = (-V) % k
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    shard = _ici_reduce_scatter(flat, ctx)
+    shard = _dcn_sum(shard, ctx, wire)
+    out = _ici_all_gather(shard, ctx)[:V].reshape(shape)
+    if op == Average:
+        out = out / (s * k)
+    return out.astype(dtype)
+
+
+def hierarchical_reduce_scatter(
+    x: jax.Array,
+    axis: Axis = WORLD_AXIS,
+    op: int = Sum,
+    topo: Optional[model.Topology] = None,
+    *,
+    wire: str = "off",
+) -> jax.Array:
+    """Two-level reduce-scatter to a 1/(s·k) shard: ICI reduce_scatter
+    (to 1/k, slice-summed), then cross-slice reduce_scatter over the
+    DCN rails.  The shard layout is the hierarchy's own — chunk
+    ``(position-in-slice, slice)`` — and is inverted exactly by
+    :func:`hierarchical_all_gather` with the same ``axis``/``wire``;
+    a ZeRO-style ``shard_update`` between the two sees each element
+    exactly once, so the composed result matches the flat RS+AG
+    elementwise.  ``wire`` quantizes only the cross-slice phase (shard
+    length then block-aligns to ``HVD_TPU_QUANT_BLOCK``)."""
+    if op not in (Sum, Average):
+        raise HorovodTpuError(
+            "hierarchical_reduce_scatter supports Sum/Average"
+        )
+    ctx = _hier_ctx(axis, topo)
+    flat = x.reshape(-1)
+    V = flat.shape[0]
+    if ctx is None:
+        n = lax.axis_size(axis) if isinstance(axis, str) else (
+            lax.axis_size(axis[0]) * lax.axis_size(axis[1])
+        )
+        pad = (-V) % n
+        if pad:
+            flat = jnp.pad(flat, (0, pad))
+        shard = lax.psum_scatter(
+            flat, axis, scatter_dimension=0, tiled=True
+        )
+        return shard / n if op == Average else shard
+    k, s = ctx["k"], ctx["s"]
+    quant = (wire or "off").lower() in ("int8", "fp8") and \
+        jnp.issubdtype(x.dtype, jnp.floating)
+    unit = k * s
+    if quant:
+        from ..ops.quantized import quant_block
+
+        unit *= quant_block()
+    pad = (-V) % unit
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    shard_k = _ici_reduce_scatter(flat, ctx)
+    if quant:
+        from ..ops.quantized import quantized_reduce_scatter
+
+        if ctx["mode"] == "axes":
+            shard = quantized_reduce_scatter(
+                shard_k, ctx["outer"], op=Sum, wire=wire
+            ).astype(x.dtype)
+        else:
+            shard = quantized_reduce_scatter(
+                shard_k, ctx["axis"], op=Sum, wire=wire,
+                groups=ctx["cross"],
+            ).astype(x.dtype)
+    elif ctx["mode"] == "axes":
+        shard = lax.psum_scatter(
+            shard_k, ctx["outer"], scatter_dimension=0, tiled=True
+        )
+    else:
+        shard = lax.psum_scatter(
+            shard_k, ctx["axis"], scatter_dimension=0,
+            axis_index_groups=ctx["cross"], tiled=True,
+        )
+    return shard / (s * k) if op == Average else shard
+
+
+def hierarchical_all_gather(
+    shard: jax.Array,
+    axis: Axis = WORLD_AXIS,
+    topo: Optional[model.Topology] = None,
+    *,
+    wire: str = "off",
+) -> jax.Array:
+    """Inverse of :func:`hierarchical_reduce_scatter`: cross-slice
+    all_gather over the DCN rails, then ICI all_gather inside the
+    slice.  ``wire`` quantizes only the cross-slice phase (the shard
+    must then be block-aligned, as the RS output is by construction).
+    Returns the full (padded) buffer; callers slice to their valid
+    length."""
+    ctx = _hier_ctx(axis, topo)
+    if ctx is None:
+        return lax.all_gather(shard, axis, tiled=True)
+    quant = (wire or "off").lower() in ("int8", "fp8") and \
+        jnp.issubdtype(shard.dtype, jnp.floating)
+    if quant:
+        from ..ops.quantized import quantized_all_gather
+
+        if ctx["mode"] == "axes":
+            out_k = quantized_all_gather(
+                shard, ctx["outer"], wire=wire
+            ).astype(shard.dtype)
+        else:
+            out_k = quantized_all_gather(
+                shard, ctx["axis"], wire=wire, groups=ctx["cross"]
+            ).astype(shard.dtype)
+    elif ctx["mode"] == "axes":
+        out_k = lax.all_gather(shard, ctx["outer"], tiled=True)
+    else:
+        out_k = lax.all_gather(
+            shard, ctx["axis"], axis_index_groups=ctx["cross"],
+            tiled=True,
+        )
+    return _ici_all_gather(out_k, ctx)

@@ -67,6 +67,24 @@ SCHED_WIRE = "SCHED_WIRE"
 SCHED_WIRE_EF = "SCHED_WIRE_EF"
 # Elements per quantization block (fp32 scale granularity), default 512.
 QUANT_BLOCK = "QUANT_BLOCK"
+# Topology-aware hierarchical collectives (topo/): forced topology
+# spec — "SxK" / "SxK1xK2" (S slices of an ICI mesh) or a JSON object
+# ({"slices":2,"ici_shape":[2,2],...}) — for CPU tests and forced
+# shapes; unset = discover from jax.devices().  See docs/topology.md.
+TOPO = "TOPO"
+# Lowering policy for gradient-exchange collectives over a multi-slice
+# axis: auto (default; cost model picks flat vs hier per bucket) |
+# flat/off (always today's single-collective path) | hier/on (force
+# the ICI reduce_scatter -> DCN all_reduce -> ICI all_gather staging).
+TOPO_LOWER = "TOPO_LOWER"
+# Cost-model parameters (per-link bandwidth GB/s, per-hop latency us,
+# per-collective-phase fixed overhead us).  Defaults model ~10x
+# ICI-vs-DCN bandwidth (arXiv:1810.11112's two-level regime).
+TOPO_ICI_GBPS = "TOPO_ICI_GBPS"
+TOPO_DCN_GBPS = "TOPO_DCN_GBPS"
+TOPO_ICI_LAT_US = "TOPO_ICI_LAT_US"
+TOPO_DCN_LAT_US = "TOPO_DCN_LAT_US"
+TOPO_PHASE_OVERHEAD_US = "TOPO_PHASE_OVERHEAD_US"
 
 # Launcher-provided rendezvous env (analog of reference gloo_run.py:65-103).
 RANK = "RANK"

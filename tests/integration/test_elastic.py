@@ -81,6 +81,7 @@ class ScriptedDiscovery(HostDiscovery):
         return dict(self._phases[-1][1])
 
 
+@pytest.mark.multiproc
 def test_elastic_membership_change(tmp_path):
     script = tmp_path / "worker.py"
     script.write_text(WORKER_SCRIPT)
@@ -181,6 +182,7 @@ COST_WORKER_SCRIPT = textwrap.dedent(
 )
 
 
+@pytest.mark.multiproc
 def test_elastic_restart_cost_bounded(tmp_path):
     """Measures the full cost of a membership-change restart (process
     respawn + hvd re-init + recompile + first step) and bounds the

@@ -147,6 +147,7 @@ def test_gpt_pretrain_example():
     assert "mesh dp2/sp2/tp2" in out
 
 
+@pytest.mark.multiproc
 def test_spark_elastic_example():
     out = _run_example(
         "spark_elastic.py", "--local", "--simulate-loss", "--epochs", "5",

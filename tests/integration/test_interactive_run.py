@@ -55,6 +55,7 @@ def _broadcast_object_value():
     return hvd.broadcast_object(obj, root_rank=0)
 
 
+@pytest.mark.multiproc
 def test_run_world_topology():
     results = runner.run(_world_info, np=2, use_cpu_devices=True)
     assert len(results) == 2
@@ -63,6 +64,7 @@ def test_run_world_topology():
     assert all(r["process_count"] == 2 for r in results)
 
 
+@pytest.mark.multiproc
 def test_run_allreduce_across_processes():
     results = runner.run(_allreduce_local, np=2, use_cpu_devices=True)
     # sum of rows [1,...] and [2,...] = [3,...] on both ranks
@@ -70,6 +72,7 @@ def test_run_allreduce_across_processes():
         np.testing.assert_allclose(np.asarray(r), 3.0)
 
 
+@pytest.mark.multiproc
 def test_run_broadcast_object():
     results = runner.run(_broadcast_object_value, np=2, use_cpu_devices=True)
     assert results[0] == results[1] == {"vec": [1, 2, 3]}
@@ -89,6 +92,7 @@ def _uneven_join():
     return hvd.join()
 
 
+@pytest.mark.multiproc
 def test_run_true_join_last_rank():
     results = runner.run(_uneven_join, np=2, use_cpu_devices=True)
     # process 1 joined last; its (only) device rank is world rank 1
@@ -114,6 +118,7 @@ def _staggered_joins_rank0_last():
     return [first, second]
 
 
+@pytest.mark.multiproc
 def test_run_staggered_joins_specific_last_rank():
     results = runner.run(
         _staggered_joins_rank0_last, np=2, use_cpu_devices=True
@@ -155,6 +160,7 @@ def _multi_collective_suite():
     return out
 
 
+@pytest.mark.multiproc
 def test_run_collective_sweep_across_processes():
     results = runner.run(_multi_collective_suite, np=2, use_cpu_devices=True)
     r0, r1 = results
@@ -210,6 +216,7 @@ def _consistency_mismatch():
         return "caught" if "consistency" in str(e) else f"wrong: {e}"
 
 
+@pytest.mark.multiproc
 def test_run_consistency_check_modes():
     env = {"HVD_TPU_CONSISTENCY_CHECK": "1"}
     ok = runner.run(_consistency_ok, np=2, use_cpu_devices=True,

@@ -79,6 +79,7 @@ def _worker():
     return failures
 
 
+@pytest.mark.multiproc
 def test_two_process_fuzz():
     cloudpickle.register_pickle_by_value(sys.modules[__name__])
     results = runner.run(_worker, np=2, use_cpu_devices=True)

@@ -72,6 +72,7 @@ SURVIVOR = textwrap.dedent("""
 """)
 
 
+@pytest.mark.multiproc
 def test_survivor_reinit_world_in_process():
     from horovod_tpu.runner.launch import free_port
 

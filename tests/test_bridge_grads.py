@@ -349,6 +349,7 @@ def test_tf_process_set_allreduce_grad(hvd_module, dynamic_sets):
 
 
 @pytest.mark.integration
+@pytest.mark.multiproc
 def test_torch_grads_multiprocess_local_rows():
     """The gradient contracts hold in the multi-process LOCAL-ROWS
     layout too: each process passes its own rows and receives its own

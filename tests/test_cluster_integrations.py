@@ -212,6 +212,7 @@ def _elastic_fn(crash_round_rank=None):
 
 
 @pytest.mark.integration
+@pytest.mark.multiproc
 def test_spark_elastic_clean_round():
     """run_elastic over the local agent backend (the Spark-task stand-in
     used when pyspark is absent): one clean round, per-rank results."""
@@ -235,6 +236,7 @@ def test_spark_elastic_clean_round():
 
 
 @pytest.mark.integration
+@pytest.mark.multiproc
 def test_spark_elastic_worker_loss_epoch():
     """Reference elastic_spark_common contract: a worker hard-dies
     mid-round; the driver blacklists its host, runs a fresh round on

@@ -271,6 +271,191 @@ class TestGroupedOps:
                 )
 
 
+class TestHierarchicalColumn:
+    """Hierarchical (ICI/DCN two-level) lowering column of the matrix:
+    flat vs hier equality across dtypes, process-set interplay, and a
+    dp×tp hybrid mesh (topo/, forced 2-slice topology)."""
+
+    @pytest.fixture(autouse=True)
+    def _forced_two_slice(self, monkeypatch):
+        from horovod_tpu import topo
+
+        monkeypatch.setenv("HVD_TPU_TOPO", "2x4")
+        topo.reset()
+        yield
+        topo.reset()
+
+    def _run(self, fn, *args, n_out=2):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu.runtime import WORLD_AXIS, get_runtime
+
+        mesh = get_runtime().mesh
+        spec = P(WORLD_AXIS)
+        return jax.jit(jax.shard_map(
+            fn, mesh=mesh, in_specs=(spec,) * len(args),
+            out_specs=(spec,) * n_out, check_vma=False,
+        ))(*args)
+
+    @pytest.mark.parametrize(
+        "dtype", [np.float32, np.float16, jnp.bfloat16, np.int32], ids=str
+    )
+    def test_allreduce_flat_vs_hier(self, hvd_module, dtype):
+        import jax
+
+        from horovod_tpu import topo
+        from horovod_tpu.ops.traced import Sum
+        from horovod_tpu.runtime import WORLD_AXIS
+
+        x = _data(dtype, shape=(N, 37), seed=20)
+
+        def f(a):
+            return jax.lax.psum(a, WORLD_AXIS), \
+                topo.hierarchical_all_reduce(a, WORLD_AXIS, op=Sum)
+
+        flat, hier = self._run(f, x)
+        if _is_float(dtype):
+            np.testing.assert_allclose(
+                np.asarray(flat, np.float64),
+                np.asarray(hier, np.float64), **_tol(dtype)
+            )
+        else:
+            # integer sums are exact: hier must be bitwise equal
+            np.testing.assert_array_equal(
+                np.asarray(flat), np.asarray(hier)
+            )
+
+    def test_allreduce_bitwise_f32_exact_sums(self, hvd_module):
+        """f32 with integer values: all partial sums representable, so
+        the two lowerings agree bit for bit."""
+        import jax
+
+        from horovod_tpu import topo
+        from horovod_tpu.ops.traced import Sum
+        from horovod_tpu.runtime import WORLD_AXIS
+
+        x = np.random.RandomState(21).randint(
+            -16, 17, (N, 129)
+        ).astype(np.float32)
+
+        def f(a):
+            return jax.lax.psum(a, WORLD_AXIS), \
+                topo.hierarchical_all_reduce(a, WORLD_AXIS, op=Sum)
+
+        flat, hier = self._run(f, x)
+        np.testing.assert_array_equal(np.asarray(flat), np.asarray(hier))
+
+    def test_rs_then_ag_matches_flat(self, hvd_module):
+        import jax
+
+        from horovod_tpu import topo
+        from horovod_tpu.ops.traced import Sum
+        from horovod_tpu.runtime import WORLD_AXIS
+
+        x = _data(np.float32, shape=(N, 53), seed=22)
+
+        def f(a):
+            sh = topo.hierarchical_reduce_scatter(a, WORLD_AXIS, op=Sum)
+            out = topo.hierarchical_all_gather(sh, WORLD_AXIS)
+            return jax.lax.psum(a, WORLD_AXIS), \
+                out[:a.size].reshape(a.shape)
+
+        flat, rt = self._run(f, x)
+        np.testing.assert_allclose(np.asarray(flat), np.asarray(rt),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_process_set_restriction_stays_flat(self, hvd_module,
+                                                monkeypatch):
+        """A process-set-restricted optimizer exchange cannot carry the
+        hier groups (they factor the whole axis): the plan downgrades
+        to flat and values match the per-set allreduce exactly."""
+        monkeypatch.setenv("HVD_TPU_DYNAMIC_PROCESS_SETS", "1")
+        from horovod_tpu import sched
+
+        ps = hvd.add_process_set([0, 1, 2, 3])
+        sched.set_config_override(
+            sched.SchedConfig(bucket_bytes=64, lowering="hier")
+        )
+        try:
+            x = _data(np.float32, seed=23)
+            y = np.asarray(hvd.allreduce(x, op=hvd.Sum, process_set=ps))
+            expect = np.asarray(x[:4]).sum(axis=0)
+            for r in range(4):
+                np.testing.assert_allclose(y[r], expect, rtol=1e-5)
+        finally:
+            sched.set_config_override(None)
+            hvd.remove_process_set(ps)
+
+    def test_non_tiling_set_raises_shared_error_type(self, hvd_module,
+                                                     monkeypatch):
+        from horovod_tpu.exceptions import ProcessSetTilingError
+        from horovod_tpu.process_sets import tiling_groups
+
+        with pytest.raises(ProcessSetTilingError, match="tile"):
+            tiling_groups([0, 1, 2], N)
+
+    @pytest.mark.parametrize("degrees", [(2, 2), (4, 2)],
+                             ids=["dp2xtp2", "dp4xtp2"])
+    def test_grad_sync_hier_column_on_dp_tp_mesh(self, hvd_module,
+                                                 degrees):
+        """dp×tp meshes: the hier lowering must agree with flat
+        (dp2xtp2's dp axis cannot factor across 2 slices — clean
+        degeneration; dp4xtp2's dp axis factors 2x2)."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu import sched
+        from horovod_tpu.parallel import make_mesh, sync_gradients
+
+        dp, tp = degrees
+        devices = jax.devices()[: dp * tp]
+        mesh = make_mesh(dp=dp, tp=tp, devices=devices)
+        g = {"a": _data(np.float32, shape=(dp * tp, 5), seed=24),
+             "b": _data(np.float32, shape=(dp * tp, 5), seed=25)}
+        shard_axes = {"a": "", "b": "tp"}
+
+        def f(grads):
+            return sync_gradients(grads, shard_axes, axes=("dp", "tp"))
+
+        outs = {}
+        spec = {"a": P("dp"), "b": P("dp")}
+        for lower in ("flat", "hier"):
+            sched.set_config_override(sched.SchedConfig(
+                bucket_bytes=64, lowering=lower))
+            try:
+                outs[lower] = jax.jit(jax.shard_map(
+                    f, mesh=mesh, in_specs=(spec,), out_specs=spec,
+                    check_vma=False,
+                ))(g)
+            finally:
+                sched.set_config_override(None)
+        for key in g:
+            np.testing.assert_allclose(
+                np.asarray(outs["flat"][key]),
+                np.asarray(outs["hier"][key]), rtol=1e-6, atol=1e-6,
+            )
+
+    def test_cost_model_choice_never_exceeds_flat_dcn(self, hvd_module):
+        """Property column: for random bucket sizes, the plan's chosen
+        lowering never moves more DCN bytes than flat would."""
+        from horovod_tpu import sched
+        from horovod_tpu.topo import model as topo_model
+
+        topo = topo_model.current()
+        rng = np.random.RandomState(42)
+        sizes = [int(rng.randint(64, 1 << 24)) for _ in range(40)]
+        schedule = sched.build_schedule(
+            sizes, ["float32"] * len(sizes),
+            sched.SchedConfig(bucket_bytes=1 << 18, lowering="auto"),
+        )
+        for b in schedule.buckets:
+            chosen = topo.lowering_bytes("all_reduce", b.nbytes,
+                                         b.lowering)
+            flat = topo.lowering_bytes("all_reduce", b.nbytes, "flat")
+            assert chosen["dcn"] <= flat["dcn"], b
+
+
 class TestGroupFusionKnob:
     def test_disable_group_fusion_matches_fused(self, hvd_module,
                                                 monkeypatch):

@@ -264,6 +264,7 @@ class TestTorchDistributedOptimizer:
 
 
 @pytest.mark.integration
+@pytest.mark.multiproc
 def test_multiprocess_torch_optimizer_averages():
     """Two processes with different grads must converge to the mean
     (the reference's allreduce-in-step contract)."""
@@ -516,6 +517,7 @@ class TestTorchCompression:
 
 
 @pytest.mark.integration
+@pytest.mark.multiproc
 def test_multiprocess_torch_sync_bn_global_moments():
     """Two processes, disjoint batches: torch SyncBatchNorm must
     normalize with GLOBAL moments and produce the global-batch dx

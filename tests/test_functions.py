@@ -283,6 +283,7 @@ class TestChunkedBroadcast:
 
 
 @pytest.mark.integration
+@pytest.mark.multiproc
 def test_multiprocess_chunked_broadcast_parameters():
     """Two real processes: a large (above-threshold) pytree must reach
     rank 1 bit-correct through the chunked device path, 64-bit leaves

@@ -186,6 +186,7 @@ def test_torch_alltoall_uneven_splits_returns_received(hvd_module):
 
 
 @pytest.mark.integration
+@pytest.mark.multiproc
 def test_multiprocess_sparse_allreduce_array_wire():
     """torch sparse COO allreduce rides the padded array wire (int64
     coordinates narrow losslessly); the pickle path is patched out."""

@@ -104,6 +104,7 @@ class TestDistributedOptimizer:
 
 
 @pytest.mark.integration
+@pytest.mark.multiproc
 def test_multiprocess_tape_averages():
     """Two processes, different grads: DistributedGradientTape must hand
     both the mean (reference DistributedGradientTape contract)."""
@@ -136,6 +137,7 @@ def test_multiprocess_tape_averages():
 
 
 @pytest.mark.integration
+@pytest.mark.multiproc
 def test_multiprocess_tape_process_set_subset():
     """Two processes, a set containing only rank 0: process 0 reduces
     over itself, process 1 keeps local grads (masked pass-through)."""
@@ -551,6 +553,7 @@ class TestTFSyncBatchNorm:
 
 
 @pytest.mark.integration
+@pytest.mark.multiproc
 def test_multiprocess_sync_bn_averages_stats():
     """Two processes with different data: SyncBatchNormalization must
     normalize with the GLOBAL batch moments (reference
@@ -681,6 +684,7 @@ def test_in_graph_allgather_keeps_static_rank(hvd_module):
 
 
 @pytest.mark.integration
+@pytest.mark.multiproc
 def test_multiprocess_in_graph_allreduce():
     """Collectives inside tf.function across two REAL processes: the
     py_function lowering must re-enter the eager bridge and average
@@ -715,6 +719,7 @@ def test_multiprocess_in_graph_allreduce():
 
 
 @pytest.mark.integration
+@pytest.mark.multiproc
 def test_multiprocess_subset_rides_member_mesh_no_gather():
     """VERDICT r5 item 6: subset bridge reductions must ride the
     member-only submesh — the O(P·V) gather fallback and any pickled
@@ -757,6 +762,7 @@ def test_multiprocess_subset_rides_member_mesh_no_gather():
 
 
 @pytest.mark.integration
+@pytest.mark.multiproc
 def test_multiprocess_indexed_slices_array_wire():
     """IndexedSlices gradients ride padded array allgathers, never
     pickle: the pickled-object path is patched to raise."""

@@ -1,0 +1,571 @@
+"""Topology-aware hierarchical collectives (topo/): discovery + env
+override, cost-model lowering choice, phase-primitive equality vs the
+flat path, mesh-axis factoring, scheduler/ZeRO-1 integration, and the
+topo.* observability surface."""
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu import metrics, sched, topo
+from horovod_tpu.exceptions import HorovodTpuError, ProcessSetTilingError
+from horovod_tpu.ops.traced import Average, Sum
+from horovod_tpu.runtime import WORLD_AXIS, get_runtime
+from horovod_tpu.topo.model import Topology
+
+pytestmark = pytest.mark.topo
+
+N = 8
+T24 = Topology(num_slices=2, slice_size=4)
+
+
+@pytest.fixture(autouse=True)
+def _clean_topo_state():
+    topo.reset()
+    sched.set_config_override(None)
+    yield
+    topo.reset()
+    sched.set_config_override(None)
+
+
+# ------------------------------------------------------------- model
+
+class TestTopologyModel:
+    def test_env_spec_sxk(self, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_TOPO", "2x4")
+        t = topo.discover([None] * 8)
+        assert (t.num_slices, t.slice_size) == (2, 4)
+        assert t.source == "env"
+
+    def test_env_spec_ici_mesh(self, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_TOPO", "2x2x2")
+        t = topo.discover([None] * 8)
+        assert (t.num_slices, t.slice_size) == (2, 4)
+        assert t.ici_shape == (2, 2)
+
+    def test_env_spec_json(self, monkeypatch):
+        monkeypatch.setenv(
+            "HVD_TPU_TOPO",
+            '{"slices": 4, "ici_shape": [2], "dcn_gbps": 5.0,'
+            ' "phase_overhead_us": 50}',
+        )
+        t = topo.discover([None] * 8)
+        assert (t.num_slices, t.slice_size) == (4, 2)
+        assert t.dcn_gbps == 5.0
+        assert t.phase_overhead_s == pytest.approx(50e-6)
+
+    def test_env_spec_device_count_mismatch_rejected(self, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_TOPO", "2x3")
+        with pytest.raises(HorovodTpuError, match="devices"):
+            topo.discover([None] * 8)
+
+    def test_env_spec_garbage_rejected(self, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_TOPO", "banana")
+        with pytest.raises(HorovodTpuError):
+            topo.discover([None] * 8)
+
+    def test_cpu_discovery_is_single_slice(self):
+        t = topo.discover()
+        assert t.num_slices == 1 and not t.multi_slice
+
+    def test_discovery_reads_slice_index(self):
+        class Dev:
+            def __init__(self, s):
+                self.slice_index = s
+
+        devs = [Dev(0)] * 4 + [Dev(1)] * 4
+        t = topo.discover(devs)
+        assert (t.num_slices, t.slice_size) == (2, 4)
+        assert t.source == "devices"
+
+    def test_ragged_slices_collapse_to_flat(self):
+        class Dev:
+            def __init__(self, s):
+                self.slice_index = s
+
+        t = topo.discover([Dev(0)] * 5 + [Dev(1)] * 3)
+        assert t.num_slices == 1
+
+    def test_factor_axis(self):
+        assert T24.factor_axis(8) == (2, 4)
+        assert T24.factor_axis(4) == (2, 2)
+        assert T24.factor_axis(2) == (1, 2)  # <= num_slices: degenerate
+        assert T24.factor_axis(7) == (1, 7)  # indivisible
+        single = Topology(num_slices=1, slice_size=8)
+        assert single.factor_axis(8) == (1, 8)
+
+    def test_axis_groups(self):
+        intra, cross = T24.axis_groups(8)
+        assert intra == [[0, 1, 2, 3], [4, 5, 6, 7]]
+        assert cross == [[0, 4], [1, 5], [2, 6], [3, 7]]
+
+    def test_axis_groups_raise_shared_tiling_error(self):
+        single = Topology(num_slices=1, slice_size=8)
+        with pytest.raises(ProcessSetTilingError):
+            single.axis_groups(8)
+
+    def test_override_wins(self):
+        topo.set_topology_override(T24)
+        assert topo.current() is T24
+
+
+class TestCostModel:
+    def test_hier_for_large_flat_for_small(self):
+        assert T24.choose_lowering("all_reduce", 1 << 10) == "flat"
+        assert T24.choose_lowering("all_reduce", 16 << 20) == "hier"
+
+    def test_single_slice_always_flat(self):
+        t = Topology(num_slices=1, slice_size=8)
+        assert t.choose_lowering("all_reduce", 1 << 30) == "flat"
+
+    def test_lower_mode_forces(self, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_TOPO_LOWER", "hier")
+        assert T24.choose_lowering("all_reduce", 1) == "hier"
+        monkeypatch.setenv("HVD_TPU_TOPO_LOWER", "off")
+        assert T24.choose_lowering("all_reduce", 1 << 30) == "flat"
+
+    def test_hier_dcn_bytes_are_flat_over_slice_size(self):
+        for nbytes in (1 << 10, 1 << 20, 1 << 26):
+            flat = T24.lowering_bytes("all_reduce", nbytes, "flat")
+            hier = T24.lowering_bytes("all_reduce", nbytes, "hier")
+            assert hier["dcn"] == pytest.approx(
+                flat["dcn"] / T24.slice_size, abs=1
+            )
+
+    def test_cost_model_crossover_is_monotone(self):
+        """One crossover: once hier wins it keeps winning as payload
+        grows (the decision is a threshold, like the fusion knob)."""
+        prev = "flat"
+        for exp in range(6, 28):
+            cur = T24.choose_lowering("all_reduce", 1 << exp)
+            if prev == "hier":
+                assert cur == "hier", f"regressed to flat at 2^{exp}"
+            prev = cur
+        assert prev == "hier"
+
+    def test_chosen_lowering_never_exceeds_flat_dcn_bytes(self):
+        """Property: across random topologies and payloads, the cost
+        model's choice never moves more DCN bytes than flat."""
+        rng = np.random.RandomState(0)
+        for _ in range(200):
+            s = int(rng.choice([1, 2, 3, 4, 8]))
+            k = int(rng.choice([1, 2, 4, 8, 16]))
+            t = Topology(
+                num_slices=s, slice_size=k,
+                ici_gbps=float(rng.uniform(50, 400)),
+                dcn_gbps=float(rng.uniform(1, 50)),
+                phase_overhead_s=float(rng.uniform(10e-6, 500e-6)),
+            )
+            nbytes = int(rng.randint(1, 1 << 28))
+            chosen = t.choose_lowering("all_reduce", nbytes)
+            got = t.lowering_bytes("all_reduce", nbytes, chosen)
+            flat = t.lowering_bytes("all_reduce", nbytes, "flat")
+            assert got["dcn"] <= flat["dcn"], (s, k, nbytes, chosen)
+
+
+# ----------------------------------------------- hierarchical primitives
+
+def _shard_run(fn, *args, mesh=None, n_out=1):
+    mesh = mesh or get_runtime().mesh
+    specs = (P(WORLD_AXIS),) * len(args)
+    return jax.jit(jax.shard_map(
+        fn, mesh=mesh, in_specs=specs,
+        out_specs=(P(WORLD_AXIS),) * n_out if n_out > 1 else P(WORLD_AXIS),
+        check_vma=False,
+    ))(*args)
+
+
+class TestHierarchicalPrimitives:
+    @pytest.mark.parametrize("dtype", [np.float32, jnp.bfloat16, np.int32],
+                             ids=str)
+    def test_all_reduce_matches_flat(self, hvd_module, dtype):
+        rng = np.random.RandomState(0)
+        if jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
+            x = rng.uniform(-2, 2, (N, 33)).astype(dtype)
+            tol = 1e-2 if dtype == jnp.bfloat16 else 1e-6
+        else:
+            x = rng.randint(0, 7, (N, 33)).astype(dtype)
+            tol = 0
+
+        def f(a):
+            flat = jax.lax.psum(a, WORLD_AXIS)
+            hier = topo.hierarchical_all_reduce(
+                a, WORLD_AXIS, op=Sum, topo=T24
+            )
+            return flat, hier
+
+        flat, hier = _shard_run(f, x, n_out=2)
+        np.testing.assert_allclose(
+            np.asarray(flat, np.float64), np.asarray(hier, np.float64),
+            rtol=tol, atol=tol,
+        )
+
+    def test_all_reduce_bitwise_on_exact_sums(self, hvd_module):
+        """Integer-valued f32: every partial sum is exactly
+        representable, so flat and hier agree bit for bit regardless of
+        summation order."""
+        x = np.random.RandomState(1).randint(-8, 9, (N, 130)).astype(
+            np.float32
+        )
+
+        def f(a):
+            return jax.lax.psum(a, WORLD_AXIS), \
+                topo.hierarchical_all_reduce(a, WORLD_AXIS, op=Sum,
+                                             topo=T24)
+
+        flat, hier = _shard_run(f, x, n_out=2)
+        np.testing.assert_array_equal(np.asarray(flat), np.asarray(hier))
+
+    def test_average_matches_pmean(self, hvd_module):
+        x = np.random.RandomState(2).randn(N, 17).astype(np.float32)
+
+        def f(a):
+            return jax.lax.pmean(a, WORLD_AXIS), \
+                topo.hierarchical_all_reduce(a, WORLD_AXIS, op=Average,
+                                             topo=T24)
+
+        flat, hier = _shard_run(f, x, n_out=2)
+        np.testing.assert_allclose(np.asarray(flat), np.asarray(hier),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_rs_ag_roundtrip_matches_flat(self, hvd_module):
+        x = np.random.RandomState(3).randn(N, 41).astype(np.float32)
+
+        def f(a):
+            shard = topo.hierarchical_reduce_scatter(
+                a, WORLD_AXIS, op=Sum, topo=T24
+            )
+            out = topo.hierarchical_all_gather(shard, WORLD_AXIS, topo=T24)
+            return jax.lax.psum(a, WORLD_AXIS), \
+                out[:a.size].reshape(a.shape)
+
+        flat, rt = _shard_run(f, x, n_out=2)
+        np.testing.assert_allclose(np.asarray(flat), np.asarray(rt),
+                                   rtol=1e-6, atol=1e-6)
+
+    @pytest.mark.parametrize("wire", ["bf16", "int8", "fp8"])
+    def test_wire_compresses_only_dcn_hop(self, hvd_module, wire):
+        """A compressed wire on the hier path still lands within the
+        DCN hop's quantization error of the flat sum — the ICI phases
+        are exact."""
+        x = np.random.RandomState(4).randn(N, 700).astype(np.float32)
+
+        def f(a):
+            return jax.lax.psum(a, WORLD_AXIS), \
+                topo.hierarchical_all_reduce(a, WORLD_AXIS, op=Sum,
+                                             topo=T24, wire=wire)
+
+        flat, hier = _shard_run(f, x, n_out=2)
+        # fp8 e4m3 keeps only 3 mantissa bits: coarser grid than int8's
+        tol = dict(rtol=0.12, atol=0.6) if wire == "fp8" else \
+            dict(rtol=0.05, atol=0.08)
+        np.testing.assert_allclose(
+            np.asarray(flat), np.asarray(hier), **tol
+        )
+
+    def test_single_slice_degenerates_to_flat_psum(self, hvd_module):
+        x = np.random.RandomState(5).randn(N, 9).astype(np.float32)
+        single = Topology(num_slices=1, slice_size=8)
+
+        def f(a):
+            return jax.lax.psum(a, WORLD_AXIS), \
+                topo.hierarchical_all_reduce(a, WORLD_AXIS, op=Sum,
+                                             topo=single)
+
+        flat, hier = _shard_run(f, x, n_out=2)
+        # identical lowering -> bitwise, not just close
+        np.testing.assert_array_equal(np.asarray(flat), np.asarray(hier))
+
+    def test_factored_sub_axes_mode(self, hvd_module):
+        """split_axis machinery: a mesh factored into (hvd_dcn,
+        hvd_ici) sub-axes runs the hierarchy over the named axes with
+        no groups."""
+        from horovod_tpu.parallel import split_axis, sub_axis_names
+
+        mesh = split_axis(get_runtime().mesh, WORLD_AXIS, 4)
+        names = sub_axis_names(WORLD_AXIS)
+        x = np.random.RandomState(6).randn(N, 21).astype(np.float32)
+
+        def f(a):
+            return jax.lax.psum(a, names), \
+                topo.hierarchical_all_reduce(a, names, op=Sum)
+
+        spec = P(names)
+        flat, hier = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(spec,), out_specs=(spec, spec),
+            check_vma=False,
+        ))(x)
+        np.testing.assert_allclose(np.asarray(flat), np.asarray(hier),
+                                   rtol=1e-6, atol=1e-6)
+
+    def test_split_axis_validates(self, hvd_module):
+        from horovod_tpu.parallel import split_axis
+
+        mesh = get_runtime().mesh
+        with pytest.raises(ValueError, match="factor"):
+            split_axis(mesh, WORLD_AXIS, 3)
+        with pytest.raises(ValueError, match="no axis"):
+            split_axis(mesh, "nope", 2)
+
+
+# ------------------------------------------------- scheduler integration
+
+def _losses(cfg, steps=12):
+    X = np.random.RandomState(1).randn(16, 4).astype(np.float32)
+    Y = (X @ np.full((4, 2), 0.7)).astype(np.float32)
+
+    def loss_fn(p, b):
+        x, y = b
+        return jnp.mean((x @ p["w1"] @ p["w2"] + p["b"] - y) ** 2)
+
+    params = {"w1": jnp.full((4, 4), 0.2), "w2": jnp.full((4, 2), 0.5),
+              "b": jnp.zeros((2,))}
+    sched.set_config_override(cfg)
+    try:
+        tx = hvd.DistributedOptimizer(optax.sgd(0.1))
+        step = hvd.distributed_train_step(loss_fn, tx)
+        st = step.init(params)
+        batch = (jnp.asarray(X), jnp.asarray(Y))
+        out = []
+        for _ in range(steps):
+            params, st, loss = step(params, st, batch)
+            out.append(float(loss))
+        return out
+    finally:
+        sched.set_config_override(None)
+
+
+class TestSchedulerLowering:
+    def test_plan_stamps_cost_model_choice(self, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_TOPO", "2x4")
+        cfg = sched.SchedConfig(bucket_bytes=1 << 20, lowering="auto")
+        small = sched.build_schedule([256] * 4, ["float32"] * 4, cfg)
+        big = sched.build_schedule(
+            [8 << 20] * 4, ["float32"] * 4, cfg
+        )
+        assert all(b.lowering == "flat" for b in small.buckets)
+        assert all(b.lowering == "hier" for b in big.buckets)
+        # lowering is part of the plan identity
+        assert small.signature() != dataclasses.replace(
+            small,
+            buckets=tuple(dataclasses.replace(b, lowering="hier")
+                          for b in small.buckets),
+        ).signature()
+
+    def test_single_slice_plan_is_flat_and_unchanged(self, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_TOPO", "1x8")
+        cfg_auto = sched.SchedConfig(bucket_bytes=1024, lowering="auto")
+        cfg_off = sched.SchedConfig(bucket_bytes=1024, lowering="off")
+        a = sched.build_schedule([4096] * 3, ["float32"] * 3, cfg_auto)
+        b = sched.build_schedule([4096] * 3, ["float32"] * 3, cfg_off)
+        assert a.signature() == b.signature()
+        assert all(bk.lowering == "flat" for bk in a.buckets)
+
+    @pytest.mark.parametrize("mode", ["allreduce", "reduce_scatter"])
+    def test_hier_losses_match_flat(self, hvd_module, monkeypatch, mode):
+        monkeypatch.setenv("HVD_TPU_TOPO", "2x4")
+        flat = _losses(sched.SchedConfig(
+            bucket_bytes=64, mode=mode, lowering="flat"))
+        hier = _losses(sched.SchedConfig(
+            bucket_bytes=64, mode=mode, lowering="hier"))
+        np.testing.assert_allclose(flat, hier, rtol=1e-6, atol=1e-6)
+
+    def test_single_slice_auto_bitwise_identical_to_off(
+        self, hvd_module, monkeypatch
+    ):
+        monkeypatch.setenv("HVD_TPU_TOPO", "1x8")
+        auto = _losses(sched.SchedConfig(bucket_bytes=64, lowering="auto"))
+        off = _losses(sched.SchedConfig(bucket_bytes=64, lowering="off"))
+        assert auto == off  # bitwise: identical floats, not just close
+
+    def test_topo_metrics_flow(self, hvd_module, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_TOPO", "2x4")
+        _losses(sched.SchedConfig(bucket_bytes=64, lowering="flat"))
+        dcn_flat = metrics.get_gauge("topo.dcn_bytes")
+        _losses(sched.SchedConfig(bucket_bytes=64, lowering="hier"))
+        dcn_hier = metrics.get_gauge("topo.dcn_bytes")
+        ici_hier = metrics.get_gauge("topo.ici_bytes")
+        assert dcn_hier and dcn_hier > 0
+        assert ici_hier and ici_hier > 0
+        assert dcn_flat / dcn_hier == pytest.approx(4.0)
+        assert metrics.get_gauge(
+            "topo.buckets", {"lowering": "hier"}
+        ) >= 1
+        assert metrics.get_counter("topo.dcn_bytes_total") > 0
+
+    def test_hier_with_quantized_wire(self, hvd_module, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_TOPO", "2x4")
+        flat = _losses(sched.SchedConfig(bucket_bytes=64, lowering="flat"))
+        hq = _losses(sched.SchedConfig(
+            bucket_bytes=64, lowering="hier", wire="int8"))
+        # only the DCN hop quantizes: close, not identical
+        assert abs(flat[-1] - hq[-1]) < 1e-2
+
+    def test_grad_sync_hier_on_hybrid_mesh(self, hvd_module, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_TOPO", "2x4")
+        from horovod_tpu.parallel import make_mesh, sync_gradients
+
+        mesh = make_mesh(dp=4, tp=2)
+        g = {"a": np.random.RandomState(0).randn(8, 6).astype(np.float32),
+             "b": np.random.RandomState(1).randn(8, 6).astype(np.float32)}
+        shard_axes = {"a": "", "b": "tp"}
+
+        def f(grads):
+            return sync_gradients(grads, shard_axes, axes=("dp", "tp"))
+
+        outs = {}
+        for lower in ("flat", "hier"):
+            sched.set_config_override(sched.SchedConfig(
+                bucket_bytes=64, lowering=lower))
+            spec = {"a": P("dp"), "b": P("dp")}
+            outs[lower] = jax.jit(jax.shard_map(
+                f, mesh=mesh, in_specs=(spec,), out_specs=spec,
+                check_vma=False,
+            ))(g)
+            sched.set_config_override(None)
+        for key in g:
+            np.testing.assert_allclose(
+                np.asarray(outs["flat"][key]),
+                np.asarray(outs["hier"][key]), rtol=1e-6, atol=1e-6,
+            )
+
+
+class TestZero1Hier:
+    def _run(self, cfg):
+        X = np.random.RandomState(1).randn(16, 4).astype(np.float32)
+        Y = (X @ np.full((4, 2), 0.7)).astype(np.float32)
+
+        def loss_fn(p, b):
+            x, y = b
+            return jnp.mean((x @ p["w1"] @ p["w2"] + p["b"] - y) ** 2)
+
+        params = {"w1": jnp.full((4, 4), 0.2),
+                  "w2": jnp.full((4, 2), 0.5), "b": jnp.zeros((2,))}
+        step = sched.bucketed_zero_step(loss_fn, optax.adam(0.05), cfg=cfg)
+        st = step.init(params)
+        batch = (jnp.asarray(X), jnp.asarray(Y))
+        out = []
+        for _ in range(10):
+            params, st, loss = step(params, st, batch)
+            out.append(float(loss))
+        return out, step.schedule
+
+    def test_hier_matches_flat(self, hvd_module, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_TOPO", "2x4")
+        flat, _ = self._run(sched.SchedConfig(
+            bucket_bytes=64, lowering="flat"))
+        hier, sh = self._run(sched.SchedConfig(
+            bucket_bytes=64, lowering="hier"))
+        assert any(b.lowering == "hier" for b in sh.buckets)
+        np.testing.assert_allclose(flat, hier, rtol=1e-6, atol=1e-6)
+
+    def test_hier_shards_on_ici_subaxis(self, hvd_module, monkeypatch):
+        """ZeRO state under hier shards k-fold (slice_size), not
+        N-fold — the update never crosses DCN."""
+        monkeypatch.setenv("HVD_TPU_TOPO", "2x4")
+        X = np.random.RandomState(1).randn(16, 4).astype(np.float32)
+        Y = (X @ np.full((4, 2), 0.7)).astype(np.float32)
+
+        def loss_fn(p, b):
+            x, y = b
+            return jnp.mean((x @ p["w"] - y) ** 2)
+
+        params = {"w": jnp.full((4, 2), 0.5)}
+        step = sched.bucketed_zero_step(
+            loss_fn, optax.sgd(0.1),
+            cfg=sched.SchedConfig(bucket_bytes=1 << 20, lowering="hier"),
+        )
+        step.init(params)
+        from horovod_tpu.sched.zero1 import _layouts
+
+        layouts, _ = _layouts(
+            params, 8,
+            sched.SchedConfig(bucket_bytes=1 << 20, lowering="hier"),
+        )
+        assert layouts[0].lowering == "hier"
+        assert layouts[0].shards == 4  # slice_size, not world=8
+
+
+class TestTunerLowering:
+    def test_explores_then_freezes(self, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_TOPO", "2x4")
+        tuner = sched.ScheduleTuner(explore_lowering=True)
+        seen = []
+        for score in (5.0, 3.0):  # flat wins
+            lo = tuner.lowering()
+            seen.append(lo)
+            tuner.begin_window()
+            metrics.inc_counter("train.steps")
+            metrics.observe("train.step_seconds", 1.0 / score)
+            metrics.set_gauge("sched.bytes_per_step", 1000)
+            tuner.end_window()
+        assert seen == ["flat", "hier"]
+        assert tuner.lowering() == "flat"
+
+    def test_single_slice_skips_exploration(self, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_TOPO", "1x8")
+        tuner = sched.ScheduleTuner(explore_lowering=True)
+        assert tuner.lowering() == "flat"
+
+    def test_default_defers_to_cost_model(self, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_TOPO", "2x4")
+        tuner = sched.ScheduleTuner()
+        assert tuner.lowering() == "auto"
+        cfg = sched.SchedConfig(bucket_bytes=1 << 20)
+        schedule = sched.build_schedule(
+            [8 << 20] * 2, ["float32"] * 2, cfg, lowering="flat"
+        )
+        stamped = tuner.apply(schedule)
+        assert all(b.lowering == "hier" for b in stamped.buckets)
+
+
+# --------------------------------------------------- shared tiling error
+
+class TestSharedTilingError:
+    def test_process_set_quantized_and_hier_raise_same_type(
+        self, hvd_module, monkeypatch
+    ):
+        """Satellite contract: the non-tiling check lives in one place
+        and every consumer raises the same structured error."""
+        from horovod_tpu.process_sets import tiling_groups
+
+        with pytest.raises(ProcessSetTilingError) as e1:
+            tiling_groups([0, 1, 2], 8)
+        assert e1.value.world_size == 8 and e1.value.ranks == (0, 1, 2)
+
+        single = Topology(num_slices=1, slice_size=8)
+        with pytest.raises(ProcessSetTilingError):
+            single.axis_groups(8)
+
+        monkeypatch.setenv("HVD_TPU_DYNAMIC_PROCESS_SETS", "1")
+        ps = hvd.add_process_set([0, 1, 2])
+        try:
+            from horovod_tpu.ops.quantized import quantized_allreduce
+
+            def f(a):
+                return quantized_allreduce(
+                    a, WORLD_AXIS, op=Sum, process_set=ps
+                )
+
+            with pytest.raises(ProcessSetTilingError, match="tile"):
+                _shard_run(
+                    f, np.ones((N, 512), np.float32)
+                )
+        finally:
+            hvd.remove_process_set(ps)
+
+    def test_partition_groups_still_returns_none(self, hvd_module):
+        """Back-compat: the table API keeps its Optional contract."""
+        from horovod_tpu.process_sets import ProcessSet
+
+        table = get_runtime().process_set_table
+        assert table.partition_groups(table.global_set) is None
+        ps = ProcessSet([0, 1, 2])
+        ps.process_set_id = 99  # detached; only tiling logic matters
+        assert table.partition_groups(ps) is None  # 5 % 3 != 0

@@ -1,0 +1,122 @@
+"""topo_hier_vs_flat micro-benchmark: flat vs hierarchical gradient
+exchange on a simulated 2-slice mesh (8 virtual CPU devices, forced
+``HVD_TPU_TOPO=2x4``).
+
+Structural numbers, not wall-clock truth: on one host both "networks"
+are memcpy, so the interesting outputs are the modeled per-rank
+bytes-over-DCN of each lowering (the subsystem's 1/slice_size claim,
+read from the ``topo.dcn_bytes`` gauge the scheduler publishes) plus
+the measured step times as a sanity bound that the hier staging costs
+no more than a few extra collective launches.  Prints ONE JSON line::
+
+    {"metric": "topo_hier_vs_flat", "dcn_bytes": {"flat":..,"hier":..},
+     "dcn_ratio": .., "step_time_ms": {"flat":..,"hier":..},
+     "loss_delta": ..}
+
+Run standalone or through ``bench.py`` (which embeds the line under
+its ``"topo_hier_vs_flat"`` key).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(
+    0, os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+os.environ.setdefault("HVD_TPU_TOPO", "2x4")
+os.environ["XLA_FLAGS"] = (
+    "--xla_force_host_platform_device_count=8 "
+    + os.environ.get("XLA_FLAGS", "")
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+
+
+def main() -> dict:
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu import metrics, sched
+
+    jax.config.update("jax_platforms", "cpu")
+    hvd.init()
+
+    rng = np.random.RandomState(7)
+    X = rng.randn(32, 64).astype(np.float32)
+    Y = (X @ rng.randn(64, 8).astype(np.float32)).astype(np.float32)
+
+    def loss_fn(p, b):
+        x, y = b
+        h = jnp.tanh(x @ p["w1"] + p["b1"])
+        return jnp.mean((h @ p["w2"] - y) ** 2)
+
+    def params():
+        r = np.random.RandomState(3)
+        return {
+            "w1": jnp.asarray(r.randn(64, 256).astype(np.float32) * 0.05),
+            "b1": jnp.zeros((256,)),
+            "w2": jnp.asarray(r.randn(256, 8).astype(np.float32) * 0.05),
+        }
+
+    def run(lowering, iters=30, warmup=5):
+        cfg = sched.SchedConfig(
+            enabled=True, bucket_bytes=16 * 1024, lowering=lowering
+        )
+        sched.set_config_override(cfg)
+        try:
+            p = params()
+            tx = hvd.DistributedOptimizer(optax.sgd(0.05))
+            step = hvd.distributed_train_step(loss_fn, tx)
+            st = step.init(p)
+            batch = (jnp.asarray(X), jnp.asarray(Y))
+            loss = None
+            for _ in range(warmup):
+                p, st, loss = step(p, st, batch)
+            jax.block_until_ready(loss)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                p, st, loss = step(p, st, batch)
+            jax.block_until_ready(loss)
+            dt = (time.perf_counter() - t0) / iters
+            return {
+                "step_time_ms": round(dt * 1000.0, 3),
+                "dcn_bytes": int(metrics.get_gauge("topo.dcn_bytes") or 0),
+                "ici_bytes": int(metrics.get_gauge("topo.ici_bytes") or 0),
+                "final_loss": float(loss),
+            }
+        finally:
+            sched.set_config_override(None)
+
+    flat = run("flat")
+    hier = run("hier")
+    ratio = (
+        flat["dcn_bytes"] / hier["dcn_bytes"] if hier["dcn_bytes"] else None
+    )
+    return {
+        "metric": "topo_hier_vs_flat",
+        "unit": "dcn_bytes_ratio",
+        "value": round(ratio, 3) if ratio else None,
+        "topo": os.environ["HVD_TPU_TOPO"],
+        "dcn_bytes": {"flat": flat["dcn_bytes"], "hier": hier["dcn_bytes"]},
+        "ici_bytes": {"flat": flat["ici_bytes"], "hier": hier["ici_bytes"]},
+        "step_time_ms": {
+            "flat": flat["step_time_ms"], "hier": hier["step_time_ms"],
+        },
+        "loss_delta": abs(flat["final_loss"] - hier["final_loss"]),
+    }
+
+
+if __name__ == "__main__":
+    try:
+        print(json.dumps(main()))
+    except Exception as e:  # degraded-run hardening: always emit a line
+        print(json.dumps(
+            {"metric": "topo_hier_vs_flat",
+             "error": f"{type(e).__name__}: {e}"}
+        ))
+        sys.exit(1)
