@@ -1,6 +1,20 @@
 from .state import ArrayState, ObjectState, State, TpuState  # noqa: F401
 from .run import run, run_fn  # noqa: F401
-from .remesh import reinit_world  # noqa: F401
+from .remesh import (  # noqa: F401
+    KVShardStore,
+    Move,
+    RemeshPlan,
+    RemeshRequest,
+    ShardLayout,
+    ShardedZeroState,
+    apply_moves,
+    join_remesh,
+    plan_moves,
+    plan_reshard,
+    reinit_world,
+    reshard_bucket_state,
+    run_remesh,
+)
 from .framework_states import (  # noqa: F401
     TensorFlowKerasState,
     TorchState,

@@ -99,6 +99,28 @@ class FixedHosts(HostDiscovery):
         return dict(self._hosts)
 
 
+def _rescale_hosts(found: Dict[str, int], np_target: int) -> Dict[str, int]:
+    """Shrink or grow a discovered ``{host: slots}`` map to exactly
+    ``np_target`` total slots, deterministically: slots are trimmed
+    from (or added to) hosts in sorted-name order, and a host trimmed
+    to zero drops out — the ``resize_to`` fault's world reshaper."""
+    out = dict(found)
+    total = sum(out.values())
+    for h in sorted(out):
+        if total == np_target:
+            break
+        if total > np_target:
+            take = min(out[h], total - np_target)
+            out[h] -= take
+            total -= take
+        else:
+            out[h] += np_target - total
+            total = np_target
+    if total < np_target and not out:
+        out["localhost"] = np_target
+    return {h: s for h, s in out.items() if s > 0}
+
+
 class _BlacklistEntry:
     __slots__ = ("failures", "until")
 
@@ -159,6 +181,16 @@ class HostManager:
     def update_available_hosts(self) -> bool:
         """Polls discovery; returns True when the usable set changed."""
         found = self._discovery.find_available_hosts_and_slots()
+        # Scripted membership change (HVD_TPU_FAULT_PLAN
+        # 'discovery.resize:resize_to:np=N'): rescale the discovered
+        # slot total to exactly N — the seed-reproducible resize half
+        # of kill-and-resize remesh tests, no scripted-discovery fake
+        # needed (docs/fault_tolerance.md).
+        from .. import faults
+
+        resize = faults.inject("discovery.resize", total=sum(found.values()))
+        if isinstance(resize, dict) and resize.get("np"):
+            found = _rescale_hosts(found, int(resize["np"]))
         with self._lock:
             self._expire_blacklist_locked()
             usable = {

@@ -14,7 +14,12 @@ import functools
 from typing import Callable
 
 from .. import runtime
-from ..exceptions import HorovodInternalError, HostsUpdatedInterrupt
+from ..exceptions import (
+    HorovodInternalError,
+    HostsUpdatedInterrupt,
+    RemeshError,
+    RemeshInterrupt,
+)
 from ..utils.logging import get_logger
 from .state import State
 
@@ -27,6 +32,7 @@ def run_fn(func: Callable, reset: Callable) -> Callable:
         if elastic_job:
             notification_manager.init()
             notification_manager.register_listener(state)
+            _maybe_join_remesh(state, notification_manager)
         skip_sync = False
         try:
             while True:
@@ -48,6 +54,40 @@ def run_fn(func: Callable, reset: Callable) -> Callable:
                         _exit_for_restart(_RESTART_CODE)
                     state.restore()
                     skip_sync = False
+                except RemeshInterrupt as e:
+                    # Zero-downtime path: reshard live state in place
+                    # and keep training — any failure degrades to the
+                    # checkpoint-restore restart round below
+                    # (docs/fault_tolerance.md).
+                    if elastic_job and e.request is not None:
+                        from . import remesh as _remesh
+
+                        try:
+                            _remesh.run_remesh(
+                                state, notification_manager, e.request
+                            )
+                        except SystemExit as shed:
+                            # shed rank: clean departure, state already
+                            # handed off through the KV store
+                            _exit_for_restart(int(shed.code or 0))
+                        except RemeshError as err:
+                            get_logger().warning(
+                                "remesh failed (%s); falling back to "
+                                "checkpoint-restore restart", err,
+                            )
+                            _exit_for_restart(_RESTART_CODE)
+                        # Success: the world is re-initialized; clear
+                        # stale compiled state and rebuild via the
+                        # user's reset callbacks, then re-sync over the
+                        # new mesh (joiners adopt rank 0's replicated
+                        # attrs there).
+                        state.on_reset()
+                        skip_sync = False
+                        continue
+                    # Non-elastic (or malformed request): behave like a
+                    # plain membership change.
+                    get_logger().info("hosts updated; re-initializing")
+                    skip_sync = e.skip_sync
                 except HostsUpdatedInterrupt as e:
                     get_logger().info("hosts updated; re-initializing")
                     if elastic_job:
@@ -62,6 +102,30 @@ def run_fn(func: Callable, reset: Callable) -> Callable:
                 notification_manager.remove_listener(state)
 
     return wrapper
+
+
+def _maybe_join_remesh(state: State, manager) -> None:
+    """A worker spawned to JOIN an in-flight remesh
+    (``HVD_TPU_REMESH_JOIN`` in its env) fetches its shard of the
+    exchanged state from the KV store before the first sync; replicated
+    attributes arrive through the normal ``sync()`` broadcast.  Any
+    failure exits for a restart round — the joiner has no state to
+    lose."""
+    try:
+        request = manager.remesh_join_request()
+    except Exception:
+        request = None
+    if request is None:
+        return
+    from . import remesh as _remesh
+
+    try:
+        _remesh.join_remesh(state, manager, request)
+    except RemeshError as err:
+        get_logger().warning(
+            "remesh join failed (%s); exiting for a restart round", err
+        )
+        _exit_for_restart(_RESTART_CODE)
 
 
 _RESTART_CODE = 73  # runner/elastic_driver.py RESTART_CODE

@@ -16,7 +16,7 @@ from typing import Any, Dict, Optional
 import jax
 
 from .. import functions, runtime
-from ..exceptions import HostsUpdatedInterrupt
+from ..exceptions import HostsUpdatedInterrupt, RemeshInterrupt
 
 
 class State:
@@ -26,9 +26,24 @@ class State:
         self._host_messages: list = []
         self._reset_callbacks: list = []
         self._known_hosts: Optional[frozenset] = None
+        self._remesh_request = None
+        self._sharded: Dict[str, Any] = {}
+        self._commit_count = 0
 
     def register_reset_callbacks(self, callbacks) -> None:
         self._reset_callbacks.extend(callbacks)
+
+    def register_sharded(self, name: str, spec) -> None:
+        """Register a sharded-state adapter (e.g.
+        :class:`~horovod_tpu.elastic.remesh.ShardedZeroState`) whose
+        per-rank shards the in-process remesh must exchange — see
+        ``docs/fault_tolerance.md``.  Replicated attributes need no
+        registration: ``save()``/``restore()``/``sync()`` already carry
+        them across a remesh."""
+        self._sharded[name] = spec
+
+    def sharded_attrs(self) -> Dict[str, Any]:
+        return dict(self._sharded)
 
     def on_reset(self) -> None:
         self.reset()
@@ -37,6 +52,12 @@ class State:
 
     def on_hosts_updated(self, timestamp, update_res) -> None:
         self._host_messages.append((timestamp, update_res))
+
+    def on_remesh_requested(self, request) -> None:
+        """Driver authorized an in-process remesh: the next commit
+        boundary raises :class:`RemeshInterrupt` instead of the plain
+        restart interrupt (``runner/elastic_worker.py`` poller)."""
+        self._remesh_request = request
 
     def commit(self) -> None:
         """Snapshot + check for host changes (reference ``elastic.py:60``).
@@ -47,6 +68,14 @@ class State:
         carry state between rounds the way the reference's surviving
         processes do.
         """
+        from .. import faults
+
+        self._commit_count += 1
+        # Deterministic kill-at-step-boundary site: the fault plan's
+        # kill_at_step sugar targets exactly this arrival counter
+        # (docs/fault_tolerance.md — seed-reproducible kill-and-resize
+        # remesh tests).
+        faults.inject("worker.commit", step=self._commit_count)
         self.save()
         self._persist()
         self.check_host_updates()
@@ -99,7 +128,13 @@ class State:
 
     def check_host_updates(self) -> None:
         """Raise HostsUpdatedInterrupt when membership changed
-        (reference ``elastic.py:73-96``)."""
+        (reference ``elastic.py:73-96``) — or :class:`RemeshInterrupt`
+        when the driver authorized resharding live state in place
+        (``elastic/remesh.py``)."""
+        if self._remesh_request is not None:
+            req, self._remesh_request = self._remesh_request, None
+            self._host_messages.clear()
+            raise RemeshInterrupt(req)
         if self._host_messages:
             self._host_messages.clear()
             raise HostsUpdatedInterrupt()

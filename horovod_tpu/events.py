@@ -52,6 +52,16 @@ WATCHDOG_TIMEOUT = "watchdog_timeout"
 SPAWN_FAILED = "spawn_failed"
 CHECKPOINT_CORRUPT = "checkpoint_corrupt"
 CHECKPOINT_FALLBACK = "checkpoint_fallback"
+# In-process remesh lifecycle (elastic/remesh.py): a remesh attempt
+# emits START, one PHASE entry per pipeline phase (pause/snapshot/
+# publish/barrier/reinit/fetch/rebuild), then OK — or FALLBACK with the
+# failing phase when it degrades to the checkpoint-restore restart
+# path, or ABORT when the driver cancels the attempt.
+REMESH_START = "remesh_start"
+REMESH_PHASE = "remesh_phase"
+REMESH_OK = "remesh_ok"
+REMESH_FALLBACK = "remesh_fallback"
+REMESH_ABORT = "remesh_abort"
 
 
 class EventLog:

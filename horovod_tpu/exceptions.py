@@ -41,6 +41,34 @@ class HostsUpdatedInterrupt(HorovodTpuError):
         self.skip_sync = skip_sync
 
 
+class RemeshInterrupt(HostsUpdatedInterrupt):
+    """Membership changed AND the driver authorized an in-process
+    remesh (``elastic/remesh.py``): instead of exiting for a respawn
+    round, the worker pauses at this step boundary, reshards live
+    state to the new world, and continues.  Subclasses
+    :class:`HostsUpdatedInterrupt` so a handler unaware of remesh
+    degrades to the plain restart path.  ``request`` carries the
+    driver's :class:`~horovod_tpu.elastic.remesh.RemeshRequest`."""
+
+    def __init__(self, request=None):
+        super().__init__()
+        self.request = request
+
+
+class RemeshError(HorovodTpuError):
+    """The in-process remesh cannot proceed (incompatible plans, a
+    source shard missing, a peer died mid-exchange, reinit failure).
+    The elastic loop catches this and falls back to the
+    checkpoint-restore restart path — a failed remesh degrades, it
+    never wedges (``docs/fault_tolerance.md``)."""
+
+
+class ShardChecksumError(RemeshError):
+    """A moved shard failed its sha256 integrity check during the
+    remesh state exchange (torn KV write, corrupted transport).  Like
+    every :class:`RemeshError`, falls back to checkpoint restore."""
+
+
 class FaultInjected(HorovodTpuError):
     """Raised by ``horovod_tpu.faults.inject`` when an ``error``/``flake``
     fault fires at a call site — the scripted stand-in for a transient

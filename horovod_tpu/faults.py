@@ -30,6 +30,19 @@ to :func:`inject`), a fault *kind*, and optional selectors/arguments:
     ``corrupt``          return ``True`` from :func:`inject`; the call
                          site cooperates (e.g. ``checkpoint.py``
                          flips bytes after writing)
+    ``kill_at_step``     sugar for ``crash`` pinned to one training
+                         step: requires ``step=K`` and fires exactly
+                         when a site's ``step`` context equals K
+                         (``worker.commit`` is the per-step-boundary
+                         site) — the deterministic worker kill of the
+                         kill-and-resize remesh tests
+    ``resize_to``        cooperative (like ``corrupt``): requires
+                         ``np=N``; :func:`inject` returns
+                         ``{"np": N}`` and the call site resizes the
+                         world (``discovery.resize`` in
+                         ``elastic/discovery.py`` rescales the
+                         discovered slot total) — a scripted,
+                         seed-reproducible membership change
 
 selectors
     ``nth=K``     fire on the K-th matching arrival only (1-based)
@@ -51,10 +64,21 @@ Registered sites (grep ``faults.inject`` for ground truth):
 
 ==============================  ==========================================
 ``discovery.script``            before each discovery-script execution
+``discovery.resize``            after each discovery poll (``resize_to``
+                                rescales the discovered slot total)
 ``driver.spawn``                before each worker spawn (host/rank/round)
 ``worker.connect``              before the worker dials the rendezvous KV
 ``worker.heartbeat``            each worker heartbeat tick (rank/round)
+``worker.commit``               each elastic-state commit (``step=`` is
+                                the per-state commit counter — the
+                                ``kill_at_step`` anchor)
 ``checkpoint.write``            after checkpoint bytes hit disk (corrupt)
+``remesh.<phase>``              each remesh pipeline phase (pause/
+                                snapshot/publish/barrier/reinit/fetch/
+                                rebuild — fail any phase on demand)
+``remesh.publish``              additionally honors ``corrupt``: the
+                                published shard blob is damaged so the
+                                receiver's checksum MUST catch it
 ==============================  ==========================================
 
 Worker scripts may add their own sites (``faults.inject("my.site")``)
@@ -75,10 +99,11 @@ from .utils.logging import get_logger
 
 ENV_VAR = "HVD_TPU_FAULT_PLAN"
 
-KINDS = ("error", "flake", "crash", "hang", "slow", "corrupt")
+KINDS = ("error", "flake", "crash", "hang", "slow", "corrupt",
+         "kill_at_step", "resize_to")
 
 # Selector/argument keys that are NOT matched against inject() context.
-_RESERVED = {"nth", "times", "p", "code", "secs", "msg"}
+_RESERVED = {"nth", "times", "p", "code", "secs", "msg", "np"}
 
 
 def _parse_scalar(val: str) -> Any:
@@ -106,6 +131,22 @@ class FaultSpec:
             )
         self.site = site
         self.kind = "error" if kind == "flake" else kind
+        self.np = int(args.pop("np", 0))            # resize_to target
+        if self.kind == "resize_to" and self.np < 1:
+            raise ValueError(
+                "resize_to requires np=N (the target world size)"
+            )
+        if self.kind == "kill_at_step":
+            # Sugar: a crash pinned to one step-counter value — the
+            # seed-reproducible worker kill of remesh tests.  The step
+            # selector matches the site's step= context
+            # (State.commit's per-step arrival counter).
+            if "step" not in args:
+                raise ValueError(
+                    "kill_at_step requires step=K (the commit counter "
+                    "value to die at)"
+                )
+            self.kind = "crash"
         self.nth = int(args.pop("nth", 0))          # 0 = any arrival
         self.times = int(args.pop("times", 1))      # 0 = unbounded
         self.prob = float(args.pop("p", 1.0))
@@ -265,11 +306,13 @@ def reset() -> None:
         _active_loaded = False
 
 
-def inject(site: str, **context: Any) -> bool:
+def inject(site: str, **context: Any):
     """Fault-injection call site.  Inert (returns False) without a
     matching armed fault.  ``error`` raises :class:`FaultInjected`;
-    ``crash`` hard-exits the process; ``hang``/``slow`` sleep;
-    ``corrupt`` returns True so the caller corrupts its own output.
+    ``crash`` (and its ``kill_at_step`` sugar) hard-exits the process;
+    ``hang``/``slow`` sleep; ``corrupt`` returns True so the caller
+    corrupts its own output; ``resize_to`` returns ``{"np": N}`` so
+    the caller resizes the world.
     """
     plan = get_plan()
     if plan is None:
@@ -297,6 +340,11 @@ def inject(site: str, **context: Any) -> bool:
                     spec.kind, spec.secs, site, context)
         time.sleep(spec.secs)
         return False
+    if spec.kind == "resize_to":
+        # cooperative: the call site resizes the world to spec.np
+        log.warning("fault injection: resize_to(np=%d) at %s %s",
+                    spec.np, site, context)
+        return {"np": spec.np}
     # corrupt: cooperate with the caller
     log.warning("fault injection: corrupt at %s %s", site, context)
     return True

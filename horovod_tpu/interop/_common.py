@@ -111,9 +111,23 @@ def gather_slices(indices: np.ndarray, values: np.ndarray):
     """
     from jax.experimental import multihost_utils
 
+    from .. import runtime as _runtime
+
     indices = np.asarray(indices)
     values = np.asarray(values)
     n = int(indices.shape[0])
+    rt = _runtime.get_runtime_or_none()
+    if rt is None or rt.process_count == 1:
+        # Single process: the gather set is itself.  process_allgather
+        # returns the input WITHOUT a leading process axis here, which
+        # would make callers' [p, :lens[p]] row selection explode
+        # (IndexError on a 1-D array) — build the [1, n, ...] result
+        # directly and skip the collective.
+        return (
+            np.asarray([n], np.int32),
+            indices[None] if n else indices.reshape((1, 0) + indices.shape[1:]),
+            values[None] if n else values.reshape((1, 0) + values.shape[1:]),
+        )
     lens = np.asarray(multihost_utils.process_allgather(
         np.asarray(n, np.int32)
     )).reshape(-1)

@@ -15,7 +15,15 @@ mesh exists:
 * **gauges** — last-write-wins values, optionally labeled
   (``stall.stalled{op="allreduce.grad"}``)
 * **histograms** — fixed-bucket distributions (per-collective dispatch
-  latency, retry attempt latency, checkpoint write/restore time)
+  latency, retry attempt latency, checkpoint write/restore time,
+  ``remesh.phase_seconds``)
+
+The zero-downtime remesh (``elastic/remesh.py``) reports through the
+``remesh.*`` family: worker-side ``remesh.{attempts,success,fallback,
+shed,joins}`` + per-phase ``remesh.phase.<name>`` counters, driver-side
+``remesh.driver_{attempts,success,fallback}``, and the
+``remesh.phase_seconds`` histogram — the counters a
+kill-and-resize postmortem reads first (docs/fault_tolerance.md).
 
 Two export renderers: :func:`render_prometheus` (text exposition
 format, ``hvd_tpu_`` family prefix, scraped by the elastic driver's

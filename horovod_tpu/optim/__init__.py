@@ -3,6 +3,7 @@ from .distributed_optimizer import (  # noqa: F401
     DistributedOptimizer,
     DistributedOptimizerState,
     distributed_train_step,
+    remesh_optimizer_state,
 )
 from .zero import (  # noqa: F401
     clip_by_global_norm,

@@ -69,6 +69,31 @@ class DistributedOptimizerState(NamedTuple):
     residual: Any = None
 
 
+def remesh_optimizer_state(
+    state: "DistributedOptimizerState", *, joined: bool = False
+) -> "DistributedOptimizerState":
+    """Carry a :class:`DistributedOptimizerState` across an in-process
+    remesh (``elastic/remesh.py``).
+
+    Every leaf is either replicated (``inner``, ``counter``) or
+    param-shaped and rank-local (``acc`` gradient accumulators, EF
+    ``residual``) — unlike ZeRO-1 bucket shards, nothing here needs a
+    shard exchange; the state is valid under any world size.  A JOINER
+    (``joined=True``) zeroes the rank-local leaves: it has no local
+    accumulation/quantization history, and zeros are the documented
+    safe cold-start for both (a partial accumulation window restarts;
+    EF degrades to plain quantization until feedback refills).
+    """
+    if not joined:
+        return state
+    zero = lambda t: None if t is None else jax.tree.map(
+        jnp.zeros_like, t
+    )
+    return state._replace(
+        acc=zero(state.acc), residual=zero(state.residual)
+    )
+
+
 def _reduce_gradients(
     grads: Any,
     *,

@@ -45,6 +45,11 @@ from . import hosts as hosts_mod
 from .launch import free_port, make_worker_env
 
 RESTART_CODE = 73
+# A worker resharded AWAY by an in-process remesh exits with this code
+# (elastic/remesh.py REMESH_SHED_CODE): a clean departure — its state
+# was handed off through the KV store — not a failure, so its host is
+# NOT blacklisted and the round keeps running with the survivors.
+REMESH_SHED_CODE = 75
 
 DISCOVERY_PERIOD_S = 1.0  # reference driver.py:30
 
@@ -65,6 +70,17 @@ ELASTIC_ROUND_TIMEOUT = "ELASTIC_ROUND_TIMEOUT"
 # Transient worker-spawn failures (ssh flake, agent staleness) retry
 # this many times before the host is blamed.
 SPAWN_RETRIES = "SPAWN_RETRIES"
+# In-process remesh (HVD_TPU_ELASTIC_REMESH=1): on a membership change
+# the driver pauses survivors at a step boundary and coordinates a live
+# state reshard (elastic/remesh.py) instead of a tear-down + restore
+# round.  Off by default — the respawn path is validated on every
+# backend; remesh is the opt-in fast path, and ANY remesh failure
+# degrades to the respawn round automatically.
+ELASTIC_REMESH = "ELASTIC_REMESH"
+# Per-phase wall-clock bound on a remesh attempt (ack/exchange/reinit
+# waits); past it the driver aborts the attempt and falls back.
+REMESH_TIMEOUT = "REMESH_TIMEOUT"
+DEFAULT_REMESH_TIMEOUT_S = 60.0
 
 
 def _with_compilation_cache(extra_env):
@@ -107,12 +123,26 @@ class ElasticDriver:
         round_timeout_s: Optional[float] = None,
         spawn_retry: Optional[RetryPolicy] = None,
         telemetry_port: Optional[int] = None,
+        remesh: Optional[bool] = None,
+        remesh_timeout_s: Optional[float] = None,
     ):
         self.host_manager = host_manager
         self.min_np = min_np
         self.max_np = max_np
         self.reset_limit = reset_limit
         self.cooldown_s = cooldown_s
+        if remesh is None:
+            remesh = hvd_env.get_bool(ELASTIC_REMESH, False)
+        self.remesh = remesh
+        if remesh_timeout_s is None:
+            remesh_timeout_s = hvd_env.get_float(
+                REMESH_TIMEOUT, DEFAULT_REMESH_TIMEOUT_S
+            )
+        self.remesh_timeout_s = remesh_timeout_s
+        self._remesh_seq = 0
+        # round-scoped spawn context so a mid-round remesh can spawn
+        # joiners with the same transport the round's workers used
+        self._round_spawn = None
         if hang_timeout_s is None:
             hang_timeout_s = hvd_env.get_float(
                 ELASTIC_HANG_TIMEOUT, DEFAULT_HANG_TIMEOUT_S
@@ -303,6 +333,18 @@ class ElasticDriver:
                 begin = getattr(make_worker, "begin_round", None)
                 if begin is not None:
                     begin(round_id)
+                # Round-scoped spawn context: a mid-round remesh spawns
+                # JOINER workers through the same transport/env recipe.
+                self._round_spawn = {
+                    "command": command,
+                    "extra_env": extra_env,
+                    "rdv_addr": round_rdv_addr,
+                    "rdv_port": server.port,
+                    "secret": secret,
+                    "make_worker": make_worker,
+                    "ssh_port": ssh_port,
+                    "ssh_identity_file": ssh_identity_file,
+                }
                 workers = []
                 spawn_failed_host = None
                 for slot in assignments:
@@ -489,16 +531,35 @@ class ElasticDriver:
 
         while pending:
             if self._membership_changed.is_set():
-                control.put(
-                    "__elastic__", f"hosts_updated_{round_id}", b"1"
-                )
                 self._membership_changed.clear()
+                remeshed = None
+                if self.remesh:
+                    remeshed = self._try_remesh(
+                        workers, assignments, control, round_id
+                    )
+                if remeshed is not None:
+                    # Live reshard succeeded: the round continues with
+                    # the NEW worker set — no respawn, no checkpoint
+                    # restore on the hot path.
+                    workers, assignments = remeshed
+                    pending = set(range(len(workers)))
+                    hb_seen.clear()
+                    metrics.set_gauge("elastic.workers", len(workers))
+                    self._last_assignments = assignments
+                else:
+                    control.put(
+                        "__elastic__", f"hosts_updated_{round_id}", b"1"
+                    )
             for i in sorted(pending):
                 rc = workers[i].returncode
                 if rc is None:
                     continue
                 pending.discard(i)
                 if rc == 0:
+                    continue
+                if rc == REMESH_SHED_CODE:
+                    # resharded away by a remesh: clean departure, the
+                    # host stays in rotation
                     continue
                 if rc == RESTART_CODE:
                     # graceful restart request: drain the others too
@@ -560,6 +621,256 @@ class ElasticDriver:
         if saw_failure:
             return RESTART_CODE if self.host_manager.available_slots() >= self.min_np else saw_failure
         return 0
+
+    # -- in-process remesh coordination (elastic/remesh.py) --------------
+    def _await_remesh_keys(self, control, keys, deadline: float,
+                           workers=None) -> bool:
+        """Poll the KV store until every key in ``keys`` exists or the
+        deadline passes.  With ``workers``, a worker death while
+        waiting fails the attempt immediately (a dead peer can never
+        ack)."""
+        remaining = set(keys)
+        while remaining:
+            for key in list(remaining):
+                try:
+                    if control.get("__remesh__", key,
+                                   timeout_ms=0) is not None:
+                        remaining.discard(key)
+                except Exception:
+                    pass
+            if not remaining:
+                return True
+            if workers is not None and any(
+                w.returncode not in (None, 0, REMESH_SHED_CODE)
+                for w in workers
+            ):
+                get_logger().warning(
+                    "remesh: a worker died while waiting for %s",
+                    sorted(remaining),
+                )
+                return False
+            if time.monotonic() > deadline:
+                get_logger().warning(
+                    "remesh: timed out waiting for %s", sorted(remaining)
+                )
+                return False
+            time.sleep(0.05)
+        return True
+
+    def _plan_remesh_world(self, workers, assignments, new_np: int,
+                           new_hosts):
+        """Old world -> new world placement: survivors keep their host
+        (new ranks assigned in old-rank order), shed workers are those
+        on removed hosts or beyond the new size, joiner slots fill the
+        remaining capacity.  Returns (survivors {old->new}, shed old
+        ranks, joiner SlotInfos, full new SlotInfo list by new rank)."""
+        capacity = dict(new_hosts)
+        keep: List[int] = []  # old ranks surviving, in old-rank order
+        shed: List[int] = []
+        for slot in assignments:
+            if len(keep) < new_np and capacity.get(slot.hostname, 0) > 0:
+                capacity[slot.hostname] -= 1
+                keep.append(slot.rank)
+            else:
+                shed.append(slot.rank)
+        survivors = {old: new for new, old in enumerate(keep)}
+        host_of: Dict[int, str] = {}
+        by_old = {s.rank: s for s in assignments}
+        for old, new in survivors.items():
+            host_of[new] = by_old[old].hostname
+        joiner_ranks = list(range(len(keep), new_np))
+        for nr in joiner_ranks:
+            for h in sorted(capacity):
+                if capacity[h] > 0:
+                    capacity[h] -= 1
+                    host_of[nr] = h
+                    break
+            else:
+                return None  # capacity accounting failed
+        # per-host local/cross numbering over the final placement
+        hosts_in_order: List[str] = []
+        for nr in range(new_np):
+            if host_of[nr] not in hosts_in_order:
+                hosts_in_order.append(host_of[nr])
+        local_index: Dict[str, int] = {h: 0 for h in hosts_in_order}
+        slots: List[hosts_mod.SlotInfo] = []
+        per_host = {
+            h: list(host_of.values()).count(h) for h in hosts_in_order
+        }
+        for nr in range(new_np):
+            h = host_of[nr]
+            slots.append(hosts_mod.SlotInfo(
+                hostname=h, rank=nr,
+                local_rank=local_index[h],
+                cross_rank=hosts_in_order.index(h),
+                size=new_np,
+                local_size=per_host[h],
+                cross_size=len(hosts_in_order),
+            ))
+            local_index[h] += 1
+        joiners = [slots[nr] for nr in joiner_ranks]
+        return survivors, shed, joiners, slots
+
+    def _try_remesh(self, workers, assignments, control, round_id):
+        """Attempt a zero-downtime in-process remesh for the current
+        membership change.  Returns ``(workers, assignments)`` for the
+        new world on success; ``None`` falls back to the respawn-round
+        path (the caller then publishes the restart signal).  Every
+        failure mode is bounded by ``remesh_timeout_s`` and ends in
+        either success or a clean fallback — never a wedged round."""
+        from ..elastic.remesh import RemeshRequest
+
+        try:
+            new_assignments = self.current_assignments()
+        except RuntimeError as e:
+            get_logger().warning("remesh: %s", e)
+            return None
+        np_old, np_new = len(assignments), len(new_assignments)
+        live = [w for w in workers if w.returncode is None]
+        if len(live) != np_old:
+            # someone already died: that is the crash path's job
+            return None
+        new_hosts: Dict[str, int] = {}
+        for a in new_assignments:
+            new_hosts[a.hostname] = new_hosts.get(a.hostname, 0) + 1
+        if np_new == np_old and all(
+            new_hosts.get(s.hostname, 0) > 0 for s in assignments
+        ):
+            return None  # not a resize; nothing to reshard
+        planned = self._plan_remesh_world(
+            workers, assignments, np_new, new_hosts
+        )
+        if planned is None:
+            return None
+        survivors, shed, joiners, new_slots = planned
+        if not survivors:
+            return None  # no survivor to carry state: full restart
+        metrics.inc_counter("remesh.driver_attempts")
+        self._remesh_seq += 1
+        rid = self._remesh_seq
+        coord_host = (
+            "127.0.0.1"
+            if exec_utils.is_local(new_slots[0].hostname)
+            else new_slots[0].hostname
+        )
+        request = RemeshRequest(
+            remesh_id=rid, round_id=round_id,
+            np_old=np_old, np_new=np_new,
+            coordinator_addr=f"{coord_host}:{free_port()}",
+            survivors=survivors,
+            deadline_s=self.remesh_timeout_s,
+        )
+        events.emit(
+            events.REMESH_START, remesh_id=rid, round=round_id,
+            np_old=np_old, np_new=np_new,
+            survivors=sorted(survivors), shed=sorted(shed),
+            joiners=[s.rank for s in joiners],
+        )
+        get_logger().warning(
+            "remesh %d: %d -> %d worker(s) (%d survivor(s), %d shed, "
+            "%d joining) — resharding in place",
+            rid, np_old, np_new, len(survivors), len(shed), len(joiners),
+        )
+        control.put("__remesh__", f"begin_{round_id}",
+                    request.to_json().encode())
+        deadline = time.monotonic() + self.remesh_timeout_s
+        old_ranks = sorted(s.rank for s in assignments)
+        joiner_procs = []
+
+        def fallback(why: str):
+            metrics.inc_counter("remesh.driver_fallback")
+            events.emit(
+                events.REMESH_FALLBACK, remesh_id=rid, round=round_id,
+                error=why,
+            )
+            get_logger().warning(
+                "remesh %d failed (%s); falling back to the respawn "
+                "round", rid, why,
+            )
+            try:
+                control.put("__remesh__", f"abort_{rid}", b"1")
+            except Exception:
+                pass
+            for p in joiner_procs:
+                p.terminate()
+            for p in joiner_procs:
+                p.wait()
+            return None
+
+        # Phase 1+2: every live old rank pauses at a step boundary and
+        # publishes its shards (pause acks piggyback on the heartbeat
+        # KV channel).
+        if not self._await_remesh_keys(
+            control, [f"pause_{rid}_{r}" for r in old_ranks],
+            deadline, workers,
+        ):
+            return fallback("pause ack timeout")
+        if not self._await_remesh_keys(
+            control, [f"snapshot_{rid}_{r}" for r in old_ranks],
+            deadline, workers,
+        ):
+            return fallback("snapshot ack timeout")
+
+        # Phase 3: spawn joiners into the NEW world, then authorize the
+        # exchange.  Joiners rendezvous on the new coordinator with the
+        # reinit-ing survivors.
+        ctx = self._round_spawn or {}
+        make_worker = ctx.get("make_worker", exec_utils.WorkerProcess)
+        for slot in joiners:
+            env = make_worker_env(
+                slot, request.coordinator_addr, ctx.get("rdv_addr"),
+                ctx.get("rdv_port"), ctx.get("secret"),
+                ctx.get("extra_env"),
+            )
+            env["HVD_TPU_ELASTIC"] = "1"
+            env["HVD_TPU_ELASTIC_ROUND"] = str(round_id)
+            env["HVD_TPU_REMESH_JOIN"] = str(rid)
+            try:
+                joiner_procs.append(self.spawn_retry.call(
+                    lambda slot=slot, env=env: make_worker(
+                        slot.rank, slot.hostname, ctx.get("command"),
+                        env, ssh_port=ctx.get("ssh_port"),
+                        ssh_identity_file=ctx.get("ssh_identity_file"),
+                    )
+                ))
+            except Exception as e:
+                return fallback(f"joiner spawn on {slot.hostname}: {e}")
+        control.put("__remesh__", f"go_{rid}", b"1")
+
+        # Phase 4: survivors reinit + fetch, joiners fetch; shed ranks
+        # leave.  Done acks are keyed by NEW ranks.
+        new_ranks = list(range(np_new))
+        if not self._await_remesh_keys(
+            control,
+            [f"done_{rid}_{r}" for r in new_ranks]
+            + [f"shed_{rid}_{r}" for r in shed],
+            deadline + self.remesh_timeout_s,  # reinit is the long pole
+            list(live) + joiner_procs,
+        ):
+            return fallback("exchange/reinit timeout")
+
+        # Reap shed workers (clean exits, hosts stay in rotation).
+        by_old = {s.rank: i for i, s in enumerate(assignments)}
+        survivor_procs = {}
+        for old, new in survivors.items():
+            survivor_procs[new] = workers[by_old[old]]
+        for r in shed:
+            workers[by_old[r]].wait()
+        new_workers = [
+            survivor_procs[nr] if nr in survivor_procs
+            else joiner_procs[[s.rank for s in joiners].index(nr)]
+            for nr in range(np_new)
+        ]
+        metrics.inc_counter("remesh.driver_success")
+        metrics.set_gauge("elastic.remesh", rid)
+        events.emit(
+            events.REMESH_OK, remesh_id=rid, round=round_id, np=np_new,
+        )
+        get_logger().warning(
+            "remesh %d complete: round %d continues with %d worker(s)",
+            rid, round_id, np_new,
+        )
+        return new_workers, new_slots
 
     def _find_hung_worker(
         self,

@@ -87,9 +87,12 @@ class WorkerNotificationManager:
         from ..faults import inject
 
         seq = 0
-        key = f"hb_{self.round}_{self.rank}"
         while not self._stop.is_set():
             seq += 1
+            # key recomputed per tick: an in-process remesh can change
+            # this worker's rank mid-round (elastic/remesh.py), and the
+            # driver's hang monitor then watches the NEW key
+            key = f"hb_{self.round}_{self.rank}"
             try:
                 client = self._client
                 if client is None:
@@ -113,7 +116,35 @@ class WorkerNotificationManager:
 
     def _poll(self) -> None:
         key = f"hosts_updated_{self.round}"
+        remesh_key = f"begin_{self.round}"
+        notified_remesh = None
         while not self._stop.is_set():
+            # Remesh authorization first: when the driver chose the
+            # in-process reshard path it publishes __remesh__/begin_*
+            # INSTEAD of the restart signal; listeners get a
+            # RemeshInterrupt at their next commit (elastic/remesh.py).
+            try:
+                raw = self._client.get(
+                    "__remesh__", remesh_key, timeout_ms=0
+                )
+            except Exception:
+                raw = None
+            if raw is not None:
+                try:
+                    from ..elastic.remesh import RemeshRequest
+
+                    req = RemeshRequest.from_json(raw.decode())
+                except Exception:
+                    req = None
+                if req is not None and req.remesh_id != notified_remesh:
+                    notified_remesh = req.remesh_id
+                    with self._lock:
+                        for state in self._listeners:
+                            notify = getattr(
+                                state, "on_remesh_requested", None
+                            )
+                            if notify is not None:
+                                notify(req)
             try:
                 val = self._client.get("__elastic__", key, timeout_ms=0)
             except Exception:
@@ -133,6 +164,77 @@ class WorkerNotificationManager:
         with self._lock:
             if state in self._listeners:
                 self._listeners.remove(state)
+
+    # -- in-process remesh plumbing (elastic/remesh.py) -----------------
+    def kv_client(self):
+        """The rendezvous KV client (shard transport of the remesh
+        state exchange)."""
+        self.init()
+        return self._client
+
+    def remesh_ack(self, remesh_id: int, phase: str) -> None:
+        """Acknowledge one remesh phase to the driver:
+        ``__remesh__/<phase>_<id>_<rank>``.  ``pause`` and ``snapshot``
+        acks carry the OLD rank, ``done`` the NEW one (the manager's
+        rank is updated by :meth:`on_world_changed` in between)."""
+        self.kv_client().put(
+            "__remesh__", f"{phase}_{int(remesh_id)}_{self.rank}", b"1"
+        )
+
+    def remesh_wait_go(self, remesh_id: int,
+                       timeout_s: float = 60.0) -> None:
+        """Block until the driver flips ``go`` (every survivor
+        published its shards) — or raise on ``abort``/timeout so the
+        caller falls back to the restart path instead of wedging."""
+        from ..exceptions import RemeshError
+
+        deadline = time.monotonic() + max(timeout_s, 1.0)
+        client = self.kv_client()
+        while True:
+            try:
+                if client.get("__remesh__", f"abort_{int(remesh_id)}",
+                              timeout_ms=0) is not None:
+                    raise RemeshError(
+                        f"driver aborted remesh {remesh_id}"
+                    )
+                if client.get("__remesh__", f"go_{int(remesh_id)}",
+                              timeout_ms=0) is not None:
+                    return
+            except RemeshError:
+                raise
+            except Exception:
+                pass  # KV blip: keep polling until the deadline
+            if time.monotonic() > deadline:
+                raise RemeshError(
+                    f"remesh {remesh_id}: no go/abort from the driver "
+                    f"within {timeout_s:.0f}s"
+                )
+            if self._stop.wait(0.1):
+                raise RemeshError("worker shutting down mid-remesh")
+
+    def on_world_changed(self, new_rank: int) -> None:
+        """Adopt the post-remesh rank: heartbeats and later acks key on
+        it (``reinit_world`` already rewrote the env triple)."""
+        self.rank = int(new_rank)
+
+    def remesh_join_request(self):
+        """The :class:`~horovod_tpu.elastic.remesh.RemeshRequest` this
+        worker was spawned to JOIN (``HVD_TPU_REMESH_JOIN=<id>`` in the
+        spawn env), or None for a normal round worker."""
+        raw_id = os.environ.get("HVD_TPU_REMESH_JOIN")
+        if not raw_id:
+            return None
+        from ..elastic.remesh import RemeshRequest
+
+        raw = self.kv_client().get(
+            "__remesh__", f"begin_{self.round}", timeout_ms=10000
+        )
+        if raw is None:
+            return None
+        req = RemeshRequest.from_json(raw.decode())
+        if req.remesh_id != int(raw_id):
+            return None
+        return req
 
     # -- state persistence across rounds (rank 0 writes) ----------------
     # Blobs are chunked: the controller protocol caps one frame at 64MB

@@ -93,6 +93,22 @@ def _layouts(
     return layouts, schedule
 
 
+def bucket_layouts(
+    params, world: int, cfg: Optional[SchedConfig] = None
+) -> List[_BucketLayout]:
+    """Public layout rebuild for a given world size (the elastic
+    remesh entry point): the deterministic host-side description of how
+    ``bucketed_zero_step`` shards ``params``' buckets over ``world``
+    ranks.  ``elastic/remesh.plan_reshard`` pairs the old and new
+    worlds' layout lists to compute the shard exchange; the layouts are
+    a pure function of (params metadata, world, cfg) so every rank —
+    and the driver — derives the identical plan."""
+    if cfg is None:
+        cfg = current_config()
+    layouts, _ = _layouts(params, world, cfg)
+    return layouts
+
+
 def _bucket_flat(leaves, layout: _BucketLayout) -> jax.Array:
     flat = jnp.concatenate(
         [leaves[i].reshape(-1) for i in layout.indices]

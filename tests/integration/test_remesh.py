@@ -1,21 +1,38 @@
-"""In-process re-mesh: the probe findings as a regression test.
+"""Zero-downtime elastic remesh (``elastic/remesh.py``).
 
-Evidence base: ``tools/probe_remesh.py`` → the elastic driver's
-respawn-per-round rationale plus the experimental
-``hvd.elastic.reinit_world`` survivor path."""
+Three layers, matching the subsystem:
 
+* **probe regressions** — the ``reinit_world`` evidence base
+  (``tools/probe_remesh.py``) this is all built on;
+* **layout exchange** — the old→new shard movement is a partition of
+  the valid elements (every byte moves exactly once), checksums are
+  preserved, the KV transport catches corruption, and a fault injected
+  into any pipeline phase degrades to the checkpoint-restore path
+  instead of wedging;
+* **end to end** — an in-process 8→4 device resize whose post-remesh
+  losses match the checkpoint-restart path BITWISE (f32 dense wire),
+  the driver's remesh coordination against scripted workers (shrink,
+  grow, ack-timeout fallback), and the real 4→3→4 process CPU resize
+  (``multiproc`` — skipped where the CPU backend rejects cross-process
+  computations).
+"""
+
+import hashlib
 import json
 import os
 import subprocess
 import sys
 import textwrap
+import threading
+import time
 
+import numpy as np
 import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 
-pytestmark = pytest.mark.integration
+pytestmark = [pytest.mark.integration, pytest.mark.remesh]
 
 _ENV = {
     "JAX_PLATFORMS": "cpu",
@@ -99,3 +116,1078 @@ def test_reinit_world_validates_partial_triple():
 
     with pytest.raises(ValueError, match="num_processes"):
         elastic.reinit_world(coordinator_address="10.0.0.5:1234")
+
+
+# =====================================================================
+# Layout exchange: the shard movement is a checksum-preserving
+# permutation of the valid elements
+# =====================================================================
+
+
+class FakeKV:
+    """In-memory stand-in for the rendezvous KV client."""
+
+    def __init__(self):
+        self.d = {}
+
+    def put(self, scope, key, val):
+        self.d[(scope, key)] = bytes(val)
+
+    def get(self, scope, key, timeout_ms=0):
+        return self.d.get((scope, key))
+
+
+def _exchange(old, new, shards_old):
+    from horovod_tpu.elastic import remesh as rm
+
+    return {
+        r: rm.apply_moves(
+            rm.plan_moves(old, new, r), new.shard_len,
+            np.float32, lambda s: shards_old[s],
+        )
+        for r in range(new.shards)
+    }
+
+
+class TestLayoutExchange:
+    @pytest.mark.parametrize("old_shards,new_shards,n", [
+        (4, 3, 10), (3, 4, 10), (8, 4, 37), (4, 8, 37),
+        (1, 4, 5), (4, 1, 5), (2, 7, 64), (7, 2, 64),
+        (4, 3, 2),  # n < both shard counts: mostly padding
+    ])
+    def test_moves_partition_valid_elements(self, old_shards,
+                                            new_shards, n):
+        """Across all destination ranks the moves cover every valid
+        element exactly once — the exchange is a permutation."""
+        from horovod_tpu.elastic import remesh as rm
+
+        old = rm.ShardLayout(n=n, shards=old_shards,
+                             shard_len=-(-n // old_shards))
+        new = rm.ShardLayout(n=n, shards=new_shards,
+                             shard_len=-(-n // new_shards))
+        seen = np.zeros(n, np.int32)
+        for r in range(new.shards):
+            for m in rm.plan_moves(old, new, r):
+                g0 = m.src_rank * old.shard_len + m.src_off
+                seen[g0:g0 + m.length] += 1
+                # destination offset names the same global interval
+                assert g0 == r * new.shard_len + m.dst_off
+        assert (seen == 1).all(), seen
+
+    def test_roundtrip_preserves_checksum(self):
+        """8 -> 3 -> 8: the full buffer (and its sha256) round-trips
+        exactly, and padding never leaks into valid data."""
+        from horovod_tpu.elastic import remesh as rm
+
+        rng = np.random.RandomState(7)
+        n = 101
+        l8 = rm.ShardLayout(n=n, shards=8, shard_len=-(-n // 8))
+        l3 = rm.ShardLayout(n=n, shards=3, shard_len=-(-n // 3))
+        data = rng.randn(n).astype(np.float32)
+        padded = np.zeros(l8.padded, np.float32)
+        padded[:n] = data
+        shards8 = {
+            r: padded[r * l8.shard_len:(r + 1) * l8.shard_len]
+            for r in range(8)
+        }
+        shards3 = _exchange(l8, l3, shards8)
+        back8 = _exchange(l3, l8, shards3)
+        digest = lambda a: hashlib.sha256(a.tobytes()).hexdigest()
+        assert digest(rm.full_buffer(l3, shards3)) == digest(data)
+        assert digest(rm.full_buffer(l8, back8)) == digest(data)
+        # padding beyond n is zero-filled in every new shard
+        lo, hi = l3.interval(2)
+        assert (shards3[2][hi - 2 * l3.shard_len:] == 0).all() or \
+            hi - 2 * l3.shard_len >= l3.shard_len
+
+    def test_changed_length_raises(self):
+        from horovod_tpu.elastic import remesh as rm
+        from horovod_tpu.exceptions import RemeshError
+
+        a = rm.ShardLayout(n=10, shards=2, shard_len=5)
+        b = rm.ShardLayout(n=12, shards=2, shard_len=6)
+        with pytest.raises(RemeshError, match="valid length"):
+            rm.plan_moves(a, b, 0)
+
+    def test_short_source_shard_raises(self):
+        from horovod_tpu.elastic import remesh as rm
+        from horovod_tpu.exceptions import RemeshError
+
+        lay = rm.ShardLayout(n=8, shards=2, shard_len=4)
+        moves = rm.plan_moves(lay, lay, 1)
+        with pytest.raises(RemeshError, match="too short"):
+            rm.apply_moves(moves, 4, np.float32,
+                           lambda s: np.zeros(2, np.float32))
+
+
+class TestPlanReshard:
+    def _toy_layouts(self, world):
+        import jax.numpy as jnp
+
+        from horovod_tpu import sched
+        from horovod_tpu.sched.zero1 import bucket_layouts
+
+        params = {
+            "a": jnp.zeros((13, 3), jnp.float32),
+            "b": jnp.zeros((7,), jnp.float32),
+            "c": jnp.zeros((4, 4), jnp.float32),
+        }
+        cfg = sched.SchedConfig(enabled=True, bucket_bytes=128,
+                                lowering="flat")
+        return bucket_layouts(params, world, cfg)
+
+    def test_plan_pairs_buckets_across_worlds(self, hvd_init):
+        from horovod_tpu.elastic import remesh as rm
+
+        lays8 = self._toy_layouts(8)
+        lays4 = self._toy_layouts(4)
+        plan = rm.plan_reshard(lays8, lays4)
+        assert len(plan.buckets) == len(lays8)
+        for b in plan.buckets:
+            assert b.old.n == b.new.n
+        # every new rank's sources are computable and within the old world
+        for r in range(4):
+            assert all(0 <= s < 8 for s in plan.src_ranks(r))
+
+    def test_membership_mismatch_raises(self, hvd_init):
+        import dataclasses
+
+        from horovod_tpu.elastic import remesh as rm
+        from horovod_tpu.exceptions import RemeshError
+
+        lays = self._toy_layouts(8)
+        mutated = [dataclasses.replace(lays[0], indices=(99,))] + \
+            list(lays[1:])
+        with pytest.raises(RemeshError, match="membership"):
+            rm.plan_reshard(lays, mutated)
+
+    def test_reshard_bucket_state_moves_sharded_leaves(self, hvd_init):
+        """Adam-like per-bucket states: (shard_len,) leaves move
+        through the plan, scalar leaves are carried verbatim, EF dicts
+        re-zero."""
+        from horovod_tpu.elastic import remesh as rm
+
+        lays8 = self._toy_layouts(8)
+        lays4 = self._toy_layouts(4)
+        plan = rm.plan_reshard(lays8, lays4)
+        b = plan.buckets[0]
+        rng = np.random.RandomState(3)
+        full_m = rng.randn(b.old.padded).astype(np.float32)
+
+        def old_state(rank):
+            lo = rank * b.old.shard_len
+            return {
+                "m": full_m[lo:lo + b.old.shard_len],
+                "count": np.asarray(5, np.int32),
+            }
+
+        outs = {
+            r: rm.reshard_bucket_state(plan, 0, r, old_state)
+            for r in range(b.new.shards)
+        }
+        got = rm.full_buffer(
+            b.new, {r: outs[r]["m"] for r in outs}
+        )
+        np.testing.assert_array_equal(got, full_m[:b.old.n])
+        assert all(int(outs[r]["count"]) == 5 for r in outs)
+        # EF wrapper: residual re-zeros at the new padded length
+        ef_out = rm.reshard_bucket_state(
+            plan, 0, 0,
+            lambda r: {"tx": old_state(r),
+                       "ef": np.ones(b.old.padded, np.float32)},
+        )
+        assert ef_out["ef"].shape == (b.new.padded,)
+        assert (ef_out["ef"] == 0).all()
+
+
+class TestKVShardStore:
+    def test_roundtrip(self):
+        from horovod_tpu.elastic import remesh as rm
+
+        store = rm.KVShardStore(FakeKV(), remesh_id=3)
+        arr = np.arange(100000, dtype=np.float32)
+        store.put(2, "zero.b0.l1", arr)
+        got = store.get(2, "zero.b0.l1")
+        np.testing.assert_array_equal(got, arr)
+        assert got.dtype == arr.dtype
+
+    def test_missing_shard_raises(self):
+        from horovod_tpu.elastic import remesh as rm
+        from horovod_tpu.exceptions import RemeshError
+
+        store = rm.KVShardStore(FakeKV(), remesh_id=3)
+        with pytest.raises(RemeshError, match="missing"):
+            store.get(0, "nope")
+
+    @pytest.mark.faults
+    def test_corrupt_transport_is_caught(self):
+        """An injected corruption of the published blob MUST surface
+        as ShardChecksumError — never as silently wrong numerics."""
+        from horovod_tpu import faults
+        from horovod_tpu.elastic import remesh as rm
+        from horovod_tpu.exceptions import ShardChecksumError
+
+        store = rm.KVShardStore(FakeKV(), remesh_id=1)
+        faults.set_plan("remesh.publish:corrupt:nth=1")
+        try:
+            store.put(0, "zero.b0.l0", np.ones(64, np.float32))
+        finally:
+            faults.set_plan(None)
+        with pytest.raises(ShardChecksumError, match="sha256"):
+            store.get(0, "zero.b0.l0")
+
+    def test_roundtrip_through_real_controller(self):
+        """The store speaks the actual rendezvous KV protocol."""
+        from horovod_tpu.elastic import remesh as rm
+        from horovod_tpu.runner import controller_py
+
+        server = controller_py.make_server("s3cret", 1)
+        client = controller_py.make_client(
+            "127.0.0.1", server.port, "s3cret", rank=0
+        )
+        try:
+            store = rm.KVShardStore(client, remesh_id=9)
+            arr = np.arange(1 << 18, dtype=np.float32)
+            store.put(1, "zero.b2.l0", arr)
+            np.testing.assert_array_equal(
+                store.get(1, "zero.b2.l0"), arr
+            )
+        finally:
+            client.close()
+            server.stop()
+
+
+class TestRemeshRequest:
+    def test_json_roundtrip(self):
+        from horovod_tpu.elastic import remesh as rm
+
+        req = rm.RemeshRequest(
+            remesh_id=4, round_id=2, np_old=4, np_new=3,
+            coordinator_addr="10.0.0.1:999",
+            survivors={0: 0, 2: 1, 3: 2}, deadline_s=30.0,
+        )
+        back = rm.RemeshRequest.from_json(req.to_json())
+        assert back == req
+        assert back.new_rank(2) == 1
+        assert back.new_rank(1) is None
+
+
+# =====================================================================
+# Worker pipeline: graceful degradation + shed path
+# =====================================================================
+
+
+class FakeManager:
+    def __init__(self, rank=0, kv=None):
+        self.rank = rank
+        self._kv = kv or FakeKV()
+        self.acks = []
+        self.world_changes = []
+
+    def kv_client(self):
+        return self._kv
+
+    def remesh_ack(self, remesh_id, phase):
+        self.acks.append((phase, self.rank))
+        self._kv.put("__remesh__", f"{phase}_{remesh_id}_{self.rank}",
+                     b"1")
+
+    def remesh_wait_go(self, remesh_id, timeout_s=60.0):
+        return None  # driver already said go
+
+    def on_world_changed(self, new_rank):
+        self.world_changes.append(new_rank)
+        self.rank = new_rank
+
+
+class _PlainState:
+    """Minimal state double: replicated attrs only."""
+
+    def __init__(self):
+        self.saved = self.restored = 0
+
+    def save(self):
+        self.saved += 1
+
+    def restore(self):
+        self.restored += 1
+
+    def sharded_attrs(self):
+        return {}
+
+
+@pytest.mark.faults
+class TestRunRemeshFallback:
+    def _request(self, survivors, np_new=1):
+        from horovod_tpu.elastic import remesh as rm
+
+        return rm.RemeshRequest(
+            remesh_id=11, round_id=1, np_old=1, np_new=np_new,
+            coordinator_addr="127.0.0.1:1", survivors=survivors,
+            deadline_s=2.0,
+        )
+
+    def test_phase_fault_degrades_to_remesh_error(self):
+        """A fault in ANY pipeline phase surfaces as RemeshError (the
+        elastic loop then exits for a checkpoint-restore round) and is
+        counted as remesh.fallback."""
+        from horovod_tpu import faults, metrics
+        from horovod_tpu.elastic import remesh as rm
+        from horovod_tpu.exceptions import RemeshError
+
+        mgr = FakeManager(rank=0)
+        state = _PlainState()
+        before = metrics.get_counter("remesh.fallback")
+        faults.set_plan("remesh.publish:error:nth=1")
+        try:
+            with pytest.raises(RemeshError):
+                rm.run_remesh(state, mgr, self._request({0: 0}))
+        finally:
+            faults.set_plan(None)
+        assert metrics.get_counter("remesh.fallback") == before + 1
+        assert ("pause", 0) in mgr.acks
+
+    def test_shed_rank_exits_with_shed_code(self):
+        from horovod_tpu import metrics
+        from horovod_tpu.elastic import remesh as rm
+
+        mgr = FakeManager(rank=1)
+        state = _PlainState()
+        before = metrics.get_counter("remesh.shed")
+        with pytest.raises(SystemExit) as exc:
+            rm.run_remesh(state, mgr, self._request({0: 0}, np_new=1))
+        assert exc.value.code == rm.REMESH_SHED_CODE
+        assert metrics.get_counter("remesh.shed") == before + 1
+        assert ("shed", 1) in mgr.acks
+        # state was snapshotted + published before leaving
+        assert state.saved == 1
+
+    def test_abort_key_unblocks_barrier(self):
+        """A worker stuck in the publish barrier sees the driver's
+        abort and falls back instead of wedging."""
+        from horovod_tpu.exceptions import RemeshError
+        from horovod_tpu.runner.elastic_worker import (
+            WorkerNotificationManager,
+        )
+
+        mgr = WorkerNotificationManager()
+        kv = FakeKV()
+        mgr._client = kv
+        kv.put("__remesh__", "abort_7", b"1")
+        with pytest.raises(RemeshError, match="abort"):
+            mgr.remesh_wait_go(7, timeout_s=5.0)
+
+    def test_barrier_timeout_raises(self):
+        from horovod_tpu.exceptions import RemeshError
+        from horovod_tpu.runner.elastic_worker import (
+            WorkerNotificationManager,
+        )
+
+        mgr = WorkerNotificationManager()
+        mgr._client = FakeKV()
+        t0 = time.monotonic()
+        with pytest.raises(RemeshError, match="no go/abort"):
+            mgr.remesh_wait_go(8, timeout_s=1.0)
+        assert time.monotonic() - t0 < 10
+
+
+class TestOptimizerStateAcrossRemesh:
+    def test_survivor_keeps_local_state_joiner_zeroes(self, hvd_init):
+        """DistributedOptimizerState leaves are replicated or
+        param-shaped rank-local: survivors carry them verbatim, a
+        joiner cold-starts acc/residual at zero."""
+        import jax.numpy as jnp
+
+        from horovod_tpu.optim.distributed_optimizer import (
+            DistributedOptimizerState,
+            remesh_optimizer_state,
+        )
+
+        state = DistributedOptimizerState(
+            counter=jnp.asarray(7, jnp.int32),
+            acc={"w": jnp.ones((3,), jnp.float32)},
+            inner=(jnp.zeros((2,)),),
+            residual={"w": jnp.full((3,), 0.5, jnp.float32)},
+        )
+        kept = remesh_optimizer_state(state, joined=False)
+        assert kept is state
+        fresh = remesh_optimizer_state(state, joined=True)
+        assert int(fresh.counter) == 7
+        assert (np.asarray(fresh.acc["w"]) == 0).all()
+        assert (np.asarray(fresh.residual["w"]) == 0).all()
+
+
+# =====================================================================
+# End to end: in-process device resize, losses match the restart path
+# =====================================================================
+
+
+def _quadratic_setup():
+    import jax.numpy as jnp
+
+    X = np.random.RandomState(0).randn(8, 4).astype(np.float32)
+    Y = (X @ np.full((4, 3), 0.3)).astype(np.float32)
+
+    def loss_fn(p, b):
+        x, y = b
+        return jnp.mean((x @ p["w1"] @ p["w2"] + p["b"] - y) ** 2)
+
+    def fresh_params():
+        return {
+            "w1": jnp.full((4, 5), 0.2, jnp.float32),
+            "w2": jnp.full((5, 3), 0.5, jnp.float32),
+            "b": jnp.zeros((3,), jnp.float32),
+        }
+
+    return loss_fn, fresh_params, (jnp.asarray(X), jnp.asarray(Y))
+
+
+def test_in_process_resize_matches_restart_path():
+    """The acceptance invariant on the CPU-testable analog of a
+    kill-and-resize: train bucketed ZeRO-1 on 8 devices, remesh the
+    live state to a 4-device world through the full resharder (host
+    snapshot -> KV publish -> plan -> fetch -> install), and the
+    post-remesh losses are BITWISE equal to restoring the same
+    boundary state through the checkpoint-restart path (f32 dense
+    wire)."""
+    import jax
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    import horovod_tpu as hvd
+    from horovod_tpu import metrics, sched
+    from horovod_tpu import runtime as rt
+    from horovod_tpu.elastic import ArrayState, remesh as rm
+    from horovod_tpu.sched.zero1 import bucket_layouts
+    from horovod_tpu.topo import model as topo_model
+
+    loss_fn, fresh_params, batch = _quadratic_setup()
+    cfg = sched.SchedConfig(enabled=True, bucket_bytes=48,
+                            lowering="flat")
+    tx = optax.adam(0.05)
+    steps = 3
+    try:
+        hvd.init()
+        step = sched.bucketed_zero_step(loss_fn, tx, cfg=cfg)
+        params = fresh_params()
+        states = step.init(params)
+        for _ in range(steps):
+            params, states, _ = step(params, states, batch)
+
+        # -- remesh boundary: run the resharder end to end ------------
+        state = ArrayState(params=params, opt_state=states)
+        spec = rm.ShardedZeroState(state, "params", "opt_state",
+                                   cfg=cfg)
+        req = rm.RemeshRequest(
+            remesh_id=1, round_id=1, np_old=1, np_new=1,
+            coordinator_addr="", survivors={0: 0},
+            dev_old=8, dev_new=4,
+        )
+        success_before = metrics.get_counter("remesh.success")
+        spec.snapshot()
+        store = rm.KVShardStore(FakeKV(), 1)
+        spec.publish(store, "zero", 0)
+        host_states = spec.reshard(req, store, "zero", 0)
+        host_params = jax.device_get(params)
+        snap_states = jax.device_get(states)  # the "checkpoint"
+
+        # -- new 4-device world: remesh path --------------------------
+        rt.shutdown()
+        topo_model.reset()
+        hvd.init(devices=jax.devices()[:4])
+        step4 = sched.bucketed_zero_step(loss_fn, tx, cfg=cfg)
+        p4 = jax.device_put(host_params)
+        step4.init(p4)  # rebuild layouts; fresh values discarded
+        spec.install(host_states)
+        losses_remesh = []
+        st4 = state.opt_state
+        for _ in range(steps):
+            p4, st4, loss = step4(p4, st4, batch)
+            losses_remesh.append(float(loss))
+
+        # -- reference: checkpoint-restore onto the same world --------
+        lays8 = bucket_layouts(fresh_params(), 8, cfg)
+        lays4 = bucket_layouts(fresh_params(), 4, cfg)
+        mesh = rt.get_runtime().mesh
+
+        def restore_bucket(full_like, lay8, lay4):
+            def leaf(x):
+                arr = np.asarray(x)
+                if arr.ndim >= 1 and arr.shape[0] == lay8.padded:
+                    out = np.zeros((lay4.padded,), arr.dtype)
+                    out[:lay8.n] = arr[:lay8.n]
+                    return jax.device_put(
+                        out, NamedSharding(mesh, P("hvd"))
+                    )
+                return jax.device_put(arr, NamedSharding(mesh, P()))
+
+            return jax.tree.map(leaf, full_like)
+
+        states_ref = tuple(
+            restore_bucket(snap_states[bi], lays8[bi], lays4[bi])
+            for bi in range(len(snap_states))
+        )
+        step4b = sched.bucketed_zero_step(loss_fn, tx, cfg=cfg)
+        p4b = jax.device_put(host_params)
+        step4b.init(p4b)
+        losses_restore = []
+        for _ in range(steps):
+            p4b, states_ref, loss = step4b(p4b, states_ref, batch)
+            losses_restore.append(float(loss))
+
+        assert losses_remesh == losses_restore, (
+            losses_remesh, losses_restore,
+        )
+    finally:
+        rt.shutdown()
+        topo_model.reset()
+
+
+def test_in_process_grow_matches_restart_path():
+    """The grow direction (4 -> 8 devices) through the same pipeline:
+    newcomer shards assemble from the published old slabs."""
+    import jax
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu import sched
+    from horovod_tpu import runtime as rt
+    from horovod_tpu.elastic import ArrayState, remesh as rm
+    from horovod_tpu.topo import model as topo_model
+
+    loss_fn, fresh_params, batch = _quadratic_setup()
+    cfg = sched.SchedConfig(enabled=True, bucket_bytes=48,
+                            lowering="flat")
+    tx = optax.adam(0.05)
+    try:
+        hvd.init(devices=None)
+        rt.shutdown()
+        topo_model.reset()
+        hvd.init(devices=jax.devices()[:4])
+        step = sched.bucketed_zero_step(loss_fn, tx, cfg=cfg)
+        params = fresh_params()
+        states = step.init(params)
+        for _ in range(3):
+            params, states, _ = step(params, states, batch)
+
+        state = ArrayState(params=params, opt_state=states)
+        spec = rm.ShardedZeroState(state, "params", "opt_state",
+                                   cfg=cfg)
+        req = rm.RemeshRequest(
+            remesh_id=2, round_id=1, np_old=1, np_new=1,
+            coordinator_addr="", survivors={0: 0},
+            dev_old=4, dev_new=8,
+        )
+        spec.snapshot()
+        store = rm.KVShardStore(FakeKV(), 2)
+        spec.publish(store, "zero", 0)
+        host_states = spec.reshard(req, store, "zero", 0)
+        host_params = jax.device_get(params)
+
+        rt.shutdown()
+        topo_model.reset()
+        hvd.init()  # all 8 devices
+        step8 = sched.bucketed_zero_step(loss_fn, tx, cfg=cfg)
+        p8 = jax.device_put(host_params)
+        step8.init(p8)
+        spec.install(host_states)
+        st8 = state.opt_state
+        l_first = None
+        for _ in range(2):
+            p8, st8, loss = step8(p8, st8, batch)
+            l_first = float(loss) if l_first is None else l_first
+        # losses keep descending from the 4-device trajectory (the
+        # batch is identical, so the first post-grow loss must equal
+        # the loss a never-resized run would see at this point — the
+        # shrink test proves bitwise equality; here we assert sane
+        # continuation)
+        assert l_first < 0.3
+    finally:
+        rt.shutdown()
+        topo_model.reset()
+
+
+# =====================================================================
+# Driver coordination: pause/ack/go/done barriers against scripted
+# workers speaking the real KV protocol (no jax worlds involved, so
+# this runs even where the CPU backend rejects cross-process
+# computations)
+# =====================================================================
+
+
+class ScriptedRemeshWorker:
+    """A worker_factory product that speaks the remesh KV protocol the
+    way ``elastic/run.py`` + ``elastic_worker.py`` do — without a jax
+    world, so the driver's coordination is testable anywhere."""
+
+    def __init__(self, rank, hostname, command, env, ssh_port=None,
+                 ssh_identity_file=None, obey_remesh=True):
+        from horovod_tpu.runner import controller_py
+
+        self.rank = rank
+        self.env = env
+        self.obey_remesh = obey_remesh
+        self._rc = None
+        self._stop = threading.Event()
+        self._client = controller_py.make_client(
+            env["HVD_TPU_RENDEZVOUS_ADDR"],
+            int(env["HVD_TPU_RENDEZVOUS_PORT"]),
+            env["HVD_TPU_SECRET"], rank,
+        )
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    @property
+    def returncode(self):
+        return self._rc
+
+    def terminate(self):
+        self._stop.set()
+
+    def wait(self):
+        self._thread.join(timeout=30)
+        if self._rc is None:
+            self._rc = -15
+        return self._rc
+
+    def _get(self, scope, key):
+        try:
+            return self._client.get(scope, key, timeout_ms=0)
+        except Exception:
+            return None
+
+    def _run(self):
+        from horovod_tpu.elastic.remesh import (
+            REMESH_SHED_CODE,
+            RemeshRequest,
+        )
+
+        round_id = self.env["HVD_TPU_ELASTIC_ROUND"]
+        rank = self.rank
+        join_id = self.env.get("HVD_TPU_REMESH_JOIN")
+        handled = set()
+        try:
+            while not self._stop.is_set():
+                if self._get("__test__", f"finish_round_{round_id}"):
+                    self._rc = 0
+                    return
+                raw = self._get("__remesh__", f"begin_{round_id}")
+                req = None
+                if raw is not None and self.obey_remesh:
+                    req = RemeshRequest.from_json(raw.decode())
+                    if req.remesh_id in handled:
+                        req = None
+                if req is not None and join_id is not None:
+                    # joiner: wait for go, then report done
+                    handled.add(req.remesh_id)
+                    while not self._get("__remesh__",
+                                        f"go_{req.remesh_id}"):
+                        if self._stop.wait(0.05):
+                            return
+                    self._client.put(
+                        "__remesh__", f"done_{req.remesh_id}_{rank}",
+                        b"1",
+                    )
+                elif req is not None:
+                    handled.add(req.remesh_id)
+                    rid = req.remesh_id
+                    self._client.put("__remesh__",
+                                     f"pause_{rid}_{rank}", b"1")
+                    self._client.put("__remesh__",
+                                     f"snapshot_{rid}_{rank}", b"1")
+                    while True:
+                        if self._get("__remesh__", f"abort_{rid}"):
+                            self._rc = 73
+                            return
+                        if self._get("__remesh__", f"go_{rid}"):
+                            break
+                        if self._stop.wait(0.05):
+                            return
+                    new_rank = req.new_rank(rank)
+                    if new_rank is None:
+                        self._client.put(
+                            "__remesh__", f"shed_{rid}_{rank}", b"1"
+                        )
+                        self._rc = REMESH_SHED_CODE
+                        return
+                    self._client.put(
+                        "__remesh__", f"done_{rid}_{new_rank}", b"1"
+                    )
+                    rank = new_rank
+                if self._get("__elastic__",
+                             f"hosts_updated_{round_id}"):
+                    self._rc = 73
+                    return
+                if self._stop.wait(0.1):
+                    return
+        finally:
+            try:
+                self._client.close()
+            except Exception:
+                pass
+
+
+class PhasedDiscovery:
+    """Host set changes after a delay (scripted-discovery fake)."""
+
+    def __init__(self, phases):
+        self._phases = phases
+        self._t0 = time.monotonic()
+
+    def find_available_hosts_and_slots(self):
+        t = time.monotonic() - self._t0
+        acc = 0.0
+        for duration, hosts in self._phases:
+            acc += duration
+            if t < acc:
+                return dict(hosts)
+        return dict(self._phases[-1][1])
+
+
+def _run_driver(driver, factory, spawned):
+    """run_rounds in a thread; returns (thread, result holder)."""
+    result = {}
+
+    def target():
+        try:
+            result["rc"] = driver.run_rounds(
+                ["true"], worker_factory=factory,
+                rendezvous_addr="127.0.0.1",
+            )
+        except Exception as e:  # surface in the test, not a hang
+            result["error"] = e
+            result["rc"] = -1
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    return t, result
+
+
+def _test_client(spawned):
+    """A KV client built from any spawned worker's env."""
+    from horovod_tpu.runner import controller_py
+
+    env = spawned[0].env
+    return controller_py.make_client(
+        env["HVD_TPU_RENDEZVOUS_ADDR"],
+        int(env["HVD_TPU_RENDEZVOUS_PORT"]),
+        env["HVD_TPU_SECRET"], rank=-2,
+    )
+
+
+def _await(cond, timeout_s=30, msg="condition"):
+    deadline = time.monotonic() + timeout_s
+    while not cond():
+        if time.monotonic() > deadline:
+            raise AssertionError(f"timed out waiting for {msg}")
+        time.sleep(0.05)
+
+
+class TestDriverRemeshCoordination:
+    def _driver(self, phases, min_np, max_np, **kw):
+        from horovod_tpu.elastic.discovery import HostManager
+        from horovod_tpu.runner.elastic_driver import ElasticDriver
+
+        disco = PhasedDiscovery(phases)
+        driver = ElasticDriver(
+            HostManager(disco), min_np=min_np, max_np=max_np,
+            remesh=True, **kw,
+        )
+        driver.start_discovery()
+        return driver
+
+    def test_shrink_resizes_in_place_without_restart_round(self):
+        """3 -> 2 slots: the driver pauses survivors, sheds one worker
+        cleanly (exit 75, not blacklisted), and the SAME round
+        continues — no respawn round, no checkpoint restore on the hot
+        path."""
+        from horovod_tpu import metrics
+
+        spawned = []
+
+        def factory(rank, hostname, command, env, **kw):
+            w = ScriptedRemeshWorker(rank, hostname, command, env, **kw)
+            spawned.append(w)
+            return w
+
+        success0 = metrics.get_counter("remesh.driver_success")
+        driver = self._driver(
+            [(3.0, {"localhost": 3}), (1e9, {"localhost": 2})],
+            min_np=2, max_np=3, remesh_timeout_s=20,
+        )
+        thread, result = _run_driver(driver, factory, spawned)
+        _await(lambda: len(spawned) >= 3, msg="3 workers spawned")
+        _await(
+            lambda: metrics.get_counter("remesh.driver_success")
+            > success0,
+            timeout_s=40, msg="remesh success",
+        )
+        client = _test_client(spawned)
+        try:
+            client.put("__test__", "finish_round_1", b"1")
+        finally:
+            client.close()
+        thread.join(timeout=30)
+        driver.stop()
+        assert result.get("rc") == 0, result
+        assert driver.rounds == 1, "resize must NOT start a new round"
+        # exactly one worker shed with the clean code; host not blamed
+        assert sorted(w.returncode for w in spawned) == [0, 0, 75]
+        assert not driver.host_manager.is_blacklisted("localhost")
+
+    def test_grow_spawns_joiner_into_same_round(self):
+        """2 -> 3 slots: a joiner is spawned mid-round with the remesh
+        join env and the round continues at the new size."""
+        from horovod_tpu import metrics
+
+        spawned = []
+
+        def factory(rank, hostname, command, env, **kw):
+            w = ScriptedRemeshWorker(rank, hostname, command, env, **kw)
+            spawned.append(w)
+            return w
+
+        success0 = metrics.get_counter("remesh.driver_success")
+        driver = self._driver(
+            [(3.0, {"localhost": 2}), (1e9, {"localhost": 3})],
+            min_np=2, max_np=3, remesh_timeout_s=20,
+        )
+        thread, result = _run_driver(driver, factory, spawned)
+        _await(
+            lambda: metrics.get_counter("remesh.driver_success")
+            > success0,
+            timeout_s=40, msg="remesh success",
+        )
+        joiners = [w for w in spawned
+                   if "HVD_TPU_REMESH_JOIN" in w.env]
+        assert len(joiners) == 1
+        assert joiners[0].env["HVD_TPU_CROSS_SIZE"] == "3"
+        client = _test_client(spawned)
+        try:
+            client.put("__test__", "finish_round_1", b"1")
+        finally:
+            client.close()
+        thread.join(timeout=30)
+        driver.stop()
+        assert result.get("rc") == 0, result
+        assert driver.rounds == 1
+
+    def test_unresponsive_workers_fall_back_to_restart_round(self):
+        """Workers that never ack the pause: the attempt times out,
+        the driver aborts it and degrades to the classic respawn
+        round — bounded fallback, never a wedged job."""
+        from horovod_tpu import metrics
+
+        spawned = []
+
+        def factory(rank, hostname, command, env, **kw):
+            w = ScriptedRemeshWorker(
+                rank, hostname, command, env,
+                obey_remesh=False, **kw,
+            )
+            spawned.append(w)
+            return w
+
+        fb0 = metrics.get_counter("remesh.driver_fallback")
+        driver = self._driver(
+            [(3.0, {"localhost": 3}), (1e9, {"localhost": 2})],
+            min_np=2, max_np=3, remesh_timeout_s=2,
+        )
+        thread, result = _run_driver(driver, factory, spawned)
+        _await(
+            lambda: metrics.get_counter("remesh.driver_fallback") > fb0,
+            timeout_s=40, msg="remesh fallback",
+        )
+        # fallback publishes the restart signal; workers exit 73 and a
+        # second round starts at the new size
+        _await(lambda: driver.rounds >= 2, timeout_s=40,
+               msg="respawn round")
+        client = _test_client(spawned)
+        try:
+            client.put("__test__", "finish_round_2", b"1")
+        finally:
+            client.close()
+        thread.join(timeout=30)
+        driver.stop()
+        assert result.get("rc") == 0, result
+        assert driver.rounds >= 2
+
+    def test_plan_remesh_world_mappings(self):
+        """Survivor/shed/joiner placement math: host-removed shrink
+        remaps ranks contiguously; grow keeps survivors' ranks."""
+        from horovod_tpu.elastic.discovery import HostManager
+        from horovod_tpu.runner import hosts as hosts_mod
+        from horovod_tpu.runner.elastic_driver import ElasticDriver
+
+        class _D:
+            def find_available_hosts_and_slots(self):
+                return {}
+
+        driver = ElasticDriver(HostManager(_D()), min_np=1, remesh=True)
+
+        def slot(host, rank, size):
+            return hosts_mod.SlotInfo(
+                hostname=host, rank=rank, local_rank=0,
+                cross_rank=0, size=size, local_size=1, cross_size=size,
+            )
+
+        class _W:
+            returncode = None
+
+        # shrink: host b (old rank 1) removed -> survivors remap 0,2->0,1
+        old = [slot("a", 0, 3), slot("b", 1, 3), slot("c", 2, 3)]
+        survivors, shed, joiners, slots = driver._plan_remesh_world(
+            [_W(), _W(), _W()], old, 2, {"a": 1, "c": 1},
+        )
+        assert survivors == {0: 0, 2: 1}
+        assert shed == [1]
+        assert joiners == []
+        assert [s.hostname for s in slots] == ["a", "c"]
+        assert all(s.size == 2 for s in slots)
+
+        # grow: survivors keep ranks, joiner fills the new slot
+        old = [slot("a", 0, 2), slot("a", 1, 2)]
+        survivors, shed, joiners, slots = driver._plan_remesh_world(
+            [_W(), _W()], old, 3, {"a": 3},
+        )
+        assert survivors == {0: 0, 1: 1}
+        assert shed == []
+        assert [j.rank for j in joiners] == [2]
+        assert slots[2].local_size == 3
+
+
+# =====================================================================
+# The real thing: 4 -> 3 -> 4 process CPU resize (needs a CPU backend
+# that supports cross-process computations; skips with the probe's
+# reason elsewhere)
+# =====================================================================
+
+
+RESIZE_WORKER = textwrap.dedent("""
+    import os, sys, time
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    os.environ.pop("PALLAS_AXON_POOL_IPS", None)
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import optax
+    import horovod_tpu as hvd
+    from horovod_tpu import sched
+    from horovod_tpu.elastic import ArrayState, ShardedZeroState
+
+    hvd.init()
+    out = open(os.environ["RESULTS_FILE"]
+               + f".{os.environ['HVD_TPU_CROSS_RANK']}."
+               + f"{os.getpid()}", "a")
+
+    X = jnp.asarray([[1.0, 2.0], [3.0, 4.0], [5.0, 6.0],
+                     [7.0, 8.0]] * 3)[:12]
+    Y = X @ jnp.full((2, 1), 0.5)
+
+    def loss_fn(p, b):
+        x, y = b
+        return jnp.mean((x @ p["w"] - y) ** 2)
+
+    cfg = sched.SchedConfig(enabled=True, bucket_bytes=32,
+                            lowering="flat")
+    params = {"w": jnp.full((2, 1), 0.1, jnp.float32)}
+    state = ArrayState(params=params, opt_state=None, epoch=0)
+    state.register_sharded(
+        "zero", ShardedZeroState(state, "params", "opt_state", cfg=cfg)
+    )
+
+    tx = optax.adam(0.05)
+    meta = {}
+
+    def build_step():
+        # rebuild the compiled step for the (possibly new) mesh; the
+        # discarded init() builds the bucket layouts without touching
+        # the installed opt_state
+        meta["step"] = sched.bucketed_zero_step(loss_fn, tx, cfg=cfg)
+        meta["step"].init(state.params)
+
+    build_step()
+    state.register_reset_callbacks([build_step])
+    # Sharded state must exist BEFORE run(): a joiner's remesh fetch
+    # happens at wrapper start and needs the fresh-init treedefs.
+    state.opt_state = meta["step"].init(state.params)
+
+    @hvd.elastic.run
+    def train(state):
+        step = meta["step"]
+        n = hvd.size()
+        if state.opt_state is None:
+            state.opt_state = step.init(state.params)
+        while state.epoch < 8:
+            state.params, state.opt_state, loss = step(
+                state.params, state.opt_state, (X[:n], Y[:n])
+            )
+            state.epoch += 1
+            out.write(f"epoch={state.epoch} size={hvd.size()} "
+                      f"loss={float(loss):.8f}\\n")
+            out.flush()
+            time.sleep(0.4)
+            state.commit()
+        return state.epoch
+
+    final = train(state)
+    out.write(f"done epoch={final} size={hvd.size()}\\n")
+    out.close()
+""")
+
+
+@pytest.mark.multiproc
+@pytest.mark.faults
+def test_process_resize_4_3_4(tmp_path):
+    """Kill-and-resize end to end with real worker processes: a
+    seed-reproducible fault plan shrinks the world 4 -> 3 and grows it
+    back 3 -> 4; training resumes in place each time (driver stays in
+    round 1) and the elastic event log records every remesh phase."""
+    from horovod_tpu import events, faults
+    from horovod_tpu.elastic.discovery import FixedHosts, HostManager
+    from horovod_tpu.runner.elastic_driver import ElasticDriver
+
+    script = tmp_path / "worker.py"
+    script.write_text(RESIZE_WORKER)
+    results_file = str(tmp_path / "results")
+    event_log = str(tmp_path / "events.jsonl")
+
+    faults.set_plan(
+        "discovery.resize:resize_to:np=3,nth=8;"
+        "discovery.resize:resize_to:np=4,nth=20,times=0"
+    )
+    events.set_event_log(events.EventLog(event_log))
+    try:
+        driver = ElasticDriver(
+            HostManager(FixedHosts({"localhost": 4})),
+            min_np=3, max_np=4, remesh=True, remesh_timeout_s=60,
+        )
+        driver.start_discovery()
+        rc = driver.run_rounds(
+            [sys.executable, str(script)],
+            extra_env={
+                "RESULTS_FILE": results_file,
+                "PYTHONPATH": REPO + os.pathsep
+                + os.environ.get("PYTHONPATH", ""),
+            },
+        )
+        assert rc == 0
+    finally:
+        faults.set_plan(None)
+        events.set_event_log(None)
+
+    logged = events.read_events(event_log)
+    names = [e["event"] for e in logged]
+    assert events.REMESH_START in names
+    assert events.REMESH_PHASE in names
+    lines = []
+    for fn in os.listdir(tmp_path):
+        if fn.startswith("results."):
+            lines += (tmp_path / fn).read_text().splitlines()
+    assert any(l.startswith("done epoch=8") for l in lines)
+    sizes = {
+        int(l.split("size=")[1].split()[0])
+        for l in lines if l.startswith("epoch=")
+    }
+    assert 3 in sizes or events.REMESH_OK in names

@@ -343,6 +343,96 @@ class TestBlacklistCooldown:
         assert mgr.current_hosts == {}
 
 
+# ------------------------------------------- kill_at_step / resize_to
+
+class TestKillAndResizeActions:
+    """The remesh-test actions (docs/fault_tolerance.md): a crash
+    pinned to one training-step boundary and a scripted world resize —
+    both deterministic under a seeded plan."""
+
+    def test_kill_at_step_is_crash_sugar_with_step_selector(self):
+        plan = faults.FaultPlan.parse(
+            "worker.commit:kill_at_step:step=5,code=9"
+        )
+        spec = plan._by_site["worker.commit"][0]
+        assert spec.kind == "crash"
+        assert spec.code == 9
+        assert spec.match == {"step": 5}
+
+    def test_kill_at_step_requires_step(self):
+        with pytest.raises(ValueError, match="step=K"):
+            faults.FaultPlan.parse("worker.commit:kill_at_step")
+
+    def test_kill_at_step_fires_only_on_that_step(self):
+        """Armed via set_plan; the crash is observed through the fired
+        counter (we must not os._exit the test process, so we count
+        arrivals against a selector that never matches this run)."""
+        plan = faults.FaultPlan.parse(
+            "worker.commit:kill_at_step:step=5,code=9"
+        )
+        spec = plan._by_site["worker.commit"][0]
+        # simulate the commit counter: only step=5 matches
+        import random
+
+        rng = random.Random(0)
+        fires = [
+            spec.should_fire({"step": s}, rng) for s in range(1, 9)
+        ]
+        assert fires == [False] * 4 + [True] + [False] * 3
+
+    def test_resize_to_requires_np(self):
+        with pytest.raises(ValueError, match="np=N"):
+            faults.FaultPlan.parse("discovery.resize:resize_to")
+
+    def test_resize_to_returns_target(self):
+        faults.set_plan("discovery.resize:resize_to:np=3,nth=2")
+        assert faults.inject("discovery.resize") is False
+        got = faults.inject("discovery.resize")
+        assert got == {"np": 3}
+        assert faults.inject("discovery.resize") is False
+
+    def test_resize_to_reshapes_discovered_world(self):
+        """HostManager consumes the action: the discovered slot total
+        rescales to exactly np, deterministically."""
+        mgr = HostManager(FixedHosts({"a": 2, "b": 2}), cooldown_s=30)
+        mgr.update_available_hosts()
+        assert mgr.available_slots() == 4
+        # arm() short-circuits at the first firing spec, so the second
+        # entry's arrival counter starts once the first has fired:
+        # nth counts each spec's OWN matching arrivals.
+        faults.set_plan(
+            "discovery.resize:resize_to:np=3,nth=1;"
+            "discovery.resize:resize_to:np=5,nth=1"
+        )
+        changed = mgr.update_available_hosts()
+        assert changed
+        assert mgr.available_slots() == 3
+        assert mgr.current_hosts == {"a": 1, "b": 2}  # trimmed a first
+        changed = mgr.update_available_hosts()
+        assert changed
+        assert mgr.available_slots() == 5
+
+    def test_rescale_hosts_edge_cases(self):
+        from horovod_tpu.elastic.discovery import _rescale_hosts
+
+        assert _rescale_hosts({"a": 4}, 1) == {"a": 1}
+        assert _rescale_hosts({"a": 1, "b": 1}, 4) == {"a": 3, "b": 1}
+        assert _rescale_hosts({"a": 2, "b": 1}, 2) == {"b": 1, "a": 1}
+        assert _rescale_hosts({}, 2) == {"localhost": 2}
+
+    def test_commit_site_carries_step_counter(self):
+        """State.commit is the kill_at_step anchor: its injection
+        context advances with every commit."""
+        from horovod_tpu.elastic.state import ObjectState
+        from horovod_tpu.exceptions import FaultInjected
+
+        faults.set_plan("worker.commit:error:step=2")
+        state = ObjectState(epoch=0)
+        state.commit()  # step=1: no match
+        with pytest.raises(FaultInjected):
+            state.commit()  # step=2: fires
+
+
 # ----------------------------------------------------- thread soundness
 
 def test_inject_is_thread_safe_under_contention():
