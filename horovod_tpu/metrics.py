@@ -79,6 +79,9 @@ class _Histogram:
         self.sum += value
         self.count += 1
 
+    def quantile(self, q: float) -> Optional[float]:
+        return hist_quantile(self.to_dict(), q)
+
     def to_dict(self) -> Dict[str, Any]:
         return {
             "buckets": list(self.bounds),
@@ -86,6 +89,32 @@ class _Histogram:
             "sum": self.sum,
             "count": self.count,
         }
+
+
+def hist_quantile(hist: Dict[str, Any], q: float) -> Optional[float]:
+    """Quantile estimate from a fixed-bucket histogram dict (the
+    ``to_dict`` / snapshot shape) by linear interpolation inside the
+    bucket the target rank lands in — the standard Prometheus
+    ``histogram_quantile`` estimator.  Observations beyond the last
+    finite bound clamp to it (no interpolation toward +inf).  ``None``
+    on an empty histogram."""
+    if not 0.0 <= q <= 1.0:
+        raise ValueError(f"quantile must be in [0, 1], got {q}")
+    bounds = hist.get("buckets") or []
+    counts = hist.get("counts") or []
+    total = hist.get("count", 0)
+    if total <= 0 or not bounds:
+        return None
+    target = q * total
+    cumulative = 0
+    lo = 0.0
+    for bound, n in zip(bounds, counts):
+        if n > 0 and cumulative + n >= target:
+            frac = (target - cumulative) / n
+            return lo + (float(bound) - lo) * frac
+        cumulative += n
+        lo = float(bound)
+    return float(bounds[-1])
 
 
 def inc_counter(name: str, value: int = 1) -> int:
@@ -169,6 +198,19 @@ def get_histogram(name: str) -> Optional[Dict[str, Any]]:
         return hist.to_dict() if hist else None
 
 
+def quantile(name: str, q: float) -> Optional[float]:
+    """Interpolated quantile of the named histogram (p50: ``q=0.5``,
+    p99: ``q=0.99``); None when the histogram is absent or empty.  The
+    extraction the topology fitter reads measured per-cell latencies
+    through (``topo/fit.py``)."""
+    with _counter_lock:
+        hist = _histograms.get(name)
+        if hist is None:
+            return None
+        snap = hist.to_dict()
+    return hist_quantile(snap, q)
+
+
 def snapshot() -> Dict[str, Any]:
     """JSON-serializable snapshot of the whole registry — the payload
     elastic workers push to the driver through the KV store."""
@@ -241,6 +283,16 @@ def render_prometheus(snap: Optional[Dict[str, Any]] = None,
             f"{fam}_bucket{_prom_labels({**base, 'le': '+Inf'})} "
             f"{h['count']}"
         )
+        # Pre-computed quantile estimates (summary-style lines): what a
+        # dashboard without PromQL — or the topology fitter reading a
+        # scrape — needs from the fixed-bucket ladder.
+        for q in (0.5, 0.99):
+            est = hist_quantile(h, q)
+            if est is not None:
+                lines.append(
+                    f"{fam}{_prom_labels({**base, 'quantile': str(q)})} "
+                    f"{est}"
+                )
         lines.append(f"{fam}_sum{_prom_labels(base)} {h['sum']}")
         lines.append(f"{fam}_count{_prom_labels(base)} {h['count']}")
     return "\n".join(lines) + "\n"

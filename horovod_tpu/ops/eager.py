@@ -91,20 +91,38 @@ def _record(name: Optional[str], op: str, nbytes: int):
                     buckets=metrics.BYTES_BUCKETS)
 
 
-def _timed(op: str, dispatch, *args):
+# Eager ops whose dispatch times feed the measured cost model
+# (topo/fit.py) and their ring-model collective class.
+_FIT_OPS = {
+    "ALLREDUCE": "all_reduce",
+    "GROUPED_ALLREDUCE": "all_reduce",
+    "ALLGATHER": "all_gather",
+    "REDUCESCATTER": "reduce_scatter",
+}
+
+
+def _timed(op: str, dispatch, *args, nbytes: int = 0):
     """Run one compiled dispatch, feeding the per-collective latency
     histogram (host-side enqueue cost: trace/compile on a cache miss,
-    async dispatch on a hit — the number the /metrics scrape exposes)."""
+    async dispatch on a hit — the number the /metrics scrape exposes).
+    Ring-priced ops also land in a tagged ``topo.obs.*`` cell so the
+    measured cost model (topo/fit.py) can fit link parameters."""
     import time as _time
 
     from .. import metrics
 
     t0 = _time.perf_counter()
     out = dispatch(*args)
-    metrics.observe(
-        f"collective.{op.lower()}.dispatch_seconds",
-        _time.perf_counter() - t0,
-    )
+    dt = _time.perf_counter() - t0
+    metrics.observe(f"collective.{op.lower()}.dispatch_seconds", dt)
+    collective = _FIT_OPS.get(op)
+    if collective is not None and nbytes > 0:
+        from ..topo import fit as topo_fit
+
+        topo_fit.record_observation(
+            collective, "flat", nbytes,
+            axis_size=get_runtime().size, seconds=dt,
+        )
     return out
 
 
@@ -398,8 +416,10 @@ def allreduce(
         ("postscale_factor", float(postscale_factor)),
         ("process_set_id", _ps_id(process_set)),
     )
-    return _delocalize(_timed("ALLREDUCE", _jitted("allreduce", static), x),
-                       was_local)
+    return _delocalize(
+        _timed("ALLREDUCE", _jitted("allreduce", static), x,
+               nbytes=x.nbytes if process_set is None else 0),
+        was_local)
 
 
 def allreduce_async(*args, name: Optional[str] = None, **kwargs) -> Handle:
@@ -443,7 +463,9 @@ def grouped_allreduce(
         ("n_tensors", len(xs)),
     )
     outs = _timed("GROUPED_ALLREDUCE", _jitted("grouped_allreduce", static),
-                  *xs)
+                  *xs,
+                  nbytes=(sum(x.nbytes for x in xs)
+                          if process_set is None else 0))
     return [_delocalize(o, p[1]) for o, p in zip(outs, pairs)]
 
 
@@ -466,8 +488,10 @@ def allgather(
     static = (
         ("process_set_id", _ps_id(process_set)),
     )
-    return _delocalize(_timed("ALLGATHER", _jitted("allgather", static), x),
-                       was_local)
+    return _delocalize(
+        _timed("ALLGATHER", _jitted("allgather", static), x,
+               nbytes=x.nbytes if process_set is None else 0),
+        was_local)
 
 
 def allgather_async(x, name: Optional[str] = None, **kwargs) -> Handle:
@@ -600,7 +624,8 @@ def reducescatter(
         ("process_set_id", _ps_id(process_set)),
     )
     return _delocalize(
-        _timed("REDUCESCATTER", _jitted("reducescatter", static), x),
+        _timed("REDUCESCATTER", _jitted("reducescatter", static), x,
+               nbytes=x.nbytes if process_set is None else 0),
         was_local)
 
 

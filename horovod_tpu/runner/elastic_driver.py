@@ -166,9 +166,25 @@ class ElasticDriver:
         self._membership_changed = threading.Event()
         self._discovery_thread: Optional[threading.Thread] = None
         self._telemetry = None
+        # Persistent schedule store (sched/store.py): backed by
+        # HVD_TPU_TUNE_DB when set, in-memory otherwise, so the
+        # /schedules endpoint + KV fan-out work either way.  Created
+        # here (not per round) — entries outlive rounds by design.
+        self._schedule_store = None
         # round state read by the /health endpoint
         self._last_assignments: List[hosts_mod.SlotInfo] = []
         self._round_active = False
+
+    def schedule_store(self):
+        """The driver-side schedule store (lazy: first use reads
+        ``HVD_TPU_TUNE_DB``)."""
+        if self._schedule_store is None:
+            from ..sched.store import ScheduleStore
+
+            self._schedule_store = (
+                ScheduleStore.from_env() or ScheduleStore(None)
+            )
+        return self._schedule_store
 
     # -- discovery loop (reference driver.py:181) ------------------------
     def start_discovery(self) -> None:
@@ -309,6 +325,7 @@ class ElasticDriver:
                 control.put("__elastic__", "round", str(round_id).encode())
                 control.put("__elastic__", f"round_{round_id}_np",
                             str(len(assignments)).encode())
+                self._publish_schedules(control)
                 get_logger().warning(
                     "elastic round %d: %d worker(s) on %d host(s)",
                     round_id, len(assignments), assignments[-1].cross_size,
@@ -398,6 +415,7 @@ class ElasticDriver:
                     return 1
                 rc = self._watch_round(workers, assignments, control, round_id)
                 self._round_active = False
+                self._collect_schedules(control)
                 events.emit(
                     events.ROUND_END, round=round_id, exit_code=rc,
                     restart=(rc == RESTART_CODE),
@@ -480,8 +498,54 @@ class ElasticDriver:
 
         return TelemetryServer(
             port=self.telemetry_port, health_fn=health_fn,
-            workers_fn=workers_fn,
+            workers_fn=workers_fn, schedule_store=self.schedule_store(),
         )
+
+    def _publish_schedules(self, control) -> None:
+        """Seed the round's workers with the schedule DB: the store's
+        entries ride the rendezvous KV (``__schedules__/db``) so a
+        worker can warm-start its ``ScheduleTuner`` before its first
+        window (``elastic_worker.py`` fetches at startup).  Fleet
+        serving's in-job half — the HTTP ``/schedules`` endpoint covers
+        cross-job."""
+        import json as _json
+
+        try:
+            entries = self.schedule_store().entries()
+            control.put(
+                "__schedules__", "db",
+                _json.dumps({"entries": entries}).encode(),
+            )
+        except Exception as e:  # advisory channel: never fail a round
+            get_logger().warning("schedule publish failed: %s", e)
+
+    def _collect_schedules(self, control) -> None:
+        """Fold worker-pushed schedule entries (``__schedules__/
+        rank_<r>``, pushed by the heartbeat thread when the worker's
+        local DB changes) into the driver store — one tuned worker
+        seeds every later identical job."""
+        import json as _json
+
+        merged = 0
+        for slot in list(self._last_assignments):
+            try:
+                raw = control.get(
+                    "__schedules__", f"rank_{slot.rank}", timeout_ms=0
+                )
+            except Exception:
+                raw = None
+            if not raw:
+                continue
+            try:
+                merged += self.schedule_store().merge(
+                    _json.loads(raw).get("entries", {})
+                )
+            except Exception as e:
+                get_logger().warning(
+                    "bad schedule push from rank %s: %s", slot.rank, e
+                )
+        if merged:
+            metrics.inc_counter("sched.tune.db_collected", merged)
 
     def _watch_round(
         self,

@@ -73,6 +73,10 @@ class WorkerNotificationManager:
         self._client = RetryPolicy(
             max_attempts=3, base_delay_s=0.2, name="worker.connect"
         ).call(connect)
+        # Schedule-DB seeding: merge the driver-published entries into
+        # the local store BEFORE training starts, so a ScheduleTuner
+        # built later in this process warm-starts from fleet state.
+        self._fetch_schedules()
         self._thread = threading.Thread(target=self._poll, daemon=True)
         self._thread.start()
         # Heartbeat: the driver's health monitor distinguishes a hung
@@ -81,6 +85,57 @@ class WorkerNotificationManager:
         self._hb_thread = threading.Thread(target=self._heartbeat,
                                            daemon=True)
         self._hb_thread.start()
+
+    def _fetch_schedules(self) -> None:
+        """Pull the driver-published schedule DB (``__schedules__/db``)
+        into the local ``HVD_TPU_TUNE_DB`` store.  No-op without a
+        configured store; any failure is advisory (a worker must start
+        without fleet state)."""
+        import json
+
+        from .. import metrics
+        from ..sched.store import ScheduleStore
+
+        store = ScheduleStore.from_env()
+        if store is None or self._client is None:
+            return
+        try:
+            raw = self._client.get("__schedules__", "db", timeout_ms=1000)
+            if not raw:
+                return
+            merged = store.merge(json.loads(raw).get("entries", {}))
+            if merged:
+                metrics.inc_counter("sched.tune.kv_seeded", merged)
+        except Exception:
+            pass
+
+    def _push_schedules(self, client) -> None:
+        """Push the local schedule DB to the driver when it changed
+        (piggybacked on the heartbeat like the metrics snapshot, but
+        gated on file mtime — convergence is rare, heartbeats are
+        not)."""
+        import json
+
+        from ..utils import env as hvd_env
+
+        path = hvd_env.get_env(hvd_env.TUNE_DB)
+        if not path:
+            return
+        try:
+            mtime = os.path.getmtime(path)
+        except OSError:
+            return
+        if mtime == getattr(self, "_sched_db_mtime", None):
+            return
+        self._sched_db_mtime = mtime
+        with open(path) as fh:
+            data = json.load(fh)
+        client.put(
+            "__schedules__", f"rank_{self.rank}",
+            json.dumps(
+                {"entries": data.get("entries", {})}
+            ).encode(),
+        )
 
     def _heartbeat(self) -> None:
         from .. import metrics
@@ -105,6 +160,7 @@ class WorkerNotificationManager:
                     "__metrics__", f"rank_{self.rank}",
                     metrics.render_json().encode(),
                 )
+                self._push_schedules(client)
             except Exception:
                 pass  # KV blips must never kill the worker
             # a 'hang' fault here freezes the heartbeat AFTER it

@@ -13,6 +13,12 @@ gives the driver process a scrapeable surface:
 * ``GET /health`` — JSON from ``health_fn`` (round number, live
   workers, blacklist, available slots), HTTP 200/503 by its
   ``"status"`` field.
+* ``GET/POST /schedules`` — the persistent autotuning database
+  (``sched/store.py``): GET returns every stored (bucket_bytes, wire,
+  lowering) winner (``?key=<hex>`` filters to one), POST merges a
+  ``{"entries": {...}}`` payload keep-best — how a tuned worker
+  anywhere in the fleet seeds every later identical job
+  (docs/autotune.md).
 
 Built on ``http.server.ThreadingHTTPServer`` — stdlib only, daemon
 threads, zero hot-path cost (everything is rendered at scrape time).
@@ -47,20 +53,68 @@ class _Handler(BaseHTTPRequestHandler):
     def do_GET(self):  # noqa: N802 (http.server API)
         srv: "TelemetryServer" = self.server.telemetry  # type: ignore[attr-defined]
         try:
-            if self.path.split("?")[0] == "/metrics":
+            route = self.path.split("?")[0]
+            if route == "/metrics":
                 self._send(200, srv.render_metrics().encode(),
                            PROMETHEUS_CONTENT_TYPE)
-            elif self.path.split("?")[0] == "/health":
+            elif route == "/health":
                 payload = srv.render_health()
                 code = 200 if payload.get("status", "ok") == "ok" else 503
                 self._send(code, json.dumps(payload).encode(),
                            "application/json")
+            elif route == "/schedules":
+                payload = srv.render_schedules(self._query_key())
+                code = 200 if payload is not None else 404
+                self._send(code, json.dumps(
+                    payload if payload is not None
+                    else {"error": "no schedule store"}
+                ).encode(), "application/json")
             else:
-                self._send(404, b"not found: try /metrics or /health\n",
-                           "text/plain")
+                self._send(
+                    404,
+                    b"not found: try /metrics, /health or /schedules\n",
+                    "text/plain")
         except Exception as e:  # a scrape must never kill the server
             self._send(500, f"telemetry error: {e}\n".encode(),
                        "text/plain")
+
+    def do_POST(self):  # noqa: N802 (http.server API)
+        srv: "TelemetryServer" = self.server.telemetry  # type: ignore[attr-defined]
+        try:
+            if self.path.split("?")[0] != "/schedules":
+                self._send(404, b"not found: POST /schedules\n",
+                           "text/plain")
+                return
+            if srv.schedule_store is None:
+                self._send(404, json.dumps(
+                    {"error": "no schedule store"}).encode(),
+                    "application/json")
+                return
+            length = int(self.headers.get("Content-Length") or 0)
+            if not 0 < length <= 16 << 20:  # bound a hostile payload
+                self._send(400, b"bad Content-Length\n", "text/plain")
+                return
+            try:
+                body = json.loads(self.rfile.read(length))
+                entries = body.get("entries", body)
+                if not isinstance(entries, dict):
+                    raise ValueError("entries must be an object")
+            except (ValueError, UnicodeDecodeError) as e:
+                self._send(400, f"bad schedules payload: {e}\n".encode(),
+                           "text/plain")
+                return
+            merged = srv.schedule_store.merge(entries)
+            self._send(200, json.dumps({"merged": merged}).encode(),
+                       "application/json")
+        except Exception as e:  # a push must never kill the server
+            self._send(500, f"telemetry error: {e}\n".encode(),
+                       "text/plain")
+
+    def _query_key(self):
+        from urllib.parse import parse_qs, urlsplit
+
+        qs = parse_qs(urlsplit(self.path).query)
+        return (qs.get("key") or [None])[0]
 
 
 class _QuietHTTPServer(ThreadingHTTPServer):
@@ -89,9 +143,11 @@ class TelemetryServer:
         workers_fn: Optional[
             Callable[[], List[Tuple[int, Dict[str, Any]]]]
         ] = None,
+        schedule_store=None,
     ):
         self.health_fn = health_fn
         self.workers_fn = workers_fn
+        self.schedule_store = schedule_store
         self._server = _QuietHTTPServer((bind_host, port), _Handler)
         self._server.telemetry = self  # type: ignore[attr-defined]
         self.port = self._server.server_address[1]
@@ -123,6 +179,20 @@ class TelemetryServer:
         if self.health_fn is None:
             return {"status": "ok"}
         return self.health_fn()
+
+    def render_schedules(
+        self, key: Optional[str] = None
+    ) -> Optional[Dict[str, Any]]:
+        """``GET /schedules`` payload: the whole store, or one entry
+        (stale-validated via ``lookup``) when ``?key=`` is given.
+        None when the server has no store (-> 404)."""
+        store = self.schedule_store
+        if store is None:
+            return None
+        if key:
+            entry = store.lookup(key)
+            return {"entries": ({key: entry} if entry else {})}
+        return {"entries": store.entries()}
 
     def stop(self) -> None:
         self._server.shutdown()

@@ -19,6 +19,12 @@ its trace-time replacement, three stages over one gradient pytree:
 * ``tune``    — wire ``utils/autotune.FusionAutotuner`` to the
                 bucket-size knob, scoring windows from the metrics
                 registry.
+* ``store``   — persist converged (bucket_bytes, wire, lowering)
+                winners to ``HVD_TPU_TUNE_DB``, keyed by (schedule
+                signature, topology, jax version, knob fingerprint);
+                the tuner warm-starts from a hit with zero exploration
+                windows and the elastic driver serves entries
+                fleet-wide (``/schedules``).  See docs/autotune.md.
 
 ``DistributedOptimizer`` uses this pipeline by default; set
 ``HVD_TPU_SCHED=off`` for the legacy single-fused-exchange path.  See
@@ -44,5 +50,6 @@ from .plan import (  # noqa: F401
     set_config_override,
     wire_bytes,
 )
+from .store import ScheduleStore, knob_fingerprint, make_key  # noqa: F401
 from .tune import ScheduleTuner  # noqa: F401
 from .zero1 import bucketed_zero_step  # noqa: F401

@@ -93,6 +93,15 @@ class ScheduleTuner:
     and a quantized wire that slows convergence shows up as fewer
     steps (the EF residual keeps trajectories close; see
     docs/quantization.md).
+
+    ``store``/``store_key`` engage the persistent autotuning DB
+    (``sched/store.py``, docs/autotune.md): a hit freezes every knob
+    before window 0 (``sched.tune.db_hit``), a miss explores as usual
+    and writes the winner back on convergence
+    (``sched.tune.db_store``)::
+
+        tuner = ScheduleTuner(store="env",
+                              store_key=schedule.signature())
     """
 
     def __init__(self, explore_wire: bool = False,
@@ -100,6 +109,8 @@ class ScheduleTuner:
                  wire_min_bucket_bytes: int = 1 << 16,
                  explore_lowering: bool = False,
                  lowering_candidates=("flat", "hier"),
+                 store="env",
+                 store_key=None,
                  **tuner_kwargs):
         self.tuner = FusionAutotuner(**tuner_kwargs)
         self._baseline: Optional[Dict[str, float]] = None
@@ -123,6 +134,79 @@ class ScheduleTuner:
             self._lowering_frozen = None
         else:
             self._lowering_frozen = "flat"
+        # Persistent warm start (sched/store.py): ``store_key`` is any
+        # deterministic schedule identity — canonically
+        # ``BucketSchedule.signature()`` — hashed together with the
+        # topology, jax version, and knob fingerprint.  The default
+        # ``store="env"`` resolves HVD_TPU_TUNE_DB, so persistence
+        # engages for ANY tuner given a key (and stays off when the
+        # env is unset — bit-identical to no store at all).
+        if store == "env":
+            if store_key is None:
+                store = None  # keyless tuner: nothing to look up
+            else:
+                from .store import ScheduleStore
+
+                store = ScheduleStore.from_env()
+        self._store = store
+        self._store_key: Optional[str] = None
+        self._db_written = False
+        self._best_score = 0.0
+        if store is not None and store_key is not None:
+            from .store import make_key
+
+            self._store_key = (
+                store_key if isinstance(store_key, str)
+                and len(store_key) == 64
+                else make_key(store_key)
+            )
+            entry = store.lookup(self._store_key)
+            if entry is not None:
+                self._warm_start(entry)
+            else:
+                metrics.inc_counter("sched.tune.db_miss")
+
+    def _warm_start(self, entry: Dict) -> None:
+        """Adopt a stored winner: every knob freezes before the first
+        window, so ``converged`` is True at window 0 and the job pays
+        zero exploration windows."""
+        from ..utils.logging import get_logger
+
+        self.tuner.freeze(int(entry["bucket_bytes"]))
+        wire = str(entry.get("wire", "off"))
+        self._wire_frozen = (
+            wire if wire in self._wire_candidates + ("off",) else "off"
+        )
+        lowering = str(entry.get("lowering", "auto"))
+        self._lowering_frozen = (
+            lowering if lowering in self._lowering_candidates + ("auto",)
+            else "auto"
+        )
+        self._best_score = float(entry.get("score", 0.0))
+        self._db_written = True  # a re-write would only echo the entry
+        metrics.inc_counter("sched.tune.db_hit")
+        metrics.set_gauge("sched.tune.warm_start", 1.0)
+        get_logger().info(
+            "schedule tuner warm start: bucket_bytes=%d wire=%s "
+            "lowering=%s (stored score %.3g, %d prior hits)",
+            int(entry["bucket_bytes"]), self._wire_frozen,
+            self._lowering_frozen, self._best_score,
+            int(entry.get("hits", 0)),
+        )
+
+    def _maybe_store(self) -> None:
+        """Write the converged winner back once (miss path only)."""
+        if (self._db_written or self._store is None
+                or self._store_key is None or not self.converged):
+            return
+        self._db_written = True
+        self._store.record(
+            self._store_key,
+            bucket_bytes=self.bucket_bytes(),
+            wire=self.wire(),
+            lowering=self.lowering(),
+            score=self._best_score,
+        )
 
     @staticmethod
     def _topo_multi_slice() -> bool:
@@ -179,6 +263,7 @@ class ScheduleTuner:
             return score
         metrics.inc_counter("sched.tune_windows")
         metrics.set_gauge("sched.tune_score", score)
+        self._best_score = max(self._best_score, score)
         if self._lowering_frozen is None:
             lo = self.lowering()
             self._lowering_scores[lo] = max(
@@ -212,6 +297,7 @@ class ScheduleTuner:
                 )
         else:
             self.tuner.observe(score)
+        self._maybe_store()
         return score
 
     def apply(self, schedule):

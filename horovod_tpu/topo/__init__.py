@@ -16,6 +16,13 @@ first-class model of that fact:
                      bandwidth/latency cost model
                      (:meth:`~horovod_tpu.topo.model.Topology.estimate_cost`)
                      that prices flat vs hierarchical lowerings.
+* ``fit``          — the measured cost model: tagged per-collective
+                     latency cells (``topo.obs.*``) fitted by least
+                     squares into effective bandwidth/latency/overhead
+                     parameters that ``estimate_cost`` prefers over the
+                     static env defaults (``HVD_TPU_TOPO_FIT=off``
+                     restores static pricing; fitted values surface as
+                     ``topo.fitted_*`` gauges).
 * ``hierarchical`` — phase-primitive collectives over a factored axis:
                      :func:`hierarchical_all_reduce` (intra-slice
                      reduce_scatter over ICI → cross-slice all_reduce
@@ -35,7 +42,8 @@ topology degenerates to the existing flat path bitwise-identically.
 See docs/topology.md.
 """
 
-from . import hierarchical, model  # noqa: F401
+from . import fit, hierarchical, model  # noqa: F401
+from .fit import record_observation  # noqa: F401
 from .hierarchical import (  # noqa: F401
     dcn_all_reduce,
     hierarchical_all_gather,

@@ -1,0 +1,344 @@
+"""Measured cost model: fit effective link parameters from telemetry.
+
+The static cost model (``model.Topology``) prices ICI/DCN from env
+defaults no real pod matches — the reference has the same flaw in
+reverse (``ParameterManager`` re-learns every knob from scratch each
+run because it never trusts a model).  This module closes the loop:
+
+1. **Tagged observations.**  Every timed collective dispatch lands in a
+   registry histogram *cell* named
+   ``topo.obs.<collective>.<lowering>.n<axis>.b<log2(nbytes)>`` with a
+   parallel ``.bytes`` counter, so each cell knows its measured latency
+   distribution AND its mean payload.  The eager layer feeds flat
+   cells automatically (``ops/eager.py``); hierarchical cells come from
+   the topo bench and tests via :func:`record_observation`.
+
+2. **Least-squares fit.**  The ring model is *linear* in
+   ``(phase_overhead, ici_lat, dcn_lat, 1/ici_bw, 1/dcn_bw)`` —
+   :func:`~horovod_tpu.topo.model.cost_coefficients` gives each cell's
+   coefficient row, the cell's p50 (``metrics.quantile``) is the target,
+   and :func:`fit_link_params` solves the weighted system once enough
+   observations accumulate.  Parameters without support in the data
+   (e.g. no DCN cells on a single-slice world) keep their static
+   values; non-physical solutions (negative bandwidth) are rejected.
+
+3. **Preferred pricing.**  ``Topology.estimate_cost`` /
+   ``choose_lowering`` consult :func:`fitted_params` before the static
+   fields, so lowering decisions track the *measured* pod.  Fitted
+   values surface as ``topo.fitted_*`` gauges (drift vs the static
+   defaults is observable in one scrape); ``HVD_TPU_TOPO_FIT=off``
+   restores static pricing without touching the recorded cells.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Dict, List, Optional, Tuple
+
+from .. import metrics
+from ..utils import env
+from ..utils.logging import get_logger
+
+OBS_PREFIX = "topo.obs."
+
+# Dispatch latencies span sub-microsecond (cached async enqueue) to
+# seconds (cold compile): a finer ladder than LATENCY_BUCKETS so the
+# p50 interpolation has resolution where collectives actually live.
+OBS_BUCKETS: Tuple[float, ...] = (
+    1e-6, 2.5e-6, 5e-6, 1e-5, 2.5e-5, 5e-5, 1e-4, 2.5e-4, 5e-4,
+    1e-3, 2.5e-3, 5e-3, 1e-2, 2.5e-2, 5e-2, 0.1, 0.25, 0.5, 1.0, 2.5,
+)
+
+_FIT_COLLECTIVES = ("all_reduce", "reduce_scatter", "all_gather")
+_PARAM_NAMES = (
+    "phase_overhead_s", "ici_latency_s", "dcn_latency_s",
+    "ici_gbps", "dcn_gbps",
+)
+
+# Minimum observations per cell before its p50 is trusted, and minimum
+# distinct cells before a fit is attempted (the system has up to 5
+# unknowns; fewer rows than active columns is underdetermined).
+MIN_CELL_OBS = 4
+
+
+@dataclasses.dataclass(frozen=True)
+class Cell:
+    """One observation cell: a (collective, lowering, axis, size-bin)
+    bucket with its measured p50 and mean payload."""
+
+    collective: str
+    lowering: str
+    axis_size: int
+    mean_nbytes: float
+    p50_s: float
+    count: int
+
+
+@dataclasses.dataclass(frozen=True)
+class FittedParams:
+    """Effective link parameters fitted from observation cells, plus
+    the topology shape they were fitted against (fits never leak onto
+    a different shape)."""
+
+    phase_overhead_s: float
+    ici_latency_s: float
+    dcn_latency_s: float
+    ici_gbps: float
+    dcn_gbps: float
+    topo_key: Tuple[int, int]  # (num_slices, slice_size)
+    n_cells: int
+    n_observations: int
+    fitted_fields: Tuple[str, ...]  # columns the data actually pinned
+
+    def as_dict(self) -> Dict[str, float]:
+        return {k: getattr(self, k) for k in _PARAM_NAMES}
+
+
+_lock = threading.Lock()
+_fitted: Optional[FittedParams] = None
+_obs_count = 0
+_last_fit_at = 0
+_fit_failed_logged = False
+
+
+def enabled() -> bool:
+    """``HVD_TPU_TOPO_FIT`` policy: fitted pricing on by default,
+    ``off``/``0`` restores the static env-parameter model."""
+    raw = (env.get_env(env.TOPO_FIT, "on") or "on").strip().lower()
+    return raw not in ("off", "0", "false", "no")
+
+
+def min_observations() -> int:
+    return max(1, env.get_int(env.TOPO_FIT_MIN_OBS, 32))
+
+
+def refit_every() -> int:
+    return max(1, env.get_int(env.TOPO_FIT_REFIT_EVERY, 16))
+
+
+def cell_name(collective: str, lowering: str, axis_size: int,
+              nbytes: int) -> str:
+    return (
+        f"{OBS_PREFIX}{collective}.{lowering}."
+        f"n{int(axis_size)}.b{max(int(nbytes), 1).bit_length() - 1}"
+    )
+
+
+def record_observation(collective: str, lowering: str, nbytes: int,
+                       axis_size: int, seconds: float) -> None:
+    """Feed one measured collective into its observation cell.  Called
+    from the eager dispatch timer (flat cells) and from benches/tests
+    for hierarchical cells; out-of-model inputs (single-member axis,
+    empty payload) are dropped silently — the hot path never raises."""
+    global _obs_count
+    if (collective not in _FIT_COLLECTIVES
+            or lowering not in ("flat", "hier")
+            or axis_size <= 1 or nbytes <= 0 or seconds < 0):
+        return
+    name = cell_name(collective, lowering, axis_size, nbytes)
+    metrics.observe(name, float(seconds), buckets=OBS_BUCKETS)
+    metrics.inc_counter(name + ".bytes", int(nbytes))
+    with _lock:
+        _obs_count += 1
+
+
+def observed_cells() -> List[Cell]:
+    """Parse the registry's ``topo.obs.*`` histograms back into cells
+    (skipping any with fewer than ``MIN_CELL_OBS`` samples)."""
+    snap = metrics.snapshot()
+    cells: List[Cell] = []
+    for name, hist in snap.get("histograms", {}).items():
+        if not name.startswith(OBS_PREFIX):
+            continue
+        parts = name[len(OBS_PREFIX):].split(".")
+        if len(parts) != 4:
+            continue
+        collective, lowering, n_tag, _b_tag = parts
+        if (collective not in _FIT_COLLECTIVES
+                or lowering not in ("flat", "hier")
+                or not n_tag.startswith("n")):
+            continue
+        try:
+            axis_size = int(n_tag[1:])
+        except ValueError:
+            continue
+        count = int(hist.get("count", 0))
+        if count < MIN_CELL_OBS:
+            continue
+        p50 = metrics.hist_quantile(hist, 0.5)
+        total_bytes = snap.get("counters", {}).get(name + ".bytes", 0)
+        if p50 is None or p50 <= 0 or total_bytes <= 0:
+            continue
+        cells.append(Cell(
+            collective=collective, lowering=lowering, axis_size=axis_size,
+            mean_nbytes=total_bytes / count, p50_s=float(p50), count=count,
+        ))
+    return cells
+
+
+def fit_link_params(topo=None,
+                    cells: Optional[List[Cell]] = None
+                    ) -> Optional[FittedParams]:
+    """Weighted least squares of the ring model over the observation
+    cells.  Returns None (static pricing stands) when the system is
+    underdetermined or the solution is non-physical."""
+    import numpy as np
+
+    from . import model as topo_model
+
+    topo = topo if topo is not None else topo_model.current()
+    cells = observed_cells() if cells is None else cells
+    rows, targets, weights = [], [], []
+    for c in cells:
+        coeff = topo_model.cost_coefficients(
+            c.collective, c.mean_nbytes, c.lowering, c.axis_size, topo,
+        )
+        if not any(coeff):
+            continue  # degenerate cell (axis collapses to one member)
+        rows.append(coeff)
+        targets.append(c.p50_s)
+        weights.append(float(c.count) ** 0.5)
+    if not rows:
+        return None
+    a = np.asarray(rows, dtype=np.float64)
+    y = np.asarray(targets, dtype=np.float64)
+    w = np.asarray(weights, dtype=np.float64)
+    # Static parameter values in solve space (columns 3/4 carry
+    # INVERSE bytes/s): the fallback for any column the data cannot
+    # pin to a physical value.
+    static_x = [
+        topo.phase_overhead_s, topo.ici_latency_s, topo.dcn_latency_s,
+        1.0 / (topo.ici_gbps * 1e9), 1.0 / (topo.dcn_gbps * 1e9),
+    ]
+    active = [j for j in range(a.shape[1]) if np.any(a[:, j] != 0.0)]
+    y_adj = y.copy()
+    fitted: dict = {}
+    # Non-physical columns (negative bandwidth, materially negative
+    # latency — usually a noise artifact on a term the data barely
+    # exercises) fall back to their STATIC value one at a time and the
+    # rest re-solves, so one ill-conditioned column cannot discard an
+    # otherwise solvable fit.
+    while active:
+        if len(rows) < len(active):
+            return None  # underdetermined: keep static pricing
+        a_act = a[:, active]
+        # Column scaling: byte coefficients are ~1e9x the hop counts;
+        # an unscaled solve loses the latency columns to round-off.
+        scale = np.max(np.abs(a_act), axis=0)
+        scale[scale == 0.0] = 1.0
+        sol, *_ = np.linalg.lstsq(
+            (a_act / scale) * w[:, None], y_adj * w, rcond=None
+        )
+        sol = sol / scale
+        bad = [
+            j for j, x in zip(active, sol)
+            if (x <= 0 if j >= 3 else x < -1e-4)
+        ]
+        if not bad:
+            for j, x in zip(active, sol):
+                fitted[j] = max(float(x), 0.0)
+            break
+        for j in bad:
+            y_adj = y_adj - a[:, j] * static_x[j]
+            active.remove(j)
+    if not fitted:
+        return None  # nothing identifiable: static pricing stands
+    out = list(static_x)
+    for j, x in fitted.items():
+        out[j] = x
+    return FittedParams(
+        phase_overhead_s=out[0], ici_latency_s=out[1],
+        dcn_latency_s=out[2],
+        ici_gbps=1.0 / out[3] / 1e9,
+        dcn_gbps=1.0 / out[4] / 1e9,
+        topo_key=(topo.num_slices, topo.slice_size),
+        n_cells=len(rows),
+        n_observations=sum(c.count for c in cells),
+        fitted_fields=tuple(
+            _PARAM_NAMES[j] for j in sorted(fitted)
+        ),
+    )
+
+
+def _publish(fp: FittedParams) -> None:
+    metrics.set_gauge("topo.fitted_ici_gbps", fp.ici_gbps)
+    metrics.set_gauge("topo.fitted_dcn_gbps", fp.dcn_gbps)
+    metrics.set_gauge("topo.fitted_ici_lat_us", fp.ici_latency_s * 1e6)
+    metrics.set_gauge("topo.fitted_dcn_lat_us", fp.dcn_latency_s * 1e6)
+    metrics.set_gauge(
+        "topo.fitted_phase_overhead_us", fp.phase_overhead_s * 1e6
+    )
+    metrics.set_gauge("topo.fit.cells", fp.n_cells)
+    metrics.set_gauge("topo.fit.observations", fp.n_observations)
+    metrics.inc_counter("topo.fit.updates")
+
+
+def refresh(topo=None, force: bool = False) -> Optional[FittedParams]:
+    """Re-fit when enough new observations accumulated (``force`` skips
+    the accumulation gate, not the solvability checks).  Thread-safe;
+    a failed fit leaves the previous one in place."""
+    global _fitted, _last_fit_at, _fit_failed_logged
+    with _lock:
+        count = _obs_count
+        due = force or (
+            count >= min_observations()
+            and count - _last_fit_at >= refit_every()
+        )
+        if due:
+            _last_fit_at = count  # claim this batch (even if fit fails)
+    if not due:
+        return _fitted
+    fp = fit_link_params(topo)
+    if fp is not None:
+        with _lock:
+            _fitted = fp
+        _publish(fp)
+        get_logger().info(
+            "topo fit: %d cells / %d obs -> ici %.1f GB/s, dcn %.1f "
+            "GB/s, lat %.1f/%.1f us, overhead %.1f us (fitted: %s)",
+            fp.n_cells, fp.n_observations, fp.ici_gbps, fp.dcn_gbps,
+            fp.ici_latency_s * 1e6, fp.dcn_latency_s * 1e6,
+            fp.phase_overhead_s * 1e6, ",".join(fp.fitted_fields),
+        )
+    elif not _fit_failed_logged:
+        _fit_failed_logged = True
+        get_logger().debug(
+            "topo fit: observations not yet solvable; static pricing "
+            "stands"
+        )
+    return _fitted
+
+
+def fitted_params(topo=None) -> Optional[FittedParams]:
+    """The current fitted parameters for ``topo``'s shape, or None when
+    fitting is disabled, nothing solvable was observed, or the fit
+    belongs to a different topology shape.  Fits are always solved
+    against the process-wide topology (``model.current()``) — the pod
+    the observations came from — never against a caller's ad-hoc
+    instance; an instance merely *reads* the fit when its shape
+    matches."""
+    if not enabled():
+        return None
+    fp = refresh()
+    if fp is None:
+        return None
+    if topo is not None and fp.topo_key != (topo.num_slices,
+                                            topo.slice_size):
+        return None
+    return fp
+
+
+def reset() -> None:
+    """Drop the fitted state and the observation cells (test isolation;
+    called from ``topo.model.reset`` so one reset covers the package)."""
+    global _fitted, _obs_count, _last_fit_at, _fit_failed_logged
+    with _lock:
+        _fitted = None
+        _obs_count = 0
+        _last_fit_at = 0
+        _fit_failed_logged = False
+    metrics.reset_counters(OBS_PREFIX)
+    # "topo.fit" prefixes both the fit bookkeeping and the fitted_*
+    # gauges — one reset covers them.
+    metrics.reset_counters("topo.fit")

@@ -143,20 +143,6 @@ class Topology:
         return intra, cross
 
     # ----------------------------------------------------- cost model
-    def _ring(self, nbytes: float, n: int, lat: float, bw_gbps: float,
-              phases: float) -> float:
-        """Ring-collective time: per-phase fixed overhead + (n-1) hops
-        of latency + the per-rank payload over the link bandwidth.
-        ``phases`` counts payload traversals (allreduce = 2: RS + AG)."""
-        if n <= 1:
-            return 0.0
-        bw = bw_gbps * 1e9
-        return (
-            self.phase_overhead_s
-            + phases * (n - 1) * lat
-            + phases * nbytes * (n - 1) / (n * bw)
-        )
-
     def estimate_cost(
         self,
         collective: str,
@@ -167,7 +153,12 @@ class Topology:
         """Estimated seconds for ``collective`` over ``nbytes`` under a
         lowering.  Flat over a multi-slice axis rides the DCN
         bottleneck end to end; hierarchical pays three phase overheads
-        but moves only the ``1/ici_degree`` shard over DCN."""
+        but moves only the ``1/ici_degree`` shard over DCN.
+
+        Link parameters prefer the *measured* fit (``topo/fit.py``:
+        effective bandwidth/latency solved from the per-collective
+        dispatch histograms) over this instance's static fields;
+        ``HVD_TPU_TOPO_FIT=off`` pins the static env pricing."""
         if collective not in _COLLECTIVES:
             raise ValueError(
                 f"unknown collective {collective!r}; "
@@ -178,26 +169,32 @@ class Topology:
                 f"unknown lowering {lowering!r}; expected {LOWER_CHOICES}"
             )
         n = self.world if axis_size is None else axis_size
-        s, k = self.factor_axis(n)
-        phases = 2.0 if collective == "all_reduce" else 1.0
-        if s == 1 or lowering == "flat":
-            lat, bw = (
-                (self.dcn_latency_s, self.dcn_gbps) if s > 1
-                else (self.ici_latency_s, self.ici_gbps)
+        coeff = cost_coefficients(collective, nbytes, lowering, n, self)
+        po, ici_lat, dcn_lat, ici_bw, dcn_bw = self._cost_params()
+        return (
+            coeff[0] * po
+            + coeff[1] * ici_lat
+            + coeff[2] * dcn_lat
+            + coeff[3] / ici_bw
+            + coeff[4] / dcn_bw
+        )
+
+    def _cost_params(self) -> Tuple[float, float, float, float, float]:
+        """(phase_overhead_s, ici_lat_s, dcn_lat_s, ici_bytes_per_s,
+        dcn_bytes_per_s) — fitted when a measured fit for this shape
+        exists and ``HVD_TPU_TOPO_FIT`` allows it, static otherwise."""
+        from . import fit
+
+        fp = fit.fitted_params(self)
+        if fp is not None:
+            return (
+                fp.phase_overhead_s, fp.ici_latency_s, fp.dcn_latency_s,
+                fp.ici_gbps * 1e9, fp.dcn_gbps * 1e9,
             )
-            return self._ring(nbytes, n, lat, bw, phases)
-        ici = self._ring(
-            nbytes, k, self.ici_latency_s, self.ici_gbps, phases
+        return (
+            self.phase_overhead_s, self.ici_latency_s, self.dcn_latency_s,
+            self.ici_gbps * 1e9, self.dcn_gbps * 1e9,
         )
-        dcn = self._ring(
-            nbytes / k, s, self.dcn_latency_s, self.dcn_gbps, phases
-        )
-        if collective == "all_reduce":
-            # RS(ici) + AR(dcn) + AG(ici): the two ICI phases are the
-            # halves of one allreduce-equivalent, already in ``ici``;
-            # count their separate launches via one extra overhead.
-            return ici + dcn + self.phase_overhead_s
-        return ici + dcn
 
     def choose_lowering(
         self,
@@ -247,6 +244,51 @@ class Topology:
             "dcn": int(phases * (nbytes / k) * (s - 1) / s),
             "ici": int(phases * nbytes * (k - 1) / k),
         }
+
+
+def cost_coefficients(
+    collective: str,
+    nbytes: float,
+    lowering: str,
+    axis_size: int,
+    topo: Topology,
+) -> Tuple[float, float, float, float, float]:
+    """Ring-model coefficient row of one collective: ``cost = c0 *
+    phase_overhead + c1 * ici_lat + c2 * dcn_lat + c3 / ici_bytes_per_s
+    + c4 / dcn_bytes_per_s``.
+
+    The model is linear in these five parameters, so this one function
+    serves both directions: :meth:`Topology.estimate_cost` dots the row
+    with the current parameters, and the fitter (``topo/fit.py``)
+    stacks rows from measured cells into the least-squares system —
+    prediction and fit cannot drift apart.
+    """
+    n = axis_size
+    s, k = topo.factor_axis(n)
+    phases = 2.0 if collective == "all_reduce" else 1.0
+    if n <= 1:
+        return (0.0, 0.0, 0.0, 0.0, 0.0)
+    if s == 1 or lowering == "flat":
+        hops = phases * (n - 1)
+        moved = phases * nbytes * (n - 1) / n
+        if s > 1:  # flat over a multi-slice axis rides DCN end to end
+            return (1.0, 0.0, hops, 0.0, moved)
+        return (1.0, hops, 0.0, moved, 0.0)
+    po = 0.0
+    ici_hops = ici_bytes = 0.0
+    if k > 1:
+        po += 1.0
+        ici_hops = phases * (k - 1)
+        ici_bytes = phases * nbytes * (k - 1) / k
+    po += 1.0
+    dcn_hops = phases * (s - 1)
+    dcn_bytes = phases * (nbytes / k) * (s - 1) / s
+    if collective == "all_reduce":
+        # RS(ici) + AR(dcn) + AG(ici): the two ICI phases are the halves
+        # of one allreduce-equivalent, already counted above; their
+        # separate launches cost one extra overhead.
+        po += 1.0
+    return (po, ici_hops, dcn_hops, ici_bytes, dcn_bytes)
 
 
 # ------------------------------------------------------------ discovery
@@ -428,11 +470,15 @@ def set_topology_override(topo: Optional[Topology]) -> None:
 
 
 def reset() -> None:
-    """Drop the discovery cache and override (tests / elastic remesh)."""
+    """Drop the discovery cache, override, and fitted cost-model state
+    (tests / elastic remesh)."""
     global _override
     with _lock:
         _override = None
         _cache.clear()
+    from . import fit
+
+    fit.reset()
 
 
 def lower_mode() -> str:

@@ -109,6 +109,13 @@ class FusionAutotuner:
             self._frozen, best_score,
         )
 
+    def freeze(self, threshold_bytes: int) -> None:
+        """Pin the knob to a known-good value without exploration — the
+        warm-start entry point for a persisted schedule
+        (``sched/store.py``): ``converged`` is True immediately and no
+        window is ever burned re-learning it."""
+        self._frozen = int(threshold_bytes)
+
     @property
     def converged(self) -> bool:
         return self._frozen is not None

@@ -85,6 +85,21 @@ TOPO_DCN_GBPS = "TOPO_DCN_GBPS"
 TOPO_ICI_LAT_US = "TOPO_ICI_LAT_US"
 TOPO_DCN_LAT_US = "TOPO_DCN_LAT_US"
 TOPO_PHASE_OVERHEAD_US = "TOPO_PHASE_OVERHEAD_US"
+# Measured cost model (topo/fit.py): fit effective link parameters
+# from the per-collective dispatch histograms and prefer them over the
+# static TOPO_* env defaults.  off = static pricing only.
+TOPO_FIT = "TOPO_FIT"  # on (default) | off
+TOPO_FIT_MIN_OBS = "TOPO_FIT_MIN_OBS"  # observations before first fit
+TOPO_FIT_REFIT_EVERY = "TOPO_FIT_REFIT_EVERY"  # new obs between refits
+# Persistent schedule autotuning database (sched/store.py): JSON file
+# recording converged (bucket_bytes, wire, lowering) per (schedule
+# signature, topology, jax version, knob fingerprint); ScheduleTuner
+# warm-starts from a hit.  Unset = no persistence (PR 6 behavior).
+TUNE_DB = "TUNE_DB"
+# A stored schedule is invalidated when the current (fitted) cost
+# model's price for it disagrees with the recorded one by more than
+# this factor in either direction.
+TUNE_STALE_FACTOR = "TUNE_STALE_FACTOR"  # default 4.0
 
 # Launcher-provided rendezvous env (analog of reference gloo_run.py:65-103).
 RANK = "RANK"

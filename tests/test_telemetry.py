@@ -98,6 +98,40 @@ class TestMetricsRegistry:
         assert metrics.get_gauge("g") is None
         assert metrics.get_histogram("h") is None
 
+    def test_quantile_interpolates_within_buckets(self):
+        metrics.reset_counters("q.")
+        # 4 observations in known buckets: (0.0025, 0.005] x2,
+        # (0.005, 0.01] x1, (0.01, 0.025] x1
+        for v in (0.003, 0.004, 0.007, 0.02):
+            metrics.observe("q.lat", v)
+        # p50: target rank 2 lands at the top of the first bucket ->
+        # linear interpolation gives exactly its upper bound
+        assert metrics.quantile("q.lat", 0.5) == pytest.approx(0.005)
+        # p100 clamps to the last occupied bucket's bound
+        assert metrics.quantile("q.lat", 1.0) == pytest.approx(0.025)
+        # p0 pins to the first occupied bucket's lower edge
+        assert metrics.quantile("q.lat", 0.0) == pytest.approx(0.0025)
+        assert metrics.quantile("missing", 0.5) is None
+        with pytest.raises(ValueError):
+            metrics.quantile("q.lat", 1.5)
+
+    def test_quantile_overflow_bucket_clamps(self):
+        metrics.reset_counters("q.")
+        metrics.observe("q.inf", 999.0)  # +Inf slot only
+        assert metrics.quantile("q.inf", 0.5) == pytest.approx(60.0)
+
+    def test_prometheus_renders_quantile_lines(self):
+        metrics.reset_counters("q.")
+        for v in (0.003, 0.004, 0.007, 0.02):
+            metrics.observe("q.lat", v)
+        text = metrics.render_prometheus()
+        assert 'hvd_tpu_q_lat{quantile="0.5"} 0.005' in text
+        assert 'hvd_tpu_q_lat{quantile="0.99"}' in text
+        # quantile lines respect extra labels like every other series
+        snap = json.loads(metrics.render_json())
+        text = metrics.render_prometheus(snap, extra_labels={"rank": "2"})
+        assert 'hvd_tpu_q_lat{quantile="0.5",rank="2"} 0.005' in text
+
 
 # ------------------------------------------------------- eager hot path
 class TestCollectiveInstrumentation:

@@ -169,6 +169,164 @@ class TestCostModel:
             assert got["dcn"] <= flat["dcn"], (s, k, nbytes, chosen)
 
 
+# ------------------------------------------------- fitted cost model
+
+def _record_ring_observations(topo_truth, axis_size=8, reps=8,
+                              noise=0.0, seed=0,
+                              sizes=(1 << 12, 1 << 16, 1 << 20, 1 << 24),
+                              lowerings=("flat", "hier")):
+    """Feed fit cells with latencies generated from a ground-truth
+    parameter set through the SAME coefficient row the fitter uses."""
+    from horovod_tpu.topo import fit
+    from horovod_tpu.topo.model import cost_coefficients
+
+    rng = np.random.RandomState(seed)
+    for lo in lowerings:
+        for nb in sizes:
+            c = cost_coefficients("all_reduce", nb, lo, axis_size,
+                                  topo_truth)
+            base = (
+                c[0] * topo_truth.phase_overhead_s
+                + c[1] * topo_truth.ici_latency_s
+                + c[2] * topo_truth.dcn_latency_s
+                + c[3] / (topo_truth.ici_gbps * 1e9)
+                + c[4] / (topo_truth.dcn_gbps * 1e9)
+            )
+            for _ in range(reps):
+                jitter = 1.0 + noise * float(rng.uniform(-1, 1))
+                fit.record_observation("all_reduce", lo, nb, axis_size,
+                                       base * jitter)
+
+
+@pytest.mark.tune
+class TestFittedCostModel:
+    def test_predictions_within_2x_of_measured_p50(self):
+        """Acceptance property: on the simulated 2x4 mesh the fitted
+        model's per-bucket predictions land within 2x of the measured
+        histogram p50 for BOTH lowerings, across cells and noise."""
+        from horovod_tpu.topo import fit
+
+        truth = Topology(
+            num_slices=2, slice_size=4, ici_gbps=80.0, dcn_gbps=8.0,
+            ici_latency_s=2e-6, dcn_latency_s=30e-6,
+            phase_overhead_s=150e-6,
+        )
+        topo.set_topology_override(T24)  # fit anchors to current()
+        _record_ring_observations(truth, noise=0.10)
+        fp = fit.refresh(force=True)
+        assert fp is not None and fp.topo_key == (2, 4)
+        cells = fit.observed_cells()
+        assert len(cells) == 8  # 2 lowerings x 4 size bins
+        for c in cells:
+            pred = T24.estimate_cost(
+                "all_reduce", int(c.mean_nbytes), c.lowering,
+                c.axis_size,
+            )
+            assert 0.5 <= pred / c.p50_s <= 2.0, (c, pred)
+
+    def test_choose_lowering_tracks_fitted_parameters(self, monkeypatch):
+        """A pod whose measured phase overhead dwarfs its wire time
+        must flip big buckets back to flat — even though the static
+        env model prices them hier."""
+        from horovod_tpu.topo import fit
+
+        topo.set_topology_override(T24)
+        assert T24.choose_lowering("all_reduce", 16 << 20) == "hier"
+        # ground truth: launches cost 5 ms, links are fast -> the
+        # hier three-phase staging can never win
+        truth = Topology(
+            num_slices=2, slice_size=4, ici_gbps=100.0, dcn_gbps=50.0,
+            ici_latency_s=1e-6, dcn_latency_s=2e-6,
+            phase_overhead_s=5e-3,
+        )
+        _record_ring_observations(truth, noise=0.05)
+        assert fit.refresh(force=True) is not None
+        assert T24.choose_lowering("all_reduce", 16 << 20) == "flat"
+        # the kill switch restores static pricing (and the decision)
+        monkeypatch.setenv("HVD_TPU_TOPO_FIT", "off")
+        assert T24.choose_lowering("all_reduce", 16 << 20) == "hier"
+
+    def test_fitted_gauges_and_counters_exported(self):
+        from horovod_tpu.topo import fit
+
+        topo.set_topology_override(T24)
+        _record_ring_observations(T24)
+        assert fit.refresh(force=True) is not None
+        assert metrics.get_gauge("topo.fitted_ici_gbps") > 0
+        assert metrics.get_gauge("topo.fitted_dcn_gbps") > 0
+        assert metrics.get_gauge("topo.fitted_phase_overhead_us") >= 0
+        assert metrics.get_gauge("topo.fit.cells") == 8
+        assert metrics.get_counter("topo.fit.updates") >= 1
+
+    def test_underdetermined_observations_keep_static_pricing(self):
+        from horovod_tpu.topo import fit
+
+        topo.set_topology_override(T24)
+        # one cell < MIN_CELL_OBS samples: no fit, static stands
+        fit.record_observation("all_reduce", "flat", 1 << 20, 8, 1e-3)
+        assert fit.refresh(force=True) is None
+        assert fit.fitted_params(T24) is None
+        static = T24.estimate_cost("all_reduce", 1 << 20, "flat")
+        assert static == pytest.approx(
+            T24.phase_overhead_s + 2 * 7 * T24.dcn_latency_s
+            + 2 * (1 << 20) * 7 / 8 / (T24.dcn_gbps * 1e9)
+        )
+
+    def test_fit_never_leaks_onto_other_shapes(self):
+        from horovod_tpu.topo import fit
+
+        topo.set_topology_override(T24)
+        _record_ring_observations(T24)
+        assert fit.refresh(force=True) is not None
+        other = Topology(num_slices=4, slice_size=2)
+        assert fit.fitted_params(other) is None
+        assert fit.fitted_params(T24) is not None
+
+    def test_record_observation_drops_degenerate_inputs(self):
+        from horovod_tpu.topo import fit
+
+        fit.record_observation("all_reduce", "flat", 1 << 20, 1, 1e-3)
+        fit.record_observation("all_reduce", "flat", 0, 8, 1e-3)
+        fit.record_observation("all_reduce", "weird", 1 << 20, 8, 1e-3)
+        fit.record_observation("broadcast", "flat", 1 << 20, 8, 1e-3)
+        fit.record_observation("all_reduce", "flat", 1 << 20, 8, -1.0)
+        assert fit.observed_cells() == []
+
+    def test_eager_allreduce_feeds_tagged_cells(self, hvd_module):
+        """The PR 2 dispatch histograms now carry (lowering, size,
+        axis) tags: one eager allreduce lands in a topo.obs cell."""
+        from horovod_tpu.topo import fit
+
+        metrics.reset_counters(fit.OBS_PREFIX)
+        x = jnp.ones((N, 16), jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(hvd.allreduce(x, average=True)), np.ones((N, 16))
+        )
+        snap = metrics.snapshot()["histograms"]
+        names = [k for k in snap if k.startswith("topo.obs.all_reduce.")]
+        assert names, f"no tagged cells in {sorted(snap)[:10]}"
+        name = names[0]
+        assert f".n{N}." in name and ".flat." in name
+        assert metrics.get_counter(name + ".bytes") == x.nbytes
+
+    def test_nonphysical_fit_rejected(self):
+        """Latencies that DECREASE with payload cannot satisfy the ring
+        model with positive bandwidth: the fit must reject itself and
+        leave static pricing in place."""
+        from horovod_tpu.topo import fit
+
+        topo.set_topology_override(T24)
+        for i, nb in enumerate((1 << 12, 1 << 16, 1 << 20, 1 << 24)):
+            for _ in range(6):
+                fit.record_observation(
+                    "all_reduce", "flat", nb, 8, 1e-2 / (10.0 ** i)
+                )
+        fit.refresh(force=True)
+        fp = fit.fitted_params(T24)
+        if fp is not None:  # a fit may survive via clamps...
+            assert fp.dcn_gbps > 0  # ...but never go non-physical
+
+
 # ----------------------------------------------- hierarchical primitives
 
 def _shard_run(fn, *args, mesh=None, n_out=1):

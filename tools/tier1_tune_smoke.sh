@@ -1,0 +1,128 @@
+#!/usr/bin/env bash
+# Persistent-autotune smoke: the SAME seeded training job runs twice
+# against a shared HVD_TPU_TUNE_DB.
+#
+#   run 1 (cold): the ScheduleTuner explores bucket sizes window by
+#     window, converges, and writes the winner to the DB
+#     (sched.tune.db_miss == 1, db_store == 1); the post-convergence
+#     schedule then trains a fresh model and records its losses.
+#   run 2 (warm): the tuner must be converged AT WINDOW 0 with ZERO
+#     exploration windows (sched.tune.db_hit == 1), adopt the stored
+#     bucket size, and the fresh-model losses must be BITWISE identical
+#     to run 1's post-convergence losses — the cold->warm proof that
+#     the 10,000th identical job starts already tuned.
+#
+# Also proves the DB-off control: with HVD_TPU_TUNE_DB unset the tuner
+# runs exactly like PR 6 (no store counters move).
+set -euo pipefail
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}"
+export PYTHONPATH="$(cd "$(dirname "$0")/.." && pwd)${PYTHONPATH:+:$PYTHONPATH}"
+
+WORKER="$(mktemp /tmp/hvd_tpu_tune_smoke.XXXXXX.py)"
+DB="$(mktemp -u /tmp/hvd_tpu_tune_smoke_db.XXXXXX.json)"
+trap 'rm -f "$WORKER" "$WORKER".out.* "$DB"' EXIT
+
+cat > "$WORKER" <<'EOF'
+import json
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu import metrics, sched
+
+hvd.init()
+X = np.random.RandomState(1).randn(16, 4).astype(np.float32)
+Y = (X @ np.full((4, 2), 0.7)).astype(np.float32)
+BATCH = (jnp.asarray(X), jnp.asarray(Y))
+SIG = ("tune_smoke", "mlp-4-4-2", "sgd0.1")
+
+
+def loss_fn(p, b):
+    x, y = b
+    return jnp.mean((x @ p["w1"] @ p["w2"] + p["b"] - y) ** 2)
+
+
+def fresh_params():
+    return {
+        "w1": jnp.full((4, 4), 0.2),
+        "w2": jnp.full((4, 2), 0.5),
+        "b": jnp.zeros((2,)),
+    }
+
+
+def train(bucket_bytes, steps):
+    """A fresh seeded model under one bucket size; returns losses."""
+    sched.set_config_override(sched.SchedConfig(bucket_bytes=bucket_bytes))
+    try:
+        tx = hvd.DistributedOptimizer(optax.sgd(0.1))
+        step = hvd.distributed_train_step(loss_fn, tx)
+        params = fresh_params()
+        st = step.init(params)
+        losses = []
+        for _ in range(steps):
+            params, st, loss = step(params, st, BATCH)
+            losses.append(float(loss))
+        return losses
+    finally:
+        sched.set_config_override(None)
+
+
+tuner = sched.ScheduleTuner(warmup_windows=3, store="env", store_key=SIG)
+windows = 0
+while not tuner.converged:
+    windows += 1
+    assert windows <= 10, "tuner failed to converge"
+    tuner.begin_window()
+    train(tuner.bucket_bytes(), steps=3)  # bumps train.* metrics
+    tuner.end_window()
+
+losses = train(tuner.bucket_bytes(), steps=12)
+json.dump({
+    "explore_windows": windows,
+    "bucket_bytes": tuner.bucket_bytes(),
+    "losses": losses,
+    "db_hit": metrics.get_counter("sched.tune.db_hit"),
+    "db_miss": metrics.get_counter("sched.tune.db_miss"),
+    "db_store": metrics.get_counter("sched.tune.db_store"),
+}, sys.stdout)
+EOF
+
+# --- run 1 (cold) and run 2 (warm) share the DB ----------------------
+HVD_TPU_TUNE_DB="$DB" python "$WORKER" > "$WORKER.out.cold"
+test -s "$DB" || { echo "FAIL: no DB written"; exit 1; }
+HVD_TPU_TUNE_DB="$DB" python "$WORKER" > "$WORKER.out.warm"
+# --- control: DB unset == PR 6 behavior ------------------------------
+python "$WORKER" > "$WORKER.out.off"
+
+python - "$WORKER" <<'EOF'
+import json
+import sys
+
+worker = sys.argv[1]
+cold = json.load(open(f"{worker}.out.cold"))
+warm = json.load(open(f"{worker}.out.warm"))
+off = json.load(open(f"{worker}.out.off"))
+
+assert cold["db_miss"] == 1 and cold["db_store"] == 1, cold
+assert cold["explore_windows"] >= 3, cold
+assert warm["db_hit"] == 1, warm
+assert warm["explore_windows"] == 0, \
+    f"warm run explored: {warm['explore_windows']} windows"
+assert warm["bucket_bytes"] == cold["bucket_bytes"], (cold, warm)
+assert warm["losses"] == cold["losses"], \
+    f"warm losses not bitwise-identical: {cold['losses'][-1]} vs " \
+    f"{warm['losses'][-1]}"
+assert off["db_hit"] == off["db_miss"] == off["db_store"] == 0, off
+assert off["losses"] == cold["losses"], "DB-off run diverged"
+print(f"cold: {cold['explore_windows']} explore windows -> "
+      f"bucket_bytes={cold['bucket_bytes']}; warm: 0 explore windows, "
+      f"db_hit=1, losses bitwise-identical over 12 steps "
+      f"(final {warm['losses'][-1]:.6f}); DB-off run matches PR 6")
+EOF
+
+echo "tier1_tune_smoke: OK"

@@ -83,6 +83,18 @@ def main() -> dict:
                 p, st, loss = step(p, st, batch)
             jax.block_until_ready(loss)
             dt = (time.perf_counter() - t0) / iters
+            # Feed the measured cost model (topo/fit.py): this is the
+            # one place hier-lowered exchanges get a wall-clock number
+            # per schedule, so both lowerings gain observation cells.
+            from horovod_tpu.topo import fit as topo_fit
+
+            nbytes = int(metrics.get_gauge("sched.bytes_per_step") or 0)
+            if nbytes > 0:
+                for _ in range(iters):
+                    topo_fit.record_observation(
+                        "all_reduce", lowering, nbytes,
+                        axis_size=hvd.size(), seconds=dt,
+                    )
             return {
                 "step_time_ms": round(dt * 1000.0, 3),
                 "dcn_bytes": int(metrics.get_gauge("topo.dcn_bytes") or 0),
