@@ -50,6 +50,27 @@ def main():
         jax.random.PRNGKey(0), jnp.zeros((1, args.seq), jnp.int32)
     )
     n_params = sum(x.size for x in jax.tree.leaves(params))
+
+    # FSDP's per-step all_gather + reduce_scatter run through the
+    # exchange IR by default (HVD_TPU_XIR=on — docs/exchange_ir.md).
+    # Prove the parity contract in-script before training: one step
+    # from identical shards, IR on vs off, must match bitwise.
+    check = []
+    for flag in (True, False):
+        hvd.xir.set_enabled_override(flag)
+        try:
+            s = hvd.fsdp_train_step(loss_fn, optax.adamw(args.lr))
+            ps, st = s.init(params)
+            ps, st, loss = s(ps, st, jnp.asarray(data[:b]))
+            check.append(float(loss))
+        finally:
+            hvd.xir.set_enabled_override(None)
+    assert check[0] == check[1], \
+        f"exchange-IR parity violated: {check[0]} vs {check[1]}"
+    if hvd.rank() == 0:
+        print(f"exchange-IR parity OK (fsdp step IR on == off bitwise, "
+              f"loss {check[0]:.4f})")
+
     step = hvd.fsdp_train_step(loss_fn, optax.adamw(args.lr))
     pshards, opt_state = step.init(params)
     del params  # full copy no longer needed: it lives sharded now

@@ -97,6 +97,36 @@ def densify(s: IndexedSlices) -> jax.Array:
     return out.at[s.indices].add(s.values)
 
 
+def _routed_gather(s: IndexedSlices, axis, process_set):
+    """The embedding exchange through the exchange IR: one
+    ``gather_dense_from_sparse`` op (allgather of indices + values).
+    The interpreter emits the identical ``traced.allgather`` pair on
+    the dense wire (``HVD_TPU_XIR=off`` calls them directly — bitwise
+    either way); a bf16 ``HVD_TPU_XIR_WIRE`` request casts only the
+    values leg, indices always ride dense int wire.  The exchange gains
+    the SPARSE_EMBED_EXCHANGE timeline lane, kind-labeled byte gauges,
+    and a persistent-store key."""
+    from .. import xir
+
+    if not xir.enabled():
+        idx = traced.allgather(s.indices, axis=axis,
+                               process_set=process_set)
+        vals = traced.allgather(s.values, axis=axis,
+                                process_set=process_set)
+        return idx, vals
+    op = xir.gather_dense_from_sparse(
+        axis, wire=xir.wire_request(),
+        set_ranks=(tuple(process_set.ranks)
+                   if process_set is not None else None),
+        nbytes=s.values.size * s.values.dtype.itemsize,
+        dtype=s.values.dtype,
+    )
+    return xir.execute(
+        xir.program("sparse_embed", [op]), [(s.indices, s.values)],
+        process_set=process_set,
+    )[0]
+
+
 def sparse_allreduce(
     s: IndexedSlices,
     axis=WORLD_AXIS,
@@ -114,8 +144,7 @@ def sparse_allreduce(
     """
     if op not in (traced.Average, traced.Sum):
         raise ValueError("sparse_allreduce supports op=Average or Sum")
-    idx = traced.allgather(s.indices, axis=axis, process_set=process_set)
-    vals = traced.allgather(s.values, axis=axis, process_set=process_set)
+    idx, vals = _routed_gather(s, axis, process_set)
     if op == traced.Average:
         if process_set is not None:
             denom = len(process_set.ranks)

@@ -434,7 +434,7 @@ def _reduce_gradients(
 
         reduced = _sched.exchange(
             wire, schedule, reduce_bucket_flat,
-            barriers=cfg.barriers, timeline=tl,
+            barriers=cfg.barriers, timeline=tl, axis=axis,
         )
         out = [compression.decompress(t, c) for t, c in zip(reduced, ctxs)]
         tree = jax.tree.unflatten(treedef, out)

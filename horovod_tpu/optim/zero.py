@@ -321,6 +321,39 @@ def _flat_layout(params_like, world: int):
     return n, padded, padded // world, ravel, unravel
 
 
+def _fsdp_exchange(op_name: str, x: jax.Array, axis, bucket: int = 0
+                   ) -> jax.Array:
+    """One FSDP exchange phase through the exchange IR (``xir``): the
+    per-step parameter ``all_gather`` or gradient ``reduce_scatter``.
+    The interpreter emits the identical flat ``lax`` collective
+    (``HVD_TPU_XIR=off`` calls it directly — bitwise either way); the
+    wire stays dense here (FSDP's wire compression is its own
+    ``compression=`` kwarg, applied by the caller around this hop) and
+    the lowering stays flat (the 1/N shard layout is the optimizer-
+    state contract, so the hierarchy's own layout cannot substitute).
+    What FSDP gains is the FSDP_EXCHANGE timeline lane, kind-labeled
+    byte gauges, and a persistent-store key for its program."""
+    from .. import xir
+
+    if not xir.enabled():
+        if op_name == "all_gather":
+            return lax.all_gather(x, axis, tiled=True)
+        return lax.psum_scatter(x, axis, scatter_dimension=0, tiled=True)
+    if op_name == "all_gather":
+        op = xir.all_gather(
+            axis, lowering="flat", bucket=bucket,
+            nbytes=x.size * x.dtype.itemsize, dtype=x.dtype,
+        )
+    else:
+        op = xir.reduce_scatter(
+            axis, lowering="flat", bucket=bucket,
+            nbytes=x.size * x.dtype.itemsize, dtype=x.dtype,
+        )
+    return xir.execute(
+        xir.program("fsdp", [op]), [x], axis_size=lax.axis_size(axis)
+    )[0]
+
+
 def fsdp_train_step(
     loss_fn,
     tx: optax.GradientTransformation,
@@ -399,7 +432,7 @@ def fsdp_train_step(
 
     def step_body(pshard, opt_state, batch):
         m = _layout()
-        pfull = lax.all_gather(pshard, axis, tiled=True)[: m["n"]]
+        pfull = _fsdp_exchange("all_gather", pshard, axis)[: m["n"]]
         params = m["unravel"](pfull)
         loss, grads = jax.value_and_grad(loss_fn)(params, batch)
         gflat = m["ravel"](grads)
@@ -408,14 +441,12 @@ def fsdp_train_step(
             # wire compression on the reduce-scatter (the DP fused-
             # allreduce compression knob, applied to the RS phase)
             wire, ctx = compression.compress(gflat)
-            gshard = lax.psum_scatter(
-                wire, axis, scatter_dimension=0, tiled=True
-            )
+            gshard = _fsdp_exchange("reduce_scatter", wire, axis,
+                                    bucket=1)
             gshard = compression.decompress(gshard, ctx) / world
         else:
-            gshard = lax.psum_scatter(
-                gflat, axis, scatter_dimension=0, tiled=True
-            ) / world
+            gshard = _fsdp_exchange("reduce_scatter", gflat, axis,
+                                    bucket=1) / world
         ushard, opt_state = tx.update(gshard, opt_state, pshard)
         pshard = optax.apply_updates(pshard, ushard)
         return pshard, opt_state, lax.pmean(loss, axis)
@@ -423,7 +454,7 @@ def fsdp_train_step(
     def gather_body(pshard):
         m = _layout()
         return m["unravel"](
-            lax.all_gather(pshard, axis, tiled=True)[: m["n"]]
+            _fsdp_exchange("all_gather", pshard, axis, bucket=2)[: m["n"]]
         )
 
     class _Step:

@@ -71,11 +71,44 @@ def _top_k_gating(
     return combine, dispatch, aux
 
 
+def _routed_all_to_all(x: jax.Array, axis: str, split_axis: int,
+                       concat_axis: int, bucket: int = 0) -> jax.Array:
+    """One MoE all_to_all through the exchange IR (``xir``): the op
+    carries the payload metadata the tuner/store key and the byte
+    gauges need, and the interpreter emits the identical
+    ``lax.all_to_all`` on the dense wire (``HVD_TPU_XIR=off`` calls it
+    directly — bitwise-equal either way).  Wire requests
+    (``HVD_TPU_XIR_WIRE`` / ``HVD_TPU_SCHED_WIRE``) gate through
+    shuffle-op eligibility: bf16 casts the wire, int8/fp8 stay off."""
+    from .. import xir
+
+    if not xir.enabled():
+        return lax.all_to_all(
+            x, axis, split_axis=split_axis, concat_axis=concat_axis,
+            tiled=True,
+        )
+    op = xir.all_to_all(
+        axis, split_axis=split_axis, concat_axis=concat_axis,
+        wire=xir.wire_request(), bucket=bucket,
+        nbytes=x.size * x.dtype.itemsize, dtype=x.dtype,
+    )
+    return xir.execute(
+        xir.program("moe", [op]), [x], axis_size=lax.axis_size(axis)
+    )[0]
+
+
 def moe_alltoall_dispatch(x: jax.Array, axis: str = EP_AXIS) -> jax.Array:
     """[E, C, d] local dispatch buffers → [E_local, n·C, d] expert shards
     (one all_to_all over the ep axis); inverse of itself with the
     reshape transposed — see MoELayer for the round trip."""
-    return lax.all_to_all(x, axis, split_axis=0, concat_axis=1, tiled=True)
+    return _routed_all_to_all(x, axis, split_axis=0, concat_axis=1)
+
+
+def moe_alltoall_combine(y: jax.Array, axis: str = EP_AXIS) -> jax.Array:
+    """Inverse all_to_all: send each n·C slice back to its source rank
+    ([E_local, n·C, d] → [E, C, d])."""
+    return _routed_all_to_all(y, axis, split_axis=1, concat_axis=0,
+                              bucket=1)
 
 
 class MoELayer(nn.Module):
@@ -133,9 +166,7 @@ class MoELayer(nn.Module):
         y = jnp.einsum("ech,ehd->ecd", h, wo.astype(compute_dtype))
 
         if n > 1:
-            # Inverse all_to_all: send each n·C slice back to its source.
-            y = lax.all_to_all(y, self.axis, split_axis=1, concat_axis=0,
-                               tiled=True)
+            y = moe_alltoall_combine(y, self.axis)
         else:
             y = y.reshape(e, capacity, d)
         out = jnp.einsum("sec,ecd->sd", combine.astype(y.dtype), y)

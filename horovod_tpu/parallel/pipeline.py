@@ -52,10 +52,28 @@ def pipeline_apply(
     """
     if remat_stage:
         stage_fn = jax.checkpoint(stage_fn)
+    from .. import xir
+
     n = lax.axis_size(axis)
     stage = lax.axis_index(axis)
     m = microbatches.shape[0]
     shift = [(j, (j + 1) % n) for j in range(n)]
+
+    def _hop(y):
+        # The stage-to-stage activation hop through the exchange IR:
+        # the interpreter emits the identical lax.ppermute on the
+        # dense wire (HVD_TPU_XIR=off calls it directly); the hop's
+        # bytes land in the PIPELINE_EXCHANGE lane + kind-labeled
+        # gauges, with the DCN share computed from which (src, dst)
+        # pairs cross a slice boundary.
+        if not xir.enabled():
+            return lax.ppermute(y, axis, shift)
+        op = xir.permute(
+            axis, shift, wire=xir.wire_request(),
+            nbytes=y.size * y.dtype.itemsize, dtype=y.dtype,
+        )
+        return xir.execute(xir.program("pipeline", [op]), [y],
+                           axis_size=n)[0]
 
     # pcast marks the loop state device-varying so the fori_loop carry
     # type matches its (varying, post-ppermute) outputs under shard_map.
@@ -84,7 +102,7 @@ def pipeline_apply(
         out = lax.dynamic_update_index_in_dim(
             out, jnp.where(write, y.astype(out.dtype), prev), out_idx, 0
         )
-        act = lax.ppermute(y, axis, shift)
+        act = _hop(y)
         return act, out
 
     _, out = lax.fori_loop(0, m + n - 1, step, (act0, out0))

@@ -42,13 +42,35 @@ def ulysses_attention(
     if h % n != 0:
         raise ValueError(f"heads ({h}) must be divisible by axis size {n}")
 
-    def seq_to_heads(x):
+    def _flip(x, split_axis, concat_axis, bucket):
+        # One head/sequence re-shard through the exchange IR: the
+        # interpreter emits the identical lax.all_to_all on the dense
+        # wire (HVD_TPU_XIR=off calls it directly), bf16 wire requests
+        # cast around it, and the flip's bytes land in the
+        # ULYSSES_EXCHANGE lane + kind-labeled gauges.
+        from .. import xir
+
+        if not xir.enabled():
+            return lax.all_to_all(
+                x, axis, split_axis=split_axis, concat_axis=concat_axis,
+                tiled=True,
+            )
+        op = xir.all_to_all(
+            axis, split_axis=split_axis, concat_axis=concat_axis,
+            wire=xir.wire_request(), bucket=bucket,
+            nbytes=x.size * x.dtype.itemsize, dtype=x.dtype,
+        )
+        return xir.execute(
+            xir.program("ulysses", [op]), [x], axis_size=n
+        )[0]
+
+    def seq_to_heads(x, bucket=0):
         # [B, T_loc, H, D] -> [B, T_global, H/n, D]
-        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+        return _flip(x, 2, 1, bucket)
 
     def heads_to_seq(x):
-        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+        return _flip(x, 1, 2, 3)
 
-    q, k, v = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    q, k, v = seq_to_heads(q, 0), seq_to_heads(k, 1), seq_to_heads(v, 2)
     out = (attn_fn or full_attention)(q, k, v, causal=causal)
     return heads_to_seq(out)

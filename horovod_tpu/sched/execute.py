@@ -17,6 +17,7 @@ timeline lane event per bucket.
 
 from __future__ import annotations
 
+import dataclasses
 import time
 from typing import Any, Callable, List, Optional, Sequence
 
@@ -100,11 +101,24 @@ def exchange(
     *,
     barriers: bool = True,
     timeline: Any = None,
+    kind: str = "dense_grad",
+    axis: Any = None,
 ) -> List[jax.Array]:
     """Run ``schedule`` over the ``wire`` leaves: per bucket, flatten ->
     one collective per dtype (via ``reduce_flat(flat, bucket)``) ->
     slice back out.  Returns the reduced leaves in original flatten
     order.
+
+    Under ``HVD_TPU_XIR=on`` (the default) the schedule is first
+    expressed as an explicit exchange program
+    (:func:`~horovod_tpu.xir.from_schedule` — one op per bucket
+    carrying the (wire, lowering, bucket, ef) tuple that used to be
+    implicit in ``Bucket`` fields), and this loop interprets that
+    program: the op record is authoritative for the per-bucket
+    dispatch.  The ops are constructed from the very bucket fields
+    they replace, so the emitted collectives — and therefore f32
+    dense losses — are bitwise identical with the IR on or off
+    (tests/test_xir.py pins this).
 
     Values are independent of bucketing: XLA collectives are
     elementwise over the buffer, so concat order never changes a sum —
@@ -113,10 +127,27 @@ def exchange(
     ``wire`` is quantized trades that identity for compressed wire
     bytes (the reducer routes it through ops/quantized.py).
     """
+    from .. import xir
+
     t0 = time.perf_counter()
+    program = (
+        xir.from_schedule(schedule, kind=kind, axis=axis)
+        if xir.enabled() else None
+    )
+    if program is not None:
+        metrics.inc_counter("xir.programs")
+        metrics.inc_counter(f"xir.programs.{kind}")
+        metrics.inc_counter("xir.ops", len(program.ops))
     reduced: List[jax.Array] = list(wire)
     token: Optional[jax.Array] = None
     for bi, bucket in enumerate(schedule.buckets):
+        if program is not None:
+            # Interpret the program: the op record drives the bucket's
+            # dispatch (equal to the plan's fields by construction).
+            op = program.ops[bi]
+            bucket = dataclasses.replace(
+                bucket, wire=op.wire, lowering=op.lowering
+            )
         ins = [wire[i] for i in bucket.indices]
         if barriers:
             ins, token = _chain(ins, token)
@@ -462,6 +493,7 @@ def sync_gradients_bucketed(
         reduced = exchange(
             [leaves[i] for i in idxs], schedule, reduce_flat,
             barriers=cfg.barriers,
+            axis=mean_over[0] if len(mean_over) == 1 else tuple(mean_over),
         )
         for i, t in zip(idxs, reduced):
             out[i] = t

@@ -94,13 +94,22 @@ def jax_version() -> str:
 def make_key(signature: Any,
              topo_spec: Optional[str] = None,
              jaxver: Optional[str] = None,
-             knobs: Optional[str] = None) -> str:
-    """The store key: sha256 over the four identity components.
+             knobs: Optional[str] = None,
+             kind: str = "dense_grad") -> str:
+    """The store key: sha256 over the five identity components.
     ``signature`` is any deterministic hashable — canonically a
     :meth:`~horovod_tpu.sched.plan.BucketSchedule.signature` tuple
-    (``repr`` of nested int/str tuples is stable across processes)."""
+    (``repr`` of nested int/str tuples is stable across processes) or
+    an :meth:`~horovod_tpu.xir.ir.ExchangeProgram.signature`.
+
+    ``kind`` is the workload discriminator (``xir.KINDS``): two
+    different exchange shapes — say a dense-DP bucket schedule and a
+    MoE all_to_all program — that happen to produce equal payload
+    signatures must never share a DB entry, because their tuned
+    (bucket_bytes, wire, lowering) answers mean different things."""
     payload = json.dumps({
         "sig": repr(signature),
+        "kind": str(kind),
         "topo": topology_spec() if topo_spec is None else topo_spec,
         "jax": jax_version() if jaxver is None else jaxver,
         "knobs": knob_fingerprint() if knobs is None else knobs,

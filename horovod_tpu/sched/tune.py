@@ -111,6 +111,7 @@ class ScheduleTuner:
                  lowering_candidates=("flat", "hier"),
                  store="env",
                  store_key=None,
+                 store_kind="dense_grad",
                  **tuner_kwargs):
         self.tuner = FusionAutotuner(**tuner_kwargs)
         self._baseline: Optional[Dict[str, float]] = None
@@ -155,10 +156,14 @@ class ScheduleTuner:
         if store is not None and store_key is not None:
             from .store import make_key
 
+            # ``store_kind`` discriminates the workload in the DB key
+            # (xir.KINDS): a tuner scoring a MoE program must never
+            # collide with a dense-gradient schedule of equal payload
+            # signature.
             self._store_key = (
                 store_key if isinstance(store_key, str)
                 and len(store_key) == 64
-                else make_key(store_key)
+                else make_key(store_key, kind=store_kind)
             )
             entry = store.lookup(self._store_key)
             if entry is not None:

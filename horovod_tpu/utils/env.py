@@ -91,6 +91,18 @@ TOPO_PHASE_OVERHEAD_US = "TOPO_PHASE_OVERHEAD_US"
 TOPO_FIT = "TOPO_FIT"  # on (default) | off
 TOPO_FIT_MIN_OBS = "TOPO_FIT_MIN_OBS"  # observations before first fit
 TOPO_FIT_REFIT_EVERY = "TOPO_FIT_REFIT_EVERY"  # new obs between refits
+# Unified exchange IR (xir/): route every collective-shaped workload
+# (dense DP buckets, MoE all_to_all, Ulysses flips, sparse embedding
+# exchange, pipeline ppermute, FSDP RS+AG) through the explicit
+# plan->lower->execute pipeline.  off restores the direct-lax call
+# paths (bitwise identical).  See docs/exchange_ir.md.
+XIR = "XIR"  # on (default) | off
+# Wire format non-gradient IR workloads request (default off — an
+# explicit numerics opt-in, NOT inherited from HVD_TPU_SCHED_WIRE:
+# these ops move activations/embedding rows, not EF-compensated
+# gradients).  Shuffle-shaped ops (all_to_all/permute/sparse gather)
+# cap at bf16 — int8/fp8 requests downgrade to off for them.
+XIR_WIRE = "XIR_WIRE"
 # Persistent schedule autotuning database (sched/store.py): JSON file
 # recording converged (bucket_bytes, wire, lowering) per (schedule
 # signature, topology, jax version, knob fingerprint); ScheduleTuner

@@ -1,0 +1,348 @@
+"""Interpreter: run an exchange program by emitting phase primitives.
+
+The interpreter gives a lowered :class:`~horovod_tpu.xir.ir.ExchangeProgram`
+meaning inside a traced step: each op emits exactly the primitive the
+pre-IR call sites used —
+
+* ``wire="off"``, ``lowering="flat"`` → the stock ``lax`` collective
+  with identical arguments, so an IR-routed exchange is **bitwise
+  identical** to the direct call it replaced (the parity contract
+  tests/test_collective_matrix.py's XIR column pins);
+* ``wire="bf16"`` → the cast-around-the-wire scheme
+  (``sched/execute.bf16_wire``'s semantics, applied per op);
+* ``wire="int8"/"fp8"`` on reduce-shaped ops → the
+  ``ops/quantized.py`` phase primitives (with optional error
+  feedback);
+* ``lowering="hier"`` on reduce-shaped ops → the
+  ``topo/hierarchical.py`` ICI/DCN staging.
+
+Observability per program: the planned bytes land in the *existing*
+``sched.wire_bytes{wire=}`` and ``topo.dcn_bytes``/``topo.ici_bytes``
+families — labeled with ``kind=`` so MoE / Ulysses / sparse traffic
+reads as its own series instead of clobbering the dense-gradient
+gauges — plus ``xir.*`` counters and one timeline lane per workload
+kind (``MOE_EXCHANGE``, ``ULYSSES_EXCHANGE``, ...).  All recording
+happens at trace time, like the scheduler's own exchange metrics: the
+gauges describe the planned program, the device profiler owns the
+wall-clock attribution.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .. import metrics
+from ..exceptions import HorovodTpuError
+from ..utils import env
+from . import ir, lower as lower_mod
+
+# Trace-time enable override (the sched config-override pattern):
+# tests and in-script parity checks pin the engine without touching
+# the environment.
+_enabled_override: Optional[bool] = None
+
+
+def set_enabled_override(value: Optional[bool]) -> None:
+    global _enabled_override
+    _enabled_override = value
+
+
+def enabled() -> bool:
+    """Whether exchanges route through the IR (``HVD_TPU_XIR``, default
+    on).  Off restores every workload's direct-``lax`` call path —
+    bitwise identical by the interpreter's own contract, so the knob is
+    a triage lever, not a numerics one."""
+    if _enabled_override is not None:
+        return _enabled_override
+    return env.get_bool("XIR", True)
+
+
+def wire_request() -> str:
+    """The wire format non-gradient IR workloads request
+    (``HVD_TPU_XIR_WIRE``, default ``off``).  Deliberately NOT
+    inherited from ``HVD_TPU_SCHED_WIRE``: that knob compresses
+    *gradients* (error feedback absorbs the rounding); these ops move
+    activations and embedding rows, where compression is a separate
+    numerics decision.  Eligibility gating per op class still applies —
+    shuffle ops cap at bf16."""
+    raw = env.get_env("XIR_WIRE", "off") or "off"
+    w = raw.strip().lower()
+    if w in ("none", "0", "false", "no"):
+        w = "off"
+    if w == "e4m3":
+        w = "fp8"
+    if w not in ir.WIRE_CHOICES:
+        raise HorovodTpuError(
+            f"HVD_TPU_XIR_WIRE must be one of {ir.WIRE_CHOICES}, "
+            f"got {raw!r}"
+        )
+    return w
+
+
+def _axis_n(op: ir.ExchangeOp) -> int:
+    if op.groups is not None:
+        return len(op.groups[0])
+    if isinstance(op.axis, tuple):
+        n = 1
+        for a in op.axis:
+            n *= lax.axis_size(a)
+        return n
+    return lax.axis_size(op.axis)
+
+
+def _bf16_around(x: jax.Array, run) -> jax.Array:
+    if not jnp.issubdtype(x.dtype, jnp.floating) or x.dtype == jnp.bfloat16:
+        return run(x)
+    return run(x.astype(jnp.bfloat16)).astype(x.dtype)
+
+
+def _run_all_reduce(op: ir.ExchangeOp, x: jax.Array, residual=None):
+    from ..ops.traced import Average, Sum
+
+    mean = (op.attr("reduce") or "sum") == "mean"
+    red = Average if mean else Sum
+    if op.lowering == "hier":
+        from ..topo import hierarchical_all_reduce
+
+        return hierarchical_all_reduce(x, op.axis, op=red, wire=op.wire)
+    if op.wire in ("int8", "fp8"):
+        if op.ef and residual is not None:
+            from ..ops.quantized import quantized_allreduce_ef
+
+            return quantized_allreduce_ef(
+                x, residual, op.axis, op=red, wire=op.wire
+            )
+        from ..ops.quantized import quantized_allreduce
+
+        return quantized_allreduce(
+            x, op.axis, op=red, wire=op.wire,
+            groups=[list(g) for g in op.groups] if op.groups else None,
+        ).astype(x.dtype)
+
+    def dense(v):
+        if op.groups is not None:
+            from ..ops.traced import _grouped_sum
+
+            y = _grouped_sum(
+                v, op.axis, [list(g) for g in op.groups],
+                len(op.groups[0]),
+            )
+        elif isinstance(op.axis, tuple):
+            y = lax.psum(v, op.axis)
+        else:
+            y = lax.psum(v, op.axis)
+        return y / _axis_n(op) if mean else y
+
+    if op.wire == "bf16":
+        return _bf16_around(x, dense)
+    return dense(x)
+
+
+def _run_reduce_scatter(op: ir.ExchangeOp, x: jax.Array):
+    from ..ops.traced import Average, Sum
+
+    mean = (op.attr("reduce") or "sum") == "mean"
+    red = Average if mean else Sum
+    if op.lowering == "hier":
+        from ..topo import hierarchical_reduce_scatter
+
+        return hierarchical_reduce_scatter(
+            x, op.axis, op=red, wire=op.wire
+        )
+    if op.wire in ("int8", "fp8"):
+        from ..ops.quantized import quantized_reduce_scatter
+
+        out = quantized_reduce_scatter(
+            x, op.axis, op=red, wire=op.wire,
+            groups=[list(g) for g in op.groups] if op.groups else None,
+        )
+        return out.astype(x.dtype) if hasattr(out, "astype") else out
+    n = _axis_n(op)
+    if x.shape[0] % n != 0:
+        raise HorovodTpuError(
+            f"reduce_scatter payload of {x.shape[0]} rows does not "
+            f"divide over {n} participants; pad before building the op"
+        )
+
+    def dense(v):
+        shard = lax.psum_scatter(
+            v, op.axis, scatter_dimension=0, tiled=True,
+            axis_index_groups=(
+                [list(g) for g in op.groups] if op.groups else None
+            ),
+        )
+        return shard / n if mean else shard
+
+    if op.wire == "bf16":
+        return _bf16_around(x, dense)
+    return dense(x)
+
+
+def _run_all_gather(op: ir.ExchangeOp, x: jax.Array):
+    if op.lowering == "hier":
+        from ..topo import hierarchical_all_gather
+
+        return hierarchical_all_gather(x, op.axis, wire=op.wire)
+    if op.wire in ("int8", "fp8"):
+        from ..ops.quantized import quantized_all_gather
+
+        return quantized_all_gather(
+            x, op.axis, wire=op.wire,
+            groups=[list(g) for g in op.groups] if op.groups else None,
+        ).astype(x.dtype)
+
+    def dense(v):
+        return lax.all_gather(
+            v, op.axis, tiled=True,
+            axis_index_groups=(
+                [list(g) for g in op.groups] if op.groups else None
+            ),
+        )
+
+    if op.wire == "bf16":
+        return _bf16_around(x, dense)
+    return dense(x)
+
+
+def _run_all_to_all(op: ir.ExchangeOp, x: jax.Array):
+    split = int(op.attr("split_axis"))
+    concat = int(op.attr("concat_axis"))
+
+    def dense(v):
+        return lax.all_to_all(
+            v, op.axis, split_axis=split, concat_axis=concat, tiled=True,
+            axis_index_groups=(
+                [list(g) for g in op.groups] if op.groups else None
+            ),
+        )
+
+    if op.wire == "bf16":
+        return _bf16_around(x, dense)
+    return dense(x)
+
+
+def _run_permute(op: ir.ExchangeOp, x: jax.Array):
+    perm = [tuple(p) for p in (op.attr("perm") or ())]
+
+    def dense(v):
+        return lax.ppermute(v, op.axis, perm)
+
+    if op.wire == "bf16":
+        return _bf16_around(x, dense)
+    return dense(x)
+
+
+def _run_gather_sparse(op: ir.ExchangeOp, x, process_set=None):
+    """x = (indices, values); returns the gathered pair, same order of
+    collectives as the pre-IR ``sparse_allreduce`` (indices first)."""
+    from ..ops import traced
+
+    indices, values = x
+    idx = traced.allgather(indices, axis=op.axis, process_set=process_set)
+    if op.wire == "bf16":
+        vals = _bf16_around(
+            values,
+            lambda v: traced.allgather(
+                v, axis=op.axis, process_set=process_set
+            ),
+        )
+    else:
+        vals = traced.allgather(
+            values, axis=op.axis, process_set=process_set
+        )
+    return idx, vals
+
+
+_RUNNERS = {
+    "all_reduce": _run_all_reduce,
+    "reduce_scatter": _run_reduce_scatter,
+    "all_gather": _run_all_gather,
+    "all_to_all": _run_all_to_all,
+    "permute": _run_permute,
+}
+
+
+def run_op(op: ir.ExchangeOp, x, *, process_set=None, residual=None):
+    """Execute one lowered op on its payload.  ``process_set`` feeds
+    the sparse gather (the op's signature carries only the rank tuple);
+    ``residual`` engages error feedback on EF-eligible reduce ops
+    (the call then returns ``(out, new_residual)``)."""
+    if op.lowering == "auto":
+        op = op.replace(lowering=lower_mod.resolve_lowering(op))
+    if op.op == "gather_dense_from_sparse":
+        return _run_gather_sparse(op, x, process_set=process_set)
+    if op.op == "all_reduce":
+        return _run_all_reduce(op, x, residual=residual)
+    return _RUNNERS[op.op](op, x)
+
+
+def account(program: ir.ExchangeProgram,
+            axis_size: Optional[int] = None,
+            timeline: Any = None) -> None:
+    """Publish one program's planned traffic: ``xir.*`` counters, the
+    kind-labeled ``sched.wire_bytes{wire=,kind=}`` +
+    ``topo.dcn_bytes{kind=}``/``topo.ici_bytes{kind=}`` gauge series,
+    the shared ``topo.*_bytes_total`` running counters, and one
+    timeline-lane event per op (lane = ``<KIND>_EXCHANGE``)."""
+    per_wire, net = lower_mod.program_bytes(program, axis_size)
+    kind = program.kind
+    metrics.inc_counter("xir.programs")
+    metrics.inc_counter(f"xir.programs.{kind}")
+    metrics.inc_counter("xir.ops", len(program.ops))
+    for w, nbytes in per_wire.items():
+        metrics.set_gauge(
+            "sched.wire_bytes", nbytes, {"wire": w, "kind": kind}
+        )
+        metrics.inc_counter(f"sched.wire_bytes.{w}", nbytes)
+    metrics.set_gauge("topo.dcn_bytes", net["dcn"], {"kind": kind})
+    metrics.set_gauge("topo.ici_bytes", net["ici"], {"kind": kind})
+    metrics.inc_counter("topo.dcn_bytes_total", net["dcn"])
+    metrics.inc_counter("topo.ici_bytes_total", net["ici"])
+    if timeline is None:
+        from ..runtime import get_runtime_or_none
+
+        rt = get_runtime_or_none()
+        timeline = rt.timeline if rt is not None else None
+    if timeline is not None:
+        lane = f"{kind.upper()}_EXCHANGE"
+        for op in program.ops:
+            timeline.record_op(
+                f"{op.op}{op.bucket}[wire={op.wire},"
+                f"lower={op.lowering}]",
+                lane, lower_mod.op_wire_nbytes(op),
+            )
+
+
+def execute(program: ir.ExchangeProgram,
+            args: Sequence[Any],
+            *,
+            axis_size: Optional[int] = None,
+            process_set=None,
+            store: bool = True) -> List[Any]:
+    """Lower (if needed) and run a program: op *i* consumes ``args[i]``
+    and produces output *i*.  The standalone entry point the non-
+    gradient workloads use — the bucketed dense-gradient path drives
+    the interpreter through ``sched/execute.py`` instead (its payloads
+    interleave with backward compute and EF state)."""
+    if len(args) != len(program.ops):
+        raise HorovodTpuError(
+            f"program has {len(program.ops)} ops but {len(args)} "
+            "payloads were passed"
+        )
+    if not program.lowered:
+        program = lower_mod.lower(program, axis_size, store=store)
+    elif store:
+        program = lower_mod._store_sync(program)
+    account(program, axis_size)
+    outs = []
+    for op, x in zip(program.ops, args):
+        with jax.named_scope(
+            f"hvd_xir_{program.kind}_{op.op}{op.bucket}_{op.wire}"
+            f"_{op.lowering}"
+        ):
+            outs.append(run_op(op, x, process_set=process_set))
+    return outs

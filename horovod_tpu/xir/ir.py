@@ -1,0 +1,312 @@
+"""The exchange IR: every collective-shaped transfer as explicit data.
+
+Horovod's core architectural bet is that *all* communication flows
+through one fusion/scheduling engine (arXiv:1802.05799 §4: tensor
+fusion, response cache, cycle dispatch).  Before this module, our
+reproduction honored that bet only for dense DP gradients — the
+``sched/`` pipeline's (bucket, wire, lowering, groups) tuple was
+implicit in ``Bucket`` fields and ``execute.py`` control flow, and the
+other collective-shaped workloads (MoE all_to_all, Ulysses head/seq
+flips, sparse embedding exchange, pipeline ppermute, FSDP RS+AG)
+called raw ``lax`` and bypassed the quantized wire, the hierarchical
+lowering, and the persistent tuner.
+
+An :class:`ExchangeProgram` makes the tuple explicit: an ordered list
+of :class:`ExchangeOp` records, each naming *what* moves (op +
+payload attrs), *where* (axis / replica groups), and *how* (wire
+format, lowering, bucket id, error-feedback eligibility).  The program
+is pure metadata — hashable, deterministic across SPMD ranks, and
+usable as a tuner/store key — and is given meaning by two passes:
+
+* ``lower.py`` resolves ``lowering="auto"`` against the topology cost
+  model and downgrades wire requests per op-class eligibility;
+* ``interp.py`` emits the existing phase primitives
+  (``ops/quantized.py``, ``topo/hierarchical.py``, stock ``lax``) and
+  accounts bytes/lanes in the metrics registry.
+
+Op set (``OPS``): ``all_reduce``, ``reduce_scatter``, ``all_gather``,
+``all_to_all``, ``permute``, ``gather_dense_from_sparse``.  See
+docs/exchange_ir.md for attribute semantics and the per-workload
+interaction table.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+from ..exceptions import HorovodTpuError
+
+OPS = (
+    "all_reduce",
+    "reduce_scatter",
+    "all_gather",
+    "all_to_all",
+    "permute",
+    "gather_dense_from_sparse",
+)
+
+# Wire formats an op may request (same vocabulary as the scheduler's
+# plan stage).  Eligibility is per op class — see ``eligible_wire``.
+WIRE_CHOICES = ("off", "bf16", "int8", "fp8")
+
+# Lowerings an op may carry.  "auto" is resolved by the lowering pass;
+# a lowered program contains only "flat"/"hier".
+LOWER_CHOICES = ("flat", "hier", "auto")
+
+# Ops the hierarchical (ICI/DCN two-level) lowering exists for.  The
+# shuffle-shaped ops (all_to_all / permute / sparse gather) have no
+# staged form — every element changes owner, so there is no 1/k shard
+# to ship across DCN — and always lower flat.
+REDUCE_OPS = ("all_reduce", "reduce_scatter", "all_gather")
+
+# Workload-kind discriminators programs are built with.  Free-form
+# strings are allowed (the kind folds into tuner/store keys and metric
+# labels); these are the ones the repo's own workloads use.
+KINDS = (
+    "dense_grad",   # sched/ bucketed DP gradient exchange
+    "moe",          # parallel/moe.py dispatch + combine all_to_all
+    "ulysses",      # parallel/ulysses.py head/sequence flips
+    "sparse_embed", # ops/sparse.py allgather-of-slices
+    "pipeline",     # parallel/pipeline.py stage-to-stage ppermute
+    "fsdp",         # optim/zero.py fsdp_train_step RS + AG
+)
+
+
+def _freeze(value: Any) -> Any:
+    """Recursively hashable form of an attribute value."""
+    if isinstance(value, dict):
+        return tuple(sorted((k, _freeze(v)) for k, v in value.items()))
+    if isinstance(value, (list, tuple)):
+        return tuple(_freeze(v) for v in value)
+    return value
+
+
+@dataclasses.dataclass(frozen=True)
+class ExchangeOp:
+    """One collective exchange: the explicit (what, where, how) record.
+
+    ``axis`` is a named mesh axis (or a 2-tuple of factored sub-axes
+    for the hierarchical addressing mode).  ``groups`` carries explicit
+    equal-size ``axis_index_groups`` (process-set subgroups); ``None``
+    means the whole axis.  ``bucket`` is the op's position in its
+    program's bucket order (the scheduler's bucket id).  ``ef`` marks
+    error-feedback eligibility — the interpreter only threads residuals
+    through ops that set it (quantized reduce-shaped ops; shuffle ops
+    are bit-moving and never carry EF).  ``attrs`` holds op-specific
+    payload metadata (``split_axis``/``concat_axis`` for all_to_all,
+    ``perm`` for permute, ``reduce`` ∈ {"sum", "mean"} for the
+    reduce-shaped ops, ``nbytes``/``dtype`` for byte accounting).
+    """
+
+    op: str
+    axis: Any
+    wire: str = "off"
+    lowering: str = "auto"
+    bucket: int = 0
+    ef: bool = False
+    groups: Optional[Tuple[Tuple[int, ...], ...]] = None
+    attrs: Tuple[Tuple[str, Any], ...] = ()
+
+    def __post_init__(self):
+        if self.op not in OPS:
+            raise HorovodTpuError(
+                f"unknown exchange op {self.op!r}; expected one of {OPS}"
+            )
+        if self.wire not in WIRE_CHOICES:
+            raise HorovodTpuError(
+                f"unknown wire {self.wire!r}; expected one of "
+                f"{WIRE_CHOICES}"
+            )
+        if self.lowering not in LOWER_CHOICES:
+            raise HorovodTpuError(
+                f"unknown lowering {self.lowering!r}; expected one of "
+                f"{LOWER_CHOICES}"
+            )
+        if self.groups is not None:
+            object.__setattr__(
+                self,
+                "groups",
+                tuple(tuple(int(i) for i in g) for g in self.groups),
+            )
+        object.__setattr__(
+            self,
+            "axis",
+            tuple(self.axis) if isinstance(self.axis, list) else self.axis,
+        )
+        object.__setattr__(self, "attrs", _freeze(dict(self.attrs)))
+
+    def attr(self, name: str, default: Any = None) -> Any:
+        for k, v in self.attrs:
+            if k == name:
+                return v
+        return default
+
+    def replace(self, **kw) -> "ExchangeOp":
+        if "attrs" not in kw:
+            return dataclasses.replace(self, **kw)
+        merged = dict(self.attrs)
+        merged.update(kw.pop("attrs"))
+        return dataclasses.replace(
+            self, attrs=tuple(sorted(merged.items())), **kw
+        )
+
+    def signature(self) -> Tuple:
+        return (
+            self.op, self.axis, self.wire, self.lowering, self.bucket,
+            self.ef, self.groups, self.attrs,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class ExchangeProgram:
+    """An ordered exchange plan for one workload.
+
+    ``kind`` is the workload discriminator — it labels metric series
+    and timeline lanes, and folds into the persistent tuner/store key
+    so two different exchange shapes with the same payload signature
+    never collide in the DB (``sched/store.py``).
+    """
+
+    kind: str
+    ops: Tuple[ExchangeOp, ...]
+
+    def __post_init__(self):
+        object.__setattr__(self, "ops", tuple(self.ops))
+
+    def __len__(self) -> int:
+        return len(self.ops)
+
+    def signature(self) -> Tuple:
+        """Hashable identity: equal signatures emit identical exchange
+        subgraphs (the determinism contract plan signatures already
+        carry, extended with the workload kind)."""
+        return (self.kind, tuple(op.signature() for op in self.ops))
+
+    @property
+    def lowered(self) -> bool:
+        return all(op.lowering != "auto" for op in self.ops)
+
+    def total_nbytes(self) -> int:
+        return sum(int(op.attr("nbytes") or 0) for op in self.ops)
+
+
+def eligible_wire(op: str, wire: str, dtype: Any = None) -> str:
+    """Downgrade a requested wire to what the op class supports.
+
+    Reduce-shaped ops accept the full menu (the quantized phase
+    primitives serve them); shuffle-shaped ops (all_to_all / permute /
+    sparse gather) move *values that must arrive exactly where they
+    were sent*, so the blockwise quantize→dequant round trip has no
+    accumulation to hide in — only the bf16 cast wire applies, and
+    int8/fp8 requests fall back to ``off`` (never a half-applied
+    quantization).  Non-floating payloads are always dense.
+    """
+    if wire == "off":
+        return wire
+    if wire not in WIRE_CHOICES:
+        raise HorovodTpuError(
+            f"unknown wire {wire!r}; expected one of {WIRE_CHOICES}"
+        )
+    if dtype is not None:
+        import jax.numpy as jnp
+
+        if not jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
+            return "off"
+        if wire == "bf16" and jnp.dtype(dtype) == jnp.bfloat16:
+            return "off"  # already on the bf16 wire; cast is a no-op
+    if op in REDUCE_OPS:
+        return wire
+    return "bf16" if wire == "bf16" else "off"
+
+
+# ------------------------------------------------------------ builders
+
+def _payload_attrs(nbytes: Optional[int], dtype: Any,
+                   extra: Dict[str, Any]) -> Tuple[Tuple[str, Any], ...]:
+    attrs = dict(extra)
+    if nbytes is not None:
+        attrs["nbytes"] = int(nbytes)
+    if dtype is not None:
+        attrs["dtype"] = str(dtype)
+    return tuple(sorted(attrs.items()))
+
+
+def all_reduce(axis, *, reduce: str = "sum", wire: str = "off",
+               lowering: str = "auto", bucket: int = 0, ef: bool = False,
+               groups=None, nbytes: Optional[int] = None,
+               dtype: Any = None) -> ExchangeOp:
+    return ExchangeOp(
+        "all_reduce", axis, wire=wire, lowering=lowering, bucket=bucket,
+        ef=ef, groups=groups,
+        attrs=_payload_attrs(nbytes, dtype, {"reduce": reduce}),
+    )
+
+
+def reduce_scatter(axis, *, reduce: str = "sum", wire: str = "off",
+                   lowering: str = "auto", bucket: int = 0,
+                   ef: bool = False, groups=None,
+                   nbytes: Optional[int] = None,
+                   dtype: Any = None) -> ExchangeOp:
+    return ExchangeOp(
+        "reduce_scatter", axis, wire=wire, lowering=lowering,
+        bucket=bucket, ef=ef, groups=groups,
+        attrs=_payload_attrs(nbytes, dtype, {"reduce": reduce}),
+    )
+
+
+def all_gather(axis, *, wire: str = "off", lowering: str = "auto",
+               bucket: int = 0, groups=None, nbytes: Optional[int] = None,
+               dtype: Any = None) -> ExchangeOp:
+    return ExchangeOp(
+        "all_gather", axis, wire=wire, lowering=lowering, bucket=bucket,
+        groups=groups, attrs=_payload_attrs(nbytes, dtype, {}),
+    )
+
+
+def all_to_all(axis, *, split_axis: int, concat_axis: int,
+               wire: str = "off", bucket: int = 0, groups=None,
+               nbytes: Optional[int] = None,
+               dtype: Any = None) -> ExchangeOp:
+    return ExchangeOp(
+        "all_to_all", axis, wire=wire, lowering="flat", bucket=bucket,
+        groups=groups,
+        attrs=_payload_attrs(nbytes, dtype, {
+            "split_axis": int(split_axis),
+            "concat_axis": int(concat_axis),
+        }),
+    )
+
+
+def permute(axis, perm: Sequence[Tuple[int, int]], *, wire: str = "off",
+            bucket: int = 0, nbytes: Optional[int] = None,
+            dtype: Any = None) -> ExchangeOp:
+    return ExchangeOp(
+        "permute", axis, wire=wire, lowering="flat", bucket=bucket,
+        attrs=_payload_attrs(nbytes, dtype, {
+            "perm": tuple((int(s), int(d)) for s, d in perm),
+        }),
+    )
+
+
+def gather_dense_from_sparse(axis, *, wire: str = "off", bucket: int = 0,
+                             set_ranks: Optional[Sequence[int]] = None,
+                             nbytes: Optional[int] = None,
+                             dtype: Any = None) -> ExchangeOp:
+    """The sparse embedding exchange: allgather of (indices, values)
+    slices (the reference's IndexedSlices lowering,
+    ``tensorflow/__init__.py:95-162``).  The indices leg is always
+    dense int wire; a ``wire`` request applies to the values leg only.
+    ``set_ranks`` records a process-set restriction in the signature
+    (the runtime ``ProcessSet`` object is passed to the interpreter)."""
+    extra: Dict[str, Any] = {}
+    if set_ranks is not None:
+        extra["set_ranks"] = tuple(int(r) for r in set_ranks)
+    return ExchangeOp(
+        "gather_dense_from_sparse", axis, wire=wire, lowering="flat",
+        bucket=bucket, attrs=_payload_attrs(nbytes, dtype, extra),
+    )
+
+
+def program(kind: str, ops: Sequence[ExchangeOp]) -> ExchangeProgram:
+    return ExchangeProgram(kind=kind, ops=tuple(ops))

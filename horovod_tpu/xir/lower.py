@@ -1,0 +1,251 @@
+"""Lowering pass: resolve an exchange program against the topology.
+
+Turns a *requested* program (ops may carry ``lowering="auto"`` and any
+wire request) into an *executable* one:
+
+* ``lowering="auto"`` on reduce-shaped ops asks the topology cost
+  model (``topo.Topology.estimate_cost`` — the fitted coefficients
+  when a measured fit exists, ``topo/fit.py``) to pick flat vs hier
+  per op, exactly like the scheduler's per-bucket
+  :func:`~horovod_tpu.sched.plan.resolve_lowering`.  Shuffle-shaped
+  ops (all_to_all / permute / sparse gather) have no staged form and
+  always resolve flat.
+* wire requests downgrade through :func:`~horovod_tpu.xir.ir.eligible_wire`
+  (shuffle ops: bf16 or dense, never a half-applied quantization).
+* when a persistent schedule store is configured
+  (``HVD_TPU_TUNE_DB``), the lowered program is keyed in it —
+  :func:`tuner_key` folds the workload kind into the
+  ``sched/store.py`` key so MoE / Ulysses / sparse programs never
+  collide with dense-DP entries of the same payload signature.  A
+  stored winner's (wire, lowering) is adopted on hit; a miss records
+  the cost-model choice so the fleet-serving path
+  (``GET/POST /schedules``) can distribute it.
+
+The pass is pure metadata → metadata: same program + topology + knobs
+on every SPMD rank resolve identically (plan determinism).
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional, Tuple
+
+from .. import metrics
+from . import ir
+
+
+def tuner_key(program: ir.ExchangeProgram) -> str:
+    """Persistent-store key of a program: the schedule-store identity
+    (topology, jax version, knob fingerprint) over the program's
+    signature WITH its workload kind folded in."""
+    from ..sched.store import make_key
+
+    return make_key(program.signature(), kind=program.kind)
+
+
+def resolve_lowering(op: ir.ExchangeOp,
+                     axis_size: Optional[int] = None) -> str:
+    """Concrete lowering for one op: shuffle ops are always flat;
+    reduce ops honor a forced choice and ask the cost model under
+    "auto" (single-slice topologies and non-factorable axes resolve
+    flat, reproducing the pre-topology program exactly)."""
+    if op.op not in ir.REDUCE_OPS or op.groups is not None:
+        return "flat"
+    if op.lowering != "auto":
+        return op.lowering
+    from ..topo import model as topo_model
+
+    topo = topo_model.current()
+    if axis_size is None:
+        if isinstance(op.axis, tuple):
+            return "hier"  # factored sub-axes: the hierarchy is the axis
+        axis_size = topo.world
+    s, _ = topo.factor_axis(axis_size)
+    if s == 1:
+        return "flat"
+    nbytes = int(op.attr("nbytes") or 0)
+    collective = op.op if op.op in ("reduce_scatter", "all_gather") \
+        else "all_reduce"
+    return topo.choose_lowering(collective, nbytes, axis_size)
+
+
+# One store lookup/record per distinct lowered program per process:
+# tracing re-runs per jit compile, and the JSON store should not be
+# re-read (or re-written) on every trace.
+_seen_lock = threading.Lock()
+_seen_keys: Dict[str, Dict] = {}
+
+
+def reset() -> None:
+    """Drop the per-process store-sync memo (tests)."""
+    with _seen_lock:
+        _seen_keys.clear()
+
+
+def _store_sync(program: ir.ExchangeProgram) -> ir.ExchangeProgram:
+    """Key the lowered program in the persistent tune DB.
+
+    Hit: adopt the stored (wire, lowering) — a converged tuner (or a
+    fleet peer) already explored this exchange shape.  Miss: record the
+    lowering pass's own choice with a zero score so the entry exists
+    for the tuner/fleet to improve (``ScheduleStore.record`` keeps
+    best-by-score, so a real tuned score always wins over this seed).
+    No store configured → identity.
+    """
+    from ..sched.store import ScheduleStore
+
+    store = ScheduleStore.from_env()
+    if store is None or not program.ops:
+        return program
+    key = tuner_key(program)
+    with _seen_lock:
+        cached = _seen_keys.get(key)
+    if cached is not None:
+        entry = cached
+    else:
+        entry = store.lookup(key)
+        if entry is None:
+            lead = program.ops[0]
+            entry = store.record(
+                key,
+                bucket_bytes=program.total_nbytes(),
+                wire=lead.wire,
+                lowering=lead.lowering,
+                score=0.0,
+                meta={"kind": program.kind, "ops": len(program.ops)},
+            )
+            metrics.inc_counter("xir.db_seeded")
+        else:
+            metrics.inc_counter("xir.db_hit")
+        with _seen_lock:
+            _seen_keys[key] = entry
+    wire = str(entry.get("wire", "off"))
+    lowering = str(entry.get("lowering", "flat"))
+    if wire not in ir.WIRE_CHOICES:
+        wire = "off"
+    if lowering not in ("flat", "hier"):
+        lowering = "flat"
+    ops = []
+    for op in program.ops:
+        new_wire = ir.eligible_wire(op.op, wire, op.attr("dtype"))
+        new_lower = lowering if (
+            op.op in ir.REDUCE_OPS and op.groups is None
+        ) else "flat"
+        ops.append(op.replace(wire=new_wire, lowering=new_lower))
+    return ir.program(program.kind, ops)
+
+
+def lower(program: ir.ExchangeProgram,
+          axis_size: Optional[int] = None,
+          store: bool = True) -> ir.ExchangeProgram:
+    """Resolve a requested program into an executable one (see module
+    docstring).  ``axis_size`` sizes the reduction axis for the cost
+    model when known at plan time (``None`` prices the full world).
+    ``store=False`` skips the persistent-DB sync (the dense-gradient
+    path owns its own store handshake through ``ScheduleTuner``)."""
+    ops = []
+    for op in program.ops:
+        wire = ir.eligible_wire(op.op, op.wire, op.attr("dtype"))
+        lowering = resolve_lowering(op, axis_size)
+        ops.append(op.replace(wire=wire, lowering=lowering))
+    lowered = ir.program(program.kind, ops)
+    if store:
+        lowered = _store_sync(lowered)
+    return lowered
+
+
+# ------------------------------------------------------- byte models
+
+def op_wire_nbytes(op: ir.ExchangeOp) -> int:
+    """One-phase wire payload bytes of an op under its wire format —
+    the same apples-to-apples convention as
+    :func:`~horovod_tpu.sched.plan.wire_bytes` (dense bytes for
+    ``off``, 2 B/elem for ``bf16``, 1 B/elem + fp32 block scales for
+    the quantized formats)."""
+    nbytes = int(op.attr("nbytes") or 0)
+    if op.wire == "off" or nbytes == 0:
+        return nbytes
+    import jax.numpy as jnp
+
+    dtype = op.attr("dtype") or "float32"
+    itemsize = jnp.dtype(dtype).itemsize
+    elems = nbytes // max(itemsize, 1)
+    if op.wire == "bf16":
+        return elems * 2
+    from ..ops.quantized import quant_block
+
+    block = quant_block()
+    return elems + 4 * (-(-elems // block))
+
+
+def op_network_bytes(op: ir.ExchangeOp,
+                     axis_size: Optional[int] = None) -> Dict[str, int]:
+    """Per-rank wire bytes of one op split by network class
+    (``{"dcn": ..., "ici": ...}``), pricing the op's *wire* payload.
+
+    Reduce-shaped ops reuse the topology ring convention
+    (:meth:`~horovod_tpu.topo.model.Topology.lowering_bytes`).  The
+    shuffle ops get their own models: an all_to_all of a local buffer
+    ``B`` over ``n`` ranks sends ``B/n`` to each of the ``n−1`` peers —
+    ``k−1`` of them share the slice (ICI), ``n−k`` do not (DCN); a
+    permute ships the whole buffer to exactly one peer, DCN when the
+    (src, dst) pair crosses a slice boundary.  Explicit subgroups are
+    priced ICI-only (they tile inside their groups).
+    """
+    from ..topo import model as topo_model
+
+    topo = topo_model.current()
+    wire_nbytes = op_wire_nbytes(op)
+    if wire_nbytes <= 0:
+        return {"dcn": 0, "ici": 0}
+    if op.groups is not None:
+        n = len(op.groups[0])
+    elif axis_size is not None:
+        n = axis_size
+    else:
+        n = int(op.attr("axis_size") or topo.world)
+    if n <= 1:
+        return {"dcn": 0, "ici": 0}
+    if op.op in ir.REDUCE_OPS:
+        if op.groups is not None:
+            moved = (2.0 if op.op == "all_reduce" else 1.0) \
+                * wire_nbytes * (n - 1) / n
+            return {"dcn": 0, "ici": int(moved)}
+        return topo.lowering_bytes(op.op, wire_nbytes, op.lowering, n)
+    s, k = (1, n) if op.groups is not None else topo.factor_axis(n)
+    if op.op == "permute":
+        perm = op.attr("perm") or ()
+        pairs = len(perm) or 1
+        crossing = sum(1 for src, dst in perm if src // k != dst // k)
+        dcn = wire_nbytes * crossing / pairs
+        return {"dcn": int(dcn), "ici": int(wire_nbytes - dcn)}
+    if op.op == "gather_dense_from_sparse":
+        # allgather-of-slices: ring convention on the values payload.
+        moved = wire_nbytes * (n - 1) / n
+        if s == 1:
+            return {"dcn": 0, "ici": int(moved)}
+        return {
+            "dcn": int(wire_nbytes * (s - 1) / s),
+            "ici": int(wire_nbytes * (k - 1) / k),
+        }
+    # all_to_all
+    return {
+        "dcn": int(wire_nbytes * (n - k) / n),
+        "ici": int(wire_nbytes * (k - 1) / n),
+    }
+
+
+def program_bytes(program: ir.ExchangeProgram,
+                  axis_size: Optional[int] = None
+                  ) -> Tuple[Dict[str, int], Dict[str, int]]:
+    """Aggregate (per-wire payload bytes, per-network bytes) of one
+    lowered program — the numbers behind the ``sched.wire_bytes{wire=}``
+    and ``topo.dcn_bytes``/``topo.ici_bytes`` series."""
+    per_wire: Dict[str, int] = {}
+    net = {"dcn": 0, "ici": 0}
+    for op in program.ops:
+        per_wire[op.wire] = per_wire.get(op.wire, 0) + op_wire_nbytes(op)
+        by = op_network_bytes(op, axis_size)
+        net["dcn"] += by["dcn"]
+        net["ici"] += by["ici"]
+    return per_wire, net

@@ -456,6 +456,273 @@ class TestHierarchicalColumn:
             assert chosen["dcn"] <= flat["dcn"], b
 
 
+class TestXirColumn:
+    """Unified exchange IR column of the matrix: IR-routed MoE
+    dispatch/combine and Ulysses flips against the direct ``lax`` path
+    — bitwise on the f32 dense wire, 1e-6 on the bf16 wire (payloads
+    chosen bf16-representable: a shuffle has no accumulation, so the
+    cast round trip is exact) — on a 2x2 hybrid mesh, a simulated
+    2-slice topology, and process-set subgroups."""
+
+    @pytest.fixture(autouse=True)
+    def _clean(self):
+        from horovod_tpu import xir
+
+        yield
+        xir.set_enabled_override(None)
+
+    def _bf16_exact(self, shape, seed):
+        # integer-valued f32: exactly representable in bf16, so the
+        # bf16 wire's cast round trip changes nothing.
+        return np.random.RandomState(seed).randint(
+            -8, 9, shape
+        ).astype(np.float32)
+
+    def test_moe_dispatch_combine_2x2_mesh(self, hvd_module):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu import xir
+        from horovod_tpu.parallel import make_mesh
+        from horovod_tpu.parallel.moe import (
+            moe_alltoall_combine,
+            moe_alltoall_dispatch,
+        )
+
+        mesh = make_mesh(dp=2, ep=2, devices=jax.devices()[:4])
+        x = _data(np.float32, shape=(4, 4, 8), seed=30)  # per-dev [2,2,8]
+
+        def roundtrip(a):
+            buf = moe_alltoall_dispatch(a, "ep")
+            return moe_alltoall_combine(buf, "ep")
+
+        def direct(a):
+            buf = jax.lax.all_to_all(a, "ep", split_axis=0,
+                                     concat_axis=1, tiled=True)
+            return jax.lax.all_to_all(buf, "ep", split_axis=1,
+                                      concat_axis=0, tiled=True)
+
+        def run(fn):
+            return np.asarray(jax.jit(jax.shard_map(
+                fn, mesh=mesh, in_specs=(P("dp", "ep"),),
+                out_specs=P("dp", "ep"), check_vma=False,
+            ))(x))
+
+        xir.set_enabled_override(True)
+        on = run(roundtrip)
+        xir.set_enabled_override(False)
+        off = run(roundtrip)
+        want = run(direct)
+        np.testing.assert_array_equal(on, want)
+        np.testing.assert_array_equal(off, want)
+
+    def test_moe_bf16_wire_2x2_mesh(self, hvd_module, monkeypatch):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu import xir
+        from horovod_tpu.parallel import make_mesh
+        from horovod_tpu.parallel.moe import moe_alltoall_dispatch
+
+        mesh = make_mesh(dp=2, ep=2, devices=jax.devices()[:4])
+        x = self._bf16_exact((4, 4, 8), seed=31)  # per-dev [2,2,8]
+
+        def run(fn):
+            return np.asarray(jax.jit(jax.shard_map(
+                fn, mesh=mesh, in_specs=(P("dp", "ep"),),
+                out_specs=P("dp", "ep"), check_vma=False,
+            ))(x))
+
+        want = run(lambda a: jax.lax.all_to_all(
+            a, "ep", split_axis=0, concat_axis=1, tiled=True))
+        monkeypatch.setenv("HVD_TPU_XIR_WIRE", "bf16")
+        xir.set_enabled_override(True)
+        got = run(lambda a: moe_alltoall_dispatch(a, "ep"))
+        np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+
+    def test_moe_two_slice_world_with_byte_gauges(self, hvd_module,
+                                                  monkeypatch):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu import metrics, topo, xir
+        from horovod_tpu.parallel.moe import moe_alltoall_dispatch
+        from horovod_tpu.runtime import WORLD_AXIS
+
+        monkeypatch.setenv("HVD_TPU_TOPO", "2x4")
+        topo.reset()
+        try:
+            x = _data(np.float32, shape=(64, 3), seed=32)
+
+            def run(fn):
+                return np.asarray(jax.jit(jax.shard_map(
+                    fn, mesh=hvd.mesh(), in_specs=(P(WORLD_AXIS),),
+                    out_specs=P(WORLD_AXIS), check_vma=False,
+                ))(x))
+
+            want = run(lambda a: jax.lax.all_to_all(
+                a, WORLD_AXIS, split_axis=0, concat_axis=1, tiled=True))
+            xir.set_enabled_override(True)
+            got = run(lambda a: moe_alltoall_dispatch(a, WORLD_AXIS))
+            np.testing.assert_array_equal(got, want)
+            # the previously-invisible a2a traffic, split by network
+            assert metrics.get_gauge(
+                "topo.dcn_bytes", {"kind": "moe"}
+            ) > 0
+            assert metrics.get_gauge(
+                "topo.ici_bytes", {"kind": "moe"}
+            ) > 0
+        finally:
+            topo.reset()
+
+    @pytest.mark.parametrize("wire", ["off", "bf16"], ids=str)
+    def test_ulysses_flips_2x2_mesh(self, hvd_module, monkeypatch, wire):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu import xir
+        from horovod_tpu.parallel import make_mesh
+        from horovod_tpu.parallel.ulysses import ulysses_attention
+
+        mesh = make_mesh(dp=2, sp=2, devices=jax.devices()[:4])
+        # [dev-sharded B, T_loc, H, D]; integer-valued for the bf16 leg
+        q = self._bf16_exact((4, 2, 4, 2), seed=33)
+        passthrough = lambda qq, kk, vv, causal=False: qq
+
+        def ul(a):
+            return ulysses_attention(
+                a, a, a, axis="sp", attn_fn=passthrough
+            )
+
+        def run(fn):
+            return np.asarray(jax.jit(jax.shard_map(
+                fn, mesh=mesh, in_specs=(P("dp", "sp"),),
+                out_specs=P("dp", "sp"), check_vma=False,
+            ))(q))
+
+        def direct(a):
+            h = jax.lax.all_to_all(a, "sp", split_axis=2, concat_axis=1,
+                                   tiled=True)
+            return jax.lax.all_to_all(h, "sp", split_axis=1,
+                                      concat_axis=2, tiled=True)
+
+        want = run(direct)
+        monkeypatch.setenv("HVD_TPU_XIR_WIRE", wire)
+        xir.set_enabled_override(True)
+        on = run(ul)
+        xir.set_enabled_override(False)
+        off = run(ul)
+        if wire == "off":
+            np.testing.assert_array_equal(on, want)
+        else:
+            np.testing.assert_allclose(on, want, rtol=1e-6, atol=1e-6)
+        np.testing.assert_array_equal(off, want)
+
+    def test_ulysses_two_slice_full_attention(self, hvd_module,
+                                              monkeypatch):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu import topo, xir
+        from horovod_tpu.parallel.ulysses import ulysses_attention
+        from horovod_tpu.runtime import WORLD_AXIS
+
+        monkeypatch.setenv("HVD_TPU_TOPO", "2x4")
+        topo.reset()
+        try:
+            q = _data(np.float32, shape=(16, 4, 16, 2), seed=34)
+
+            def ul(a):
+                return ulysses_attention(a, a, a, axis=WORLD_AXIS)
+
+            def run():
+                return np.asarray(jax.jit(jax.shard_map(
+                    ul, mesh=hvd.mesh(), in_specs=(P(WORLD_AXIS),),
+                    out_specs=P(WORLD_AXIS), check_vma=False,
+                ))(q))
+
+            xir.set_enabled_override(True)
+            on = run()
+            xir.set_enabled_override(False)
+            off = run()
+            np.testing.assert_array_equal(on, off)
+        finally:
+            topo.reset()
+
+    def test_alltoall_process_set_subgroups(self, hvd_module):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu import xir
+        from horovod_tpu.process_sets import tiling_groups
+        from horovod_tpu.runtime import WORLD_AXIS
+
+        groups = tiling_groups(range(4), N)  # [[0..3], [4..7]]
+        x = _data(np.float32, shape=(32, 3), seed=35)
+
+        def via_ir(a):
+            op = xir.all_to_all(
+                WORLD_AXIS, split_axis=0, concat_axis=1,
+                groups=groups, nbytes=a.size * 4, dtype=a.dtype,
+            )
+            return xir.execute(
+                xir.program("moe", [op]), [a], store=False
+            )[0]
+
+        def direct(a):
+            return jax.lax.all_to_all(
+                a, WORLD_AXIS, split_axis=0, concat_axis=1, tiled=True,
+                axis_index_groups=[list(g) for g in groups],
+            )
+
+        def run(fn):
+            return np.asarray(jax.jit(jax.shard_map(
+                fn, mesh=hvd.mesh(), in_specs=(P(WORLD_AXIS),),
+                out_specs=P(WORLD_AXIS), check_vma=False,
+            ))(x))
+
+        np.testing.assert_array_equal(run(via_ir), run(direct))
+
+    def test_sparse_exchange_process_set(self, hvd_module, monkeypatch):
+        """IR-routed sparse embedding exchange over a process-set
+        subgroup: identical to the direct allgather-of-slices path."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu import xir
+        from horovod_tpu.ops.sparse import IndexedSlices, sparse_allreduce
+        from horovod_tpu.runtime import WORLD_AXIS
+
+        monkeypatch.setenv("HVD_TPU_DYNAMIC_PROCESS_SETS", "1")
+        ps = hvd.add_process_set([0, 1, 2, 3])
+        try:
+            idx = np.tile(np.arange(4, dtype=np.int32), N)
+            vals = _data(np.float32, shape=(N * 4, 3), seed=36)
+
+            def sp(i, v):
+                out = sparse_allreduce(
+                    IndexedSlices(i, v, (16, 3)), axis=WORLD_AXIS,
+                    process_set=ps,
+                )
+                return out.values
+
+            def run():
+                return np.asarray(jax.jit(jax.shard_map(
+                    sp, mesh=hvd.mesh(),
+                    in_specs=(P(WORLD_AXIS), P(WORLD_AXIS)),
+                    out_specs=P(WORLD_AXIS), check_vma=False,
+                ))(idx, vals))
+
+            xir.set_enabled_override(True)
+            on = run()
+            xir.set_enabled_override(False)
+            off = run()
+            np.testing.assert_array_equal(on, off)
+        finally:
+            xir.set_enabled_override(None)
+            hvd.remove_process_set(ps)
+
+
 class TestGroupFusionKnob:
     def test_disable_group_fusion_matches_fused(self, hvd_module,
                                                 monkeypatch):
