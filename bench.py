@@ -458,6 +458,7 @@ def main():
             result["gpt2_small"] = {"error": f"{type(e).__name__}: {e}"}
     _maybe_scaling(result, deadline_s, t_start)
     _maybe_topo(result, deadline_s, t_start)
+    _maybe_quant_backend(result, deadline_s, t_start)
     print(json.dumps(result))
 
 
@@ -554,6 +555,57 @@ def _maybe_topo(result: dict, deadline_s: float, t_start: float) -> None:
         )
     except Exception as e:
         result["topo_hier_vs_flat"] = {"error": f"{type(e).__name__}: {e}"}
+
+
+def _maybe_quant_backend(result: dict, deadline_s: float,
+                         t_start: float) -> None:
+    """Append the ``quant_fused_vs_phase`` record (HVD_BENCH_QUANT=0
+    skips): the int8 wire under the phase vs fused
+    (``HVD_TPU_QUANT_BACKEND``) backends on the simulated 2-slice
+    mesh, run by ``tools/topo_bench.py --quant`` in a scrubbed
+    8-device CPU subprocess — per-bucket exchange wall time, wire
+    bytes, fused-path counters, and the phase/fused loss delta.
+    Structured-skip on probe/deadline failure like the topo record."""
+    import sys
+
+    if os.environ.get("HVD_BENCH_QUANT", "1") == "0":
+        return
+    if deadline_s - (time.monotonic() - t_start) < 75:
+        result["quant_fused_vs_phase"] = {
+            "error": "skipped: deadline too close"
+        }
+        return
+    try:
+        import subprocess as sp
+
+        repo = os.path.dirname(os.path.abspath(__file__))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + (
+            os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+        )
+        env["XLA_FLAGS"] = (
+            "--xla_force_host_platform_device_count=8 "
+            + env.get("XLA_FLAGS", "")
+        ).strip()
+        env["JAX_PLATFORMS"] = "cpu"
+        env.setdefault("HVD_TPU_TOPO", "2x4")
+        for key in ("JAX_PLATFORM_NAME", "PJRT_DEVICE",
+                    "TPU_LIBRARY_PATH", "PALLAS_AXON_POOL_IPS"):
+            env.pop(key, None)
+        out = sp.run(
+            [sys.executable, os.path.join(repo, "tools", "topo_bench.py"),
+             "--quant"],
+            capture_output=True, text=True, timeout=300, env=env, cwd=repo,
+        )
+        line = (out.stdout or "").strip().splitlines()
+        result["quant_fused_vs_phase"] = (
+            json.loads(line[-1]) if out.returncode == 0 and line
+            else {"error": f"rc={out.returncode}: {(out.stderr or '')[-300:]}"}
+        )
+    except Exception as e:
+        result["quant_fused_vs_phase"] = {
+            "error": f"{type(e).__name__}: {e}"
+        }
 
 
 # --- device-probe result cache (module level: tested directly) -------

@@ -101,6 +101,22 @@ def _scale_buffer_impl(x: jax.Array, scale, out_dtype_name: str) -> jax.Array:
     return out.reshape(-1)[:n].reshape(shape)
 
 
+def cast_buffer(x: jax.Array, dtype) -> jax.Array:
+    """``out = x.astype(dtype)`` as one VMEM-tiled kernel:
+    :func:`scale_buffer` with scale 1 (the cast half of the reference's
+    ``BatchedScaledD2DMemcpyCudaImpl``).  The bf16 cast wire routes its
+    down/up casts through this (``sched/execute.bf16_wire``,
+    ``xir/interp._bf16_around``) so the cast around a collective is a
+    single fused pass rather than separate astype + multiply HLOs;
+    values are identical to a plain ``astype`` (scale 1 is exact, and
+    the f32 staging round-trips f16/bf16 inputs losslessly).
+    Differentiable like :func:`scale_buffer`; identity when the dtype
+    already matches."""
+    if jnp.dtype(dtype) == jnp.dtype(x.dtype):
+        return x
+    return scale_buffer(x, 1.0, dtype)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(2,))
 def _scale_buffer_vjp(x, scale, out_dtype_name):
     return _scale_buffer_impl(x, scale, out_dtype_name)

@@ -81,11 +81,76 @@ WIRE_FORMATS = {
     "fp8": (jnp.float8_e4m3fn, 448.0),
 }
 
+# Wire backends (``HVD_TPU_QUANT_BACKEND``): "phase" is the stock-XLA
+# three-HLO pipeline below; "fused" lowers the same contract to the
+# Pallas transfer-loop kernels (ops/pallas_quant.py) — quantize /
+# remote-DMA / fp32 dequant-accumulate in one kernel per ICI hop, with
+# lax.ppermute standing in for the DMA off-TPU.  Same numerics contract
+# either way (one quantization per contribution); see
+# docs/quantization.md#wire-backends.
+BACKENDS = ("phase", "fused")
+
 
 def quant_block() -> int:
     """Quantization block size (``HVD_TPU_QUANT_BLOCK``, default 512)."""
     b = env.get_int("QUANT_BLOCK", BLOCK)
     return b if b > 0 else BLOCK
+
+
+def quant_backend() -> str:
+    """The active wire backend (``HVD_TPU_QUANT_BACKEND``, default
+    ``phase``)."""
+    return _canon_backend(env.get_env("QUANT_BACKEND", "phase"))
+
+
+def _canon_backend(backend: Optional[str]) -> str:
+    b = (backend or "phase").strip().lower()
+    if b in ("", "off", "0", "none", "xla"):
+        b = "phase"
+    if b in ("pallas", "ring"):
+        b = "fused"
+    if b not in BACKENDS:
+        raise QuantizedWireError(
+            f"HVD_TPU_QUANT_BACKEND must be one of {BACKENDS}, "
+            f"got {backend!r}"
+        )
+    return b
+
+
+def _fused_mode(groups, n: int, c: int, block: int, wire: str,
+                backend: Optional[str]) -> Optional[str]:
+    """Resolve the backend for one collective: the fused dispatch mode
+    string when the fused Pallas lowering serves it, else ``None`` (the
+    phase pipeline below runs).  An ineligible shape under
+    ``backend="fused"`` falls back to phase with a counter
+    (``quant.fused_fallback``) — never an error: the two backends are
+    interchangeable per bucket by contract."""
+    resolved = quant_backend() if backend is None \
+        else _canon_backend(backend)
+    if resolved != "fused":
+        return None
+    from . import pallas_quant
+
+    wire_nbytes = n * (c * wire_itemsize(wire) + 4 * (c // block))
+    mode = pallas_quant.dispatch_mode(groups, n, wire_nbytes)
+    if mode is None:
+        from .. import metrics
+
+        metrics.inc_counter("quant.fused_fallback")
+    return mode
+
+
+def _block_scale(amax: jax.Array, qmax: float):
+    """Per-block wire scale with the zero/non-finite guard applied in
+    ONE place (both backends and every call site share it): an all-zero
+    block gets a safe divisor of 1.0 — so quantize→dequant of a zero
+    block is exactly zero, never 0/0 — while a non-finite block gets a
+    NaN wire scale so the corruption PROPAGATES through dequantize
+    (silently zeroing inf/nan would defeat overflow-skip logic
+    downstream).  Returns ``(wire_scale, safe_divisor)``."""
+    finite = jnp.isfinite(amax)
+    safe = jnp.where(finite & (amax > 0), amax / qmax, 1.0)
+    return jnp.where(finite, safe, jnp.nan).astype(jnp.float32), safe
 
 
 def wire_itemsize(wire: str) -> int:
@@ -121,9 +186,7 @@ def _quantize_blocks(rows: jax.Array, wire: str = "int8",
     r, c = rows.shape
     b = rows.reshape(r, c // block, block).astype(jnp.float32)
     amax = jnp.max(jnp.abs(b), axis=-1)
-    finite = jnp.isfinite(amax)
-    safe = jnp.where(finite & (amax > 0), amax / qmax, 1.0)
-    scale = jnp.where(finite, safe, jnp.nan).astype(jnp.float32)
+    scale, safe = _block_scale(amax, qmax)
     scaled = b / safe[..., None]
     if wire == "int8":
         q = jnp.clip(jnp.round(scaled), -qmax, qmax)
@@ -207,6 +270,7 @@ def quantized_reduce_scatter(
     block: Optional[int] = None,
     ef: bool = False,
     groups=None,
+    backend: Optional[str] = None,
 ):
     """Reduce-scatter with a quantized wire: blockwise quantize →
     ``all_to_all`` of wire chunks + fp32 block scales → fp32
@@ -223,6 +287,12 @@ def quantized_reduce_scatter(
     ``x − dequant(quantize(x))`` in ``x``'s shape/dtype — the caller
     carries it in optimizer state and adds it to the next step's
     payload (``docs/quantization.md``).
+
+    ``backend`` (``HVD_TPU_QUANT_BACKEND``, default ``phase``):
+    ``"fused"`` lowers the same contract through the Pallas
+    transfer-loop kernels (ops/pallas_quant.py) — one quantization per
+    contribution either way, so the EF residual is bitwise identical
+    and the reduced shard matches up to fp32 summation order.
     """
     if op not in (Sum, Average):
         raise QuantizedWireError(
@@ -239,6 +309,24 @@ def quantized_reduce_scatter(
     if c * n != V:
         flat = jnp.pad(flat, (0, c * n - V))
     chunks = flat.reshape(n, c)
+
+    mode = _fused_mode(groups, n, c, block, wire, backend)
+    if mode is not None:
+        from . import pallas_quant
+
+        mine, deq = pallas_quant.fused_reduce_scatter(
+            chunks, axis, groups=groups, n=n, wire=wire, block=block,
+            want_deq=ef, mode=mode,
+        )
+        if op == Average:
+            mine = mine / n
+        if ef:
+            residual = (
+                (chunks.astype(jnp.float32) - deq)
+                .reshape(-1)[:V].reshape(shape).astype(dtype)
+            )
+            return mine, residual
+        return mine
 
     q, s = _quantize_blocks(chunks, wire, block)  # (n, c), (n, c/block)
     residual = None
@@ -272,6 +360,7 @@ def quantized_all_gather(
     wire: str = "int8",
     block: Optional[int] = None,
     groups=None,
+    backend: Optional[str] = None,
 ) -> jax.Array:
     """All-gather with a quantized wire: re-quantize this rank's fp32
     shard (a reduced gradient chunk, or a post-update parameter shard
@@ -283,7 +372,9 @@ def quantized_all_gather(
     construction for :func:`quantized_reduce_scatter` output; align
     your layout when gathering optimizer-update shards).  Returns the
     fp32 concatenation of every participant's shard, length
-    ``n * len(shard)``.
+    ``n * len(shard)``.  ``backend="fused"`` rides the Pallas ring
+    kernels — bitwise identical to phase here (the gather has no
+    accumulation, and the quantization grid is shared).
     """
     wire = _canon_wire(wire)
     if block is None:
@@ -296,6 +387,14 @@ def quantized_all_gather(
             f"quantized_all_gather shard length {c} is not a multiple "
             f"of the quantization block ({block}); align the shard "
             "layout (HVD_TPU_QUANT_BLOCK) before gathering"
+        )
+    mode = _fused_mode(groups, n, c, block, wire, backend)
+    if mode is not None:
+        from . import pallas_quant
+
+        return pallas_quant.fused_all_gather(
+            flat, axis, groups=groups, n=n, wire=wire, block=block,
+            mode=mode,
         )
     q, s = _quantize_blocks(flat[None], wire, block)
     qg = lax.all_gather(
@@ -318,6 +417,7 @@ def quantized_allreduce(
     wire: str = "int8",
     block: Optional[int] = None,
     groups=None,
+    backend: Optional[str] = None,
 ) -> jax.Array:
     """In-jit quantized-wire allreduce over a mesh axis: the two phase
     primitives composed.  Serves the global set, any process set that
@@ -330,12 +430,12 @@ def quantized_allreduce(
     V = x.size
     shard = quantized_reduce_scatter(
         x, axis, op=Sum, process_set=process_set, wire=wire, block=block,
-        groups=groups,
+        groups=groups, backend=backend,
     )
     _, n = _axis_groups(axis, process_set, groups)
     out = quantized_all_gather(
         shard, axis, process_set=process_set, wire=wire, block=block,
-        groups=groups,
+        groups=groups, backend=backend,
     )[:V]
     if op == Average:
         out = out / n
@@ -351,6 +451,7 @@ def quantized_allreduce_ef(
     *,
     wire: str = "int8",
     block: Optional[int] = None,
+    backend: Optional[str] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Error-feedback allreduce: quantize ``e = x + residual`` on the
     wire, return ``(allreduced(e), e − dequant(quantize(e)))``.  The new
@@ -360,11 +461,12 @@ def quantized_allreduce_ef(
     e = x.astype(jnp.float32) + residual.astype(jnp.float32)
     shard, r_new = quantized_reduce_scatter(
         e, axis, op=Sum, process_set=process_set, wire=wire, block=block,
-        ef=True,
+        ef=True, backend=backend,
     )
     _, n = _axis_groups(axis, process_set)
     out = quantized_all_gather(
-        shard, axis, process_set=process_set, wire=wire, block=block
+        shard, axis, process_set=process_set, wire=wire, block=block,
+        backend=backend,
     )[:V]
     if op == Average:
         out = out / n

@@ -265,13 +265,20 @@ def quantized_exchange_flat(
 def bf16_wire(reduce_dense: Callable[[jax.Array], jax.Array]):
     """Wrap a dense flat reducer with a bf16 cast around the wire (the
     per-bucket ``wire="bf16"`` lowering — same scheme as
-    ``Compression.bf16`` but chosen per bucket by the plan/tuner)."""
+    ``Compression.bf16`` but chosen per bucket by the plan/tuner).  The
+    casts run as single VMEM-tiled kernels
+    (``ops/pallas_kernels.cast_buffer``, the reference's ScaleBuffer
+    device kernel) instead of separate astype + multiply HLOs; values
+    are identical to a plain astype pair."""
 
     def reduce(f: jax.Array) -> jax.Array:
         if not jnp.issubdtype(f.dtype, jnp.floating) \
                 or f.dtype == jnp.bfloat16:
             return reduce_dense(f)
-        return reduce_dense(f.astype(jnp.bfloat16)).astype(f.dtype)
+        from ..ops.pallas_kernels import cast_buffer
+
+        return cast_buffer(reduce_dense(cast_buffer(f, jnp.bfloat16)),
+                           f.dtype)
 
     return reduce
 

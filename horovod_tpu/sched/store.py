@@ -58,15 +58,30 @@ def knob_fingerprint() -> str:
     """Stable digest of every ``HVD_TPU_SCHED*/WIRE*/TOPO*/QUANT*``
     env knob (and its legacy ``HOROVOD_`` spelling): two processes with
     the same fingerprint plan identical schedules from identical
-    metadata, so stored winners are only shared between them."""
+    metadata, so stored winners are only shared between them.
+
+    The *resolved* quantized-wire backend is folded in explicitly (not
+    just the raw env var): an unset ``HVD_TPU_QUANT_BACKEND`` and an
+    explicit ``phase`` mean the same schedules and must share entries,
+    while ``fused`` winners — whose exchange wall time has different
+    constants — must never collide with phase ones."""
     items = []
     for k in sorted(os.environ):
         for head in ("HVD_TPU_", "HOROVOD_"):
             if k.startswith(head):
                 tail = k[len(head):]
-                if tail.startswith(_KNOB_PREFIXES) and tail != "TUNE_DB":
+                # QUANT_BACKEND joins below in resolved form only, so
+                # "unset" and an explicit default spelling agree.
+                if (tail.startswith(_KNOB_PREFIXES)
+                        and tail not in ("TUNE_DB", "QUANT_BACKEND")):
                     items.append((k, os.environ[k]))
                 break
+    try:
+        from ..ops.quantized import quant_backend
+
+        items.append(("HVD_TPU_QUANT_BACKEND(resolved)", quant_backend()))
+    except Exception:
+        pass
     return hashlib.sha256(
         json.dumps(items, sort_keys=True).encode()
     ).hexdigest()[:16]

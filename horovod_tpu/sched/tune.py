@@ -30,6 +30,7 @@ import time
 from typing import Dict, Optional
 
 from .. import metrics
+from ..utils import env
 from ..utils.autotune import FusionAutotuner
 
 
@@ -109,6 +110,8 @@ class ScheduleTuner:
                  wire_min_bucket_bytes: int = 1 << 16,
                  explore_lowering: bool = False,
                  lowering_candidates=("flat", "hier"),
+                 explore_backend: bool = False,
+                 backend_candidates=("phase", "fused"),
                  store="env",
                  store_key=None,
                  store_kind="dense_grad",
@@ -120,6 +123,19 @@ class ScheduleTuner:
         self.wire_min_bucket_bytes = wire_min_bucket_bytes
         self._wire_scores: Dict[str, float] = {}
         self._wire_frozen: Optional[str] = None if explore_wire else "off"
+        # Quantized-wire backend exploration (HVD_TPU_QUANT_BACKEND as
+        # a tuned dimension): each window runs one candidate — the
+        # suggestion is applied process-wide via the env knob, since
+        # the backend resolves at trace time — scored from the same
+        # registry deltas; the winner freezes and is pinned into the
+        # environment.  "env" defers to the operator's knob (the
+        # default: not a tuned dimension).
+        self._explore_backend = explore_backend
+        self._backend_candidates = tuple(backend_candidates)
+        self._backend_scores: Dict[str, float] = {}
+        self._backend_frozen: Optional[str] = (
+            None if explore_backend else "env"
+        )
         # Lowering exploration (the HVD_TPU_TOPO_LOWER knob as a tuned
         # dimension): each window runs one candidate, scored from the
         # same registry deltas; the winner freezes.  On a single-slice
@@ -187,6 +203,13 @@ class ScheduleTuner:
             lowering if lowering in self._lowering_candidates + ("auto",)
             else "auto"
         )
+        backend = str((entry.get("meta") or {}).get("backend", ""))
+        if backend in self._backend_candidates:
+            self._backend_frozen = backend
+            if self._explore_backend:
+                env.set_env("QUANT_BACKEND", backend)
+        elif self._backend_frozen is None:
+            self._backend_frozen = "env"
         self._best_score = float(entry.get("score", 0.0))
         self._db_written = True  # a re-write would only echo the entry
         metrics.inc_counter("sched.tune.db_hit")
@@ -211,6 +234,7 @@ class ScheduleTuner:
             wire=self.wire(),
             lowering=self.lowering(),
             score=self._best_score,
+            meta={"backend": self.backend()},
         )
 
     @staticmethod
@@ -234,6 +258,25 @@ class ScheduleTuner:
                 return w
         return self._wire_frozen or "off"
 
+    def backend(self) -> str:
+        """Quantized-wire backend suggestion for the next window: the
+        next unscored candidate while exploring, the frozen winner
+        after, or the ``HVD_TPU_QUANT_BACKEND`` env knob when the
+        backend is not a tuned dimension.  Exploration applies the
+        suggestion through the env knob in :meth:`begin_window` —
+        the backend resolves at trace time, so the caller rebuilds its
+        step per window exactly as with wire exploration."""
+        if self._backend_frozen == "env":
+            from ..ops.quantized import quant_backend
+
+            return quant_backend()
+        if self._backend_frozen is not None:
+            return self._backend_frozen
+        for b in self._backend_candidates:
+            if b not in self._backend_scores:
+                return b
+        return "phase"
+
     def lowering(self) -> str:
         """Lowering suggestion for the next window
         (``build_schedule(..., lowering=...)``): the next unscored
@@ -251,6 +294,9 @@ class ScheduleTuner:
         # Prime the suggestion: FusionAutotuner only accepts an observe
         # for a threshold it suggested (suggest-before-observe contract).
         self.tuner.threshold_bytes()
+        if self._backend_frozen is None:
+            # backend candidates apply process-wide (trace-time knob)
+            env.set_env("QUANT_BACKEND", self.backend())
         self._baseline = registry_view()
 
     def end_window(self) -> float:
@@ -269,7 +315,25 @@ class ScheduleTuner:
         metrics.inc_counter("sched.tune_windows")
         metrics.set_gauge("sched.tune_score", score)
         self._best_score = max(self._best_score, score)
-        if self._lowering_frozen is None:
+        if self._backend_frozen is None:
+            b = self.backend()
+            self._backend_scores[b] = max(
+                self._backend_scores.get(b, 0.0), score
+            )
+            metrics.set_gauge(
+                "sched.tune_backend_score", score, {"backend": b}
+            )
+            if all(c in self._backend_scores
+                   for c in self._backend_candidates):
+                self._backend_frozen = max(
+                    self._backend_scores, key=self._backend_scores.get
+                )
+                env.set_env("QUANT_BACKEND", self._backend_frozen)
+                metrics.set_gauge(
+                    "sched.tune_backend_frozen", 1.0,
+                    {"backend": self._backend_frozen},
+                )
+        elif self._lowering_frozen is None:
             lo = self.lowering()
             self._lowering_scores[lo] = max(
                 self._lowering_scores.get(lo, 0.0), score
@@ -338,5 +402,6 @@ class ScheduleTuner:
         return (
             self._wire_frozen is not None
             and self._lowering_frozen is not None
+            and self._backend_frozen is not None
             and self.tuner.converged
         )

@@ -27,6 +27,15 @@ phases stay dense — bandwidth there is cheap, and the quantizer's
 all_to_all rides the same replica groups); ``wire="bf16"`` casts just
 the DCN hop.  A single-slice topology (or an axis that cannot factor)
 degenerates to the flat collective — bitwise-identical to today's path.
+
+The quantized-wire *backend* (``HVD_TPU_QUANT_BACKEND``) composes here
+unchanged: the quantized hop dispatches through ``ops/quantized.py``,
+whose fused Pallas lowering (``ops/pallas_quant.py``) serves it on the
+CPU test mesh (ppermute transport — fused==phase parity covers the
+hier column) and on single-slice/ICI rings on hardware, while a real
+cross-slice DCN hop falls back to the phase pipeline — the RDMA ring
+rides ICI links only, so on a TPU pod only the DCN hop stays phase and
+ICI-resident quantized collectives go fused.
 """
 
 from __future__ import annotations

@@ -67,6 +67,14 @@ SCHED_WIRE = "SCHED_WIRE"
 SCHED_WIRE_EF = "SCHED_WIRE_EF"
 # Elements per quantization block (fp32 scale granularity), default 512.
 QUANT_BLOCK = "QUANT_BLOCK"
+# Quantized-wire backend: "phase" (default; blockwise quantize ->
+# all_to_all of wire chunks + scales -> dequant-accumulate as separate
+# XLA HLOs) or "fused" (ops/pallas_quant.py Pallas ring kernels:
+# quantize / remote-DMA / fp32 dequant-accumulate in one kernel per ICI
+# hop, lax.ppermute standing in for the DMA off-TPU).  Same numerics
+# contract either way; participates in the tune-DB knob fingerprint so
+# fused and phase winners never collide.  See docs/quantization.md.
+QUANT_BACKEND = "QUANT_BACKEND"
 # Topology-aware hierarchical collectives (topo/): forced topology
 # spec — "SxK" / "SxK1xK2" (S slices of an ICI mesh) or a JSON object
 # ({"slices":2,"ici_shape":[2,2],...}) — for CPU tests and forced

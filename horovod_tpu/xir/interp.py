@@ -97,7 +97,13 @@ def _axis_n(op: ir.ExchangeOp) -> int:
 def _bf16_around(x: jax.Array, run) -> jax.Array:
     if not jnp.issubdtype(x.dtype, jnp.floating) or x.dtype == jnp.bfloat16:
         return run(x)
-    return run(x.astype(jnp.bfloat16)).astype(x.dtype)
+    # The down/up casts around the wire are single VMEM-tiled kernels
+    # (ops/pallas_kernels.cast_buffer — the reference's ScaleBuffer
+    # device kernel), not separate convert HLOs; values are identical
+    # to a plain astype pair.
+    from ..ops.pallas_kernels import cast_buffer
+
+    return cast_buffer(run(cast_buffer(x, jnp.bfloat16)), x.dtype)
 
 
 def _run_all_reduce(op: ir.ExchangeOp, x: jax.Array, residual=None):
@@ -114,13 +120,15 @@ def _run_all_reduce(op: ir.ExchangeOp, x: jax.Array, residual=None):
             from ..ops.quantized import quantized_allreduce_ef
 
             return quantized_allreduce_ef(
-                x, residual, op.axis, op=red, wire=op.wire
+                x, residual, op.axis, op=red, wire=op.wire,
+                backend=op.attr("qbackend"),
             )
         from ..ops.quantized import quantized_allreduce
 
         return quantized_allreduce(
             x, op.axis, op=red, wire=op.wire,
             groups=[list(g) for g in op.groups] if op.groups else None,
+            backend=op.attr("qbackend"),
         ).astype(x.dtype)
 
     def dense(v):
@@ -159,6 +167,7 @@ def _run_reduce_scatter(op: ir.ExchangeOp, x: jax.Array):
         out = quantized_reduce_scatter(
             x, op.axis, op=red, wire=op.wire,
             groups=[list(g) for g in op.groups] if op.groups else None,
+            backend=op.attr("qbackend"),
         )
         return out.astype(x.dtype) if hasattr(out, "astype") else out
     n = _axis_n(op)
@@ -193,6 +202,7 @@ def _run_all_gather(op: ir.ExchangeOp, x: jax.Array):
         return quantized_all_gather(
             x, op.axis, wire=op.wire,
             groups=[list(g) for g in op.groups] if op.groups else None,
+            backend=op.attr("qbackend"),
         ).astype(x.dtype)
 
     def dense(v):

@@ -11,7 +11,12 @@ wire request) into an *executable* one:
   ops (all_to_all / permute / sparse gather) have no staged form and
   always resolve flat.
 * wire requests downgrade through :func:`~horovod_tpu.xir.ir.eligible_wire`
-  (shuffle ops: bf16 or dense, never a half-applied quantization).
+  (shuffle ops: bf16 or dense, never a half-applied quantization), and
+  quantized ops carry a resolved ``qbackend`` attribute
+  (:func:`resolve_backend`): the fused Pallas backend
+  (``HVD_TPU_QUANT_BACKEND=fused``, ops/pallas_quant.py) is eligible
+  only for the reduce-shaped op class — shuffle ops have no
+  dequant-accumulate to fuse and pin ``phase``.
 * when a persistent schedule store is configured
   (``HVD_TPU_TUNE_DB``), the lowered program is keyed in it —
   :func:`tuner_key` folds the workload kind into the
@@ -41,6 +46,29 @@ def tuner_key(program: ir.ExchangeProgram) -> str:
     from ..sched.store import make_key
 
     return make_key(program.signature(), kind=program.kind)
+
+
+def resolve_backend(op: ir.ExchangeOp) -> Optional[str]:
+    """Quantized-wire backend for one op (``HVD_TPU_QUANT_BACKEND``),
+    gated per op class: only the reduce-shaped ops have a fused Pallas
+    lowering (the ring kernels implement quantize/DMA/dequant-
+    accumulate — a shuffle op has no accumulation to fuse), so anything
+    else pins ``"phase"``.  ``None`` for dense/bf16 wires — the backend
+    attribute only exists where a quantizer runs."""
+    if op.wire not in ("int8", "fp8"):
+        return None
+    if op.op not in ir.REDUCE_OPS:
+        return "phase"
+    from ..ops.quantized import quant_backend
+
+    return quant_backend()
+
+
+def _with_backend(op: ir.ExchangeOp) -> ir.ExchangeOp:
+    backend = resolve_backend(op)
+    if backend is None:
+        return op
+    return op.replace(attrs={"qbackend": backend})
 
 
 def resolve_lowering(op: ir.ExchangeOp,
@@ -131,7 +159,9 @@ def _store_sync(program: ir.ExchangeProgram) -> ir.ExchangeProgram:
         new_lower = lowering if (
             op.op in ir.REDUCE_OPS and op.groups is None
         ) else "flat"
-        ops.append(op.replace(wire=new_wire, lowering=new_lower))
+        ops.append(_with_backend(
+            op.replace(wire=new_wire, lowering=new_lower)
+        ))
     return ir.program(program.kind, ops)
 
 
@@ -147,7 +177,9 @@ def lower(program: ir.ExchangeProgram,
     for op in program.ops:
         wire = ir.eligible_wire(op.op, op.wire, op.attr("dtype"))
         lowering = resolve_lowering(op, axis_size)
-        ops.append(op.replace(wire=wire, lowering=lowering))
+        ops.append(_with_backend(
+            op.replace(wire=wire, lowering=lowering)
+        ))
     lowered = ir.program(program.kind, ops)
     if store:
         lowered = _store_sync(lowered)

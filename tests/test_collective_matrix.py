@@ -723,6 +723,167 @@ class TestXirColumn:
             hvd.remove_process_set(ps)
 
 
+@pytest.mark.pallas
+@pytest.mark.quant
+class TestFusedQuantColumn:
+    """Fused quantized-wire backend column of the matrix
+    (``HVD_TPU_QUANT_BACKEND=fused`` → ops/pallas_quant.py ring
+    kernels, interpret mode + ppermute transport on the CPU mesh):
+    fused vs phase across dtypes, exact-payload bitwise agreement,
+    process-set subgroups, the hierarchical lowering with the fused
+    backend on its quantized hop, and EF residual equivalence."""
+
+    def _run(self, fn, *args, n_out=1):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu.runtime import WORLD_AXIS, get_runtime
+
+        mesh = get_runtime().mesh
+        spec = P(WORLD_AXIS)
+        return jax.jit(jax.shard_map(
+            fn, mesh=mesh, in_specs=(spec,) * len(args),
+            out_specs=(spec,) * n_out if n_out > 1 else spec,
+            check_vma=False,
+        ))(*args)
+
+    @pytest.mark.parametrize(
+        "dtype", [np.float32, np.float16, jnp.bfloat16], ids=str
+    )
+    @pytest.mark.parametrize("wire", ["int8", "fp8"])
+    def test_allreduce_fused_vs_phase(self, hvd_module, dtype, wire):
+        from horovod_tpu.ops.quantized import quantized_allreduce
+        from horovod_tpu.ops.traced import Sum
+
+        x = _data(dtype, shape=(N, 777), seed=40)
+
+        def f(backend):
+            return self._run(
+                lambda a, _b=backend: quantized_allreduce(
+                    a[0], op=Sum, wire=wire, backend=_b
+                ).astype(jnp.float32)[None], x,
+            )
+
+        # same grid, same fp32 accumulation — only summation order
+        # differs between the ring and the all_to_all wire, so f32
+        # agrees at 1e-6 and the half dtypes at their own rounding
+        # (the phase primitive casts its fp32 result back to dtype)
+        tol = dict(rtol=1e-6, atol=1e-6) if dtype == np.float32 \
+            else _tol(dtype)
+        np.testing.assert_allclose(
+            np.asarray(f("phase"), np.float64),
+            np.asarray(f("fused"), np.float64), **tol,
+        )
+
+    def test_bitwise_when_every_block_quantizes_exactly(self,
+                                                        hvd_module):
+        """Payload crafted so every quantization block has amax 127 and
+        integer values: both backends' grids are exact, partial sums
+        are exactly representable, so summation order cannot matter —
+        fused must equal phase bit for bit."""
+        from horovod_tpu.ops.quantized import quant_block, quantized_allreduce
+        from horovod_tpu.ops.traced import Sum
+
+        block = quant_block()
+        rng = np.random.RandomState(41)
+        x = rng.randint(-16, 17, (N, 2 * block)).astype(np.float32)
+        x[:, ::block] = 127.0  # pin every block's amax -> scale == 1
+
+        def f(backend):
+            return np.asarray(self._run(
+                lambda a, _b=backend: quantized_allreduce(
+                    a[0], op=Sum, wire="int8", backend=_b
+                )[None], x,
+            ))
+
+        np.testing.assert_array_equal(f("phase"), f("fused"))
+
+    def test_process_set_subgroups(self, hvd_module, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_DYNAMIC_PROCESS_SETS", "1")
+        from horovod_tpu.ops.quantized import quantized_allreduce
+        from horovod_tpu.ops.traced import Sum
+        from horovod_tpu.runtime import WORLD_AXIS
+
+        ps = hvd.add_process_set([0, 1, 2, 3])
+        try:
+            x = _data(np.float32, shape=(N, 1030), seed=42)
+
+            def f(backend):
+                return np.asarray(self._run(
+                    lambda a, _b=backend: quantized_allreduce(
+                        a[0], WORLD_AXIS, op=Sum, process_set=ps,
+                        backend=_b,
+                    )[None], x,
+                ))
+
+            ph, fu = f("phase"), f("fused")
+            np.testing.assert_allclose(ph, fu, rtol=1e-6, atol=1e-6)
+            # and the grouped reduction actually stayed within the set
+            expect = np.asarray(x[:4], np.float64).sum(axis=0)
+            np.testing.assert_allclose(
+                np.asarray(fu[0], np.float64), expect,
+                rtol=1e-2, atol=1e-1,
+            )
+        finally:
+            hvd.remove_process_set(ps)
+
+    def test_hier_lowering_fused_quantized_hop(self, hvd_module,
+                                               monkeypatch):
+        """Hierarchical lowering on a forced 2-slice topology with a
+        quantized wire: the quantized hop dispatches through the
+        backend knob — fused must agree with phase (on hardware the
+        cross-slice DCN hop falls back to phase and only ICI-resident
+        rings go fused; the CPU mesh exercises the fused kernels on
+        the same groups)."""
+        from horovod_tpu import topo
+        from horovod_tpu.ops.traced import Sum
+        from horovod_tpu.runtime import WORLD_AXIS
+
+        monkeypatch.setenv("HVD_TPU_TOPO", "2x4")
+        topo.reset()
+        try:
+            x = _data(np.float32, shape=(N, 1100), seed=43)
+
+            def f(backend):
+                monkeypatch.setenv("HVD_TPU_QUANT_BACKEND", backend)
+                return np.asarray(self._run(
+                    lambda a: topo.hierarchical_all_reduce(
+                        a, WORLD_AXIS, op=Sum, wire="int8"
+                    ), x,
+                ))
+
+            np.testing.assert_allclose(
+                f("phase"), f("fused"), rtol=1e-6, atol=1e-6
+            )
+        finally:
+            topo.reset()
+
+    def test_ef_residual_equivalence(self, hvd_module):
+        """End-to-end EF: quantize(g + r) on the wire under both
+        backends — reduced values agree to summation order and the new
+        residual (one local quantization) is bitwise identical."""
+        from horovod_tpu.ops.quantized import quantized_allreduce_ef
+        from horovod_tpu.ops.traced import Sum
+
+        x = _data(np.float32, shape=(N, 1536), seed=44)
+        r = _data(np.float32, shape=(N, 1536), seed=45) * 0.01
+
+        def f(backend):
+            def body(a, b):
+                out, rn = quantized_allreduce_ef(
+                    a, b, op=Sum, backend=backend
+                )
+                return out, rn
+
+            o, rn = self._run(body, x, r, n_out=2)
+            return np.asarray(o), np.asarray(rn)
+
+        o_p, r_p = f("phase")
+        o_f, r_f = f("fused")
+        np.testing.assert_allclose(o_p, o_f, rtol=1e-6, atol=1e-6)
+        np.testing.assert_array_equal(r_p, r_f)
+
+
 class TestGroupFusionKnob:
     def test_disable_group_fusion_matches_fused(self, hvd_module,
                                                 monkeypatch):

@@ -145,6 +145,38 @@ def test_zero_input_safe(hvd_module):
     np.testing.assert_array_equal(y, 0.0)
 
 
+@pytest.mark.parametrize("wire", ["int8", "fp8"])
+def test_zero_block_roundtrip_is_exact_zero_property(wire):
+    """Property: quantize→dequantize of an all-zero block is EXACTLY
+    zero for both wire formats, across block sizes and in any mixed
+    payload position — the _block_scale guard clamps the divisor away
+    from zero once, centrally, so no call site can reintroduce a 0/0.
+    The scale itself stays finite (a NaN scale is reserved for
+    non-finite payloads, where propagation is the contract)."""
+    from horovod_tpu.ops.quantized import (
+        _block_scale,
+        _dequantize_blocks,
+        _quantize_blocks,
+    )
+
+    rng = np.random.RandomState(9)
+    for block in (64, 128, 512):
+        for rows in (1, 3):
+            x = rng.randn(rows, 4 * block).astype(np.float32)
+            # zero out a different block per row, plus one fully-zero row
+            for r in range(rows):
+                x[r, r * block:(r + 1) * block] = 0.0
+            x[-1, :] = 0.0
+            q, s = _quantize_blocks(jnp.asarray(x), wire, block)
+            out = np.asarray(_dequantize_blocks(q, s, block))
+            assert np.isfinite(np.asarray(s)).all()
+            np.testing.assert_array_equal(out[x == 0.0], 0.0)
+    # the guard itself: zero amax -> unit divisor, finite unit scale
+    scale, safe = _block_scale(jnp.zeros((4,), jnp.float32), 127.0)
+    np.testing.assert_array_equal(np.asarray(safe), 1.0)
+    np.testing.assert_array_equal(np.asarray(scale), 1.0)
+
+
 def test_rejects_nontiling_subsets_and_bad_ops(hvd_module, monkeypatch):
     monkeypatch.setenv("HVD_TPU_DYNAMIC_PROCESS_SETS", "1")
     # [0, 1, 2] cannot tile 8 ranks into equal replica groups (5 % 3)

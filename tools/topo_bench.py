@@ -1,20 +1,28 @@
-"""topo_hier_vs_flat micro-benchmark: flat vs hierarchical gradient
-exchange on a simulated 2-slice mesh (8 virtual CPU devices, forced
-``HVD_TPU_TOPO=2x4``).
+"""Topology/wire micro-benchmarks on a simulated 2-slice mesh (8
+virtual CPU devices, forced ``HVD_TPU_TOPO=2x4``).
 
-Structural numbers, not wall-clock truth: on one host both "networks"
-are memcpy, so the interesting outputs are the modeled per-rank
-bytes-over-DCN of each lowering (the subsystem's 1/slice_size claim,
-read from the ``topo.dcn_bytes`` gauge the scheduler publishes) plus
-the measured step times as a sanity bound that the hier staging costs
-no more than a few extra collective launches.  Prints ONE JSON line::
+Default record — ``topo_hier_vs_flat``: flat vs hierarchical gradient
+exchange.  Structural numbers, not wall-clock truth: on one host both
+"networks" are memcpy, so the interesting outputs are the modeled
+per-rank bytes-over-DCN of each lowering (the subsystem's
+1/slice_size claim, read from the ``topo.dcn_bytes`` gauge the
+scheduler publishes) plus the measured step times as a sanity bound
+that the hier staging costs no more than a few extra collective
+launches.  Prints ONE JSON line::
 
     {"metric": "topo_hier_vs_flat", "dcn_bytes": {"flat":..,"hier":..},
      "dcn_ratio": .., "step_time_ms": {"flat":..,"hier":..},
      "loss_delta": ..}
 
-Run standalone or through ``bench.py`` (which embeds the line under
-its ``"topo_hier_vs_flat"`` key).
+``--quant`` record — ``quant_fused_vs_phase``: the int8 wire under
+``HVD_TPU_QUANT_BACKEND=phase`` vs ``fused`` (ops/pallas_quant.py ring
+kernels, interpret mode + ppermute transport on CPU) on the same
+train loop: per-bucket exchange wall time, ``sched.wire_bytes``,
+fused-path counters, and the phase/fused loss delta (same numerics
+contract, so it must sit at fp32-summation-order noise).
+
+Run standalone or through ``bench.py`` (which embeds the lines under
+its ``"topo_hier_vs_flat"`` / ``"quant_fused_vs_phase"`` keys).
 """
 
 import json
@@ -123,12 +131,163 @@ def main() -> dict:
     }
 
 
+def main_quant() -> dict:
+    """The ``quant_fused_vs_phase`` record: one seeded train loop on
+    the int8+EF wire per backend, plus an isolated exchange microbench
+    (the per-bucket number the acceptance bar reads — step time also
+    includes fwd/bwd/optimizer, which the backend cannot touch)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu import metrics, sched
+
+    jax.config.update("jax_platforms", "cpu")
+    hvd.init()
+
+    rng = np.random.RandomState(7)
+    X = rng.randn(32, 64).astype(np.float32)
+    Y = (X @ rng.randn(64, 8).astype(np.float32)).astype(np.float32)
+
+    def loss_fn(p, b):
+        x, y = b
+        h = jnp.tanh(x @ p["w1"] + p["b1"])
+        return jnp.mean((h @ p["w2"] - y) ** 2)
+
+    def params():
+        r = np.random.RandomState(3)
+        return {
+            "w1": jnp.asarray(r.randn(64, 256).astype(np.float32) * 0.05),
+            "b1": jnp.zeros((256,)),
+            "w2": jnp.asarray(r.randn(256, 8).astype(np.float32) * 0.05),
+        }
+
+    def run(backend, iters=30, warmup=5):
+        os.environ["HVD_TPU_QUANT_BACKEND"] = backend
+        metrics.reset_counters("quant.")
+        # lowering pinned flat so the record isolates the wire backend
+        # (hier would move the quantizer onto the DCN-hop groups)
+        cfg = sched.SchedConfig(
+            enabled=True, bucket_bytes=16 * 1024, wire="int8",
+            wire_ef=True, lowering="flat",
+        )
+        sched.set_config_override(cfg)
+        try:
+            p = params()
+            tx = hvd.DistributedOptimizer(optax.sgd(0.05))
+            step = hvd.distributed_train_step(loss_fn, tx)
+            st = step.init(p)
+            batch = (jnp.asarray(X), jnp.asarray(Y))
+            loss = None
+            for _ in range(warmup):
+                p, st, loss = step(p, st, batch)
+            jax.block_until_ready(loss)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                p, st, loss = step(p, st, batch)
+            jax.block_until_ready(loss)
+            dt = (time.perf_counter() - t0) / iters
+            buckets = int(metrics.get_gauge("sched.buckets_per_step") or 1)
+
+            # isolated exchange at a realistic tuned bucket (16 MiB
+            # fp32): the per-bucket wall-clock of the reduce-scatter —
+            # the hop-fused operation itself — plus the composed RS+AG
+            # allreduce for context.  Tiny buckets are dispatch-bound
+            # on the CPU sim (each ppermute stand-in is a full-mesh
+            # sync the real ICI DMA doesn't pay), so the byte-bound
+            # regime is the comparable one.
+            from horovod_tpu.ops.quantized import (
+                quantized_allreduce,
+                quantized_reduce_scatter,
+            )
+            from horovod_tpu.ops.traced import Sum
+            from horovod_tpu.runtime import WORLD_AXIS, get_runtime
+            from jax.sharding import PartitionSpec as P
+
+            g = jnp.asarray(
+                np.random.RandomState(11)
+                .randn(hvd.size(), 4 * 1024 * 1024).astype(np.float32)
+            )
+
+            def bench_op(body, iters=20):
+                ex = jax.jit(jax.shard_map(
+                    body, mesh=get_runtime().mesh,
+                    in_specs=(P(WORLD_AXIS),),
+                    out_specs=P(WORLD_AXIS), check_vma=False,
+                ))
+                jax.block_until_ready(ex(g))
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    out = ex(g)
+                jax.block_until_ready(out)
+                return (time.perf_counter() - t0) / iters * 1000.0
+
+            rs_ms = bench_op(
+                lambda v: quantized_reduce_scatter(
+                    v[0], op=Sum, wire="int8"
+                )[None]
+            )
+            ar_ms = bench_op(
+                lambda v: quantized_allreduce(
+                    v[0], op=Sum, wire="int8"
+                )[None]
+            )
+            return {
+                "step_time_ms": round(dt * 1000.0, 3),
+                "per_bucket_exchange_ms": round(rs_ms, 4),
+                "per_bucket_allreduce_ms": round(ar_ms, 4),
+                "buckets_per_step": buckets,
+                "wire_bytes_int8": int(metrics.get_gauge(
+                    "sched.wire_bytes", {"wire": "int8"}) or 0),
+                "fused_collectives": metrics.get_counter(
+                    "quant.fused_collectives"),
+                "fused_fallbacks": metrics.get_counter(
+                    "quant.fused_fallback"),
+                "final_loss": float(loss),
+            }
+        finally:
+            sched.set_config_override(None)
+            os.environ.pop("HVD_TPU_QUANT_BACKEND", None)
+
+    phase = run("phase")
+    fused = run("fused")
+    assert fused["fused_collectives"] > 0, "fused path never engaged"
+    return {
+        "metric": "quant_fused_vs_phase",
+        "unit": "per_bucket_exchange_ms",
+        "value": {
+            "phase": phase["per_bucket_exchange_ms"],
+            "fused": fused["per_bucket_exchange_ms"],
+        },
+        "per_bucket_allreduce_ms": {
+            "phase": phase["per_bucket_allreduce_ms"],
+            "fused": fused["per_bucket_allreduce_ms"],
+        },
+        "topo": os.environ["HVD_TPU_TOPO"],
+        "wire_bytes_int8": {
+            "phase": phase["wire_bytes_int8"],
+            "fused": fused["wire_bytes_int8"],
+        },
+        "step_time_ms": {
+            "phase": phase["step_time_ms"], "fused": fused["step_time_ms"],
+        },
+        "buckets_per_step": phase["buckets_per_step"],
+        "fused_collectives": fused["fused_collectives"],
+        "fused_fallbacks": fused["fused_fallbacks"],
+        "loss_delta": abs(phase["final_loss"] - fused["final_loss"]),
+    }
+
+
 if __name__ == "__main__":
+    which = "quant" if "--quant" in sys.argv[1:] else "topo"
     try:
-        print(json.dumps(main()))
+        print(json.dumps(main_quant() if which == "quant" else main()))
     except Exception as e:  # degraded-run hardening: always emit a line
         print(json.dumps(
-            {"metric": "topo_hier_vs_flat",
+            {"metric": ("quant_fused_vs_phase" if which == "quant"
+                        else "topo_hier_vs_flat"),
              "error": f"{type(e).__name__}: {e}"}
         ))
         sys.exit(1)
