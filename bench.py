@@ -326,6 +326,28 @@ def main():
         "device_kind": device.device_kind,
         "peak_bf16_tflops": _chip_peak_tflops(device),
     }
+    if device.platform == "cpu":
+        # A CPU-only backend cannot finish the 224px ResNet-50 sweep
+        # inside the deadline (the alarm would fire mid-compile and the
+        # round would record a raw error blob).  Measure the CPU-sim
+        # resnet config instead — a real, non-null images/sec + MFU
+        # with peak_source provenance, flagged scale=cpu_sim — plus
+        # every device-free record.
+        result["reason"] = (
+            "cpu-only backend: resnet50@224 cannot finish inside the "
+            "deadline; measured the cpu_sim config instead"
+        )
+        deadline_s = int(os.environ.get("HVD_BENCH_DEADLINE_S", "480"))
+        t_start = _ALARM_ARMED_AT if _ALARM_ARMED_AT is not None else (
+            time.monotonic()
+        )
+        _cpu_resnet_fallback(result, deadline_s, t_start)
+        _maybe_scaling(result, deadline_s, t_start)
+        _maybe_topo(result, deadline_s, t_start)
+        _maybe_quant_backend(result, deadline_s, t_start)
+        _maybe_adasum(result, deadline_s, t_start)
+        print(json.dumps(result))
+        return
     # Config sweep (HVD_BENCH_SWEEP=0 pins the single explicit config):
     # space-to-depth leads (the known MFU winner for the 7x7/2 stem on
     # MXU hardware — the SNIPPETS.md MFU>=0.30 target's first lever),
@@ -459,7 +481,108 @@ def main():
     _maybe_scaling(result, deadline_s, t_start)
     _maybe_topo(result, deadline_s, t_start)
     _maybe_quant_backend(result, deadline_s, t_start)
+    _maybe_adasum(result, deadline_s, t_start)
     print(json.dumps(result))
+
+
+def _scrubbed_cpu_env() -> dict:
+    """Environment for the device-free CPU-subprocess records: repo on
+    the path, 8 virtual CPU devices, every device-tunnel variable
+    scrubbed (prepend/append, never clobber — the driver may rely on
+    its own PYTHONPATH entries or XLA flags)."""
+    repo = os.path.dirname(os.path.abspath(__file__))
+    env = dict(os.environ)
+    env["PYTHONPATH"] = repo + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else ""
+    )
+    env["XLA_FLAGS"] = (
+        "--xla_force_host_platform_device_count=8 "
+        + env.get("XLA_FLAGS", "")
+    ).strip()
+    env["JAX_PLATFORMS"] = "cpu"
+    for key in ("JAX_PLATFORM_NAME", "PJRT_DEVICE",
+                "TPU_LIBRARY_PATH", "PALLAS_AXON_POOL_IPS"):
+        env.pop(key, None)
+    return env
+
+
+def _maybe_adasum(result: dict, deadline_s: float,
+                  t_start: float) -> None:
+    """Append the ``adasum_vs_sum`` record (HVD_BENCH_ADASUM=0 skips):
+    steps-to-loss-target at 4x batch without LR retuning, flat summed
+    gradients vs the ``hier_adasum`` lowering, on the simulated 2-slice
+    mesh via ``tools/topo_bench.py --adasum`` in a scrubbed 8-device
+    CPU subprocess (docs/adasum.md).  Structured-skip on deadline
+    pressure like the other device-free records."""
+    if os.environ.get("HVD_BENCH_ADASUM", "1") == "0":
+        return
+    if deadline_s - (time.monotonic() - t_start) < 75:
+        result["adasum_vs_sum"] = {"error": "skipped: deadline too close"}
+        return
+    try:
+        import subprocess as sp
+
+        repo = os.path.dirname(os.path.abspath(__file__))
+        env = _scrubbed_cpu_env()
+        env.setdefault("HVD_TPU_TOPO", "2x4")
+        out = sp.run(
+            [sys.executable, os.path.join(repo, "tools", "topo_bench.py"),
+             "--adasum"],
+            capture_output=True, text=True, timeout=300, env=env, cwd=repo,
+        )
+        line = (out.stdout or "").strip().splitlines()
+        result["adasum_vs_sum"] = (
+            json.loads(line[-1]) if out.returncode == 0 and line
+            else {"error": f"rc={out.returncode}: {(out.stderr or '')[-300:]}"}
+        )
+    except Exception as e:
+        result["adasum_vs_sum"] = {"error": f"{type(e).__name__}: {e}"}
+
+
+def _cpu_resnet_fallback(result: dict, deadline_s: float,
+                         t_start: float) -> None:
+    """Fill the primary resnet record from the CPU-sim measurement when
+    the device probe is dead (``tools/resnet_cpu_bench.py``): the
+    record then carries a *measured* non-null images/sec + MFU with
+    ``peak_source`` provenance — flagged ``scale: cpu_sim`` so rounds
+    on real chips never cross-compare with it — instead of the bare
+    ``value 0.0`` skip blob BENCH_r05 recorded."""
+    if deadline_s - (time.monotonic() - t_start) < 90:
+        result["cpu_fallback"] = {"error": "skipped: deadline too close"}
+        return
+    try:
+        import subprocess as sp
+
+        repo = os.path.dirname(os.path.abspath(__file__))
+        out = sp.run(
+            [sys.executable,
+             os.path.join(repo, "tools", "resnet_cpu_bench.py")],
+            capture_output=True, text=True, timeout=540,
+            env=_scrubbed_cpu_env(), cwd=repo,
+        )
+        line = (out.stdout or "").strip().splitlines()
+        rec = (
+            json.loads(line[-1]) if out.returncode == 0 and line
+            else {"error": f"rc={out.returncode}: {(out.stderr or '')[-300:]}"}
+        )
+    except Exception as e:
+        rec = {"error": f"{type(e).__name__}: {e}"}
+    result["cpu_fallback"] = rec
+    if "error" not in rec:
+        result.update(
+            value=rec["images_per_sec_per_chip"],
+            vs_baseline=round(
+                rec["images_per_sec_per_chip"]
+                / BASELINE_IMG_PER_SEC_PER_ACCEL, 3
+            ),
+            step_time_ms=rec["step_time_ms"],
+            batch_per_chip=rec["batch_per_chip"],
+            mfu=rec["mfu"],
+            peak_source=rec["peak_source"],
+            achieved_tflops=rec["achieved_tflops"],
+            scale="cpu_sim",
+            status="cpu_fallback",
+        )
 
 
 def _maybe_scaling(result: dict, deadline_s: float,
@@ -758,9 +881,12 @@ if __name__ == "__main__":
                 _probe_cache_store()
         if probe_skip_reason is not None:
             # Structured skip for the device-bound primary metric — but
-            # the CPU-subprocess records (scaling, topo) need no device
-            # tunnel: run them so a bench round with a wedged device
-            # still produces real numbers instead of nothing.
+            # the CPU-subprocess records need no device tunnel: the
+            # resnet record itself falls back to a measured CPU-sim
+            # number (non-null MFU with peak_source provenance), and
+            # the scaling/topo/quant/adasum records run as usual, so a
+            # bench round with a wedged device still produces real
+            # numbers instead of nothing.
             result = {
                 "metric": "resnet50_synthetic_train_throughput",
                 "value": 0.0,
@@ -770,8 +896,11 @@ if __name__ == "__main__":
                 "reason": probe_skip_reason,
             }
             deadline_s = int(os.environ.get("HVD_BENCH_DEADLINE_S", "480"))
+            _cpu_resnet_fallback(result, deadline_s, _ALARM_ARMED_AT)
             _maybe_scaling(result, deadline_s, _ALARM_ARMED_AT)
             _maybe_topo(result, deadline_s, _ALARM_ARMED_AT)
+            _maybe_quant_backend(result, deadline_s, _ALARM_ARMED_AT)
+            _maybe_adasum(result, deadline_s, _ALARM_ARMED_AT)
             print(json.dumps(result))
             sys.exit(0)
         main()

@@ -815,11 +815,12 @@ class ShardedZeroState:
         lays = bucket_layouts(params, world_devices, self._config())
         out = []
         for lay in lays:
-            if lay.lowering == "hier":
-                # Hier buckets replicate their ICI-sharded state across
-                # slices — the contiguous-slab exchange below does not
-                # describe them.  Degrade honestly: the caller falls
-                # back to checkpoint restore (docs/fault_tolerance.md).
+            if lay.lowering in ("hier", "hier_adasum"):
+                # Hier-family buckets replicate their ICI-sharded state
+                # across slices — the contiguous-slab exchange below
+                # does not describe them.  Degrade honestly: the caller
+                # falls back to checkpoint restore
+                # (docs/fault_tolerance.md).
                 raise RemeshError(
                     "in-place reshard of hierarchically-lowered ZeRO "
                     "buckets is not supported; set "

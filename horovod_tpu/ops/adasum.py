@@ -180,6 +180,29 @@ def _vhdd_over_groups(v: jax.Array, axis: str, n: int, groups) -> jax.Array:
     return gathered[jnp.asarray(rows)].reshape(padded)[:size]
 
 
+def _topo_slice_grid(axis: str):
+    """``(local_groups, cross_groups)`` from the slice topology
+    (``topo/model.py``), or ``None`` on a single-slice world or an axis
+    the topology cannot factor.  ``local_groups[j]`` is slice j (ICI
+    neighbors), ``cross_groups[i]`` the i-th rank of every slice (the
+    DCN rail) — the same contract as ``traced.host_groups``."""
+    from jax import lax as _lax
+
+    from ..exceptions import HorovodTpuError
+    from ..topo import model as topo_model
+
+    topo = topo_model.current()
+    n = _lax.axis_size(axis)
+    s, _k = topo.factor_axis(n)
+    if s == 1:
+        return None
+    try:
+        intra, cross = topo.axis_groups(n)
+    except HorovodTpuError:
+        return None
+    return intra, cross
+
+
 def _hierarchical_adasum(x: jax.Array, axis: str) -> Optional[jax.Array]:
     """Intra-host sum + cross-host Adasum (the ``AdasumGpuAllreduceOp``
     schedule, ``adasum_gpu_operations.cc:44-329``):
@@ -193,12 +216,19 @@ def _hierarchical_adasum(x: jax.Array, axis: str) -> Optional[jax.Array]:
          postscale, ``operations.cc:1404-1410``) so the result is the
          Adasum of per-host *average* gradients.
 
-    Returns ``None`` when the world is not a homogeneous host grid
-    (caller falls back to flat VHDD).
+    Returns ``None`` when the world is neither a homogeneous host grid
+    nor a cross-slice topology (caller falls back to flat VHDD).  The
+    slice grid from ``topo/`` (multi-slice TPU, or a forced
+    ``HVD_TPU_TOPO``) serves the same two-level role as the host grid:
+    slices are the ICI islands, the inter-slice DCN links the rails —
+    so single-controller multi-slice worlds get the hierarchical
+    schedule too, not just multi-process host grids.
     """
     from .traced import host_groups
 
     grid = host_groups(axis)
+    if grid is None:
+        grid = _topo_slice_grid(axis)
     if grid is None:
         return None
     local_groups, cross_groups = grid

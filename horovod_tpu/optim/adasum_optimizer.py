@@ -7,6 +7,17 @@ optimizer *locally* first and adaptively combines the resulting
 parameter *deltas* — this preserves Adasum's scale-invariance through
 optimizers with per-parameter state (Adam etc.), which is the variant
 the Adasum paper (arXiv:2006.02924) recommends.
+
+Since PR 10 this is a thin preset over the ``DistributedOptimizer``
+reduction machinery with the exchange lowering pinned to
+``hier_adasum``: the delta reduction rides the bucketed overlap
+scheduler — reverse-backward buckets, cost-model byte accounting, the
+persistent tune DB, and (on cross-slice topologies) the hierarchical
+staging that sums deltas over ICI and applies Adasum's adaptive
+dot-product combination only on the DCN hop, where divergence actually
+lives (docs/adasum.md).  A quantized ``compression`` compresses just
+that DCN leg.  Single-slice topologies resolve the pin to ``flat`` and
+reduce through the flat VHDD tree, exactly as before.
 """
 
 from __future__ import annotations
@@ -51,6 +62,7 @@ def DistributedAdasumOptimizer(
             postscale_factor=1.0,
             process_set=process_set,
             fusion_threshold_bytes=fusion_threshold_bytes,
+            lowering="hier_adasum",
         )
         return reduced, state
 

@@ -94,6 +94,25 @@ def remesh_optimizer_state(
     )
 
 
+def _adasum_hier_eligible(axis, process_set) -> bool:
+    """Whether ``op=Adasum`` can take the hierarchical ``hier_adasum``
+    lowering: one named present axis that factors across slices, and
+    the global set — plain sum over ICI, adaptive summation only on
+    the DCN hop (docs/adasum.md).  Single-slice topologies, process
+    subsets, and multi-axis reductions stay on the flat VHDD tree."""
+    from ..parallel.tensor import _axis_present
+    from ..topo import model as topo_model
+
+    if not (isinstance(axis, str) and _axis_present(axis)):
+        return False
+    if process_set is not None and process_set.process_set_id != 0:
+        return False
+    topo = topo_model.current()
+    if not topo.multi_slice:
+        return False
+    return topo.factor_axis(jax.lax.axis_size(axis))[0] > 1
+
+
 def _reduce_gradients(
     grads: Any,
     *,
@@ -107,6 +126,7 @@ def _reduce_gradients(
     groups: Optional[Sequence[Sequence[int]]] = None,
     sparse_as_dense: bool = False,
     residuals: Any = None,
+    lowering: Optional[str] = None,
 ) -> Any:
     """Bucket, compress, and allreduce a gradient pytree as few fused
     collectives (the FuseResponses + fusion-buffer path, compiled).
@@ -120,6 +140,11 @@ def _reduce_gradients(
     ``residuals`` (pytree matching ``grads``, fp32 leaves) engages
     error feedback on quantized-wire buckets; the call then returns
     ``(reduced, new_residuals)`` instead of just the reduced tree.
+
+    ``lowering`` pins the per-bucket exchange lowering for this
+    reduction (``None`` defers to ``HVD_TPU_TOPO_LOWER`` /
+    ``SchedConfig.lowering``) — the Adasum optimizer preset passes
+    ``"hier_adasum"``.
     """
     from ..ops.sparse import IndexedSlices, densify, sparse_allreduce
 
@@ -135,10 +160,22 @@ def _reduce_gradients(
         quantized = True
     if quantized:
         if op not in (Average, Sum):
-            raise QuantizedWireError(
-                "the quantized wire requires op=Average/Sum "
-                "(ops/quantized.py)"
-            )
+            from .. import sched as _sched_mod
+
+            # Narrowed raise (PR 10): hierarchical Adasum quantizes only
+            # the DCN hop (the intra-slice sum stays dense), so a
+            # cross-slice topology serves Compression.int8/fp8 + Adasum
+            # through the hier_adasum lowering.  Flat Adasum (single
+            # slice, process subsets, multi-axis) still raises — the
+            # VHDD tree has no quantized form.
+            if not (op == Adasum and _sched_mod.current_config().enabled
+                    and _adasum_hier_eligible(axis, process_set)):
+                raise QuantizedWireError(
+                    "the quantized wire requires op=Average/Sum "
+                    "(ops/quantized.py); flat Adasum has no quantized "
+                    "lowering — on a cross-slice topology hier_adasum "
+                    "quantizes just the DCN hop (docs/adasum.md)"
+                )
         if process_set is not None and process_set.process_set_id != 0:
             # v2 serves sets that tile the axis into equal replica
             # groups (the phase collectives ride replica_groups);
@@ -229,6 +266,7 @@ def _reduce_gradients(
             prescale_factor=prescale_factor,
             postscale_factor=postscale_factor, process_set=process_set,
             fusion_threshold_bytes=fusion_threshold_bytes, groups=groups,
+            lowering=lowering,
         )
         out = list(leaves)
         for i, t in zip(dense_pos, dense_reduced):
@@ -321,13 +359,20 @@ def _reduce_gradients(
             # Satellite contract: the quantized wire raises instead of
             # silently degrading when the reduction shape cannot carry
             # it (non-Sum/Average ops, multi-axis reductions; process
-            # sets were validated above, non-tiling ones at trace time).
-            if op not in (Average, Sum):
+            # sets were validated above, non-tiling ones at trace
+            # time).  Adasum is the narrowed exception: on a
+            # cross-slice topology the hier_adasum lowering quantizes
+            # just the DCN hop, so only *flat* Adasum still raises.
+            if op not in (Average, Sum) and not (
+                op == Adasum
+                and _adasum_hier_eligible(axis, process_set)
+            ):
                 raise QuantizedWireError(
                     f"quantized wire {wire_req!r} requires op=Average/"
-                    "Sum; Adasum and min/max reductions have no "
+                    "Sum; flat Adasum and min/max reductions have no "
                     "quantized lowering — unset HVD_TPU_SCHED_WIRE or "
-                    "use a cast compressor"
+                    "use a cast compressor (cross-slice topologies "
+                    "quantize Adasum's DCN hop via hier_adasum)"
                 )
             if not isinstance(axis, str):
                 raise QuantizedWireError(
@@ -347,14 +392,35 @@ def _reduce_gradients(
             and _axis_present(axis)
             and (process_set is None or process_set.process_set_id == 0)
         )
+        # op=Adasum rides the hierarchical machinery too (ROADMAP 5a):
+        # eligible buckets lower hier_adasum — the reference's
+        # AdasumGpuAllreduceOp schedule (sum inside the slice, adaptive
+        # summation across) — unless the lowering is forced flat, in
+        # which case (and on single-slice topologies, where the plan
+        # resolves flat anyway) the flat VHDD tree serves the bucket.
+        adasum_ok = (
+            op == Adasum
+            and isinstance(axis, str)
+            and _axis_present(axis)
+            and (process_set is None or process_set.process_set_id == 0)
+        )
+        req_lowering = cfg.lowering if lowering is None else lowering
+        if hier_ok:
+            lower_req = req_lowering
+        elif adasum_ok:
+            lower_req = "flat" if req_lowering == "flat" \
+                else "hier_adasum"
+        else:
+            lower_req = "flat"
         schedule = _sched.build_schedule(
             sizes, wire_dtypes, cfg,
             order=_sched.hooks.consume_order(len(wire)),
             pinned=pinned,
             wire=wire_req,
-            lowering=cfg.lowering if hier_ok else "flat",
+            lowering=lower_req,
             axis_size=(
-                jax.lax.axis_size(axis) if hier_ok else None
+                jax.lax.axis_size(axis) if (hier_ok or adasum_ok)
+                else None
             ),
         )
         # reduce_scatter+all_gather exchange (arXiv:2004.13336) needs a
@@ -388,6 +454,18 @@ def _reduce_gradients(
                 )
 
         def reduce_bucket_flat(f, bucket):
+            if bucket.lowering == "hier_adasum" and (hier_ok or adasum_ok):
+                # Hierarchical Adasum (both sched modes — the staged
+                # allreduce IS the RS+AG composition): intra-slice sum,
+                # adaptive combination on the 1/k DCN shard, ICI
+                # gather.  The bucket's wire compresses only the DCN
+                # leg; EF does not apply (hier semantics).
+                return _sched.execute.hier_adasum_flat(
+                    f, axis=axis, average=(op != Sum),
+                    wire=bucket.wire,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor,
+                )
             if bucket.lowering == "hier" and hier_ok:
                 # Two-level ICI/DCN staging (topo/): the bucket's wire
                 # compresses only the cross-slice hop.  EF residuals
@@ -488,6 +566,7 @@ def DistributedOptimizer(
     groups: Optional[Sequence[Sequence[int]]] = None,
     sparse_as_dense: bool = False,
     axis=WORLD_AXIS,
+    lowering: Optional[str] = None,
 ) -> optax.GradientTransformation:
     """Wrap an optax transform with distributed gradient reduction.
 
@@ -498,6 +577,12 @@ def DistributedOptimizer(
     ``dense_grad_to_indexed_slices``); those reduce as allgather-of-
     slices unless ``sparse_as_dense=True`` densifies them first
     (reference ``torch/optimizer.py`` knob of the same name).
+
+    ``lowering`` pins this optimizer's per-bucket exchange lowering
+    (``flat``/``hier``/``hier_adasum``/``auto``; ``None`` defers to
+    ``HVD_TPU_TOPO_LOWER``) — the ``DistributedAdasumOptimizer``
+    preset pins ``hier_adasum``.  Ineligible buckets (non-float,
+    single-slice topologies, process subsets) still resolve flat.
     """
     if gradient_predivide_factor != 1.0:
         if op != Average:
@@ -526,6 +611,7 @@ def DistributedOptimizer(
             groups=groups,
             sparse_as_dense=sparse_as_dense,
             residuals=residuals,
+            lowering=lowering,
         )
 
     def _ef_active() -> bool:

@@ -158,18 +158,22 @@ def exchange(
                 f"wire={bucket.wire},lower={bucket.lowering}]",
                 "SCHED_EXCHANGE", wire_bytes(bucket),
             )
-            if bucket.lowering == "hier":
+            if bucket.lowering in ("hier", "hier_adasum"):
                 # One TOPO_PHASE lane event per hierarchical phase so a
                 # slow hop (almost always the DCN one) is identifiable
                 # without a device profiler trace.
                 from ..topo import model as topo_model
 
                 by = topo_model.current().lowering_bytes(
-                    "all_reduce", bucket.nbytes, "hier"
+                    "all_reduce", bucket.nbytes, bucket.lowering
+                )
+                dcn_phase = (
+                    "adasum_dcn" if bucket.lowering == "hier_adasum"
+                    else "ar_dcn"
                 )
                 for phase, nb in (
                     ("rs_ici", by["ici"] // 2),
-                    ("ar_dcn", by["dcn"]),
+                    (dcn_phase, by["dcn"]),
                     ("ag_ici", by["ici"] // 2),
                 ):
                     timeline.record_op(
@@ -343,6 +347,32 @@ def hier_allreduce_flat(
     return _scale(out, postscale_factor)
 
 
+def hier_adasum_flat(
+    f: jax.Array,
+    *,
+    axis,
+    average: bool,
+    wire: str = "off",
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+) -> jax.Array:
+    """One bucket's hierarchical-Adasum exchange (the
+    ``lowering="hier_adasum"`` bucket in either ``HVD_TPU_SCHED_MODE``):
+    intra-slice sum over ICI → Adasum's adaptive combination across
+    slices on the 1/k DCN shard → intra-slice all_gather
+    (topo/hierarchical.py).  ``average=True`` combines per-slice *mean*
+    gradients (the reference postscale semantics); a quantized/bf16
+    ``wire`` compresses only the DCN gather, EF-free like ``hier``."""
+    from ..ops.traced import Average as _Avg, Sum as _Sum, _scale
+    from ..topo import hierarchical_adasum_all_reduce
+
+    g = _scale(f, prescale_factor)
+    out = hierarchical_adasum_all_reduce(
+        g, axis, op=(_Avg if average else _Sum), wire=wire
+    )
+    return _scale(out, postscale_factor)
+
+
 def hier_reduce_scatter_flat(
     f: jax.Array,
     *,
@@ -462,6 +492,16 @@ def sync_gradients_bucketed(
         def reduce_flat(f, bucket, _m=mean_over, _idxs=idxs):
             # bucket.indices are positions in this group's leaf list;
             # _idxs maps them back to global flatten indices.
+            if bucket.lowering == "hier_adasum" and len(_m) == 1:
+                # Hierarchical Adasum pmean: slice means combined
+                # adaptively across slices; the bucket's wire rides
+                # only the DCN gather, EF-free like hier.
+                from ..ops.traced import Average as _Avg
+                from ..topo import hierarchical_adasum_all_reduce
+
+                return hierarchical_adasum_all_reduce(
+                    f, _m[0], op=_Avg, wire=bucket.wire
+                )
             if bucket.lowering == "hier" and len(_m) == 1:
                 # Hierarchical pmean: the ICI/DCN staging with the
                 # bucket's wire on the DCN hop only.  EF residuals do

@@ -35,9 +35,14 @@ WIRE_CHOICES = ("off", "bf16", "int8", "fp8")
 # Per-bucket lowerings the plan stage can assign.  "flat" is today's
 # single-collective exchange; "hier" stages it as intra-slice
 # reduce_scatter (ICI) -> cross-slice all_reduce (DCN, 1/k payload) ->
-# intra-slice all_gather (topo/hierarchical.py).  Chosen per bucket by
+# intra-slice all_gather (topo/hierarchical.py); "hier_adasum" keeps
+# hier's staging but combines across slices with Adasum's adaptive
+# summation (arXiv:2006.02924) — float buckets on cross-slice
+# topologies only, and never picked by "auto" (it changes the
+# reduction algorithm; it is requested by knob / tuner / the Adasum
+# optimizer preset).  The sum-preserving pair is chosen per bucket by
 # the topology cost model under HVD_TPU_TOPO_LOWER=auto.
-LOWER_CHOICES = ("flat", "hier")
+LOWER_CHOICES = ("flat", "hier", "hier_adasum")
 
 
 def _canon_lowering(lowering: str) -> str:
@@ -46,9 +51,12 @@ def _canon_lowering(lowering: str) -> str:
         lo = "flat"
     if lo in ("on", "1", "true", "yes", "hierarchical"):
         lo = "hier"
+    if lo == "adasum":
+        lo = "hier_adasum"
     if lo not in LOWER_CHOICES + ("auto",):
         raise ValueError(
-            f"HVD_TPU_TOPO_LOWER must be auto|flat|hier, got {lowering!r}"
+            f"HVD_TPU_TOPO_LOWER must be auto|flat|hier|hier_adasum, "
+            f"got {lowering!r}"
         )
     return lo
 
@@ -79,7 +87,8 @@ class SchedConfig:
     capture_order: bool = True
     wire: str = "off"  # "off" | "bf16" | "int8" | "fp8"
     wire_ef: bool = True  # error-feedback residuals for quantized wires
-    lowering: str = "auto"  # "auto" | "flat" | "hier" (HVD_TPU_TOPO_LOWER)
+    # "auto" | "flat" | "hier" | "hier_adasum" (HVD_TPU_TOPO_LOWER)
+    lowering: str = "auto"
 
     def __post_init__(self):
         if self.mode not in ("allreduce", "reduce_scatter"):
@@ -288,12 +297,18 @@ def eligible_wire(wire: str, wire_dtypes: Sequence[str]) -> str:
 
 
 def resolve_lowering(
-    requested: str, nbytes: int, axis_size: Optional[int] = None
+    requested: str, nbytes: int, axis_size: Optional[int] = None,
+    wire_dtypes: Sequence[str] = (),
 ) -> str:
-    """Resolve a requested lowering ("auto"/"flat"/"hier") to the
-    concrete per-bucket choice.  "auto" asks the topology cost model;
-    a single-slice topology (or non-factorable axis) always resolves
-    flat, so the pre-topology schedule is reproduced exactly."""
+    """Resolve a requested lowering ("auto"/"flat"/"hier"/
+    "hier_adasum") to the concrete per-bucket choice.  "auto" asks the
+    topology cost model (flat vs hier only — it never switches the
+    reduction algorithm to hier_adasum); a single-slice topology (or
+    non-factorable axis) always resolves flat, so the pre-topology
+    schedule is reproduced exactly — including for a hier_adasum
+    request, which must be bitwise-identical to flat there.  A
+    hier_adasum request on a non-floating bucket (``wire_dtypes``)
+    also resolves flat: the adaptive coefficients divide by norms."""
     if requested == "flat":
         return "flat"
     from ..topo import model as topo_model
@@ -303,6 +318,16 @@ def resolve_lowering(
     s, _ = topo.factor_axis(n)
     if s == 1:
         return "flat"
+    if requested == "hier_adasum":
+        import jax.numpy as jnp
+
+        floating = all(
+            jnp.issubdtype(jnp.dtype(d), jnp.floating)
+            for d in wire_dtypes
+        )
+        if wire_dtypes and not floating:
+            return "flat"
+        return "hier_adasum"
     if requested == "hier":
         return "hier"
     return topo.choose_lowering("all_reduce", nbytes, n)
@@ -325,7 +350,8 @@ def _make_bucket(
         wire_dtypes=wire_dtypes,
         pinned=pinned,
         wire=eligible_wire(wire, wire_dtypes),
-        lowering=resolve_lowering(lowering, nbytes, axis_size),
+        lowering=resolve_lowering(lowering, nbytes, axis_size,
+                                  wire_dtypes),
     )
 
 

@@ -263,7 +263,7 @@ class ScheduleStore:
             from ..topo import model as topo_model
 
             lowering = entry.get("lowering", "flat")
-            if lowering not in ("flat", "hier"):
+            if lowering not in ("flat", "hier", "hier_adasum"):
                 lowering = "flat"
             return topo_model.current().estimate_cost(
                 "all_reduce", int(entry["bucket_bytes"]), lowering,

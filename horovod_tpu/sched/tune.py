@@ -109,7 +109,7 @@ class ScheduleTuner:
                  wire_candidates=("off", "bf16", "int8", "fp8"),
                  wire_min_bucket_bytes: int = 1 << 16,
                  explore_lowering: bool = False,
-                 lowering_candidates=("flat", "hier"),
+                 lowering_candidates=("flat", "hier", "hier_adasum"),
                  explore_backend: bool = False,
                  backend_candidates=("phase", "fused"),
                  store="env",
@@ -137,10 +137,12 @@ class ScheduleTuner:
             None if explore_backend else "env"
         )
         # Lowering exploration (the HVD_TPU_TOPO_LOWER knob as a tuned
-        # dimension): each window runs one candidate, scored from the
-        # same registry deltas; the winner freezes.  On a single-slice
-        # topology "hier" resolves flat anyway, so exploration is
-        # skipped and the knob pins to "flat" immediately.
+        # dimension): each window runs one candidate — including
+        # hier_adasum, the adaptive cross-slice combine the cost model
+        # never picks on its own — scored from the same registry
+        # deltas; the winner freezes.  On a single-slice topology every
+        # candidate resolves flat anyway, so exploration is skipped and
+        # the knob pins to "flat" immediately.
         self._explore_lowering = explore_lowering
         self._lowering_candidates = tuple(lowering_candidates)
         self._lowering_scores: Dict[str, float] = {}
@@ -393,7 +395,8 @@ class ScheduleTuner:
             buckets.append(_dc.replace(
                 b,
                 wire=eligible_wire(req, b.wire_dtypes),
-                lowering=resolve_lowering(lo, b.nbytes),
+                lowering=resolve_lowering(lo, b.nbytes,
+                                          wire_dtypes=b.wire_dtypes),
             ))
         return _dc.replace(schedule, buckets=tuple(buckets))
 

@@ -40,10 +40,11 @@ class _BucketLayout:
     padded: int  # n rounded up to a shard-count multiple
     shard_len: int
     wire: str = "off"  # per-bucket wire format (plan.WIRE_CHOICES)
-    # per-bucket lowering (plan.LOWER_CHOICES): "hier" shards over the
-    # ICI sub-axis only — k = slice_size shards, replicated across
-    # slices — so the optimizer update and its all_gather never cross
-    # DCN; only the 1/k gradient reduction does.
+    # per-bucket lowering (plan.LOWER_CHOICES): "hier"/"hier_adasum"
+    # shard over the ICI sub-axis only — k = slice_size shards,
+    # replicated across slices — so the optimizer update and its
+    # all_gather never cross DCN; only the 1/k gradient reduction
+    # (plain sum for "hier", adaptive summation for "hier_adasum") does.
     lowering: str = "flat"
     shards: int = 0  # world (flat) or slice_size (hier)
 
@@ -75,7 +76,7 @@ def _layouts(
         # Hier buckets shard over the ICI sub-axis only: k shards per
         # slice, the shard replicated across slices, so the optimizer
         # update and its all_gather stay on ICI.
-        shards = k_ici if lowering == "hier" else world
+        shards = k_ici if lowering in ("hier", "hier_adasum") else world
         unit = shards
         if b.wire in ("int8", "fp8"):
             # Quantized shards must stay block-aligned so the
@@ -161,8 +162,12 @@ def bucketed_zero_step(
     gradient shard's cross-slice sum does (and only that hop carries a
     compressed wire).  Optimizer state shrinks k-fold instead of
     N-fold: the slice-vs-world sharding trade documented in
-    docs/topology.md.  Single-slice topologies resolve every bucket
-    flat and reproduce the PR 3/4 behavior exactly.
+    docs/topology.md.  ``hier_adasum`` buckets shard identically but
+    the cross-slice hop adaptively combines the per-slice *mean*
+    shards (Adasum, arXiv:2006.02924) before the sharded update — the
+    large-batch lowering, docs/adasum.md.  Single-slice topologies
+    resolve every bucket flat and reproduce the PR 3/4 behavior
+    exactly.
     """
     from jax.sharding import PartitionSpec as P
 
@@ -186,13 +191,13 @@ def bucketed_zero_step(
         # gradient, so a gradient-shaped residual has nothing to absorb.
         return (
             cfg.wire_ef and lay.wire in ("int8", "fp8")
-            and lay.lowering != "hier"
+            and lay.lowering not in ("hier", "hier_adasum")
         )
 
     def _shard_index(lay: _BucketLayout, idx):
-        # Hier buckets shard over the ICI sub-axis: position within the
-        # slice (slice-major device order, topo/ contract).
-        if lay.lowering == "hier":
+        # Hier-family buckets shard over the ICI sub-axis: position
+        # within the slice (slice-major device order, topo/ contract).
+        if lay.lowering in ("hier", "hier_adasum"):
             return lax.rem(idx, lay.shards)
         return idx
 
@@ -241,24 +246,33 @@ def bucketed_zero_step(
         token = None
         intra = (
             _intra_groups()
-            if any(lay.lowering == "hier" for lay in layouts) else None
+            if any(lay.lowering in ("hier", "hier_adasum")
+                   for lay in layouts) else None
         )
         for lay, st in zip(layouts, opt_states):
             g = _bucket_flat(gleaves, lay)
             if cfg.barriers and token is not None:
                 g, token = lax.optimization_barrier((g, token))
-            if lay.lowering == "hier":
+            if lay.lowering in ("hier", "hier_adasum"):
                 # ICI reduce_scatter to the slice-local 1/k shard, then
-                # the cross-slice sum over DCN — the only slow-network
-                # hop, and the only one the bucket's wire compresses.
-                from ..topo import dcn_all_reduce
+                # the cross-slice hop over DCN — the only slow-network
+                # leg, and the only one the bucket's wire compresses.
+                # "hier" sums across slices (then /world = global
+                # mean); "hier_adasum" adaptively combines the
+                # per-slice means (arXiv:2006.02924) on the 1/k shard
+                # before the sharded update.
+                from ..topo import dcn_adasum, dcn_all_reduce
 
                 shard = lax.psum_scatter(
                     g, axis, scatter_dimension=0, tiled=True,
                     axis_index_groups=intra,
                 )
-                shard = dcn_all_reduce(shard, axis, wire=lay.wire)
-                shard = shard / world
+                if lay.lowering == "hier_adasum":
+                    shard = shard / lay.shards  # slice mean
+                    shard = dcn_adasum(shard, axis, wire=lay.wire)
+                else:
+                    shard = dcn_all_reduce(shard, axis, wire=lay.wire)
+                    shard = shard / world
                 new_residuals.append(None)
             elif lay.wire in ("int8", "fp8"):
                 if _ef_on(lay):
@@ -304,7 +318,7 @@ def bucketed_zero_step(
                 new_states.append({"tx": tx_state, "ef": r_new})
             else:
                 new_states.append(tx_state)
-            if lay.lowering == "hier":
+            if lay.lowering in ("hier", "hier_adasum"):
                 # ICI-only gather: every slice holds the full shard
                 # set, so the updated parameters reassemble without
                 # touching DCN (dense — the wire compressed only the

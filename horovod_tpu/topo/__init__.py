@@ -34,7 +34,11 @@ first-class model of that fact:
                      quantizes.
 
 The bucketed overlap scheduler (``sched/``) consumes both: each bucket
-carries a ``lowering ∈ {flat, hier}`` chosen by the cost model
+carries a ``lowering ∈ {flat, hier, hier_adasum}`` — ``hier_adasum``
+(:func:`hierarchical_adasum_all_reduce`) keeps hier's ICI staging but
+combines across slices with Adasum's adaptive summation
+(arXiv:2006.02924, docs/adasum.md); the sum-preserving pair is chosen
+by the cost model
 (``HVD_TPU_TOPO_LOWER=auto``), ZeRO-1 shards land on the ICI sub-axis
 so the optimizer update never crosses DCN, and ``topo.dcn_bytes`` /
 ``topo.ici_bytes`` flow into the telemetry registry.  A single-slice
@@ -45,7 +49,9 @@ See docs/topology.md.
 from . import fit, hierarchical, model  # noqa: F401
 from .fit import record_observation  # noqa: F401
 from .hierarchical import (  # noqa: F401
+    dcn_adasum,
     dcn_all_reduce,
+    hierarchical_adasum_all_reduce,
     hierarchical_all_gather,
     hierarchical_all_reduce,
     hierarchical_reduce_scatter,

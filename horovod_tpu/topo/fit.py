@@ -133,7 +133,7 @@ def record_observation(collective: str, lowering: str, nbytes: int,
     empty payload) are dropped silently — the hot path never raises."""
     global _obs_count
     if (collective not in _FIT_COLLECTIVES
-            or lowering not in ("flat", "hier")
+            or lowering not in ("flat", "hier", "hier_adasum")
             or axis_size <= 1 or nbytes <= 0 or seconds < 0):
         return
     name = cell_name(collective, lowering, axis_size, nbytes)
@@ -156,7 +156,7 @@ def observed_cells() -> List[Cell]:
             continue
         collective, lowering, n_tag, _b_tag = parts
         if (collective not in _FIT_COLLECTIVES
-                or lowering not in ("flat", "hier")
+                or lowering not in ("flat", "hier", "hier_adasum")
                 or not n_tag.startswith("n")):
             continue
         try:

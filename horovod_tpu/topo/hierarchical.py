@@ -28,6 +28,14 @@ all_to_all rides the same replica groups); ``wire="bf16"`` casts just
 the DCN hop.  A single-slice topology (or an axis that cannot factor)
 degenerates to the flat collective — bitwise-identical to today's path.
 
+A third staging — :func:`hierarchical_adasum_all_reduce`, the
+``hier_adasum`` lowering — keeps the same three phases but replaces the
+cross-slice *sum* with Adasum's adaptive dot-product combination
+(arXiv:2006.02924): plain sum over ICI where gradients barely diverge,
+adaptive summation across slices where divergence actually lives.  Its
+DCN hop is one all_gather of the 1/k shard plus per-level 3-scalar
+psums, so it moves *fewer* DCN bytes than ``hier``'s all_reduce.
+
 The quantized-wire *backend* (``HVD_TPU_QUANT_BACKEND``) composes here
 unchanged: the quantized hop dispatches through ``ops/quantized.py``,
 whose fused Pallas lowering (``ops/pallas_quant.py``) serves it on the
@@ -146,6 +154,177 @@ def _dcn_sum(shard: jax.Array, ctx, wire: str) -> jax.Array:
             shard.astype(jnp.bfloat16), ctx
         ).astype(shard.dtype)
     return _dcn_sum_dense(shard, ctx)
+
+
+def _psum_all(v: jax.Array, ctx) -> jax.Array:
+    if ctx["mode"] == "axes":
+        return lax.psum(v, (ctx["outer"], ctx["inner"]))
+    return lax.psum(v, ctx["axis"])
+
+
+def _adasum_tree(parts, ctx):
+    """Adasum binary tree over per-slice contributions, on local compute.
+
+    ``parts`` is a list of ``s`` fp32 rail-shards (this rank's 1/k chunk
+    of each slice's contribution, already gathered over DCN).  The pair
+    coefficients need *full-vector* dot/norms; each rank only holds one
+    rail, so every level batches its pairs into one ``(npairs, 3)``
+    psum over the whole axis — the ``ops/adasum.py`` slotted-psum trick
+    at hierarchical addressing.  Each rail's locals are replicated on
+    every slice member of its cross group, so the psum over all s·k
+    ranks overcounts by exactly ``s``; dividing restores the true
+    full-vector scalars.  Non-power-of-two slice counts fold stragglers
+    into the leading cores first (the reference's communicator
+    construction, ``adasum_mpi.cc``), then the power-of-two tree runs —
+    the same recursion as the flat VHDD, so values match the flat
+    Adasum of the per-slice contributions up to fp ordering.
+    """
+    s = len(parts)
+
+    def combine(pairs):
+        scal = jnp.stack([
+            jnp.stack([jnp.sum(a * b), jnp.sum(a * a), jnp.sum(b * b)])
+            for a, b in pairs
+        ])
+        sums = _psum_all(scal, ctx) / s
+        outs = []
+        for i, (a, b) in enumerate(pairs):
+            dot, na, nb = sums[i, 0], sums[i, 1], sums[i, 2]
+            ca = jnp.where(na > 0, 1.0 - dot / (2.0 * na), 1.0)
+            cb = jnp.where(nb > 0, 1.0 - dot / (2.0 * nb), 1.0)
+            outs.append(ca * a + cb * b)
+        return outs
+
+    vals = list(parts)
+    p = 1 << (s.bit_length() - 1)
+    extras = s - p
+    if extras:
+        folded = combine([(vals[i], vals[p + i]) for i in range(extras)])
+        vals = folded + vals[extras:p]
+    while len(vals) > 1:
+        vals = combine(
+            [(vals[2 * i], vals[2 * i + 1]) for i in range(len(vals) // 2)]
+        )
+    return vals[0]
+
+
+def _dcn_adasum(shard: jax.Array, ctx, wire: str) -> jax.Array:
+    """Cross-slice adaptive summation on the 1/k shard (the
+    ``hier_adasum`` DCN hop): one all_gather of every slice's shard over
+    the DCN rails — the only bulk DCN payload, and the only leg a
+    quantized/bf16 ``wire`` compresses — then the Adasum tree combines
+    the gathered contributions in fp32 on local compute, with exact
+    full-vector coefficients from per-level 3-scalar psums."""
+    s = ctx["s"]
+    dtype = shard.dtype
+    L = shard.shape[0]
+    w = (wire or "off").lower()
+    floating = jnp.issubdtype(dtype, jnp.floating)
+    if w in ("int8", "fp8") and floating:
+        from ..ops.quantized import quantized_all_gather
+
+        if ctx["mode"] == "axes":
+            gathered = quantized_all_gather(
+                shard.astype(jnp.float32), ctx["outer"], wire=w
+            )
+        else:
+            gathered = quantized_all_gather(
+                shard.astype(jnp.float32), ctx["axis"], wire=w,
+                groups=ctx["cross"],
+            )
+        gathered = gathered[: s * L]
+    else:
+        g = shard
+        if w == "bf16" and floating and dtype != jnp.bfloat16:
+            g = g.astype(jnp.bfloat16)
+        if ctx["mode"] == "axes":
+            gathered = lax.all_gather(g, ctx["outer"], tiled=True)
+        else:
+            gathered = lax.all_gather(
+                g, ctx["axis"], axis_index_groups=ctx["cross"], tiled=True
+            )
+    parts = gathered.astype(jnp.float32).reshape(s, L)
+    out = _adasum_tree([parts[j] for j in range(s)], ctx)
+    return out.astype(dtype)
+
+
+def dcn_adasum(
+    shard: jax.Array,
+    axis: Axis = WORLD_AXIS,
+    topo: Optional[model.Topology] = None,
+    *,
+    wire: str = "off",
+) -> jax.Array:
+    """Adaptively combine ``shard`` across slices only (the
+    ``hier_adasum`` DCN hop on its own — the ZeRO-1 path feeds its
+    ICI-resident slice-mean shard through this before the sharded
+    optimizer update).  ``wire`` compresses just this hop; identity on
+    a single-slice topology (Adasum of one contribution)."""
+    ctx = _hier_ctx(axis, topo)
+    if ctx is None:
+        return shard
+    return _dcn_adasum(shard, ctx, wire)
+
+
+def hierarchical_adasum_all_reduce(
+    x: jax.Array,
+    axis: Axis = WORLD_AXIS,
+    op: int = Average,
+    topo: Optional[model.Topology] = None,
+    *,
+    wire: str = "off",
+) -> jax.Array:
+    """Two-level adaptive-summation allreduce — the ``hier_adasum``
+    lowering (arXiv:2006.02924 composed with the hierarchy): plain sum
+    over ICI inside the slice (where gradients barely diverge), Adasum
+    across slices on the DCN hop (where divergence actually lives),
+    staged as intra-slice psum_scatter → cross-slice Adasum on the 1/k
+    shard → intra-slice all_gather.
+
+    ``op=Average`` returns the Adasum of per-slice *mean* gradients
+    (the reference ``AdasumGpuAllreduceOp`` postscale semantics,
+    ``operations.cc:1404-1410``); ``op=Sum`` the Adasum of per-slice
+    sums.  A quantized/bf16 ``wire`` compresses only the DCN gather.
+    On a single-slice topology (or a non-factorable axis) this
+    degenerates to the plain flat sum/mean — Adasum of one contribution
+    is the identity — though the plan layer resolves such buckets to
+    ``flat`` before ever reaching here."""
+    if op not in (Sum, Average):
+        raise HorovodTpuError(
+            "hierarchical_adasum_all_reduce supports Sum/Average slice "
+            "reductions (the cross-slice combine is always Adasum)"
+        )
+    if not jnp.issubdtype(x.dtype, jnp.floating):
+        raise HorovodTpuError(
+            "hier_adasum needs a floating dtype: the pair coefficients "
+            "divide by gradient norms (integer buckets lower flat)"
+        )
+    ctx = _hier_ctx(axis, topo)
+    if ctx is None:
+        y = lax.psum(x, axis)
+        if op == Average:
+            n = lax.axis_size(axis) if isinstance(axis, str) else (
+                lax.axis_size(axis[0]) * lax.axis_size(axis[1])
+            )
+            y = y / n
+        return y.astype(x.dtype)
+    shape, dtype, V = x.shape, x.dtype, x.size
+    k = ctx["k"]
+    flat = x.reshape(-1)
+    unit = k
+    if (wire or "off").lower() in ("int8", "fp8"):
+        from ..ops.quantized import quant_block
+
+        unit *= quant_block()
+    pad = (-V) % unit
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    shard = _ici_reduce_scatter(flat, ctx)
+    if op == Average:
+        shard = shard / k  # slice mean: Adasum combines per-slice averages
+    shard = _dcn_adasum(shard, ctx, wire)
+    out = _ici_all_gather(shard, ctx)[:V].reshape(shape)
+    return out.astype(dtype)
 
 
 def hierarchical_all_reduce(

@@ -41,7 +41,11 @@ from ..utils import env
 from ..utils.logging import get_logger
 
 # Lowering choices a collective (or a scheduler bucket) can carry.
-LOWER_CHOICES = ("flat", "hier")
+# "hier_adasum" keeps hier's ICI staging but combines across slices
+# with Adasum's adaptive summation (arXiv:2006.02924) instead of a
+# plain sum — an algorithm choice, so "auto" never picks it; it is
+# requested explicitly (knob / tuner / DistributedAdasumOptimizer).
+LOWER_CHOICES = ("flat", "hier", "hier_adasum")
 
 # Cost-model defaults: ~10x ICI-vs-DCN bandwidth (arXiv:1810.11112's
 # two-level regime), per-hop wire latencies, and a fixed per-collective
@@ -211,8 +215,15 @@ class Topology:
         if s == 1:
             return "flat"
         mode = lower_mode()
+        if mode == "hier_adasum" and collective != "all_reduce":
+            # Adaptive summation is an allreduce-shaped combine; a
+            # forced hier_adasum knob still stages RS/AG hierarchically.
+            return "hier"
         if mode in LOWER_CHOICES:
             return mode
+        # "auto" compares the two sum-preserving lowerings only:
+        # hier_adasum changes the reduction algorithm, never a silent
+        # cost-model pick.
         flat = self.estimate_cost(collective, nbytes, "flat", n)
         hier = self.estimate_cost(collective, nbytes, "hier", n)
         return "hier" if hier < flat else "flat"
@@ -238,6 +249,14 @@ class Topology:
         if lowering == "flat":
             return {
                 "dcn": int(phases * nbytes * (s - 1) / s),
+                "ici": int(phases * nbytes * (k - 1) / k),
+            }
+        if lowering == "hier_adasum":
+            # One cross-slice all_gather of the 1/k shard (the scalar
+            # dot-product rounds are byte-free): strictly no more DCN
+            # bytes than hier's 1/k all_reduce.
+            return {
+                "dcn": int((nbytes / k) * (s - 1) / s),
                 "ici": int(phases * nbytes * (k - 1) / k),
             }
         return {
@@ -274,6 +293,28 @@ def cost_coefficients(
         if s > 1:  # flat over a multi-slice axis rides DCN end to end
             return (1.0, 0.0, hops, 0.0, moved)
         return (1.0, hops, 0.0, moved, 0.0)
+    if lowering == "hier_adasum":
+        # ICI legs as hier (RS + AG of the full buffer); the DCN leg is
+        # one all_gather of the 1/k shard plus the extra dot-product
+        # rounds — ceil(log2 p) tree levels (+1 fold on a non-power-of-
+        # two slice count) of a 3-scalar psum each, priced as one phase
+        # overhead and a DCN latency ring per round (their bytes are
+        # negligible).  Still linear in the five parameters, so the
+        # fitter (topo/fit.py) consumes the row unchanged.
+        p2 = 1 << ((s).bit_length() - 1)
+        rounds = (p2.bit_length() - 1) + (1 if s != p2 else 0)
+        po = 0.0
+        ici_hops = ici_bytes = 0.0
+        if k > 1:
+            po += 1.0
+            ici_hops = phases * (k - 1)
+            ici_bytes = phases * nbytes * (k - 1) / k
+        po += 1.0 + rounds
+        if collective == "all_reduce":
+            po += 1.0  # separate ICI RS / AG launches
+        dcn_hops = (s - 1) * (1.0 + rounds)
+        dcn_bytes = (nbytes / k) * (s - 1) / s
+        return (po, ici_hops, dcn_hops, ici_bytes, dcn_bytes)
     po = 0.0
     ici_hops = ici_bytes = 0.0
     if k > 1:
@@ -482,15 +523,20 @@ def reset() -> None:
 
 
 def lower_mode() -> str:
-    """``HVD_TPU_TOPO_LOWER`` policy: ``auto`` (cost model decides),
-    ``flat`` (``off``), or ``hier`` (``on``)."""
+    """``HVD_TPU_TOPO_LOWER`` policy: ``auto`` (cost model decides
+    between the sum-preserving lowerings), ``flat`` (``off``), ``hier``
+    (``on``), or ``hier_adasum`` (``adasum`` — force the adaptive
+    cross-slice combine on every eligible bucket)."""
     raw = (env.get_env(env.TOPO_LOWER, "auto") or "auto").strip().lower()
     if raw in ("off", "0", "false", "no", "flat", ""):
         return "flat"
     if raw in ("on", "1", "true", "yes", "hier", "hierarchical"):
         return "hier"
+    if raw in ("hier_adasum", "adasum"):
+        return "hier_adasum"
     if raw != "auto":
         raise HorovodTpuError(
-            f"HVD_TPU_TOPO_LOWER must be auto|flat|hier (got {raw!r})"
+            f"HVD_TPU_TOPO_LOWER must be auto|flat|hier|hier_adasum "
+            f"(got {raw!r})"
         )
     return "auto"

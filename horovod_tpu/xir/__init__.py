@@ -44,6 +44,7 @@ from .ir import (  # noqa: F401
     all_gather,
     all_reduce,
     all_to_all,
+    eligible_lowering,
     eligible_wire,
     gather_dense_from_sparse,
     permute,

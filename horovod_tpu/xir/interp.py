@@ -111,6 +111,12 @@ def _run_all_reduce(op: ir.ExchangeOp, x: jax.Array, residual=None):
 
     mean = (op.attr("reduce") or "sum") == "mean"
     red = Average if mean else Sum
+    if op.lowering == "hier_adasum":
+        from ..topo import hierarchical_adasum_all_reduce
+
+        return hierarchical_adasum_all_reduce(
+            x, op.axis, op=red, wire=op.wire
+        )
     if op.lowering == "hier":
         from ..topo import hierarchical_all_reduce
 
@@ -155,6 +161,16 @@ def _run_reduce_scatter(op: ir.ExchangeOp, x: jax.Array):
 
     mean = (op.attr("reduce") or "sum") == "mean"
     red = Average if mean else Sum
+    if op.lowering == "hier_adasum":
+        # A standalone adasum reduce_scatter has no meaning: the
+        # adaptive combine needs the paired all_gather (the scheduler's
+        # RS+AG exchange drives hier_adasum buckets through
+        # sched/execute.hier_adasum_flat, never this runner).
+        raise HorovodTpuError(
+            "reduce_scatter ops cannot run lowering='hier_adasum' "
+            "standalone; use the scheduler's paired RS+AG exchange "
+            "(or an all_reduce op)"
+        )
     if op.lowering == "hier":
         from ..topo import hierarchical_reduce_scatter
 

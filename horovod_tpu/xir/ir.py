@@ -51,8 +51,11 @@ OPS = (
 WIRE_CHOICES = ("off", "bf16", "int8", "fp8")
 
 # Lowerings an op may carry.  "auto" is resolved by the lowering pass;
-# a lowered program contains only "flat"/"hier".
-LOWER_CHOICES = ("flat", "hier", "auto")
+# a lowered program contains only "flat"/"hier"/"hier_adasum" (the
+# last — Adasum's adaptive cross-slice combine — only on float
+# reduce-shaped ops, and never from "auto": it changes the reduction
+# algorithm, so it must be requested explicitly).
+LOWER_CHOICES = ("flat", "hier", "hier_adasum", "auto")
 
 # Ops the hierarchical (ICI/DCN two-level) lowering exists for.  The
 # shuffle-shaped ops (all_to_all / permute / sparse gather) have no
@@ -218,6 +221,28 @@ def eligible_wire(op: str, wire: str, dtype: Any = None) -> str:
     if op in REDUCE_OPS:
         return wire
     return "bf16" if wire == "bf16" else "off"
+
+
+def eligible_lowering(op: str, lowering: str, dtype: Any = None) -> str:
+    """Downgrade a requested lowering to what the op class supports.
+
+    Only ``hier_adasum`` has eligibility rules of its own: the adaptive
+    combination is reduce-shaped (there is nothing to adaptively sum in
+    an all_gather or a shuffle) and its pair coefficients divide by
+    gradient norms, so it serves ``all_reduce``/``reduce_scatter`` ops
+    with floating payloads only — everything else falls back to
+    ``flat`` (plain sum; never a half-applied algorithm change).
+    """
+    if lowering != "hier_adasum":
+        return lowering
+    if op not in ("all_reduce", "reduce_scatter"):
+        return "flat"
+    if dtype is not None:
+        import jax.numpy as jnp
+
+        if not jnp.issubdtype(jnp.dtype(dtype), jnp.floating):
+            return "flat"
+    return lowering
 
 
 # ------------------------------------------------------------ builders

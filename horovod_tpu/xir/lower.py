@@ -74,13 +74,30 @@ def _with_backend(op: ir.ExchangeOp) -> ir.ExchangeOp:
 def resolve_lowering(op: ir.ExchangeOp,
                      axis_size: Optional[int] = None) -> str:
     """Concrete lowering for one op: shuffle ops are always flat;
-    reduce ops honor a forced choice and ask the cost model under
-    "auto" (single-slice topologies and non-factorable axes resolve
-    flat, reproducing the pre-topology program exactly)."""
+    reduce ops honor a forced choice — ``hier_adasum`` gated by
+    :func:`~horovod_tpu.xir.ir.eligible_lowering` (float reduce ops
+    only) and by the topology (single-slice resolves flat, like the
+    plan stage) — and ask the cost model under "auto" (which compares
+    the sum-preserving pair only; single-slice topologies and
+    non-factorable axes resolve flat, reproducing the pre-topology
+    program exactly)."""
     if op.op not in ir.REDUCE_OPS or op.groups is not None:
         return "flat"
     if op.lowering != "auto":
-        return op.lowering
+        lowering = ir.eligible_lowering(
+            op.op, op.lowering, op.attr("dtype")
+        )
+        if lowering == "hier_adasum":
+            from ..topo import model as topo_model
+
+            n = axis_size
+            if n is None and not isinstance(op.axis, tuple):
+                n = topo_model.current().world
+            if n is not None:
+                s, _ = topo_model.current().factor_axis(n)
+                if s == 1:
+                    return "flat"
+        return lowering
     from ..topo import model as topo_model
 
     topo = topo_model.current()
@@ -151,14 +168,14 @@ def _store_sync(program: ir.ExchangeProgram) -> ir.ExchangeProgram:
     lowering = str(entry.get("lowering", "flat"))
     if wire not in ir.WIRE_CHOICES:
         wire = "off"
-    if lowering not in ("flat", "hier"):
+    if lowering not in ("flat", "hier", "hier_adasum"):
         lowering = "flat"
     ops = []
     for op in program.ops:
         new_wire = ir.eligible_wire(op.op, wire, op.attr("dtype"))
-        new_lower = lowering if (
-            op.op in ir.REDUCE_OPS and op.groups is None
-        ) else "flat"
+        new_lower = ir.eligible_lowering(
+            op.op, lowering, op.attr("dtype")
+        ) if (op.op in ir.REDUCE_OPS and op.groups is None) else "flat"
         ops.append(_with_backend(
             op.replace(wire=new_wire, lowering=new_lower)
         ))

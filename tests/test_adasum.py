@@ -302,3 +302,89 @@ def test_hierarchical_adasum_env_knob(hvd_module, monkeypatch):
                                        rtol=1e-4, atol=1e-5)
     finally:
         rt.local_size, rt.cross_size = old
+
+
+# ---- hierarchical Adasum as a lowering (PR 10, docs/adasum.md) ---------
+
+
+@pytest.mark.adasum
+def test_topo_slice_grid_serves_eager_hierarchical(hvd_module,
+                                                   monkeypatch):
+    """A forced cross-slice topology (no multi-host grid) now serves
+    the hierarchical Adasum schedule: intra-slice sum, cross-slice
+    VHDD on the rails, /slice_size postscale."""
+    from horovod_tpu import topo
+
+    monkeypatch.setenv("HVD_TPU_HIERARCHICAL_ALLREDUCE", "1")
+    monkeypatch.setenv("HVD_TPU_TOPO", "2x4")
+    topo.reset()
+    try:
+        rng = np.random.RandomState(11)
+        x = rng.randn(N, 33).astype(np.float32)
+        y = np.asarray(hvd.allreduce(x, op=hvd.Adasum))
+        expected = adasum_np([x[:4].mean(0), x[4:].mean(0)])
+        for r in range(N):
+            np.testing.assert_allclose(y[r], expected[r // 4],
+                                       rtol=1e-4, atol=1e-5)
+    finally:
+        topo.reset()
+
+
+@pytest.mark.adasum
+def test_large_batch_stability_property(hvd_module, monkeypatch):
+    """Quadratic-bowl convergence property (the Adasum paper's
+    large-batch claim, arXiv:2006.02924): at 4x the batch the learning
+    rate was tuned for, summed gradients step past the stability
+    boundary (8*lr*curvature > 2) and diverge, while the hier_adasum
+    lowering — sum inside the slice, adaptive combination of the
+    near-parallel slice aggregates across DCN — stays in the stable
+    region (4*lr*curvature < 2) and reaches the loss target with NO LR
+    retuning.  Adasum stability >= plain sum, measured, not assumed."""
+    from horovod_tpu import sched, topo
+
+    monkeypatch.setenv("HVD_TPU_TOPO", "2x4")
+    topo.reset()
+    try:
+        d = 4
+        curv = np.asarray([1.0, 0.5, 0.25, 0.125], np.float32)
+        wstar = np.asarray([2.0, -1.0, 0.5, 1.5], np.float32)
+        lr = 1.5 / (4.0 * float(curv.max()))
+        batch = (
+            jnp.asarray(np.tile(curv, (N, 1))),
+            jnp.asarray(np.tile(wstar, (N, 1))),
+        )
+
+        def loss_fn(p, b):
+            h, ws = b
+            return 0.5 * jnp.mean(
+                jnp.sum(h * (p["w"] - ws) ** 2, axis=-1)
+            )
+
+        def run(lowering, steps=40):
+            params = {"w": jnp.zeros((d,))}
+            sched.set_config_override(sched.SchedConfig(
+                enabled=True, bucket_bytes=4096, lowering=lowering))
+            try:
+                tx = hvd.DistributedOptimizer(optax.sgd(lr), op=hvd.Sum)
+                step = hvd.distributed_train_step(loss_fn, tx)
+                st = step.init(params)
+                out = []
+                for _ in range(steps):
+                    params, st, loss = step(params, st, batch)
+                    out.append(float(loss))
+                    if not np.isfinite(out[-1]) or out[-1] > 1e9:
+                        break
+                return out
+            finally:
+                sched.set_config_override(None)
+
+        flat = run("flat")
+        adasum = run("hier_adasum")
+        target = 1e-3
+        assert adasum[-1] < target, f"adasum did not converge: {adasum}"
+        assert not np.isfinite(flat[-1]) or flat[-1] > adasum[-1], \
+            f"plain sum unexpectedly stable: {flat[-1]}"
+        # monotone stability: the adasum trajectory never blows up
+        assert all(np.isfinite(v) for v in adasum)
+    finally:
+        topo.reset()

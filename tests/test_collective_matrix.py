@@ -456,6 +456,362 @@ class TestHierarchicalColumn:
             assert chosen["dcn"] <= flat["dcn"], b
 
 
+def _adasum_pair_np(a, b):
+    a = a.astype(np.float64)
+    b = b.astype(np.float64)
+    dot, na, nb = (a * b).sum(), (a * a).sum(), (b * b).sum()
+    ca = 1.0 - dot / (2.0 * na) if na > 0 else 1.0
+    cb = 1.0 - dot / (2.0 * nb) if nb > 0 else 1.0
+    return ca * a + cb * b
+
+
+@pytest.mark.adasum
+class TestHierAdasumColumn:
+    """hier_adasum lowering column: plain sum over ICI, Adasum's
+    adaptive combination across slices on the DCN hop (topo/, forced
+    2-slice topology) — dtype sweep vs the NumPy reference, single-
+    slice flat degeneration, process-set downgrade, quantized DCN hop,
+    and the scheduler/ZeRO-1/tuner integration gauges."""
+
+    @pytest.fixture(autouse=True)
+    def _forced_two_slice(self, monkeypatch):
+        from horovod_tpu import topo
+
+        monkeypatch.setenv("HVD_TPU_TOPO", "2x4")
+        topo.reset()
+        yield
+        topo.reset()
+
+    def _run(self, fn, *args, n_out=1):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu.runtime import WORLD_AXIS, get_runtime
+
+        mesh = get_runtime().mesh
+        spec = P(WORLD_AXIS)
+        return jax.jit(jax.shard_map(
+            fn, mesh=mesh, in_specs=(spec,) * len(args),
+            out_specs=(spec,) * n_out if n_out > 1 else spec,
+            check_vma=False,
+        ))(*args)
+
+    def _sched_losses(self, lowering, steps=8, op=None, compression=None):
+        import jax.numpy as jnp
+        import optax
+
+        from horovod_tpu import sched
+
+        X = np.random.RandomState(1).randn(16, 4).astype(np.float32)
+        Y = (X @ np.full((4, 2), 0.7)).astype(np.float32)
+
+        def loss_fn(p, b):
+            x, y = b
+            return jnp.mean((x @ p["w1"] @ p["w2"] + p["b"] - y) ** 2)
+
+        params = {"w1": jnp.full((4, 4), 0.2),
+                  "w2": jnp.full((4, 2), 0.5), "b": jnp.zeros((2,))}
+        sched.set_config_override(sched.SchedConfig(
+            enabled=True, bucket_bytes=64, lowering=lowering))
+        try:
+            kw = {}
+            if op is not None:
+                kw["op"] = op
+            if compression is not None:
+                kw["compression"] = compression
+            tx = hvd.DistributedOptimizer(optax.sgd(0.1), **kw)
+            step = hvd.distributed_train_step(loss_fn, tx)
+            st = step.init(params)
+            batch = (jnp.asarray(X), jnp.asarray(Y))
+            out = []
+            for _ in range(steps):
+                params, st, loss = step(params, st, batch)
+                out.append(float(loss))
+            return out
+        finally:
+            sched.set_config_override(None)
+
+    @pytest.mark.parametrize(
+        "dtype", [np.float32, np.float16, jnp.bfloat16], ids=str
+    )
+    def test_allreduce_vs_numpy_reference(self, hvd_module, dtype):
+        """op=Average: Adasum of per-slice mean gradients (the
+        reference AdasumGpuAllreduceOp postscale semantics)."""
+        from horovod_tpu import topo
+        from horovod_tpu.ops.traced import Average
+        from horovod_tpu.runtime import WORLD_AXIS
+
+        x = _data(dtype, shape=(N, 37), seed=30)
+
+        def f(a):
+            return topo.hierarchical_adasum_all_reduce(
+                a, WORLD_AXIS, op=Average
+            )
+
+        out = np.asarray(self._run(f, x), np.float64)
+        xs = np.asarray(x, np.float64)
+        expect = _adasum_pair_np(xs[:4].mean(0), xs[4:].mean(0))
+        for r in range(N):
+            np.testing.assert_allclose(out[r], expect, **_tol(dtype))
+
+    def test_allreduce_sum_semantics(self, hvd_module):
+        """op=Sum: Adasum of per-slice sums."""
+        from horovod_tpu import topo
+        from horovod_tpu.ops.traced import Sum
+        from horovod_tpu.runtime import WORLD_AXIS
+
+        x = _data(np.float32, shape=(N, 53), seed=31)
+
+        def f(a):
+            return topo.hierarchical_adasum_all_reduce(
+                a, WORLD_AXIS, op=Sum
+            )
+
+        out = np.asarray(self._run(f, x), np.float64)
+        xs = np.asarray(x, np.float64)
+        expect = _adasum_pair_np(xs[:4].sum(0), xs[4:].sum(0))
+        np.testing.assert_allclose(out[0], expect, rtol=1e-5, atol=1e-5)
+
+    def test_non_float_rejected_and_bucket_resolves_flat(self,
+                                                         hvd_module):
+        from horovod_tpu import sched, topo
+        from horovod_tpu.runtime import WORLD_AXIS
+
+        x = _data(np.int32, shape=(N, 8), seed=32)
+        with pytest.raises(HorovodTpuError, match="floating"):
+            self._run(
+                lambda a: topo.hierarchical_adasum_all_reduce(
+                    a, WORLD_AXIS
+                ),
+                x,
+            )
+        # plan-level eligibility: integer buckets resolve flat
+        s = sched.build_schedule(
+            [4096], ["int32"],
+            sched.SchedConfig(bucket_bytes=8192,
+                              lowering="hier_adasum"),
+        )
+        assert s.buckets[0].lowering == "flat"
+
+    def test_single_slice_resolves_flat_bitwise(self, hvd_module,
+                                                monkeypatch):
+        """Acceptance: on a forced single-slice topology a hier_adasum
+        request resolves flat and f32 dense losses are bitwise
+        identical to the flat run (and auto never selects it)."""
+        from horovod_tpu import sched, topo
+
+        monkeypatch.setenv("HVD_TPU_TOPO", "1x8")
+        topo.reset()
+        try:
+            assert sched.resolve_lowering("hier_adasum", 1 << 20) == \
+                "flat"
+            flat = self._sched_losses("flat")
+            ha = self._sched_losses("hier_adasum")
+            auto = self._sched_losses("auto")
+            assert flat == ha == auto
+        finally:
+            topo.reset()
+
+    def test_auto_never_selects_hier_adasum(self, hvd_module):
+        from horovod_tpu import sched
+
+        rng = np.random.RandomState(7)
+        sizes = [int(rng.randint(64, 1 << 24)) for _ in range(30)]
+        schedule = sched.build_schedule(
+            sizes, ["float32"] * len(sizes),
+            sched.SchedConfig(bucket_bytes=1 << 18, lowering="auto"),
+        )
+        assert all(b.lowering in ("flat", "hier")
+                   for b in schedule.buckets)
+
+    def test_process_set_restriction_stays_flat(self, hvd_module,
+                                                monkeypatch):
+        """A process-set-restricted exchange cannot carry the slice
+        groups: the plan downgrades to flat and values match the
+        per-set allreduce exactly."""
+        monkeypatch.setenv("HVD_TPU_DYNAMIC_PROCESS_SETS", "1")
+        from horovod_tpu import sched
+
+        ps = hvd.add_process_set([0, 1, 2, 3])
+        sched.set_config_override(
+            sched.SchedConfig(bucket_bytes=64, lowering="hier_adasum")
+        )
+        try:
+            x = _data(np.float32, seed=33)
+            y = np.asarray(hvd.allreduce(x, op=hvd.Sum, process_set=ps))
+            expect = np.asarray(x[:4]).sum(axis=0)
+            for r in range(4):
+                np.testing.assert_allclose(y[r], expect, rtol=1e-5)
+        finally:
+            sched.set_config_override(None)
+            hvd.remove_process_set(ps)
+
+    def test_two_slice_sched_gauges(self, hvd_module):
+        """Acceptance: on the 2-slice sim mesh hier_adasum buckets
+        publish nonzero dcn/ici gauges, the per-lowering bucket count,
+        and DCN bytes <= hier's for the same schedule."""
+        from horovod_tpu import metrics, sched
+
+        self._sched_losses("hier")
+        dcn_hier = metrics.get_gauge("topo.dcn_bytes")
+        losses = self._sched_losses("hier_adasum")
+        assert all(np.isfinite(losses))
+        dcn = metrics.get_gauge("topo.dcn_bytes")
+        ici = metrics.get_gauge("topo.ici_bytes")
+        buckets = metrics.get_gauge(
+            "topo.buckets", {"lowering": "hier_adasum"}
+        )
+        assert dcn and dcn > 0
+        assert ici and ici > 0
+        assert buckets and buckets >= 1
+        assert dcn <= dcn_hier
+        # byte-model property on random sizes too
+        from horovod_tpu.topo import model as topo_model
+
+        topo = topo_model.current()
+        rng = np.random.RandomState(9)
+        for _ in range(20):
+            nb = int(rng.randint(64, 1 << 24))
+            ha = topo.lowering_bytes("all_reduce", nb, "hier_adasum")
+            hi = topo.lowering_bytes("all_reduce", nb, "hier")
+            assert ha["dcn"] <= hi["dcn"], nb
+
+    def test_op_adasum_routes_hierarchical(self, hvd_module):
+        """DistributedOptimizer(op=Adasum) lowers its buckets
+        hier_adasum on a cross-slice topology."""
+        from horovod_tpu import metrics
+
+        losses = self._sched_losses("auto", op=hvd.Adasum)
+        assert all(np.isfinite(losses))
+        assert metrics.get_gauge(
+            "topo.buckets", {"lowering": "hier_adasum"}
+        ) >= 1
+
+    def test_quantized_dcn_hop(self, hvd_module):
+        """Compression.int8 + op=Adasum rides the hier_adasum lowering
+        (only the DCN gather quantizes) and stays close to the dense
+        trajectory; a bf16/int8 wire on hier_adasum sum buckets too."""
+        dense = self._sched_losses("hier_adasum")
+        quant = self._sched_losses(
+            "hier_adasum", compression=hvd.Compression.int8
+        )
+        assert abs(dense[-1] - quant[-1]) < 1e-2
+        ad = self._sched_losses("auto", op=hvd.Adasum)
+        adq = self._sched_losses(
+            "auto", op=hvd.Adasum, compression=hvd.Compression.int8
+        )
+        assert abs(ad[-1] - adq[-1]) < 1e-2
+
+    def test_quantized_flat_adasum_still_raises(self, hvd_module,
+                                                monkeypatch):
+        """The narrowed satellite contract: single-slice topologies
+        (flat VHDD Adasum) still raise QuantizedWireError."""
+        from horovod_tpu import topo
+        from horovod_tpu.exceptions import QuantizedWireError
+
+        monkeypatch.setenv("HVD_TPU_TOPO", "1x8")
+        topo.reset()
+        try:
+            with pytest.raises(QuantizedWireError, match="Average"):
+                self._sched_losses(
+                    "auto", steps=1, op=hvd.Adasum,
+                    compression=hvd.Compression.int8,
+                )
+        finally:
+            topo.reset()
+
+    def test_zero1_hier_adasum_buckets(self, hvd_module):
+        """bucketed_zero_step: hier_adasum buckets shard k-fold over
+        the ICI sub-axis and the Adasum combine happens on the 1/k DCN
+        shard before the sharded update."""
+        import jax.numpy as jnp
+        import optax
+
+        from horovod_tpu import sched
+        from horovod_tpu.sched.zero1 import bucket_layouts, bucketed_zero_step
+
+        X = np.random.RandomState(1).randn(16, 4).astype(np.float32)
+        Y = (X @ np.full((4, 2), 0.7)).astype(np.float32)
+
+        def loss_fn(p, b):
+            x, y = b
+            return jnp.mean((x @ p["w1"] @ p["w2"] + p["b"] - y) ** 2)
+
+        params = {"w1": jnp.full((4, 4), 0.2),
+                  "w2": jnp.full((4, 2), 0.5), "b": jnp.zeros((2,))}
+        cfg = sched.SchedConfig(
+            enabled=True, bucket_bytes=64, mode="reduce_scatter",
+            lowering="hier_adasum",
+        )
+        lays = bucket_layouts(params, 8, cfg)
+        assert all(l.lowering == "hier_adasum" for l in lays)
+        assert all(l.shards == 4 for l in lays)  # k = slice_size
+        step = bucketed_zero_step(loss_fn, optax.adam(0.05), cfg=cfg)
+        st = step.init(params)
+        batch = (jnp.asarray(X), jnp.asarray(Y))
+        loss = None
+        for _ in range(5):
+            params, st, loss = step(params, st, batch)
+        assert np.isfinite(float(loss))
+
+    def test_xir_eligibility_and_interp(self, hvd_module):
+        """XIR column: eligible_lowering gates hier_adasum to float
+        reduce ops; an all_reduce op carrying it interprets to the topo
+        primitive (bitwise vs the direct call)."""
+        import jax
+
+        from horovod_tpu import xir
+        from horovod_tpu import topo
+        from horovod_tpu.ops.traced import Average
+        from horovod_tpu.runtime import WORLD_AXIS
+
+        assert xir.eligible_lowering(
+            "all_reduce", "hier_adasum", "float32") == "hier_adasum"
+        assert xir.eligible_lowering(
+            "all_reduce", "hier_adasum", "int32") == "flat"
+        assert xir.eligible_lowering(
+            "all_to_all", "hier_adasum", "float32") == "flat"
+        assert xir.eligible_lowering(
+            "all_gather", "hier_adasum", "float32") == "flat"
+        assert xir.eligible_lowering("hier", "hier", None) == "hier"
+
+        x = _data(np.float32, shape=(N, 21), seed=34)
+        op = xir.all_reduce(
+            WORLD_AXIS, reduce="mean", lowering="hier_adasum",
+            nbytes=x[0].nbytes, dtype="float32",
+        )
+
+        def f(a):
+            return xir.run_op(op, a)
+
+        def g(a):
+            return topo.hierarchical_adasum_all_reduce(
+                a, WORLD_AXIS, op=Average
+            )
+
+        via_ir = np.asarray(self._run(f, x))
+        direct = np.asarray(self._run(g, x))
+        np.testing.assert_array_equal(via_ir, direct)
+
+    def test_tuner_candidates_include_hier_adasum(self, hvd_module):
+        from horovod_tpu.sched.tune import ScheduleTuner
+
+        tuner = ScheduleTuner(explore_lowering=True)
+        seen = set()
+        # drain the exploration order without scoring
+        for _ in range(4):
+            lo = tuner.lowering()
+            seen.add(lo)
+            tuner._lowering_scores[lo] = 1.0
+            if all(c in tuner._lowering_scores
+                   for c in tuner._lowering_candidates):
+                break
+        assert {"flat", "hier", "hier_adasum"} <= seen | set(
+            tuner._lowering_candidates
+        )
+        assert "hier_adasum" in tuner._lowering_candidates
+
+
 class TestXirColumn:
     """Unified exchange IR column of the matrix: IR-routed MoE
     dispatch/combine and Ulysses flips against the direct ``lax`` path

@@ -655,7 +655,7 @@ class TestTunerLowering:
         monkeypatch.setenv("HVD_TPU_TOPO", "2x4")
         tuner = sched.ScheduleTuner(explore_lowering=True)
         seen = []
-        for score in (5.0, 3.0):  # flat wins
+        for score in (5.0, 3.0, 2.0):  # flat wins
             lo = tuner.lowering()
             seen.append(lo)
             tuner.begin_window()
@@ -663,7 +663,7 @@ class TestTunerLowering:
             metrics.observe("train.step_seconds", 1.0 / score)
             metrics.set_gauge("sched.bytes_per_step", 1000)
             tuner.end_window()
-        assert seen == ["flat", "hier"]
+        assert seen == ["flat", "hier", "hier_adasum"]
         assert tuner.lowering() == "flat"
 
     def test_single_slice_skips_exploration(self, monkeypatch):

@@ -21,8 +21,18 @@ train loop: per-bucket exchange wall time, ``sched.wire_bytes``,
 fused-path counters, and the phase/fused loss delta (same numerics
 contract, so it must sit at fp32-summation-order noise).
 
+``--adasum`` record — ``adasum_vs_sum``: the large-batch scaling claim
+of arXiv:2006.02924 on the 2-slice sim mesh — steps-to-loss-target on
+a quadratic bowl at 4x the batch the learning rate was tuned for,
+``op=Sum`` under ``lowering=flat`` (naive summed-gradient scaling,
+which overshoots) vs ``lowering=hier_adasum`` (sum over ICI, adaptive
+summation across slices — stays in the stable region without LR
+retuning).  Also reports each run's DCN bytes so the record doubles as
+the hier_adasum ≤ hier wire-cost proof.
+
 Run standalone or through ``bench.py`` (which embeds the lines under
-its ``"topo_hier_vs_flat"`` / ``"quant_fused_vs_phase"`` keys).
+its ``"topo_hier_vs_flat"`` / ``"quant_fused_vs_phase"`` /
+``"adasum_vs_sum"`` keys).
 """
 
 import json
@@ -280,14 +290,116 @@ def main_quant() -> dict:
     }
 
 
+def main_adasum() -> dict:
+    """The ``adasum_vs_sum`` record: a quadratic bowl whose learning
+    rate is tuned for the per-slice gradient aggregate, trained at 4x
+    that batch with summed gradients and NO LR retune.  Flat sum scales
+    the effective step by the world size (8) — past the stability
+    boundary, it diverges; ``hier_adasum`` sums only inside the slice
+    and adaptively combines the (near-parallel) slice contributions
+    across DCN, so the effective step stays at the slice aggregate (4)
+    and training reaches the target.  Steps-to-target is the metric;
+    per-run ``topo.dcn_bytes`` rides along (hier_adasum ≤ hier)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu import metrics, sched
+
+    jax.config.update("jax_platforms", "cpu")
+    hvd.init()
+
+    d = 4
+    curv = np.asarray([1.0, 0.5, 0.25, 0.125], np.float32)
+    wstar = np.asarray([2.0, -1.0, 0.5, 1.5], np.float32)
+    # Stability: per-rank grad g is identical (the 4x global batch
+    # replicates the tuned batch on every rank), so op=Sum steps with
+    # 8*lr*curv — diverges past 2 — while hier_adasum steps with
+    # 4*lr*curv (slice sum, then adaptive combine ~ average of the two
+    # parallel slice sums).  lr = 1.5 / (4 * max curv): adasum factor
+    # 1.5 (converges), flat-sum factor 3.0 (diverges).
+    lr = 1.5 / (4.0 * float(curv.max()))
+    batch = (
+        jnp.asarray(np.tile(curv, (hvd.size(), 1))),
+        jnp.asarray(np.tile(wstar, (hvd.size(), 1))),
+    )
+    target = 1e-3
+    max_steps = 60
+
+    def loss_fn(p, b):
+        h, ws = b
+        return 0.5 * jnp.mean(jnp.sum(h * (p["w"] - ws) ** 2, axis=-1))
+
+    def run(lowering):
+        params = {"w": jnp.zeros((d,))}
+        sched.set_config_override(sched.SchedConfig(
+            enabled=True, bucket_bytes=4096, lowering=lowering,
+        ))
+        try:
+            tx = hvd.DistributedOptimizer(optax.sgd(lr), op=hvd.Sum)
+            step = hvd.distributed_train_step(loss_fn, tx)
+            st = step.init(params)
+            hit = None
+            loss = None
+            for i in range(max_steps):
+                params, st, loss = step(params, st, batch)
+                loss = float(loss)
+                if hit is None and loss < target:
+                    hit = i + 1
+                    break
+                if not np.isfinite(loss) or loss > 1e9:
+                    break
+            return {
+                "steps_to_target": hit,
+                "final_loss": loss,
+                "dcn_bytes": int(
+                    metrics.get_gauge("topo.dcn_bytes") or 0
+                ),
+            }
+        finally:
+            sched.set_config_override(None)
+
+    flat = run("flat")
+    adasum = run("hier_adasum")
+    assert adasum["steps_to_target"] is not None, \
+        f"hier_adasum never reached the target: {adasum}"
+    return {
+        "metric": "adasum_vs_sum",
+        "unit": "steps_to_loss_target",
+        "value": adasum["steps_to_target"],
+        "topo": os.environ["HVD_TPU_TOPO"],
+        "batch_scale": 4,
+        "lr": round(lr, 5),
+        "target": target,
+        "max_steps": max_steps,
+        "steps_to_target": {
+            "sum": flat["steps_to_target"],
+            "hier_adasum": adasum["steps_to_target"],
+        },
+        "final_loss": {
+            "sum": flat["final_loss"],
+            "hier_adasum": adasum["final_loss"],
+        },
+        "dcn_bytes": {
+            "sum": flat["dcn_bytes"],
+            "hier_adasum": adasum["dcn_bytes"],
+        },
+    }
+
+
 if __name__ == "__main__":
-    which = "quant" if "--quant" in sys.argv[1:] else "topo"
+    args = sys.argv[1:]
+    which = ("quant" if "--quant" in args
+             else "adasum" if "--adasum" in args else "topo")
+    mains = {"quant": main_quant, "adasum": main_adasum, "topo": main}
+    names = {"quant": "quant_fused_vs_phase", "adasum": "adasum_vs_sum",
+             "topo": "topo_hier_vs_flat"}
     try:
-        print(json.dumps(main_quant() if which == "quant" else main()))
+        print(json.dumps(mains[which]()))
     except Exception as e:  # degraded-run hardening: always emit a line
         print(json.dumps(
-            {"metric": ("quant_fused_vs_phase" if which == "quant"
-                        else "topo_hier_vs_flat"),
-             "error": f"{type(e).__name__}: {e}"}
+            {"metric": names[which], "error": f"{type(e).__name__}: {e}"}
         ))
         sys.exit(1)
