@@ -25,6 +25,7 @@ published per-accelerator number).
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -341,11 +342,7 @@ def main():
         t_start = _ALARM_ARMED_AT if _ALARM_ARMED_AT is not None else (
             time.monotonic()
         )
-        _cpu_resnet_fallback(result, deadline_s, t_start)
-        _maybe_scaling(result, deadline_s, t_start)
-        _maybe_topo(result, deadline_s, t_start)
-        _maybe_quant_backend(result, deadline_s, t_start)
-        _maybe_adasum(result, deadline_s, t_start)
+        _device_free_records(result, deadline_s, t_start)
         print(json.dumps(result))
         return
     # Config sweep (HVD_BENCH_SWEEP=0 pins the single explicit config):
@@ -478,11 +475,61 @@ def main():
             result["gpt2_small"] = bench_gpt(hvd, jnp, batch_per_chip=8)
         except Exception as e:  # secondary workload must not sink primary
             result["gpt2_small"] = {"error": f"{type(e).__name__}: {e}"}
+    _device_free_records(result, deadline_s, t_start)
+    print(json.dumps(result))
+
+
+def _device_free_records(result: dict, deadline_s: float,
+                         t_start: float) -> None:
+    """Every record that needs no device tunnel, in budget order: the
+    CPU-sim resnet fallback (only when the primary metric is missing)
+    plus the scaling/topo/quant/adasum/railpipe subprocess records.
+    One function serves the cpu-only path, the probe-skip path, and
+    the regression test that pins "a hung probe still yields real sim
+    records" — the skip path can no longer drift away from the record
+    list."""
+    if result.get("value", 0.0) == 0.0:
+        _cpu_resnet_fallback(result, deadline_s, t_start)
     _maybe_scaling(result, deadline_s, t_start)
     _maybe_topo(result, deadline_s, t_start)
     _maybe_quant_backend(result, deadline_s, t_start)
     _maybe_adasum(result, deadline_s, t_start)
-    print(json.dumps(result))
+    _maybe_railpipe(result, deadline_s, t_start)
+
+
+def _maybe_railpipe(result: dict, deadline_s: float,
+                    t_start: float) -> None:
+    """Append the ``railpipe_overlap`` record (HVD_BENCH_RAILPIPE=0
+    skips): pipelined vs serialized hier multi-bucket exchange wall
+    time on the simulated 2-slice mesh via ``tools/topo_bench.py
+    --pipeline`` in a scrubbed 8-device CPU subprocess
+    (docs/exchange_ir.md "Program scheduling").  Structured-skip on
+    deadline pressure like the other device-free records."""
+    if os.environ.get("HVD_BENCH_RAILPIPE", "1") == "0":
+        return
+    if deadline_s - (time.monotonic() - t_start) < 75:
+        result["railpipe_overlap"] = {
+            "error": "skipped: deadline too close"
+        }
+        return
+    try:
+        import subprocess as sp
+
+        repo = os.path.dirname(os.path.abspath(__file__))
+        env = _scrubbed_cpu_env()
+        env.setdefault("HVD_TPU_TOPO", "2x4")
+        out = sp.run(
+            [sys.executable, os.path.join(repo, "tools", "topo_bench.py"),
+             "--pipeline"],
+            capture_output=True, text=True, timeout=300, env=env, cwd=repo,
+        )
+        line = (out.stdout or "").strip().splitlines()
+        result["railpipe_overlap"] = (
+            json.loads(line[-1]) if out.returncode == 0 and line
+            else {"error": f"rc={out.returncode}: {(out.stderr or '')[-300:]}"}
+        )
+    except Exception as e:
+        result["railpipe_overlap"] = {"error": f"{type(e).__name__}: {e}"}
 
 
 def _scrubbed_cpu_env() -> dict:
@@ -773,6 +820,89 @@ def _probe_cache_key() -> str:
     return f"{sys.executable}:{jax_version}:{_knob_fingerprint()}"
 
 
+def run_device_probe(deadline_s: float, armed_at: float,
+                     retry=None):
+    """Prove the device runtime boots before paying compiles in-process
+    (the BENCH_r03..r05 failure mode: a wedged TPU tunnel hangs the
+    first jax call forever).  Returns ``None`` when the device is live
+    (or a fresh cache entry says so); on exhaustion returns the
+    structured skip fields — a non-empty ``reason`` plus the probe
+    subprocess's captured ``probe_stderr`` tail, so the round records
+    *why* the tunnel died instead of a bare TimeoutExpired repr.
+
+    Every attempt runs with its own bounded deadline **inside** the
+    alarm window: the per-attempt subprocess timeout is recomputed
+    from the remaining alarm budget (never more than half of it, and
+    always leaving ≥ 90 s for the device-free records), so two
+    attempts can never race the SIGALRM into the outer raw-error path.
+    ``retry`` injects a prebuilt RetryPolicy (tests); the default is 2
+    attempts with a 5 s backoff."""
+    if _probe_cached_ok():
+        return None
+
+    stderr_tail = {"text": ""}
+
+    def _tail(err) -> str:
+        if err is None:
+            return ""
+        if isinstance(err, bytes):
+            err = err.decode("utf-8", "replace")
+        return str(err)[-400:]
+
+    def _attempt():
+        remaining = deadline_s - (time.monotonic() - armed_at)
+        budget = max(20, int(min(
+            float(os.environ.get("HVD_BENCH_PROBE_TIMEOUT_S", "150")),
+            remaining / 2 - 45,
+        )))
+        try:
+            probe = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax, jax.numpy as jnp; "
+                 "print(float(jnp.ones(8).sum()))"],
+                capture_output=True, text=True,
+                timeout=budget,
+                env=dict(os.environ),
+            )
+        except subprocess.TimeoutExpired as e:
+            # A hung probe still surfaces whatever the runtime said
+            # before it stalled (partial stderr rides the exception).
+            stderr_tail["text"] = _tail(getattr(e, "stderr", None))
+            raise
+        if probe.returncode != 0:
+            stderr_tail["text"] = _tail(probe.stderr)
+            raise RuntimeError(
+                f"device probe failed (rc={probe.returncode})"
+            )
+
+    if retry is None:
+        from horovod_tpu.utils.retry import RetryPolicy
+
+        retry = RetryPolicy(
+            max_attempts=2, base_delay_s=5.0, jitter=0.0,
+            name="bench.probe",
+            retry_on=(RuntimeError, subprocess.TimeoutExpired),
+        )
+    try:
+        retry.call(_attempt)
+    except BaseException as e:  # alarm TimeoutError included: probe
+        # exhaustion must ALWAYS yield the structured skip record,
+        # never the outer raw-error blob
+        if isinstance(e, (KeyboardInterrupt, SystemExit)):
+            raise
+        return {
+            "status": "skipped",
+            "reason": (
+                f"device probe exhausted retries: "
+                f"{type(e).__name__}: {e}".strip()
+                or "device probe exhausted retries"
+            ),
+            "probe_stderr": stderr_tail["text"],
+        }
+    _probe_cache_store()
+    return None
+
+
 def _probe_cached_ok() -> bool:
     try:
         with open(_probe_cache_path()) as f:
@@ -813,94 +943,30 @@ if __name__ == "__main__":
     signal.alarm(int(os.environ.get("HVD_BENCH_DEADLINE_S", "480")))
     try:
         # Fail fast on a wedged device tunnel: probe device liveness in
-        # a short-lived subprocess before paying compiles in-process.
-        # The probe runs under a RetryPolicy (2 attempts, bounded
-        # per-attempt timeout): a transient runtime-bring-up hiccup gets
-        # one more chance, and a genuinely dead device produces a
-        # structured {"status": "skipped"} record instead of an error
-        # blob, so BENCH_*.json stays machine-comparable (the r05 bench
-        # died with a raw TimeoutExpired here).
-        #
-        # A successful probe is cached to a sidecar file (module-level
-        # helpers above) keyed by interpreter + jax version + the knob
-        # fingerprint: cold JAX imports in the probe subprocess have
-        # eaten a bench's whole 150 s budget before (BENCH_r05), so
-        # within 24 h the budget goes to the actual measurement instead
-        # of re-proving the same runtime boots.
-        import subprocess
-
-        def _probe():
-            # Per-attempt timeout bounded by the REMAINING alarm
-            # budget: two 150 s attempts must never race the 480 s
-            # SIGALRM into the outer error path (BENCH_r05 recorded a
-            # raw TimeoutExpired "error" blob instead of the
-            # structured skip record exactly because the probe and the
-            # deadline interleaved) — and at least 90 s must be left
-            # for the device-free records below.
-            remaining = (
-                int(os.environ.get("HVD_BENCH_DEADLINE_S", "480"))
-                - (time.monotonic() - _ALARM_ARMED_AT)
-            )
-            budget = max(20, int(min(
-                float(os.environ.get("HVD_BENCH_PROBE_TIMEOUT_S", "150")),
-                remaining / 2 - 45,
-            )))
-            probe = subprocess.run(
-                [sys.executable, "-c",
-                 "import jax, jax.numpy as jnp; "
-                 "print(float(jnp.ones(8).sum()))"],
-                capture_output=True, text=True,
-                timeout=budget,
-                env=dict(os.environ),
-            )
-            if probe.returncode != 0:
-                raise RuntimeError(
-                    f"device probe failed: {probe.stderr[-300:]}"
-                )
-
-        from horovod_tpu.utils.retry import RetryPolicy
-
-        probe_skip_reason = None
-        if not _probe_cached_ok():
-            try:
-                RetryPolicy(
-                    max_attempts=2, base_delay_s=5.0, jitter=0.0,
-                    name="bench.probe",
-                    retry_on=(RuntimeError, subprocess.TimeoutExpired),
-                ).call(_probe)
-            except BaseException as e:  # alarm TimeoutError included:
-                # probe exhaustion must ALWAYS yield the structured
-                # skip record, never the outer raw-error blob
-                if isinstance(e, (KeyboardInterrupt, SystemExit)):
-                    raise
-                probe_skip_reason = (
-                    f"device probe exhausted retries: "
-                    f"{type(e).__name__}: {e}"
-                )
-            else:
-                _probe_cache_store()
-        if probe_skip_reason is not None:
+        # a short-lived subprocess before paying compiles in-process
+        # (run_device_probe above — a RetryPolicy around per-attempt
+        # timeouts bounded inside the alarm window, with the probe's
+        # stderr captured into the skip record; a successful probe is
+        # cached to the sidecar so within 24 h the budget goes to the
+        # actual measurement instead of re-proving the runtime boots).
+        deadline_s = int(os.environ.get("HVD_BENCH_DEADLINE_S", "480"))
+        probe_skip = run_device_probe(deadline_s, _ALARM_ARMED_AT)
+        if probe_skip is not None:
             # Structured skip for the device-bound primary metric — but
             # the CPU-subprocess records need no device tunnel: the
             # resnet record itself falls back to a measured CPU-sim
             # number (non-null MFU with peak_source provenance), and
-            # the scaling/topo/quant/adasum records run as usual, so a
-            # bench round with a wedged device still produces real
-            # numbers instead of nothing.
+            # the scaling/topo/quant/adasum/railpipe records run as
+            # usual, so a bench round with a wedged device still
+            # produces real numbers instead of nothing.
             result = {
                 "metric": "resnet50_synthetic_train_throughput",
                 "value": 0.0,
                 "unit": "images/sec/chip",
                 "vs_baseline": 0.0,
-                "status": "skipped",
-                "reason": probe_skip_reason,
             }
-            deadline_s = int(os.environ.get("HVD_BENCH_DEADLINE_S", "480"))
-            _cpu_resnet_fallback(result, deadline_s, _ALARM_ARMED_AT)
-            _maybe_scaling(result, deadline_s, _ALARM_ARMED_AT)
-            _maybe_topo(result, deadline_s, _ALARM_ARMED_AT)
-            _maybe_quant_backend(result, deadline_s, _ALARM_ARMED_AT)
-            _maybe_adasum(result, deadline_s, _ALARM_ARMED_AT)
+            result.update(probe_skip)
+            _device_free_records(result, deadline_s, _ALARM_ARMED_AT)
             print(json.dumps(result))
             sys.exit(0)
         main()

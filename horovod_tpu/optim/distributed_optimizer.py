@@ -510,9 +510,22 @@ def _reduce_gradients(
                 return _sched.execute.bf16_wire(dense_flat)(f)
             return dense_flat(f)
 
+        # Rail pipeliner (xir/pipeline.py): hier buckets may emit as
+        # per-rail phase chains — the factory mirrors the serialized
+        # hier reducers above op for op, so pipeline on/off/auto is
+        # bitwise-identical on the f32 dense wire.
+        phase_factory = (
+            _sched.execute.hier_phase_factory(
+                axis=axis, average=(op == Average), rs_mode=rs_ok,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor,
+            )
+            if hier_ok else None
+        )
         reduced = _sched.exchange(
             wire, schedule, reduce_bucket_flat,
             barriers=cfg.barriers, timeline=tl, axis=axis,
+            phases=phase_factory,
         )
         out = [compression.decompress(t, c) for t, c in zip(reduced, ctxs)]
         tree = jax.tree.unflatten(treedef, out)

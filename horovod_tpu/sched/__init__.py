@@ -34,6 +34,7 @@ docs/scheduler.md.
 from . import execute, hooks, plan, tune, zero1  # noqa: F401
 from .execute import (  # noqa: F401
     exchange,
+    hier_phase_factory,
     quantized_exchange_flat,
     sync_gradients_bucketed,
 )

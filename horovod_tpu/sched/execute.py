@@ -46,6 +46,154 @@ def _chain(tensors: List[jax.Array], token: Optional[jax.Array]):
     return list(out[:-1]), out[-1]
 
 
+class _PhasedBucket:
+    """One decomposable bucket's rail phases: ``rs`` (ICI
+    reduce-scatter), ``mid`` (the DCN leg — hop, or RS + shard update +
+    AG in reduce_scatter mode), ``ag`` (ICI all-gather back to the flat
+    buffer).  Built per bucket by :func:`hier_phase_factory`; the three
+    closures emit exactly the ops the serialized reducer would, so a
+    phase-emitted bucket is bitwise identical to its serialized twin."""
+
+    __slots__ = ("rs", "mid", "ag")
+
+    def __init__(self, rs, mid, ag):
+        self.rs, self.mid, self.ag = rs, mid, ag
+
+
+def hier_phase_factory(
+    *,
+    axis,
+    average: bool = False,
+    rs_mode: bool = False,
+    prescale_factor: float = 1.0,
+    postscale_factor: float = 1.0,
+    shard_update: Optional[Callable[[jax.Array], jax.Array]] = None,
+    pmean: bool = False,
+):
+    """Phase decomposition of the hier bucket reducers for the rail
+    pipeliner (``xir/pipeline.py``): returns ``factory(bucket) ->
+    _PhasedBucket | None``.  ``None`` marks the bucket serialized (not
+    ``hier``, mixed dtypes, or a non-factoring axis) and the exchange
+    falls back to its ``reduce_flat`` for that bucket.
+
+    Three flavors, each mirroring its serialized reducer op for op:
+
+    * default — :func:`hier_allreduce_flat` (prescale → staged Sum →
+      postscale/average);
+    * ``rs_mode=True`` — :func:`hier_reduce_scatter_flat` on floating
+      buckets (the RS+AG decomposition with the optional ZeRO
+      ``shard_update`` riding the DCN leg), allreduce flavor otherwise;
+    * ``pmean=True`` — ``hierarchical_all_reduce(op=Average)``, the
+      ``sync_gradients_bucketed`` hier pmean.
+    """
+    from ..ops.traced import _scale
+    from ..topo import (
+        dcn_all_gather_phase,
+        dcn_reduce_scatter_phase,
+        dcn_sum_phase,
+        ici_all_gather_phase,
+        ici_reduce_scatter_phase,
+        phase_context,
+    )
+
+    def factory(bucket: Bucket) -> Optional[_PhasedBucket]:
+        from ..xir import pipeline as railpipe
+
+        if not railpipe.decomposable(bucket):
+            return None
+        ctx = phase_context(axis)
+        if ctx is None:
+            return None
+        wire = bucket.wire
+        k, s = ctx["k"], ctx["s"]
+        n_axis = k * s
+        cell: dict = {}
+        floating = bool(bucket.wire_dtypes) and jnp.issubdtype(
+            jnp.dtype(bucket.wire_dtypes[0]), jnp.floating
+        )
+
+        if pmean:
+            # hierarchical_all_reduce(op=Average, wire): slice sum →
+            # DCN sum → gather, /(s*k) before the dtype cast.
+            def rs(f):
+                cell["V"], cell["dtype"] = f.size, f.dtype
+                flat = f.reshape(-1)
+                pad = (-f.size) % k
+                if pad:
+                    flat = jnp.pad(flat, (0, pad))
+                return ici_reduce_scatter_phase(flat, ctx)
+
+            def mid(shard):
+                return dcn_sum_phase(shard, ctx, wire)
+
+            def ag(shard):
+                out = ici_all_gather_phase(shard, ctx)[: cell["V"]]
+                out = out / (s * k)
+                return out.astype(cell["dtype"])
+
+            return _PhasedBucket(rs, mid, ag)
+
+        if rs_mode and floating:
+            # hier_reduce_scatter_flat: both DCN legs (and the shard
+            # update between them) ride the DCN rail.
+            quant = wire in ("int8", "fp8")
+            unit = k * s
+            if quant:
+                from ..ops.quantized import quant_block
+
+                unit *= quant_block()
+
+            def rs(f):
+                cell["n"] = f.shape[0]
+                g = _scale(f, prescale_factor)
+                flat = g.reshape(-1)
+                pad = (-flat.shape[0]) % unit
+                if pad:
+                    flat = jnp.pad(flat, (0, pad))
+                return ici_reduce_scatter_phase(flat, ctx)
+
+            def mid(shard_k):
+                shard = dcn_reduce_scatter_phase(shard_k, ctx, wire)
+                post = (
+                    postscale_factor / n_axis if average
+                    else postscale_factor
+                )
+                shard = _scale(shard, post)
+                if shard_update is not None:
+                    shard = shard_update(shard)
+                return dcn_all_gather_phase(shard, ctx, wire)
+
+            def ag(out_k):
+                return ici_all_gather_phase(out_k, ctx)[: cell["n"]]
+
+            return _PhasedBucket(rs, mid, ag)
+
+        # hier_allreduce_flat: prescale → staged Sum → postscale.
+        def rs(f):
+            cell["V"], cell["dtype"] = f.size, f.dtype
+            g = _scale(f, prescale_factor)
+            flat = g.reshape(-1)
+            pad = (-g.size) % k
+            if pad:
+                flat = jnp.pad(flat, (0, pad))
+            return ici_reduce_scatter_phase(flat, ctx)
+
+        def mid(shard):
+            return dcn_sum_phase(shard, ctx, wire)
+
+        def ag(shard):
+            out = ici_all_gather_phase(shard, ctx)[: cell["V"]]
+            out = out.astype(cell["dtype"])
+            post = (
+                postscale_factor / n_axis if average else postscale_factor
+            )
+            return _scale(out, post)
+
+        return _PhasedBucket(rs, mid, ag)
+
+    return factory
+
+
 def record_wire_metrics(schedule: BucketSchedule) -> None:
     """Publish the per-wire payload gauges for one planned exchange:
     ``sched.wire_bytes{wire=}`` (bytes/step on each wire format) and
@@ -94,6 +242,139 @@ def record_topo_metrics(
         metrics.set_gauge("topo.buckets", count, {"lowering": lo})
 
 
+def _bucket_timeline(timeline, bi: int, bucket: Bucket) -> None:
+    """One SCHED_EXCHANGE event per bucket plus TOPO_PHASE lane events
+    for hierarchical buckets (shared by the serialized and pipelined
+    emissions — a slow hop stays identifiable either way)."""
+    timeline.record_op(
+        f"bucket{bi}[n={len(bucket.indices)},"
+        f"dtype={'+'.join(bucket.wire_dtypes)},"
+        f"wire={bucket.wire},lower={bucket.lowering}]",
+        "SCHED_EXCHANGE", wire_bytes(bucket),
+    )
+    if bucket.lowering in ("hier", "hier_adasum"):
+        from ..topo import model as topo_model
+
+        by = topo_model.current().lowering_bytes(
+            "all_reduce", bucket.nbytes, bucket.lowering
+        )
+        dcn_phase = (
+            "adasum_dcn" if bucket.lowering == "hier_adasum" else "ar_dcn"
+        )
+        for phase, nb in (
+            ("rs_ici", by["ici"] // 2),
+            (dcn_phase, by["dcn"]),
+            ("ag_ici", by["ici"] // 2),
+        ):
+            timeline.record_op(f"bucket{bi}.{phase}", "TOPO_PHASE", nb)
+
+
+def _exchange_pipelined(
+    wire: Sequence[jax.Array],
+    schedule: BucketSchedule,
+    reduce_flat: Callable[[jax.Array, Bucket], jax.Array],
+    phases: Callable[[Bucket], Optional[_PhasedBucket]],
+    program: Any,
+    timeline: Any,
+) -> List[jax.Array]:
+    """Rail-chained emission (``HVD_TPU_XIR_PIPELINE``): decomposable
+    buckets split into ICI/DCN phases chained per rail — the ICI chain
+    runs RS(i), RS(i+1), AG(i), RS(i+2), AG(i+1), … while each DCN hop
+    chains only against the previous DCN hop, so bucket *i*'s
+    cross-slice hop overlaps bucket *i+1*'s reduce-scatter and bucket
+    *i−1*'s all-gather.  Non-decomposable buckets serialize against
+    BOTH rails (full ordering, exactly their serialized behavior).
+    Values are bitwise identical to the serialized emission: every
+    barrier is identity and per-bucket op order never changes."""
+    import dataclasses as _dc
+
+    from ..xir import pipeline as railpipe
+
+    reduced: List[jax.Array] = list(wire)
+    rail = railpipe.RailChain()
+    # (bi, bucket, meta, phased, dcn_out) — bucket i's ICI all-gather,
+    # held back until bucket i+1's reduce-scatter has entered the ICI
+    # chain (the overlap window the pipeline.overlap_windows counter
+    # reads).
+    deferred = None
+    overlaps = 0
+
+    def _flush():
+        nonlocal deferred
+        bi_, bucket_, meta_, pb_, mid_ = deferred
+        deferred = None
+        (mid_,) = rail.tie([mid_], ("ici",))
+        with jax.named_scope(
+            f"hvd_sched_bucket{bi_}_{bucket_.nbytes}B_{bucket_.wire}"
+            f"_{bucket_.lowering}_ag"
+        ):
+            out = pb_.ag(mid_)
+        rail.bump(out, ("ici",))
+        for i, t in zip(
+            bucket_.indices, fusion.unflatten_group([out], meta_)
+        ):
+            reduced[i] = t
+
+    for bi, bucket in enumerate(schedule.buckets):
+        if program is not None:
+            op = program.ops[bi]
+            bucket = _dc.replace(
+                bucket, wire=op.wire, lowering=op.lowering
+            )
+        pb = phases(bucket)
+        ins = [wire[i] for i in bucket.indices]
+        if timeline is not None:
+            _bucket_timeline(timeline, bi, bucket)
+        if pb is None:
+            # Serialized bucket inside the pipeline: flush the pending
+            # all-gather first, then order against both rails.
+            if deferred is not None:
+                _flush()
+            ins = rail.tie(ins, ("ici", "dcn"))
+            with jax.named_scope(
+                f"hvd_sched_bucket{bi}_{bucket.nbytes}B_{bucket.wire}"
+                f"_{bucket.lowering}"
+            ):
+                flats, meta = fusion.flatten_group(ins)
+                outs = [reduce_flat(f, bucket) for f in flats]
+            rail.bump(outs[0], ("ici", "dcn"))
+            for i, t in zip(
+                bucket.indices, fusion.unflatten_group(outs, meta)
+            ):
+                reduced[i] = t
+        else:
+            ins = rail.tie(ins, ("ici",))
+            flats, meta = fusion.flatten_group(ins)
+            with jax.named_scope(
+                f"hvd_sched_bucket{bi}_{bucket.nbytes}B_{bucket.wire}"
+                f"_{bucket.lowering}_rs"
+            ):
+                shard = pb.rs(flats[0])
+            rail.bump(shard, ("ici",))
+            if deferred is not None:
+                # Bucket i's RS is on the chain; bucket i-1's AG may
+                # now follow it — its DCN hop already ran concurrently.
+                _flush()
+                overlaps += 1
+            (shard,) = rail.tie([shard], ("dcn",))
+            with jax.named_scope(
+                f"hvd_sched_bucket{bi}_{bucket.nbytes}B_{bucket.wire}"
+                f"_{bucket.lowering}_dcn"
+            ):
+                mid = pb.mid(shard)
+            rail.bump(mid, ("dcn",))
+            deferred = (bi, bucket, meta, pb, mid)
+        metrics.observe(
+            "sched.bytes_per_bucket", bucket.nbytes,
+            buckets=metrics.BYTES_BUCKETS,
+        )
+    if deferred is not None:
+        _flush()
+    metrics.inc_counter("sched.pipeline.overlap_windows", overlaps)
+    metrics.set_gauge("sched.pipeline.overlap_windows_per_step", overlaps)
+    return reduced
+
+
 def exchange(
     wire: Sequence[jax.Array],
     schedule: BucketSchedule,
@@ -103,6 +384,7 @@ def exchange(
     timeline: Any = None,
     kind: str = "dense_grad",
     axis: Any = None,
+    phases: Optional[Callable[[Bucket], Optional[_PhasedBucket]]] = None,
 ) -> List[jax.Array]:
     """Run ``schedule`` over the ``wire`` leaves: per bucket, flatten ->
     one collective per dtype (via ``reduce_flat(flat, bucket)``) ->
@@ -126,8 +408,18 @@ def exchange(
     single-fused-exchange legacy path by construction.  A bucket whose
     ``wire`` is quantized trades that identity for compressed wire
     bytes (the reducer routes it through ops/quantized.py).
+
+    ``phases`` (a :func:`hier_phase_factory`) opts the schedule into
+    the rail pipeliner: when ``HVD_TPU_XIR_PIPELINE`` engages
+    (``xir.pipeline.engaged``), decomposable hier buckets emit as
+    ICI/DCN phases chained **per rail** instead of per bucket, so
+    bucket *i*'s cross-slice DCN hop overlaps bucket *i+1*'s ICI
+    reduce-scatter and bucket *i−1*'s ICI all-gather.  Ordering-only:
+    f32 dense losses are bitwise identical to the serialized emission
+    in every mode.
     """
     from .. import xir
+    from ..xir import pipeline as railpipe
 
     t0 = time.perf_counter()
     program = (
@@ -138,64 +430,64 @@ def exchange(
         metrics.inc_counter("xir.programs")
         metrics.inc_counter(f"xir.programs.{kind}")
         metrics.inc_counter("xir.ops", len(program.ops))
-    reduced: List[jax.Array] = list(wire)
-    token: Optional[jax.Array] = None
-    for bi, bucket in enumerate(schedule.buckets):
-        if program is not None:
-            # Interpret the program: the op record drives the bucket's
-            # dispatch (equal to the plan's fields by construction).
-            op = program.ops[bi]
-            bucket = dataclasses.replace(
-                bucket, wire=op.wire, lowering=op.lowering
-            )
-        ins = [wire[i] for i in bucket.indices]
-        if barriers:
-            ins, token = _chain(ins, token)
-        if timeline is not None:
-            timeline.record_op(
-                f"bucket{bi}[n={len(bucket.indices)},"
-                f"dtype={'+'.join(bucket.wire_dtypes)},"
-                f"wire={bucket.wire},lower={bucket.lowering}]",
-                "SCHED_EXCHANGE", wire_bytes(bucket),
-            )
-            if bucket.lowering in ("hier", "hier_adasum"):
-                # One TOPO_PHASE lane event per hierarchical phase so a
-                # slow hop (almost always the DCN one) is identifiable
-                # without a device profiler trace.
-                from ..topo import model as topo_model
-
-                by = topo_model.current().lowering_bytes(
-                    "all_reduce", bucket.nbytes, bucket.lowering
-                )
-                dcn_phase = (
-                    "adasum_dcn" if bucket.lowering == "hier_adasum"
-                    else "ar_dcn"
-                )
-                for phase, nb in (
-                    ("rs_ici", by["ici"] // 2),
-                    (dcn_phase, by["dcn"]),
-                    ("ag_ici", by["ici"] // 2),
-                ):
-                    timeline.record_op(
-                        f"bucket{bi}.{phase}", "TOPO_PHASE", nb
-                    )
-        with jax.named_scope(
-            f"hvd_sched_bucket{bi}_{bucket.nbytes}B_{bucket.wire}"
-            f"_{bucket.lowering}"
-        ):
-            flats, meta = fusion.flatten_group(ins)
-            outs = [reduce_flat(f, bucket) for f in flats]
-        if barriers:
-            # Scalar carried out of this bucket's collective: the next
-            # bucket's inputs are barrier-tied to it, enforcing issue
-            # order without touching values.
-            token = outs[0].reshape(-1)[0]
-        for i, t in zip(bucket.indices, fusion.unflatten_group(outs, meta)):
-            reduced[i] = t
-        metrics.observe(
-            "sched.bytes_per_bucket", bucket.nbytes,
-            buckets=metrics.BYTES_BUCKETS,
+    axis_size = None
+    if isinstance(axis, str):
+        try:
+            axis_size = lax.axis_size(axis)
+        except Exception:
+            axis_size = None
+    # Rail pipelining (xir/pipeline.py): needs barriers (the rails ARE
+    # barrier chains), a phase factory from the caller, and an engaged
+    # knob/cost-model verdict.  Values are bitwise identical either
+    # way; the branch only changes ordering edges.
+    pipelined = bool(
+        barriers and phases is not None
+        and railpipe.engaged(schedule, axis_size)
+    )
+    metrics.set_gauge(
+        "sched.pipeline.engaged", 1.0 if pipelined else 0.0,
+        {"mode": railpipe.mode()},
+    )
+    if pipelined:
+        reduced = _exchange_pipelined(
+            wire, schedule, reduce_flat, phases, program, timeline
         )
+    else:
+        reduced = list(wire)
+        token: Optional[jax.Array] = None
+        for bi, bucket in enumerate(schedule.buckets):
+            if program is not None:
+                # Interpret the program: the op record drives the
+                # bucket's dispatch (equal to the plan's fields by
+                # construction).
+                op = program.ops[bi]
+                bucket = dataclasses.replace(
+                    bucket, wire=op.wire, lowering=op.lowering
+                )
+            ins = [wire[i] for i in bucket.indices]
+            if barriers:
+                ins, token = _chain(ins, token)
+            if timeline is not None:
+                _bucket_timeline(timeline, bi, bucket)
+            with jax.named_scope(
+                f"hvd_sched_bucket{bi}_{bucket.nbytes}B_{bucket.wire}"
+                f"_{bucket.lowering}"
+            ):
+                flats, meta = fusion.flatten_group(ins)
+                outs = [reduce_flat(f, bucket) for f in flats]
+            if barriers:
+                # Scalar carried out of this bucket's collective: the
+                # next bucket's inputs are barrier-tied to it, enforcing
+                # issue order without touching values.
+                token = outs[0].reshape(-1)[0]
+            for i, t in zip(
+                bucket.indices, fusion.unflatten_group(outs, meta)
+            ):
+                reduced[i] = t
+            metrics.observe(
+                "sched.bytes_per_bucket", bucket.nbytes,
+                buckets=metrics.BYTES_BUCKETS,
+            )
     metrics.inc_counter("sched.plans")
     metrics.inc_counter("sched.buckets", len(schedule))
     metrics.inc_counter("sched.exchange_bytes", schedule.total_bytes)
@@ -541,6 +833,13 @@ def sync_gradients_bucketed(
             [leaves[i] for i in idxs], schedule, reduce_flat,
             barriers=cfg.barriers,
             axis=mean_over[0] if len(mean_over) == 1 else tuple(mean_over),
+            # Rail pipelining for hier pmean buckets: the factory's
+            # pmean flavor replicates hierarchical_all_reduce(Average)
+            # phase for phase, so engaged == serialized bitwise.
+            phases=(
+                hier_phase_factory(axis=mean_over[0], pmean=True)
+                if len(mean_over) == 1 else None
+            ),
         )
         for i, t in zip(idxs, reduced):
             out[i] = t

@@ -222,6 +222,18 @@ def build_schedule(
     lowering = _canon_lowering(
         cfg.lowering if lowering is None else lowering
     )
+    if cfg.bucket_bytes is None and lowering in ("auto", "hier"):
+        # Rail pipeliner split points (HVD_TPU_XIR_PIPELINE=on only —
+        # "auto" is reorder-only so the plan stays identical): pick the
+        # bucket size whose equal-split schedule the max-of-rails model
+        # prices cheapest under the fitted per-rail bandwidths.
+        from ..xir import pipeline as railpipe
+
+        pipe_bytes = railpipe.plan_bucket_bytes(
+            sum(int(s) for s in sizes_bytes), axis_size
+        )
+        if pipe_bytes is not None:
+            cfg = dataclasses.replace(cfg, bucket_bytes=pipe_bytes)
     n = len(sizes_bytes)
     if order is None:
         order = range(n - 1, -1, -1)

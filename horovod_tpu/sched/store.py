@@ -82,6 +82,17 @@ def knob_fingerprint() -> str:
         items.append(("HVD_TPU_QUANT_BACKEND(resolved)", quant_backend()))
     except Exception:
         pass
+    try:
+        # The rail-pipeliner knob joins in resolved form for the same
+        # reason as the backend: an unset HVD_TPU_XIR_PIPELINE and an
+        # explicit "auto" plan identical schedules and share entries,
+        # while "on" — whose split points come from the per-rail
+        # bandwidths — keys distinctly.
+        from ..xir import pipeline as _railpipe
+
+        items.append(("HVD_TPU_XIR_PIPELINE(resolved)", _railpipe.mode()))
+    except Exception:
+        pass
     return hashlib.sha256(
         json.dumps(items, sort_keys=True).encode()
     ).hexdigest()[:16]

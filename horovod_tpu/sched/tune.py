@@ -112,6 +112,8 @@ class ScheduleTuner:
                  lowering_candidates=("flat", "hier", "hier_adasum"),
                  explore_backend: bool = False,
                  backend_candidates=("phase", "fused"),
+                 explore_pipeline: bool = False,
+                 pipeline_candidates=("off", "on", "auto"),
                  store="env",
                  store_key=None,
                  store_kind="dense_grad",
@@ -136,6 +138,24 @@ class ScheduleTuner:
         self._backend_frozen: Optional[str] = (
             None if explore_backend else "env"
         )
+        # Rail-pipeliner exploration (HVD_TPU_XIR_PIPELINE as a tuned
+        # dimension, xir/pipeline.py): each window runs one candidate —
+        # applied process-wide through the env knob, since engagement
+        # resolves at trace time — scored from the same registry
+        # deltas; the winner freezes, pins the knob, and persists in
+        # entry meta.pipeline.  Reordering is numerics-free (losses
+        # bitwise-identical across candidates), so the score ranks pure
+        # wall-clock.  On a single-slice topology nothing ever engages:
+        # exploration is skipped and the knob pins "off" immediately.
+        self._explore_pipeline = explore_pipeline
+        self._pipeline_candidates = tuple(pipeline_candidates)
+        self._pipeline_scores: Dict[str, float] = {}
+        if not explore_pipeline:
+            self._pipeline_frozen: Optional[str] = "env"
+        elif self._topo_multi_slice():
+            self._pipeline_frozen = None
+        else:
+            self._pipeline_frozen = "off"
         # Lowering exploration (the HVD_TPU_TOPO_LOWER knob as a tuned
         # dimension): each window runs one candidate — including
         # hier_adasum, the adaptive cross-slice combine the cost model
@@ -212,6 +232,13 @@ class ScheduleTuner:
                 env.set_env("QUANT_BACKEND", backend)
         elif self._backend_frozen is None:
             self._backend_frozen = "env"
+        pipe = str((entry.get("meta") or {}).get("pipeline", ""))
+        if pipe in self._pipeline_candidates:
+            self._pipeline_frozen = pipe
+            if self._explore_pipeline:
+                env.set_env("XIR_PIPELINE", pipe)
+        elif self._pipeline_frozen is None:
+            self._pipeline_frozen = "env"
         self._best_score = float(entry.get("score", 0.0))
         self._db_written = True  # a re-write would only echo the entry
         metrics.inc_counter("sched.tune.db_hit")
@@ -236,7 +263,8 @@ class ScheduleTuner:
             wire=self.wire(),
             lowering=self.lowering(),
             score=self._best_score,
-            meta={"backend": self.backend()},
+            meta={"backend": self.backend(),
+                  "pipeline": self.pipeline()},
         )
 
     @staticmethod
@@ -279,6 +307,26 @@ class ScheduleTuner:
                 return b
         return "phase"
 
+    def pipeline(self) -> str:
+        """Rail-pipeliner mode suggestion for the next window
+        (``HVD_TPU_XIR_PIPELINE``): the next unscored candidate while
+        exploring, the frozen winner after, or the env knob's resolved
+        mode when pipelining is not a tuned dimension.  Exploration
+        applies the suggestion through the env knob in
+        :meth:`begin_window` — engagement resolves at trace time, so
+        the caller rebuilds its step per window exactly as with
+        backend exploration."""
+        if self._pipeline_frozen == "env":
+            from ..xir import pipeline as railpipe
+
+            return railpipe.mode()
+        if self._pipeline_frozen is not None:
+            return self._pipeline_frozen
+        for p in self._pipeline_candidates:
+            if p not in self._pipeline_scores:
+                return p
+        return "auto"
+
     def lowering(self) -> str:
         """Lowering suggestion for the next window
         (``build_schedule(..., lowering=...)``): the next unscored
@@ -299,6 +347,9 @@ class ScheduleTuner:
         if self._backend_frozen is None:
             # backend candidates apply process-wide (trace-time knob)
             env.set_env("QUANT_BACKEND", self.backend())
+        if self._pipeline_frozen is None:
+            # pipeline candidates apply process-wide (trace-time knob)
+            env.set_env("XIR_PIPELINE", self.pipeline())
         self._baseline = registry_view()
 
     def end_window(self) -> float:
@@ -334,6 +385,24 @@ class ScheduleTuner:
                 metrics.set_gauge(
                     "sched.tune_backend_frozen", 1.0,
                     {"backend": self._backend_frozen},
+                )
+        elif self._pipeline_frozen is None:
+            p = self.pipeline()
+            self._pipeline_scores[p] = max(
+                self._pipeline_scores.get(p, 0.0), score
+            )
+            metrics.set_gauge(
+                "sched.tune_pipeline_score", score, {"pipeline": p}
+            )
+            if all(c in self._pipeline_scores
+                   for c in self._pipeline_candidates):
+                self._pipeline_frozen = max(
+                    self._pipeline_scores, key=self._pipeline_scores.get
+                )
+                env.set_env("XIR_PIPELINE", self._pipeline_frozen)
+                metrics.set_gauge(
+                    "sched.tune_pipeline_frozen", 1.0,
+                    {"pipeline": self._pipeline_frozen},
                 )
         elif self._lowering_frozen is None:
             lo = self.lowering()
@@ -406,5 +475,6 @@ class ScheduleTuner:
             self._wire_frozen is not None
             and self._lowering_frozen is not None
             and self._backend_frozen is not None
+            and self._pipeline_frozen is not None
             and self.tuner.converged
         )

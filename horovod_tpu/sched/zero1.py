@@ -241,8 +241,22 @@ def bucketed_zero_step(
         # Quantized buckets ride the int8/fp8 wire (ops/quantized.py);
         # the dequant-accumulated shard is fp32 either way, so the
         # sharded optimizer update below always runs in full precision.
+        #
+        # Rail pipelining (xir/pipeline.py): when engaged, hier buckets
+        # chain their ICI reduce-scatter on the ICI rail and their
+        # cross-slice hop on the DCN rail — bucket i's DCN hop then
+        # overlaps bucket i+1's ICI reduce-scatter.  hier_adasum and
+        # flat buckets serialize against both rails (docs/adasum.md);
+        # ordering-only, values bitwise-identical either way.
+        from ..xir import pipeline as railpipe
+
         gshards = []
         new_residuals = []
+        rails = railpipe.RailChain()
+        use_rails = cfg.barriers and railpipe.engaged(
+            meta["schedule"], world
+        )
+        pipe_overlaps = 0
         token = None
         intra = (
             _intra_groups()
@@ -251,7 +265,13 @@ def bucketed_zero_step(
         )
         for lay, st in zip(layouts, opt_states):
             g = _bucket_flat(gleaves, lay)
-            if cfg.barriers and token is not None:
+            if use_rails:
+                bucket_rails = (
+                    ("ici",) if lay.lowering == "hier"
+                    else ("ici", "dcn")
+                )
+                (g,) = rails.tie([g], bucket_rails)
+            elif cfg.barriers and token is not None:
                 g, token = lax.optimization_barrier((g, token))
             if lay.lowering in ("hier", "hier_adasum"):
                 # ICI reduce_scatter to the slice-local 1/k shard, then
@@ -267,6 +287,13 @@ def bucketed_zero_step(
                     g, axis, scatter_dimension=0, tiled=True,
                     axis_index_groups=intra,
                 )
+                if use_rails and lay.lowering == "hier":
+                    # ICI phase done: release the ICI rail before the
+                    # cross-slice hop so the next bucket's ICI
+                    # reduce-scatter can overlap this bucket's DCN leg.
+                    rails.bump(shard, ("ici",))
+                    (shard,) = rails.tie([shard], ("dcn",))
+                    pipe_overlaps += 1
                 if lay.lowering == "hier_adasum":
                     shard = shard / lay.shards  # slice mean
                     shard = dcn_adasum(shard, axis, wire=lay.wire)
@@ -292,9 +319,19 @@ def bucketed_zero_step(
                     g, axis, scatter_dimension=0, tiled=True
                 ) / world
                 new_residuals.append(None)
-            if cfg.barriers:
+            if use_rails:
+                rails.bump(
+                    shard,
+                    ("dcn",) if lay.lowering == "hier"
+                    else ("ici", "dcn"),
+                )
+            elif cfg.barriers:
                 token = shard.reshape(-1)[0]
             gshards.append(shard)
+        if use_rails:
+            metrics.inc_counter(
+                "sched.pipeline.overlap_windows", max(pipe_overlaps - 1, 0)
+            )
         if pre_update is not None:
             gshards = pre_update(gshards)
 

@@ -50,11 +50,17 @@ from . import fit, hierarchical, model  # noqa: F401
 from .fit import record_observation  # noqa: F401
 from .hierarchical import (  # noqa: F401
     dcn_adasum,
+    dcn_all_gather_phase,
     dcn_all_reduce,
+    dcn_reduce_scatter_phase,
+    dcn_sum_phase,
     hierarchical_adasum_all_reduce,
     hierarchical_all_gather,
     hierarchical_all_reduce,
     hierarchical_reduce_scatter,
+    ici_all_gather_phase,
+    ici_reduce_scatter_phase,
+    phase_context,
 )
 from .model import (  # noqa: F401
     LOWER_CHOICES,

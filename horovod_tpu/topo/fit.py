@@ -329,6 +329,28 @@ def fitted_params(topo=None) -> Optional[FittedParams]:
     return fp
 
 
+def effective_params(topo) -> Tuple[float, float, float, float, float]:
+    """The link parameters every cost entry point prices with:
+    ``(phase_overhead_s, ici_lat_s, dcn_lat_s, ici_bytes_per_s,
+    dcn_bytes_per_s)`` — the *measured* fit when one exists for
+    ``topo``'s shape (and ``HVD_TPU_TOPO_FIT`` allows it), the static
+    env/instance fields otherwise.  Shared by
+    ``Topology.estimate_cost``/``rail_times`` and the rail pipeliner's
+    split-point search (``xir/pipeline.py``), so schedule pricing and
+    bucket splitting can never disagree about the per-rail
+    bandwidths."""
+    fp = fitted_params(topo)
+    if fp is not None:
+        return (
+            fp.phase_overhead_s, fp.ici_latency_s, fp.dcn_latency_s,
+            fp.ici_gbps * 1e9, fp.dcn_gbps * 1e9,
+        )
+    return (
+        topo.phase_overhead_s, topo.ici_latency_s, topo.dcn_latency_s,
+        topo.ici_gbps * 1e9, topo.dcn_gbps * 1e9,
+    )
+
+
 def reset() -> None:
     """Drop the fitted state and the observation cells (test isolation;
     called from ``topo.model.reset`` so one reset covers the package)."""

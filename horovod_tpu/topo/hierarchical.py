@@ -119,6 +119,90 @@ def _dcn_sum_dense(shard: jax.Array, ctx) -> jax.Array:
     return _grouped_sum(shard, ctx["axis"], ctx["cross"], ctx["s"])
 
 
+# --------------------------------------------------------- phase API
+#
+# The rail pipeliner (xir/pipeline.py + sched/execute.py) emits the
+# hierarchy one phase at a time so bucket i's DCN hop can chain on the
+# DCN rail while bucket i+1's ICI phase chains on the ICI rail.  These
+# wrappers expose the exact primitives the monolithic entry points
+# below are built from — same groups, same op order, same padding —
+# so a phase-emitted bucket is bitwise identical to the serialized
+# hierarchical_all_reduce/..._reduce_scatter call it replaces.
+
+def phase_context(axis: Axis, topo: Optional[model.Topology] = None):
+    """The hierarchy of ``axis`` for phase-at-a-time emission, or
+    ``None`` when the axis does not factor (callers lower flat)."""
+    return _hier_ctx(axis, topo)
+
+
+def ici_reduce_scatter_phase(flat: jax.Array, ctx) -> jax.Array:
+    """Intra-slice reduce_scatter (ICI rail): full buffer → slice-summed
+    1/k shard.  ``flat`` must be 1-D and k-divisible (callers pad)."""
+    return _ici_reduce_scatter(flat, ctx)
+
+
+def ici_all_gather_phase(shard: jax.Array, ctx) -> jax.Array:
+    """Intra-slice all_gather (ICI rail): 1/k shard → full buffer."""
+    return _ici_all_gather(shard, ctx)
+
+
+def dcn_sum_phase(shard: jax.Array, ctx, wire: str = "off") -> jax.Array:
+    """Cross-slice all_reduce of the 1/k shard (DCN rail) — the hier
+    allreduce's middle hop; ``wire`` compresses only this leg."""
+    return _dcn_sum(shard, ctx, wire)
+
+
+def dcn_reduce_scatter_phase(
+    shard_k: jax.Array, ctx, wire: str = "off",
+) -> jax.Array:
+    """Cross-slice reduce_scatter of the slice-summed 1/k shard (DCN
+    rail) — the hier RS+AG exchange's first DCN leg."""
+    quant = (wire or "off").lower() in ("int8", "fp8") and \
+        jnp.issubdtype(shard_k.dtype, jnp.floating)
+    if quant:
+        from ..ops.quantized import quantized_reduce_scatter
+
+        if ctx["mode"] == "axes":
+            return quantized_reduce_scatter(
+                shard_k, ctx["outer"], op=Sum, wire=wire
+            ).astype(shard_k.dtype)
+        return quantized_reduce_scatter(
+            shard_k, ctx["axis"], op=Sum, wire=wire, groups=ctx["cross"],
+        ).astype(shard_k.dtype)
+    if ctx["mode"] == "axes":
+        return lax.psum_scatter(
+            shard_k, ctx["outer"], scatter_dimension=0, tiled=True
+        )
+    return lax.psum_scatter(
+        shard_k, ctx["axis"], scatter_dimension=0,
+        axis_index_groups=ctx["cross"], tiled=True,
+    )
+
+
+def dcn_all_gather_phase(
+    shard: jax.Array, ctx, wire: str = "off",
+) -> jax.Array:
+    """Cross-slice all_gather (DCN rail) — the hier RS+AG exchange's
+    second DCN leg, inverse of :func:`dcn_reduce_scatter_phase`."""
+    quant = (wire or "off").lower() in ("int8", "fp8") and \
+        jnp.issubdtype(shard.dtype, jnp.floating)
+    if quant:
+        from ..ops.quantized import quantized_all_gather
+
+        if ctx["mode"] == "axes":
+            return quantized_all_gather(
+                shard, ctx["outer"], wire=wire
+            ).astype(shard.dtype)
+        return quantized_all_gather(
+            shard, ctx["axis"], wire=wire, groups=ctx["cross"]
+        ).astype(shard.dtype)
+    if ctx["mode"] == "axes":
+        return lax.all_gather(shard, ctx["outer"], tiled=True)
+    return lax.all_gather(
+        shard, ctx["axis"], axis_index_groups=ctx["cross"], tiled=True,
+    )
+
+
 def dcn_all_reduce(
     shard: jax.Array,
     axis: Axis = WORLD_AXIS,
@@ -415,27 +499,7 @@ def hierarchical_reduce_scatter(
     if pad:
         flat = jnp.pad(flat, (0, pad))
     shard_k = _ici_reduce_scatter(flat, ctx)
-    if quant:
-        from ..ops.quantized import quantized_reduce_scatter
-
-        if ctx["mode"] == "axes":
-            shard = quantized_reduce_scatter(
-                shard_k, ctx["outer"], op=Sum, wire=wire
-            ).astype(x.dtype)
-        else:
-            shard = quantized_reduce_scatter(
-                shard_k, ctx["axis"], op=Sum, wire=wire,
-                groups=ctx["cross"],
-            ).astype(x.dtype)
-    elif ctx["mode"] == "axes":
-        shard = lax.psum_scatter(
-            shard_k, ctx["outer"], scatter_dimension=0, tiled=True
-        )
-    else:
-        shard = lax.psum_scatter(
-            shard_k, ctx["axis"], scatter_dimension=0,
-            axis_index_groups=ctx["cross"], tiled=True,
-        )
+    shard = dcn_reduce_scatter_phase(shard_k, ctx, wire)
     return shard / (s * k) if op == Average else shard
 
 
@@ -455,24 +519,5 @@ def hierarchical_all_gather(
     ctx = _hier_ctx(axis, topo)
     if ctx is None:
         return lax.all_gather(shard, axis, tiled=True)
-    quant = (wire or "off").lower() in ("int8", "fp8") and \
-        jnp.issubdtype(shard.dtype, jnp.floating)
-    if quant:
-        from ..ops.quantized import quantized_all_gather
-
-        if ctx["mode"] == "axes":
-            out_k = quantized_all_gather(
-                shard, ctx["outer"], wire=wire
-            ).astype(shard.dtype)
-        else:
-            out_k = quantized_all_gather(
-                shard, ctx["axis"], wire=wire, groups=ctx["cross"]
-            ).astype(shard.dtype)
-    elif ctx["mode"] == "axes":
-        out_k = lax.all_gather(shard, ctx["outer"], tiled=True)
-    else:
-        out_k = lax.all_gather(
-            shard, ctx["axis"], axis_index_groups=ctx["cross"],
-            tiled=True,
-        )
+    out_k = dcn_all_gather_phase(shard, ctx, wire)
     return _ici_all_gather(out_k, ctx)

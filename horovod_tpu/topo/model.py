@@ -153,11 +153,20 @@ class Topology:
         nbytes: int,
         lowering: str = "flat",
         axis_size: Optional[int] = None,
+        *,
+        pipelined: bool = False,
     ) -> float:
         """Estimated seconds for ``collective`` over ``nbytes`` under a
         lowering.  Flat over a multi-slice axis rides the DCN
         bottleneck end to end; hierarchical pays three phase overheads
         but moves only the ``1/ici_degree`` shard over DCN.
+
+        ``pipelined=True`` prices the collective as one stage of a
+        rail-pipelined schedule (``xir/pipeline.py``): its ICI and DCN
+        phases overlap neighbouring buckets' phases on the other rail,
+        so the cost is the **max of the two rail times** instead of
+        their sum — the per-op form of the max-of-rails schedule
+        estimate.  Serialized (default) pricing is the sum of phases.
 
         Link parameters prefer the *measured* fit (``topo/fit.py``:
         effective bandwidth/latency solved from the per-collective
@@ -173,32 +182,39 @@ class Topology:
                 f"unknown lowering {lowering!r}; expected {LOWER_CHOICES}"
             )
         n = self.world if axis_size is None else axis_size
+        params = self._cost_params()
+        if pipelined:
+            ici_s, dcn_s = self.rail_times(collective, nbytes, lowering, n)
+            return max(ici_s, dcn_s)
         coeff = cost_coefficients(collective, nbytes, lowering, n, self)
-        po, ici_lat, dcn_lat, ici_bw, dcn_bw = self._cost_params()
-        return (
-            coeff[0] * po
-            + coeff[1] * ici_lat
-            + coeff[2] * dcn_lat
-            + coeff[3] / ici_bw
-            + coeff[4] / dcn_bw
+        return _dot_cost(coeff, params)
+
+    def rail_times(
+        self,
+        collective: str,
+        nbytes: int,
+        lowering: str = "flat",
+        axis_size: Optional[int] = None,
+    ) -> Tuple[float, float]:
+        """Per-rail seconds ``(ici_s, dcn_s)`` of one collective — the
+        split the rail pipeliner schedules against.  The two times sum
+        exactly to the serialized :meth:`estimate_cost` (the rail rows
+        partition the coefficient row)."""
+        n = self.world if axis_size is None else axis_size
+        ici_row, dcn_row = rail_cost_coefficients(
+            collective, nbytes, lowering, n, self
         )
+        params = self._cost_params()
+        return _dot_cost(ici_row, params), _dot_cost(dcn_row, params)
 
     def _cost_params(self) -> Tuple[float, float, float, float, float]:
         """(phase_overhead_s, ici_lat_s, dcn_lat_s, ici_bytes_per_s,
         dcn_bytes_per_s) — fitted when a measured fit for this shape
-        exists and ``HVD_TPU_TOPO_FIT`` allows it, static otherwise."""
+        exists and ``HVD_TPU_TOPO_FIT`` allows it, static otherwise
+        (``topo.fit.effective_params`` owns the preference order)."""
         from . import fit
 
-        fp = fit.fitted_params(self)
-        if fp is not None:
-            return (
-                fp.phase_overhead_s, fp.ici_latency_s, fp.dcn_latency_s,
-                fp.ici_gbps * 1e9, fp.dcn_gbps * 1e9,
-            )
-        return (
-            self.phase_overhead_s, self.ici_latency_s, self.dcn_latency_s,
-            self.ici_gbps * 1e9, self.dcn_gbps * 1e9,
-        )
+        return fit.effective_params(self)
 
     def choose_lowering(
         self,
@@ -330,6 +346,83 @@ def cost_coefficients(
         # separate launches cost one extra overhead.
         po += 1.0
     return (po, ici_hops, dcn_hops, ici_bytes, dcn_bytes)
+
+
+def _dot_cost(coeff, params) -> float:
+    """Dot one coefficient row with ``(po, ici_lat, dcn_lat,
+    ici_bytes_per_s, dcn_bytes_per_s)`` — the single pricing expression
+    every cost entry point shares."""
+    po, ici_lat, dcn_lat, ici_bw, dcn_bw = params
+    return (
+        coeff[0] * po
+        + coeff[1] * ici_lat
+        + coeff[2] * dcn_lat
+        + coeff[3] / ici_bw
+        + coeff[4] / dcn_bw
+    )
+
+
+def rail_cost_coefficients(
+    collective: str,
+    nbytes: float,
+    lowering: str,
+    axis_size: int,
+    topo: Topology,
+) -> Tuple[Tuple[float, float, float, float, float],
+           Tuple[float, float, float, float, float]]:
+    """Split :func:`cost_coefficients` into its ``(ici_row, dcn_row)``
+    rail halves: element-wise, the two rows sum exactly to the
+    serialized row (a pinned test property), so serialized pricing is
+    ``ici + dcn`` and pipelined pricing is ``max(ici, dcn)`` with the
+    *same* fitted parameters.  Latency/byte columns split by network
+    class; phase overheads go to the rail that launches the phase (the
+    lone DCN-hop launch on the DCN row, the ICI staging launches on
+    the ICI row).  Flat over a multi-slice axis is DCN-rail-only —
+    every hop of the ring crosses a slice boundary in the model —
+    which is what lets a slice-local shuffle workload merge into its
+    idle ICI windows (``xir/pipeline.py`` merge rules)."""
+    n = axis_size
+    s, k = topo.factor_axis(n)
+    phases = 2.0 if collective == "all_reduce" else 1.0
+    zero = (0.0, 0.0, 0.0, 0.0, 0.0)
+    if n <= 1:
+        return zero, zero
+    if s == 1 or lowering == "flat":
+        row = cost_coefficients(collective, nbytes, lowering, n, topo)
+        if s > 1:
+            return zero, row  # flat multi-slice rides DCN end to end
+        return row, zero
+    if lowering == "hier_adasum":
+        p2 = 1 << ((s).bit_length() - 1)
+        rounds = (p2.bit_length() - 1) + (1 if s != p2 else 0)
+        ici_po = ici_hops = ici_bytes = 0.0
+        if k > 1:
+            ici_po = 1.0
+            ici_hops = phases * (k - 1)
+            ici_bytes = phases * nbytes * (k - 1) / k
+        if collective == "all_reduce":
+            ici_po += 1.0  # separate ICI RS / AG launches
+        dcn_po = 1.0 + rounds
+        dcn_hops = (s - 1) * (1.0 + rounds)
+        dcn_bytes = (nbytes / k) * (s - 1) / s
+        return (
+            (ici_po, ici_hops, 0.0, ici_bytes, 0.0),
+            (dcn_po, 0.0, dcn_hops, 0.0, dcn_bytes),
+        )
+    # "hier"
+    ici_po = ici_hops = ici_bytes = 0.0
+    if k > 1:
+        ici_po = 1.0
+        ici_hops = phases * (k - 1)
+        ici_bytes = phases * nbytes * (k - 1) / k
+    if collective == "all_reduce":
+        ici_po += 1.0  # separate ICI RS / AG launches
+    dcn_hops = phases * (s - 1)
+    dcn_bytes = phases * (nbytes / k) * (s - 1) / s
+    return (
+        (ici_po, ici_hops, 0.0, ici_bytes, 0.0),
+        (1.0, 0.0, dcn_hops, 0.0, dcn_bytes),
+    )
 
 
 # ------------------------------------------------------------ discovery

@@ -111,6 +111,21 @@ XIR = "XIR"  # on (default) | off
 # gradients).  Shuffle-shaped ops (all_to_all/permute/sparse gather)
 # cap at bf16 — int8/fp8 requests downgrade to off for them.
 XIR_WIRE = "XIR_WIRE"
+# XIR rail pipeliner (xir/pipeline.py): phase-interleave the ICI and
+# DCN rails across hier buckets (bucket i's cross-slice DCN hop runs
+# concurrently with bucket i+1's ICI reduce-scatter and bucket i-1's
+# ICI all-gather, via per-rail optimization_barrier chains).
+#   off  = per-bucket chains, PR 10 emission exactly;
+#   auto = (default) reorder-only — engage the rail chains when the
+#          cost model prices the pipelined order cheaper, never
+#          changing the bucket plan;
+#   on   = rail chains AND bucket split points chosen from the fitted
+#          per-rail bandwidths (plan.build_schedule defers to
+#          pipeline.plan_bucket_bytes when no explicit size is set).
+# f32 dense losses are bitwise-identical in every mode: the barriers
+# are identity on values and reordering never changes summation
+# grouping within a bucket.  See docs/exchange_ir.md.
+XIR_PIPELINE = "XIR_PIPELINE"
 # Persistent schedule autotuning database (sched/store.py): JSON file
 # recording converged (bucket_bytes, wire, lowering) per (schedule
 # signature, topology, jax version, knob fingerprint); ScheduleTuner

@@ -21,15 +21,21 @@ the dense-gradient path already enjoys:
   phase primitives (``ops/quantized.py``, ``topo/hierarchical.py``,
   stock ``lax``) with per-exchange metrics and timeline lanes.
 
+A fourth pass — **schedule** (:mod:`~horovod_tpu.xir.pipeline`, the
+rail pipeliner) — phase-interleaves the ICI and DCN rails across
+buckets and merges co-scheduled programs with disjoint rails
+(``HVD_TPU_XIR_PIPELINE``; ordering-only, losses bitwise-identical).
+
 ``HVD_TPU_XIR=off`` restores every direct call path (bitwise-identical
 by the interpreter's parity contract).  See docs/exchange_ir.md.
 """
 
-from . import interp, ir, lower  # noqa: F401
+from . import interp, ir, lower, pipeline  # noqa: F401
 from .interp import (  # noqa: F401
     account,
     enabled,
     execute,
+    execute_merged,
     run_op,
     set_enabled_override,
     wire_request,
@@ -52,6 +58,7 @@ from .ir import (  # noqa: F401
     reduce_scatter,
 )
 from .lower import (  # noqa: F401
+    estimate_program_cost,
     lower as lower_program,
     op_network_bytes,
     op_wire_nbytes,

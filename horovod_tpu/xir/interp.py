@@ -343,6 +343,64 @@ def account(program: ir.ExchangeProgram,
             )
 
 
+def execute_merged(programs: Sequence[ir.ExchangeProgram],
+                   args_lists: Sequence[Sequence[Any]],
+                   *,
+                   axis_size: Optional[int] = None,
+                   process_set=None,
+                   store: bool = False) -> List[List[Any]]:
+    """Run several co-scheduled programs as ONE rail-interleaved
+    emission (the cross-workload merge of ``xir/pipeline.py``): when
+    the programs' rails are disjoint — a slice-local MoE all_to_all or
+    Ulysses flip riding the dense-grad hop loop — their ops emit in
+    the merged order with per-rail ``optimization_barrier`` chains, so
+    each workload's collectives land in the other's idle windows.
+
+    Values are identical to executing each program separately (the
+    chains are ordering-only and the programs share no payloads);
+    ineligible combinations — pipelining off, overlapping rails —
+    fall back to exactly that, so the entry point is always safe to
+    call.  Returns one output list per program, in input order."""
+    from . import pipeline
+
+    programs = [
+        p if p.lowered else lower_mod.lower(p, axis_size, store=store)
+        for p in programs
+    ]
+    for p, args in zip(programs, args_lists):
+        if len(args) != len(p.ops):
+            raise HorovodTpuError(
+                f"program {p.kind!r} has {len(p.ops)} ops but "
+                f"{len(args)} payloads were passed"
+            )
+    merged = pipeline.merge(programs, axis_size)
+    if merged is None:
+        return [
+            execute(p, a, axis_size=axis_size, process_set=process_set,
+                    store=store)
+            for p, a in zip(programs, args_lists)
+        ]
+    metrics.inc_counter("xir.pipeline.merged_programs", len(programs))
+    for p in programs:
+        account(p, axis_size)
+    rail = pipeline.RailChain()
+    outs: List[List[Any]] = [[None] * len(p.ops) for p in programs]
+    for pi, oi in pipeline.merge_order(programs, axis_size):
+        op = programs[pi].ops[oi]
+        r = pipeline.op_rail(op, axis_size)
+        x = args_lists[pi][oi]
+        leaves = list(x) if isinstance(x, tuple) else [x]
+        leaves = rail.tie(leaves, (r,))
+        x = tuple(leaves) if isinstance(x, tuple) else leaves[0]
+        with jax.named_scope(
+            f"hvd_xir_merged_{programs[pi].kind}_{op.op}{op.bucket}_{r}"
+        ):
+            out = run_op(op, x, process_set=process_set)
+        rail.bump(out[0] if isinstance(out, tuple) else out, (r,))
+        outs[pi][oi] = out
+    return outs
+
+
 def execute(program: ir.ExchangeProgram,
             args: Sequence[Any],
             *,

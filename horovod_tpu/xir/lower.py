@@ -284,6 +284,43 @@ def op_network_bytes(op: ir.ExchangeOp,
     }
 
 
+def estimate_program_cost(
+    program: ir.ExchangeProgram,
+    axis_size: Optional[int] = None,
+    *,
+    pipelined: Optional[bool] = None,
+) -> float:
+    """Cost-model seconds for one lowered program: serialized
+    (sum-of-phases) or rail-pipelined (max-of-rails,
+    ``xir/pipeline.py``).  ``pipelined=None`` prices whichever the
+    current ``HVD_TPU_XIR_PIPELINE`` mode would run — the lowering
+    pass's hook for comparing schedules the way the executor will
+    actually emit them.  Shuffle-shaped ops are priced as one
+    all_gather-weight stage on their dominant rail (the ring model has
+    no shuffle row; the approximation only matters for merge pricing,
+    never numerics)."""
+    from . import pipeline
+
+    items = []
+    for op in program.ops:
+        nbytes = int(op.attr("nbytes") or 0)
+        collective = (
+            op.op if op.op in ("all_reduce", "reduce_scatter",
+                               "all_gather") else "all_gather"
+        )
+        lowering = op.lowering if op.lowering in (
+            "flat", "hier", "hier_adasum") else "flat"
+        items.append((collective, nbytes, lowering))
+    if pipelined is None:
+        pipelined = pipeline.mode() != "off" and pipeline.engaged(
+            program.ops if hasattr(program, "ops") else program,
+            axis_size,
+        )
+    return pipeline.estimate_schedule_cost(
+        items, axis_size, pipelined=bool(pipelined)
+    )
+
+
 def program_bytes(program: ir.ExchangeProgram,
                   axis_size: Optional[int] = None
                   ) -> Tuple[Dict[str, int], Dict[str, int]]:

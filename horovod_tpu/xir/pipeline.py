@@ -1,0 +1,379 @@
+"""Rail pipeliner: phase-interleave the ICI and DCN rails across
+buckets and workloads.
+
+Horovod's core speedup is pipelining — the background loop keeps the
+wire busy while compute proceeds (arXiv:1802.05799 §4), and the RS+AG
+decomposition exists precisely so phases can be scheduled
+independently (arXiv:2004.13336).  PRs 5–10 made each *op* optimal but
+left the two networks idle in alternation: a ``hier`` bucket's three
+phases (ICI reduce-scatter → DCN hop → ICI all-gather) serialize, and
+the per-bucket ``lax.optimization_barrier`` chain in
+``sched/execute.py`` forces bucket *i*'s ICI all-gather before bucket
+*i+1*'s ICI reduce-scatter even though the DCN hop between them uses a
+different network entirely.
+
+This pass re-expresses the ordering **per rail instead of per
+bucket**: two independent ``optimization_barrier`` token chains — one
+for the ICI rail, one for the DCN rail — so bucket *i*'s cross-slice
+DCN hop runs concurrently with bucket *i+1*'s intra-slice ICI
+reduce-scatter (and bucket *i−1*'s ICI all-gather).  The barriers are
+identity on values and summation grouping within a bucket never
+changes, so f32 dense losses are **bitwise identical** to the
+serialized emission in every mode (the knob is a scheduling lever,
+never a numerics one).
+
+Three jobs live here:
+
+* **Engagement** (:func:`engaged`): ``HVD_TPU_XIR_PIPELINE`` =
+  ``off`` (per-bucket chains, the PR 10 emission exactly) | ``auto``
+  (default: engage the rail chains when the cost model prices the
+  pipelined order cheaper — reorder-only, the bucket plan is
+  untouched) | ``on`` (rail chains AND bucket split points from the
+  fitted per-rail bandwidths, :func:`plan_bucket_bytes`).
+* **Pricing** (:func:`estimate_schedule_cost`): the serialized
+  schedule costs the sum of every phase; the pipelined schedule costs
+  the **max of the two rail sums** plus one bucket's worth of
+  fill/drain — so pipelined ≤ serialized and ≥ either rail alone, by
+  construction (``Topology.rail_times`` supplies the per-bucket
+  split, fitted parameters included).
+* **Cross-workload merge** (:func:`merge` / :func:`merge_order`): two
+  lowered programs whose traffic lives on *disjoint rails* (a
+  slice-local MoE all_to_all or Ulysses flip is ICI-only; flat dense
+  buckets over a multi-slice axis are DCN-only in the model) can ride
+  one emission, interleaved so each program fills the other's idle
+  rail windows — ``xir.interp.execute_merged`` drives it.
+
+The :class:`RailChain` helper owns the two token chains; both
+``sched/execute.py`` (dense buckets) and ``xir/interp.py`` (merged
+programs) emit through it.  ``ScheduleTuner(explore_pipeline=True)``
+window-scores the knob and persists the winner in the tune DB
+(``meta.pipeline``), and ``tools/topo_bench.py --pipeline`` measures
+the pipelined-vs-serialized wall time on the simulated 2×4 mesh.
+See docs/exchange_ir.md ("Program scheduling").
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ..exceptions import HorovodTpuError
+from ..utils import env
+
+MODES = ("off", "on", "auto")
+
+# Buckets/ops the pipeliner can decompose into rail phases: the "hier"
+# lowering only.  hier_adasum's DCN leg is ceil(log2 s) dot-product
+# *rounds* interleaved with local combines — a cross-rail dependency
+# chain per bucket, not one hop — so hier_adasum buckets pin the
+# serialized path in v1 (docs/adasum.md).  Flat buckets occupy one rail
+# end to end and need no decomposition (they serialize against both
+# rails so their summation order never reorders across a wire change).
+DECOMPOSABLE_LOWERINGS = ("hier",)
+
+_mode_override: Optional[str] = None
+
+
+def set_mode_override(mode: Optional[str]) -> None:
+    """Trace-time knob override (the sched config-override pattern):
+    tests and bench variants pin the pipeliner without touching the
+    environment."""
+    global _mode_override
+    if mode is not None and mode not in MODES:
+        raise HorovodTpuError(
+            f"pipeline mode override must be one of {MODES}, got {mode!r}"
+        )
+    _mode_override = mode
+
+
+def mode() -> str:
+    """``HVD_TPU_XIR_PIPELINE`` policy: ``off`` | ``on`` | ``auto``
+    (default).  See the module docstring for what each engages."""
+    if _mode_override is not None:
+        return _mode_override
+    raw = (env.get_env(env.XIR_PIPELINE, "auto") or "auto").strip().lower()
+    if raw in ("0", "false", "no", "none", ""):
+        raw = "off"
+    if raw in ("1", "true", "yes"):
+        raw = "on"
+    if raw not in MODES:
+        raise HorovodTpuError(
+            f"HVD_TPU_XIR_PIPELINE must be off|on|auto, got {raw!r}"
+        )
+    return raw
+
+
+# ------------------------------------------------------------ pricing
+
+def rail_times(
+    collective: str,
+    nbytes: int,
+    lowering: str,
+    axis_size: Optional[int] = None,
+    topo=None,
+) -> Tuple[float, float]:
+    """Per-rail ``(ici_s, dcn_s)`` of one exchange under the current
+    (fitted) cost parameters — ``Topology.rail_times`` against the
+    process-wide topology by default."""
+    from ..topo import model as topo_model
+
+    topo = topo if topo is not None else topo_model.current()
+    return topo.rail_times(collective, nbytes, lowering, axis_size)
+
+
+def estimate_schedule_cost(
+    items: Sequence[Tuple[str, int, str]],
+    axis_size: Optional[int] = None,
+    *,
+    pipelined: bool = False,
+    topo=None,
+) -> float:
+    """Price a multi-bucket exchange: ``items`` is a sequence of
+    ``(collective, nbytes, lowering)`` stages in schedule order.
+
+    Serialized: the sum of every stage's two rail times (phases run
+    back to back).  Pipelined: ``max(Σici, Σdcn)`` — the busy rail is
+    the wall clock — plus one stage's worth of the other rail as
+    fill/drain (the pipeline must start and finish somewhere).  The
+    construction guarantees the property the tests pin::
+
+        max(Σici, Σdcn)  ≤  pipelined  ≤  serialized
+    """
+    if not items:
+        return 0.0
+    splits = [
+        rail_times(c, b, lo, axis_size, topo) for c, b, lo in items
+    ]
+    sum_ici = sum(s[0] for s in splits)
+    sum_dcn = sum(s[1] for s in splits)
+    if not pipelined:
+        return sum_ici + sum_dcn
+    return max(sum_ici, sum_dcn) + min(sum_ici, sum_dcn) / len(items)
+
+
+def plan_bucket_bytes(
+    total_nbytes: int,
+    axis_size: Optional[int] = None,
+    topo=None,
+) -> Optional[int]:
+    """Bucket split point for a pipelined schedule, from the fitted
+    per-rail bandwidths: the bucket size whose equal-split schedule
+    the max-of-rails model prices cheapest.
+
+    Small buckets amortize fill/drain but pay a phase-overhead tax per
+    bucket; large buckets do the opposite.  The search walks
+    power-of-two sizes between 64 KiB and ``total/2`` (a pipeline
+    needs ≥ 2 stages) and returns the argmin — ``None`` when the
+    topology is single-slice, the payload too small to split, or the
+    mode is not ``on`` (under ``auto`` the pass is reorder-only: the
+    bucket plan must stay identical to the serialized one)."""
+    from ..topo import model as topo_model
+
+    if mode() != "on":
+        return None
+    topo = topo if topo is not None else topo_model.current()
+    n = topo.world if axis_size is None else axis_size
+    s, _ = topo.factor_axis(n)
+    if s == 1 or total_nbytes < 2 * 65536:
+        return None
+    best_b, best_cost = None, None
+    b = 65536
+    while b <= max(total_nbytes // 2, 65536):
+        count = -(-total_nbytes // b)
+        items = [("all_reduce", min(b, total_nbytes), "hier")] * count
+        cost = estimate_schedule_cost(
+            items, n, pipelined=True, topo=topo
+        )
+        if best_cost is None or cost < best_cost:
+            best_b, best_cost = b, cost
+        b *= 2
+    return best_b
+
+
+# --------------------------------------------------------- engagement
+
+def _nbytes_of(bucket_or_op) -> int:
+    nb = getattr(bucket_or_op, "nbytes", None)
+    if nb is None and hasattr(bucket_or_op, "attr"):
+        nb = bucket_or_op.attr("nbytes")
+    return int(nb or 0)
+
+
+def decomposable(bucket_or_op) -> bool:
+    """Whether one bucket/op can split into rail phases: the ``hier``
+    lowering, a single wire dtype (one flat buffer), and no explicit
+    replica subgroups (the hierarchy factors the whole axis)."""
+    lowering = getattr(bucket_or_op, "lowering", "flat")
+    if lowering not in DECOMPOSABLE_LOWERINGS:
+        return False
+    dtypes = getattr(bucket_or_op, "wire_dtypes", None)
+    if dtypes is not None and len(set(dtypes)) != 1:
+        return False
+    if getattr(bucket_or_op, "groups", None) is not None:
+        return False
+    return True
+
+
+def engaged(schedule, axis_size: Optional[int] = None) -> bool:
+    """Whether the rail-chained emission runs for ``schedule`` (a
+    ``BucketSchedule`` or anything with ``.buckets``): off-mode never;
+    otherwise at least two decomposable buckets must exist (a single
+    stage has nothing to overlap) — and under ``auto`` the cost model
+    must price the pipelined order cheaper than the serialized one."""
+    m = mode()
+    if m == "off":
+        return False
+    buckets = list(getattr(schedule, "buckets", schedule))
+    n_decomp = sum(1 for b in buckets if decomposable(b))
+    if n_decomp < 2:
+        return False
+    if m == "on":
+        return True
+    items = [
+        ("all_reduce", _nbytes_of(b), b.lowering) for b in buckets
+    ]
+    pipe = estimate_schedule_cost(items, axis_size, pipelined=True)
+    serial = estimate_schedule_cost(items, axis_size, pipelined=False)
+    return pipe < serial
+
+
+# ------------------------------------------------------ rail chaining
+
+class RailChain:
+    """Two independent ``lax.optimization_barrier`` token chains — one
+    per rail.  ``tie`` makes tensors wait for the named rails' previous
+    occupants; ``bump`` installs a scalar carried out of an op as the
+    rails' new token.  Identity on values: the chains only add ordering
+    edges, which is the whole trick."""
+
+    RAILS = ("ici", "dcn")
+
+    def __init__(self):
+        self._tok: Dict[str, Any] = {r: None for r in self.RAILS}
+        self.overlap_windows = 0
+
+    def tie(self, tensors: List[Any], rails: Sequence[str]) -> List[Any]:
+        from jax import lax
+
+        toks = tuple(
+            self._tok[r] for r in rails if self._tok[r] is not None
+        )
+        if not toks or not tensors:
+            return list(tensors)
+        out = lax.optimization_barrier(tuple(tensors) + toks)
+        return list(out[: len(tensors)])
+
+    def bump(self, tensor: Any, rails: Sequence[str]) -> None:
+        tok = tensor.reshape(-1)[0]
+        for r in rails:
+            self._tok[r] = tok
+
+
+# --------------------------------------------------- workload merging
+
+def _op_rail_split(op, axis_size: Optional[int]) -> Tuple[float, float]:
+    """One lowered op's ``(ici, dcn)`` occupancy.  Ungrouped
+    reduce-shaped ops use the cost model's rail split (flat over a
+    multi-slice axis rides the DCN bottleneck end to end — its ring
+    *time* is DCN-gated even where individual hops stay on ICI; hier
+    occupies both rails); shuffle-shaped and subgroup ops — which the
+    ring cost model has no row for — split by modeled bytes (a
+    slice-local all_to_all is ICI-only)."""
+    from . import ir, lower as lower_mod
+
+    if op.op in ir.REDUCE_OPS and op.groups is None:
+        lowering = op.lowering if op.lowering in (
+            "flat", "hier", "hier_adasum") else "flat"
+        return rail_times(
+            op.op, int(op.attr("nbytes") or 0), lowering, axis_size
+        )
+    by = lower_mod.op_network_bytes(op, axis_size)
+    return float(by["ici"]), float(by["dcn"])
+
+
+def op_rail(op, axis_size: Optional[int] = None) -> str:
+    """Dominant rail of one lowered op: ``"dcn"`` when its cross-slice
+    occupancy exceeds its intra-slice one (flat dense buckets over a
+    multi-slice axis), ``"ici"`` otherwise (slice-local subgroups,
+    single-slice worlds, and the ICI-heavy hier phases)."""
+    ici, dcn = _op_rail_split(op, axis_size)
+    return "dcn" if dcn > ici else "ici"
+
+
+def program_rails(program, axis_size: Optional[int] = None) -> frozenset:
+    """The set of rails a lowered program occupies: ``hier`` ops both;
+    a slice-local shuffle only ``{"ici"}``; flat dense buckets over a
+    multi-slice axis only ``{"dcn"}`` (the cost-model view — their
+    wall-clock is DCN-gated, leaving the ICI rail's windows free for a
+    merged rider)."""
+    rails = set()
+    for op in program.ops:
+        ici, dcn = _op_rail_split(op, axis_size)
+        if ici > 0:
+            rails.add("ici")
+        if dcn > 0:
+            rails.add("dcn")
+        if op.lowering in ("hier", "hier_adasum"):
+            rails.update(("ici", "dcn"))
+    return frozenset(rails)
+
+
+def rails_disjoint(a, b, axis_size: Optional[int] = None) -> bool:
+    """Merge eligibility: two programs may co-schedule when their rail
+    sets do not overlap — each one's traffic fills windows the other
+    leaves idle, so interleaving can only hide time, never contend."""
+    return not (program_rails(a, axis_size) & program_rails(b, axis_size))
+
+
+def merge_order(
+    programs: Sequence,
+    axis_size: Optional[int] = None,
+) -> List[Tuple[int, int]]:
+    """Interleaved emission order of several co-scheduled programs:
+    ``[(program_idx, op_idx), ...]``.  Round-robin over the programs,
+    preferring at each step a program whose next op sits on a
+    different rail than the op just emitted — the DCN-heavy loop and
+    the ICI-only rider alternate, each landing in the other's idle
+    window.  Deterministic (pure function of the lowered programs), so
+    every SPMD rank emits the identical merged order."""
+    queues = [list(range(len(p.ops))) for p in programs]
+    order: List[Tuple[int, int]] = []
+    last_rail: Optional[str] = None
+    while any(queues):
+        pick = None
+        for pi, q in enumerate(queues):
+            if not q:
+                continue
+            r = op_rail(programs[pi].ops[q[0]], axis_size)
+            if last_rail is None or r != last_rail:
+                pick = pi
+                break
+        if pick is None:
+            pick = next(pi for pi, q in enumerate(queues) if q)
+        oi = queues[pick].pop(0)
+        last_rail = op_rail(programs[pick].ops[oi], axis_size)
+        order.append((pick, oi))
+    return order
+
+
+def merge(programs: Sequence, axis_size: Optional[int] = None):
+    """Merge several lowered programs into one co-scheduled
+    :class:`~horovod_tpu.xir.ir.ExchangeProgram` (kind =
+    ``"kind_a+kind_b"``, ops renumbered in the interleaved order), or
+    ``None`` when merging is ineligible: pipelining off, fewer than
+    two programs, or any pair sharing a rail.  The merged program is
+    pure metadata — ``xir.interp.execute_merged`` gives it meaning
+    with one :class:`RailChain` emission."""
+    from . import ir
+
+    if mode() == "off" or len(programs) < 2:
+        return None
+    for i in range(len(programs)):
+        for j in range(i + 1, len(programs)):
+            if not rails_disjoint(programs[i], programs[j], axis_size):
+                return None
+    order = merge_order(programs, axis_size)
+    ops = [
+        programs[pi].ops[oi].replace(bucket=pos)
+        for pos, (pi, oi) in enumerate(order)
+    ]
+    return ir.program("+".join(p.kind for p in programs), ops)

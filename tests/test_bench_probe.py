@@ -1,0 +1,170 @@
+"""Regression tests for the bench.py device probe (the dead-probe
+satellite): a hung probe subprocess must yield the structured skip
+record — non-empty reason, captured stderr, bounded per-attempt
+deadline inside the alarm window — AND the device-free sim records
+must still run (the BENCH_r03..r05 failure mode was the probe racing
+the SIGALRM into the outer raw-error path, which skipped them all)."""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+
+from horovod_tpu.utils.retry import RetryPolicy  # noqa: E402
+
+
+def _no_sleep_retry():
+    return RetryPolicy(
+        max_attempts=2, base_delay_s=0.0, jitter=0.0,
+        name="bench.probe.test",
+        retry_on=(RuntimeError, subprocess.TimeoutExpired),
+    )
+
+
+@pytest.fixture(autouse=True)
+def _no_cache(monkeypatch, tmp_path):
+    # never read or write the real probe-cache sidecar
+    monkeypatch.setenv(
+        "HVD_BENCH_PROBE_CACHE", str(tmp_path / "probe_cache.json")
+    )
+
+
+class TestHungProbe:
+    def test_timeout_yields_skip_with_stderr(self, monkeypatch):
+        calls = {"n": 0, "timeouts": []}
+
+        def hung_run(cmd, timeout=None, **kw):
+            calls["n"] += 1
+            calls["timeouts"].append(timeout)
+            raise subprocess.TimeoutExpired(
+                cmd, timeout, stderr=b"tpu tunnel wedged: boom"
+            )
+
+        monkeypatch.setattr(bench.subprocess, "run", hung_run)
+        skip = bench.run_device_probe(
+            480, time.monotonic(), retry=_no_sleep_retry()
+        )
+        assert skip is not None
+        assert skip["status"] == "skipped"
+        assert skip["reason"]  # non-empty, always
+        assert "TimeoutExpired" in skip["reason"]
+        assert "boom" in skip["probe_stderr"]
+        assert calls["n"] == 2  # both attempts ran
+        # per-attempt deadline bounded INSIDE the alarm window: never
+        # more than half the remaining budget minus the records reserve
+        for t in calls["timeouts"]:
+            assert t <= 480 / 2 - 45 + 1
+
+    def test_attempt_budget_shrinks_with_alarm(self, monkeypatch):
+        seen = []
+
+        def hung_run(cmd, timeout=None, **kw):
+            seen.append(timeout)
+            raise subprocess.TimeoutExpired(cmd, timeout, stderr=None)
+
+        monkeypatch.setattr(bench.subprocess, "run", hung_run)
+        # alarm armed 400 s ago of a 480 s window: 80 s remain, so each
+        # attempt gets the 20 s floor, never 150 s
+        bench.run_device_probe(
+            480, time.monotonic() - 400, retry=_no_sleep_retry()
+        )
+        assert seen and all(t == 20 for t in seen)
+
+    def test_failed_probe_captures_rc_and_stderr(self, monkeypatch):
+        def failing_run(cmd, **kw):
+            return subprocess.CompletedProcess(
+                cmd, returncode=3, stdout="",
+                stderr="ImportError: libtpu not found",
+            )
+
+        monkeypatch.setattr(bench.subprocess, "run", failing_run)
+        skip = bench.run_device_probe(
+            480, time.monotonic(), retry=_no_sleep_retry()
+        )
+        assert skip is not None
+        assert "rc=3" in skip["reason"]
+        assert "libtpu" in skip["probe_stderr"]
+
+    def test_live_probe_returns_none_and_caches(self, monkeypatch):
+        def ok_run(cmd, **kw):
+            return subprocess.CompletedProcess(
+                cmd, returncode=0, stdout="8.0\n", stderr=""
+            )
+
+        monkeypatch.setattr(bench.subprocess, "run", ok_run)
+        assert bench.run_device_probe(
+            480, time.monotonic(), retry=_no_sleep_retry()
+        ) is None
+        assert bench._probe_cached_ok()  # second call skips subprocess
+
+        def exploding_run(cmd, **kw):  # pragma: no cover - must not run
+            raise AssertionError("probe re-ran despite fresh cache")
+
+        monkeypatch.setattr(bench.subprocess, "run", exploding_run)
+        assert bench.run_device_probe(480, time.monotonic()) is None
+
+
+class TestSkipPathStillRecords:
+    def test_device_free_records_run_on_skip(self, monkeypatch):
+        """The skip result flows through the SAME record list as a
+        healthy cpu-only run: a hung probe still yields real sim
+        records plus the non-empty reason."""
+        ran = []
+
+        def fake_record(name):
+            def record(result, deadline_s, t_start):
+                ran.append(name)
+                result[name] = {"metric": name, "value": 1.0}
+            return record
+
+        monkeypatch.setattr(
+            bench, "_cpu_resnet_fallback", fake_record("cpu_fallback")
+        )
+        for rec in ("_maybe_scaling", "_maybe_topo",
+                    "_maybe_quant_backend", "_maybe_adasum",
+                    "_maybe_railpipe"):
+            monkeypatch.setattr(bench, rec, fake_record(rec))
+
+        result = {
+            "metric": "resnet50_synthetic_train_throughput",
+            "value": 0.0,
+            "unit": "images/sec/chip",
+            "vs_baseline": 0.0,
+            "status": "skipped",
+            "reason": "device probe exhausted retries: TimeoutExpired",
+            "probe_stderr": "boom",
+        }
+        bench._device_free_records(result, 480, time.monotonic())
+        assert ran == ["cpu_fallback", "_maybe_scaling", "_maybe_topo",
+                       "_maybe_quant_backend", "_maybe_adasum",
+                       "_maybe_railpipe"]
+        assert result["reason"]
+        assert result["cpu_fallback"]["value"] == 1.0
+
+    def test_fallback_skipped_when_primary_measured(self, monkeypatch):
+        """A healthy TPU run (nonzero primary value) never pays the
+        CPU-sim resnet fallback subprocess."""
+        ran = []
+
+        def fake(result, deadline_s, t_start):
+            ran.append("cpu_fallback")
+
+        def noop(result, deadline_s, t_start):
+            pass
+
+        monkeypatch.setattr(bench, "_cpu_resnet_fallback", fake)
+        for rec in ("_maybe_scaling", "_maybe_topo",
+                    "_maybe_quant_backend", "_maybe_adasum",
+                    "_maybe_railpipe"):
+            monkeypatch.setattr(bench, rec, noop)
+        bench._device_free_records(
+            {"value": 123.0}, 480, time.monotonic()
+        )
+        assert ran == []

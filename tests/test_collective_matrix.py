@@ -1079,6 +1079,205 @@ class TestXirColumn:
             hvd.remove_process_set(ps)
 
 
+@pytest.mark.railpipe
+class TestPipelineColumn:
+    """XIR rail-pipeliner column of the matrix: the phase-interleaved
+    emission (``HVD_TPU_XIR_PIPELINE``, xir/pipeline.py) against the
+    serialized per-bucket chain — bitwise on the f32 dense wire, 1e-3
+    on int8+EF — plus per-rail byte-gauge invariance, the merged
+    a2a+dense program, and the max-of-rails cost properties."""
+
+    @pytest.fixture(autouse=True)
+    def _forced_two_slice(self, monkeypatch):
+        from horovod_tpu import sched, topo
+        from horovod_tpu.xir import pipeline as railpipe
+
+        monkeypatch.setenv("HVD_TPU_TOPO", "2x4")
+        topo.reset()
+        yield
+        railpipe.set_mode_override(None)
+        sched.set_config_override(None)
+        topo.reset()
+
+    def _train(self, mode, wire="off", iters=5, lowering="hier"):
+        import optax
+
+        from horovod_tpu import metrics, sched
+        from horovod_tpu.xir import pipeline as railpipe
+
+        rng = np.random.RandomState(7)
+        X = rng.randn(32, 64).astype(np.float32)
+        Y = (X @ rng.randn(64, 8).astype(np.float32)).astype(np.float32)
+
+        def loss_fn(p, b):
+            x, y = b
+            h = jnp.tanh(x @ p["w1"] + p["b1"])
+            return jnp.mean((h @ p["w2"] - y) ** 2)
+
+        r = np.random.RandomState(3)
+        p = {
+            "w1": jnp.asarray(r.randn(64, 256).astype(np.float32) * 0.05),
+            "b1": jnp.zeros((256,)),
+            "w2": jnp.asarray(r.randn(256, 8).astype(np.float32) * 0.05),
+        }
+        railpipe.set_mode_override(mode)
+        sched.set_config_override(sched.SchedConfig(
+            enabled=True, bucket_bytes=16 * 1024, lowering=lowering,
+            wire=wire,
+        ))
+        overlap0 = metrics.get_counter("sched.pipeline.overlap_windows")
+        try:
+            tx = hvd.DistributedOptimizer(optax.sgd(0.05))
+            step = hvd.distributed_train_step(loss_fn, tx)
+            st = step.init(p)
+            batch = (jnp.asarray(X), jnp.asarray(Y))
+            losses = []
+            for _ in range(iters):
+                p, st, loss = step(p, st, batch)
+                losses.append(float(loss))
+            gauges = {
+                "dcn": metrics.get_gauge("topo.dcn_bytes"),
+                "ici": metrics.get_gauge("topo.ici_bytes"),
+            }
+            overlaps = metrics.get_counter(
+                "sched.pipeline.overlap_windows"
+            ) - overlap0
+            return losses, gauges, overlaps
+        finally:
+            from horovod_tpu import sched as _s
+
+            _s.set_config_override(None)
+            railpipe.set_mode_override(None)
+
+    def test_pipelined_vs_serialized_bitwise_f32(self, hvd_module):
+        off, _, n_off = self._train("off")
+        on, _, n_on = self._train("on")
+        assert off == on  # bitwise: reordering never touches values
+        assert n_off == 0
+        assert n_on > 0  # the rail chains actually engaged
+
+    def test_auto_mode_bitwise_and_engaged(self, hvd_module):
+        off, _, _ = self._train("off")
+        auto, _, n_auto = self._train("auto")
+        assert off == auto
+        assert n_auto > 0  # cost model prices pipelined cheaper here
+
+    def test_int8_ef_within_tolerance(self, hvd_module):
+        """Quantized buckets serialize inside the pipelined emission
+        (they occupy both rails), so pipelined == serialized holds to
+        the wire's own tolerance; both stay close to dense."""
+        dense, _, _ = self._train("off")
+        off, _, _ = self._train("off", wire="int8")
+        on, _, _ = self._train("on", wire="int8")
+        np.testing.assert_allclose(off, on, rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(dense, on, rtol=1e-3, atol=1e-3)
+
+    def test_rail_byte_gauges_identical(self, hvd_module):
+        """Pipelining is ordering-only: the planned per-rail traffic —
+        topo.dcn_bytes / topo.ici_bytes — is identical either way."""
+        _, g_off, _ = self._train("off")
+        _, g_on, _ = self._train("on")
+        assert g_off == g_on
+        assert g_on["dcn"] > 0 and g_on["ici"] > 0
+
+    def test_merged_a2a_dense_program_parity(self, hvd_module):
+        """Cross-workload merge on a 2x2 dp×ep mesh: a dense-grad
+        all_reduce program over dp merged with a MoE all_to_all over
+        ep — executed as one rail-interleaved emission — is bitwise
+        identical to executing the programs separately."""
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu import xir
+        from horovod_tpu.parallel import make_mesh
+        from horovod_tpu.xir import pipeline as railpipe
+
+        mesh = make_mesh(dp=2, ep=2, devices=jax.devices()[:4])
+        g = _data(np.float32, shape=(4, 8), seed=40)
+        a = _data(np.float32, shape=(4, 4, 8), seed=41)
+
+        def progs():
+            dense = xir.program("dense_grad", [xir.all_reduce(
+                "dp", lowering="flat", nbytes=g.size * 4,
+                dtype="float32",
+            )])
+            moe = xir.program("moe", [xir.all_to_all(
+                "ep", split_axis=0, concat_axis=1,
+                nbytes=a.size * 4, dtype="float32",
+            )])
+            return dense, moe
+
+        def merged(gg, aa):
+            dense, moe = progs()
+            outs = xir.execute_merged(
+                [dense, moe], [[gg], [aa]], store=False
+            )
+            return outs[0][0], outs[1][0]
+
+        def separate(gg, aa):
+            dense, moe = progs()
+            o1 = xir.execute(dense, [gg], store=False)[0]
+            o2 = xir.execute(moe, [aa], store=False)[0]
+            return o1, o2
+
+        def run(fn):
+            return jax.jit(jax.shard_map(
+                fn, mesh=mesh,
+                in_specs=(P("dp"), P("dp", "ep")),
+                out_specs=(P("dp"), P("dp", "ep")),
+                check_vma=False,
+            ))(g, a)
+
+        railpipe.set_mode_override("on")
+        m1, m2 = run(merged)
+        railpipe.set_mode_override("off")
+        s1, s2 = run(separate)
+        np.testing.assert_array_equal(np.asarray(m1), np.asarray(s1))
+        np.testing.assert_array_equal(np.asarray(m2), np.asarray(s2))
+
+    def test_cost_model_properties(self, hvd_module):
+        """max(rail sums) ≤ pipelined ≤ serialized for random
+        schedules, and the rail coefficient rows partition the
+        serialized row exactly."""
+        from horovod_tpu.topo import model as topo_model
+        from horovod_tpu.xir import pipeline as railpipe
+
+        topo = topo_model.current()
+        rng = np.random.RandomState(11)
+        for _ in range(20):
+            items = [
+                ("all_reduce", int(rng.randint(1 << 10, 1 << 24)),
+                 rng.choice(["hier", "flat"]))
+                for _ in range(int(rng.randint(2, 8)))
+            ]
+            serial = railpipe.estimate_schedule_cost(items, 8)
+            pipe = railpipe.estimate_schedule_cost(
+                items, 8, pipelined=True
+            )
+            splits = [railpipe.rail_times(c, b, lo, 8)
+                      for c, b, lo in items]
+            max_rail = max(sum(s[0] for s in splits),
+                           sum(s[1] for s in splits))
+            assert max_rail <= pipe <= serial, (items, max_rail, pipe,
+                                                serial)
+        for lowering in ("flat", "hier", "hier_adasum"):
+            for coll in ("all_reduce", "reduce_scatter", "all_gather"):
+                full = topo_model.cost_coefficients(
+                    coll, 1 << 20, lowering, 8, topo
+                )
+                ici, dcn = topo_model.rail_cost_coefficients(
+                    coll, 1 << 20, lowering, 8, topo
+                )
+                for f, i, d in zip(full, ici, dcn):
+                    assert abs(f - (i + d)) < 1e-9
+        # the single-op pipelined estimate is the max of its rails
+        t = topo.estimate_cost("all_reduce", 1 << 20, "hier", 8,
+                               pipelined=True)
+        assert abs(
+            t - max(topo.rail_times("all_reduce", 1 << 20, "hier", 8))
+        ) < 1e-12
+
+
 @pytest.mark.pallas
 @pytest.mark.quant
 class TestFusedQuantColumn:

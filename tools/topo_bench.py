@@ -30,9 +30,17 @@ summation across slices — stays in the stable region without LR
 retuning).  Also reports each run's DCN bytes so the record doubles as
 the hier_adasum ≤ hier wire-cost proof.
 
+``--pipeline`` record — ``railpipe_overlap``: the XIR rail pipeliner
+(``HVD_TPU_XIR_PIPELINE``, xir/pipeline.py) on the hier multi-bucket
+exchange — serialized per-bucket chains vs the reorder-only per-rail
+chains (losses bitwise equal, overlap windows > 0) vs the fully
+pipelined emission whose bucket split comes from the fitted per-rail
+bandwidths; the headline value is the serialized/pipelined step-time
+speedup.
+
 Run standalone or through ``bench.py`` (which embeds the lines under
 its ``"topo_hier_vs_flat"`` / ``"quant_fused_vs_phase"`` /
-``"adasum_vs_sum"`` keys).
+``"adasum_vs_sum"`` / ``"railpipe_overlap"`` keys).
 """
 
 import json
@@ -389,13 +397,137 @@ def main_adasum() -> dict:
     }
 
 
+def main_pipeline() -> dict:
+    """The ``railpipe_overlap`` record (docs/exchange_ir.md "Program
+    scheduling"): the same seeded train loop under three emissions of
+    the hier multi-bucket exchange —
+
+    * **serialized** — ``HVD_TPU_XIR_PIPELINE=off``, 16 KiB buckets:
+      the PR 10 per-bucket barrier chain (3 collectives per bucket,
+      fully ordered);
+    * **reorder-only** — ``auto`` with the same 16 KiB buckets: the
+      identical plan emitted with per-rail chains (losses must be
+      BITWISE equal to serialized — the acceptance contract);
+    * **pipelined** — ``on`` with no pinned size: rail chains AND the
+      split point chosen from the fitted per-rail bandwidths
+      (``xir.pipeline.plan_bucket_bytes``), i.e. what the tuner's
+      winning knob actually runs.
+
+    The headline value is serialized/pipelined step-time speedup;
+    reorder-only rides along so the split-vs-reorder contributions
+    stay separable.  ``overlap_windows`` proves the rail chains
+    engaged (one window per deferred all-gather)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import horovod_tpu as hvd
+    from horovod_tpu import metrics, sched
+    from horovod_tpu.xir import pipeline as railpipe
+
+    jax.config.update("jax_platforms", "cpu")
+    hvd.init()
+
+    rng = np.random.RandomState(7)
+    X = rng.randn(32, 64).astype(np.float32)
+    Y = (X @ rng.randn(64, 8).astype(np.float32)).astype(np.float32)
+
+    def loss_fn(p, b):
+        x, y = b
+        h = jnp.tanh(x @ p["w1"] + p["b1"])
+        return jnp.mean((h @ p["w2"] - y) ** 2)
+
+    def params():
+        r = np.random.RandomState(3)
+        return {
+            "w1": jnp.asarray(r.randn(64, 512).astype(np.float32) * 0.05),
+            "b1": jnp.zeros((512,)),
+            "w2": jnp.asarray(r.randn(512, 8).astype(np.float32) * 0.05),
+        }
+
+    def run(mode, bucket_bytes, iters=30, warmup=5):
+        railpipe.set_mode_override(mode)
+        cfg = sched.SchedConfig(
+            enabled=True, bucket_bytes=bucket_bytes, lowering="hier"
+        )
+        sched.set_config_override(cfg)
+        overlap0 = metrics.get_counter("sched.pipeline.overlap_windows")
+        try:
+            p = params()
+            tx = hvd.DistributedOptimizer(optax.sgd(0.05))
+            step = hvd.distributed_train_step(loss_fn, tx)
+            st = step.init(p)
+            batch = (jnp.asarray(X), jnp.asarray(Y))
+            losses = []
+            for _ in range(warmup):
+                p, st, loss = step(p, st, batch)
+                losses.append(float(loss))
+            jax.block_until_ready(loss)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                p, st, loss = step(p, st, batch)
+            jax.block_until_ready(loss)
+            dt = (time.perf_counter() - t0) / iters
+            return {
+                "step_time_ms": round(dt * 1000.0, 3),
+                "buckets_per_step": int(
+                    metrics.get_gauge("sched.buckets_per_step") or 0
+                ),
+                "overlap_windows": metrics.get_counter(
+                    "sched.pipeline.overlap_windows"
+                ) - overlap0,
+                "losses": losses,
+                "final_loss": float(loss),
+            }
+        finally:
+            sched.set_config_override(None)
+            railpipe.set_mode_override(None)
+
+    serialized = run("off", 16 * 1024)
+    reorder = run("auto", 16 * 1024)
+    pipelined = run("on", None)
+    bitwise = serialized["losses"] == reorder["losses"]
+    assert bitwise, "pipeline reorder changed values — contract broken"
+    assert reorder["overlap_windows"] > 0, "rail chains never engaged"
+    speedup = serialized["step_time_ms"] / max(
+        pipelined["step_time_ms"], 1e-9
+    )
+    return {
+        "metric": "railpipe_overlap",
+        "unit": "serialized_over_pipelined_step_time",
+        "value": round(speedup, 3),
+        "topo": os.environ["HVD_TPU_TOPO"],
+        "step_time_ms": {
+            "serialized": serialized["step_time_ms"],
+            "reorder_only": reorder["step_time_ms"],
+            "pipelined": pipelined["step_time_ms"],
+        },
+        "buckets_per_step": {
+            "serialized": serialized["buckets_per_step"],
+            "pipelined": pipelined["buckets_per_step"],
+        },
+        "overlap_windows": {
+            "reorder_only": reorder["overlap_windows"],
+            "pipelined": pipelined["overlap_windows"],
+        },
+        "loss_bitwise_serialized_vs_reorder": bitwise,
+        "loss_delta_pipelined": abs(
+            serialized["final_loss"] - pipelined["final_loss"]
+        ),
+    }
+
+
 if __name__ == "__main__":
     args = sys.argv[1:]
     which = ("quant" if "--quant" in args
-             else "adasum" if "--adasum" in args else "topo")
-    mains = {"quant": main_quant, "adasum": main_adasum, "topo": main}
+             else "adasum" if "--adasum" in args
+             else "pipeline" if "--pipeline" in args else "topo")
+    mains = {"quant": main_quant, "adasum": main_adasum, "topo": main,
+             "pipeline": main_pipeline}
     names = {"quant": "quant_fused_vs_phase", "adasum": "adasum_vs_sum",
-             "topo": "topo_hier_vs_flat"}
+             "topo": "topo_hier_vs_flat",
+             "pipeline": "railpipe_overlap"}
     try:
         print(json.dumps(mains[which]()))
     except Exception as e:  # degraded-run hardening: always emit a line
