@@ -623,6 +623,16 @@ def run_remesh(state: Any, manager: Any, request: RemeshRequest) -> None:
     try:
         with remesh_phase("pause", remesh_id=request.remesh_id,
                           rank=old_rank):
+            # Quiesce the exchange service at the pause point: every
+            # in-flight submission (a delayed DCN hop, a tenant's
+            # eager program) resolves before state snapshots, and the
+            # service restarts lazily against the NEW mesh after
+            # reinit — its cached executors must not cross a world
+            # change.
+            from .. import svc as _svc
+
+            _svc.drain(timeout_s=request.deadline_s)
+            _svc.reset_service()
             manager.remesh_ack(request.remesh_id, "pause")
 
         sharded = getattr(state, "sharded_attrs", lambda: {})()

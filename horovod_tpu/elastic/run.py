@@ -135,6 +135,17 @@ def _exit_for_restart(code: int) -> None:
     import os
     import sys
 
+    # Quiesce the async exchange service first: in-flight DCN hops
+    # resolve (or fall back inline) so no producer thread is mid-submit
+    # when the process dies — a restart round must never orphan a
+    # future another thread will block on during interpreter teardown.
+    try:
+        from .. import svc as _svc
+
+        _svc.drain(timeout_s=5.0)
+        _svc.reset_service()
+    except Exception:  # the exit path must never wedge on the service
+        pass
     sys.stdout.flush()
     sys.stderr.flush()
     os._exit(code)  # skip atexit: the mesh may be wedged on a dead peer
@@ -144,7 +155,9 @@ def _default_reset() -> None:
     """Full re-initialization: tear down the runtime (dropping compiled
     collectives for the old mesh) and re-init against the (possibly
     changed) device world — the analog of the reference's
-    ``hvd.shutdown(); hvd.init()`` in ``tensorflow/elastic.py:64``."""
+    ``hvd.shutdown(); hvd.init()`` in ``tensorflow/elastic.py:64``.
+    ``runtime.shutdown`` also restarts the exchange service, whose
+    cached executors were compiled against the old mesh."""
     runtime.shutdown()
     runtime.init()
 

@@ -79,6 +79,17 @@ Registered sites (grep ``faults.inject`` for ground truth):
 ``remesh.publish``              additionally honors ``corrupt``: the
                                 published shard blob is damaged so the
                                 receiver's checksum MUST catch it
+``svc.submit``                  each exchange-service submission (host
+                                and traced producers; ``producer=``,
+                                ``kind=`` context) — an ``error`` kills
+                                the service and the submission degrades
+                                to synchronous inline dispatch
+                                (``svc.fallback_sync``)
+``svc.drain``                   each service drain (remesh pause,
+                                elastic restart, shutdown)
+``svc.loop``                    each background-loop cycle tick
+                                (``cycle=`` context) — kill the service
+                                mid-flight between submissions
 ==============================  ==========================================
 
 Worker scripts may add their own sites (``faults.inject("my.site")``)

@@ -723,6 +723,20 @@ def DistributedOptimizer(
         and op in (Average, Sum)
         and (process_set is None or process_set.process_set_id == 0)
     )
+    # Exchange-service markers (svc/): the wrapped inner transform so
+    # the bounded-staleness pipeline (HVD_TPU_SVC_STALENESS>=1) can
+    # drive it directly — its exchange splits into a synchronous ICI
+    # leg and a service-submitted DCN leg, replacing the inline global
+    # reduction above — and the eligibility gate (plain averaged DP
+    # over the whole world; anything else stays synchronous).
+    update_fn._hvd_inner = optimizer
+    update_fn._hvd_stale_eligible = (
+        op == Average
+        and not getattr(compression, "quantized_wire", False)
+        and (process_set is None or process_set.process_set_id == 0)
+        and prescale_factor == 1.0 and postscale_factor == 1.0
+        and k == 1
+    )
     return optax.GradientTransformation(init_fn, update_fn)
 
 
@@ -1030,7 +1044,35 @@ def distributed_train_step(
     ``loss_fn(params, model_state, batch) -> (loss, new_model_state)``)
     is written for a *local* batch shard; batches passed to the step
     carry the global batch with leading dimension divisible by ``size``.
+
+    With the exchange service on and a staleness bound
+    (``HVD_TPU_SVC=on``, ``HVD_TPU_SVC_STALENESS=k>=1``), an eligible
+    DistributedOptimizer (plain averaged DP over the whole world, no
+    aux/model state) returns the bounded-staleness step instead
+    (:class:`~horovod_tpu.svc.stale.StaleTrainStep`): the ICI leg of
+    the exchange stays synchronous, the DCN leg is submitted to the
+    service and lands as a correction ``k`` steps later.  Ineligible
+    shapes — and ``staleness=0``, which is bitwise identical to
+    ``HVD_TPU_SVC=off`` — keep this synchronous step.
     """
+    from .. import svc as _svc
+
+    if (_svc.enabled() and _svc.staleness() >= 1
+            and not has_aux and not stateful
+            and getattr(optimizer.update, "_hvd_stale_eligible", False)):
+        from ..svc import stale as _stale
+
+        why = _stale.eligible(axis)
+        if why is None:
+            return _stale.StaleTrainStep(
+                loss_fn, optimizer.update._hvd_inner, axis=axis,
+            )
+        from ..utils.logging import get_logger
+
+        get_logger().warning(
+            "HVD_TPU_SVC_STALENESS=%d requested but unavailable (%s); "
+            "running the synchronous step", _svc.staleness(), why,
+        )
     return TrainStep(
         loss_fn, optimizer, axis=axis, has_aux=has_aux, stateful=stateful
     )

@@ -201,6 +201,17 @@ class Runtime:
         from .ops import eager
         from .topo import model as topo_model
 
+        # Async exchange service: drain in-flight submissions and stop
+        # the background loop before the mesh goes away — its cached
+        # executors are compiled against this runtime's mesh and must
+        # not survive into a re-init'ed world.
+        try:
+            from . import svc as _svc
+
+            _svc.drain(timeout_s=5.0)
+            _svc.reset_service()
+        except Exception as e:  # teardown must never wedge on the svc
+            get_logger().warning("exchange service shutdown: %s", e)
         eager.clear_cache()
         # Drop the topology discovery cache: an elastic restart may come
         # back with a different device set (slice count included).

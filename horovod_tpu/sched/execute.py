@@ -427,6 +427,26 @@ def exchange(
         if xir.enabled() else None
     )
     if program is not None:
+        # Async exchange service (svc/): the bucketed pipeline is a
+        # *producer* — the program is submitted to the service at
+        # trace time and the (ResponseCache-resolved) copy it hands
+        # back drives the emission below.  A repeat signature costs
+        # zero re-lowering; a dead service falls back to the local
+        # program (svc.fallback_sync).  The ops are equal either way,
+        # so HVD_TPU_SVC on/off stays bitwise identical on this path.
+        from .. import svc as _svc
+
+        if _svc.enabled():
+            axis_size_hint = None
+            if isinstance(axis, str):
+                try:
+                    axis_size_hint = lax.axis_size(axis)
+                except Exception:
+                    axis_size_hint = None
+            program = _svc.get_service().submit_traced(
+                program, producer=f"sched.{kind}",
+                axis_size=axis_size_hint, store=False,
+            )
         metrics.inc_counter("xir.programs")
         metrics.inc_counter(f"xir.programs.{kind}")
         metrics.inc_counter("xir.ops", len(program.ops))

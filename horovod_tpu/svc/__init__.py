@@ -1,0 +1,53 @@
+"""Async exchange service: Horovod's background controller, TPU-native.
+
+The reference system's defining idea (arXiv:1802.05799 §4) is that
+exchange is a *service*, not a call: framework threads enqueue tensors
+into a ``TensorQueue``, a ``BackgroundThreadLoop`` negotiates readiness
+across ranks (the coordinator bitvector), a ``ResponseCache`` lets
+steady-state steps skip negotiation entirely, and callers collect
+futures.  ``svc`` is that architecture rebuilt over the XIR pipeline —
+one persistent executor owns the wires, everyone else submits plans:
+
+* :mod:`~horovod_tpu.svc.queue` — the ``TensorQueue``: thread-safe
+  submissions of (:class:`~horovod_tpu.xir.ir.ExchangeProgram`,
+  payloads) with per-producer depth gauges and futures;
+* :mod:`~horovod_tpu.svc.negotiate` — readiness negotiation: a
+  program naming several participants dispatches only when every one
+  has enqueued it, in deterministic order;
+* :mod:`~horovod_tpu.svc.cache` — the ``ResponseCache``: repeat
+  program signatures skip negotiation *and* re-lowering (keys fold in
+  the topo-fit epoch so a cost-model refit invalidates stale
+  decisions);
+* :mod:`~horovod_tpu.svc.service` — the background loop itself, with
+  a traced producer path (``sched/execute.py`` and ``xir/interp.py``
+  submit at trace time; bitwise identical to ``HVD_TPU_SVC=off``) and
+  a host path (eager stacked payloads, executed through cached jitted
+  emissions); fault sites ``svc.submit``/``svc.drain``/``svc.loop``
+  kill it mid-flight and every submission degrades to synchronous
+  inline dispatch (``svc.fallback_sync``) instead of wedging;
+* :mod:`~horovod_tpu.svc.stale` — bounded staleness
+  (``HVD_TPU_SVC_STALENESS=k``): local SGD / delayed DCN sync, where
+  the cross-slice hop of step *i* completes during step *i+k*
+  (``svc.overlap_steps``).
+
+``HVD_TPU_SVC=off`` (the default) keeps every exchange inline exactly
+as before.  See docs/exchange_service.md.
+"""
+
+from . import cache, negotiate, queue, service, stale  # noqa: F401
+from .cache import CachedResponse, ResponseCache  # noqa: F401
+from .negotiate import Negotiator  # noqa: F401
+from .queue import Submission, SvcFuture, TensorQueue  # noqa: F401
+from .service import (  # noqa: F401
+    ExchangeService,
+    drain,
+    enabled,
+    get_service,
+    get_service_or_none,
+    reset_service,
+    set_enabled_override,
+    set_staleness_override,
+    staleness,
+    submit,
+)
+from .stale import StaleTrainStep, stale_train_step  # noqa: F401

@@ -1,0 +1,107 @@
+"""ResponseCache: the negotiation + lowering bypass for repeat programs.
+
+The reference's ``ResponseCache`` (``response_cache.{h,cc}``) is the
+reason steady-state Horovod steps cost no coordinator round-trips:
+after a tensor's first negotiated cycle, its ``Response`` is cached by
+signature and every later identical request skips the controller.  Our
+equivalent caches the expensive *host-side* work per program
+signature:
+
+* the **lowered program** — the ``xir/lower.py`` pass (cost-model
+  resolution, wire eligibility, tune-DB sync) runs once per distinct
+  signature, not once per submission;
+* the **compiled executor** — the jitted ``shard_map`` emission for
+  host-path payloads (jit's own shape cache handles payload variants
+  under it).
+
+Keys fold in the topo-fit epoch (``topo/fit.py:fit_epoch``): a cost-
+model refit invalidates every cached lowering decision, exactly like
+the per-process memo fix in ``xir/lower.py`` — a stale hit would pin
+pre-fit flat/hier choices forever.  Capacity rides the reference's
+``HOROVOD_CACHE_CAPACITY`` knob (default 1024; 0 disables), LRU like
+the reference's bypass-on-overflow behavior.  Counters:
+``svc.cache_hit`` / ``svc.cache_miss`` / ``svc.cache_evict`` +
+``svc.cache_entries`` gauge.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from collections import OrderedDict
+from typing import Any, Optional, Tuple
+
+from .. import metrics
+from ..utils import env
+
+DEFAULT_CAPACITY = 1024
+
+
+def capacity() -> int:
+    """``HVD_TPU_CACHE_CAPACITY`` / ``HOROVOD_CACHE_CAPACITY``:
+    entries the cache holds (reference common.h:118).  0 disables —
+    every submission renegotiates and re-lowers."""
+    return max(0, env.get_int(env.CACHE_CAPACITY, DEFAULT_CAPACITY))
+
+
+@dataclasses.dataclass
+class CachedResponse:
+    """One cached signature's resolution: the lowered program and
+    (lazily) its compiled host-path executor."""
+
+    program: Any  # lowered xir.ir.ExchangeProgram
+    executor: Any = None
+    hits: int = 0
+
+
+class ResponseCache:
+    """Signature -> :class:`CachedResponse`, LRU, fit-epoch aware."""
+
+    def __init__(self, cap: Optional[int] = None):
+        self._lock = threading.Lock()
+        self._entries: "OrderedDict[Tuple, CachedResponse]" = OrderedDict()
+        self._cap = capacity() if cap is None else int(cap)
+
+    @staticmethod
+    def key(program, axis_size: Optional[int] = None) -> Tuple:
+        """Cache identity of a program: its signature + the reduction
+        axis size it was lowered for + the topo-fit epoch (a refit
+        must re-run the lowering pass — the cost model changed)."""
+        from ..topo import fit as topo_fit
+
+        return (program.signature(), axis_size, topo_fit.fit_epoch())
+
+    def lookup(self, key: Tuple) -> Optional[CachedResponse]:
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is None:
+                metrics.inc_counter("svc.cache_miss")
+                return None
+            self._entries.move_to_end(key)
+            entry.hits += 1
+        metrics.inc_counter("svc.cache_hit")
+        return entry
+
+    def insert(self, key: Tuple, entry: CachedResponse) -> CachedResponse:
+        if self._cap <= 0:
+            return entry  # cache disabled: never stored
+        with self._lock:
+            self._entries[key] = entry
+            self._entries.move_to_end(key)
+            evicted = 0
+            while len(self._entries) > self._cap:
+                self._entries.popitem(last=False)
+                evicted += 1
+            metrics.set_gauge("svc.cache_entries", len(self._entries))
+        if evicted:
+            metrics.inc_counter("svc.cache_evict", evicted)
+        return entry
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._entries)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+        metrics.set_gauge("svc.cache_entries", 0)

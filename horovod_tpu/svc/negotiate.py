@@ -1,0 +1,99 @@
+"""Readiness negotiation: the coordinator bitvector, per program.
+
+The reference coordinator (``controller.cc``) gates every collective on
+a readiness vote: rank 0 collects ``Request`` messages, sets the bit
+for each rank that announced a tensor, and broadcasts a ``Response``
+only when the bitvector is full — so no rank ever enters a collective
+a peer hasn't reached.  Under single-controller SPMD the *ranks* agree
+by construction (one program, one trace), but the service has the same
+problem one level up: several concurrent **producers** (the dense-grad
+pipeline, a MoE layer, a second tenant's job, the staleness pipeline)
+submit programs into one queue, and a program that names multiple
+participants must not dispatch until every one of them has enqueued
+it.
+
+:class:`Negotiator` keeps one pending entry per program signature:
+``post`` sets the submitting producer's bit and returns the ready
+batch — every matching submission, in deterministic (participant-
+sorted) order — once the bitvector is full.  Latency from first post
+to ready lands in the ``svc.negotiation_seconds`` histogram (the p50/
+p99 the driver's ``/metrics`` endpoint renders); entries abandoned by
+a drain are counted, never silently dropped.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Tuple
+
+from .. import metrics
+from .queue import Submission
+
+
+class Negotiator:
+    """Per-signature readiness bitvector over producer names."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        # signature -> {producer: Submission}, plus first-post stamp
+        self._pending: Dict[Tuple, Dict[str, Submission]] = {}
+        self._first_post: Dict[Tuple, float] = {}
+
+    def post(self, sub: Submission) -> List[Submission]:
+        """Record one submission; return the ready batch (possibly just
+        ``sub`` itself) or ``[]`` while the bitvector is short.
+
+        A submission whose ``participants`` is empty or names only its
+        own producer is ready immediately — the negotiation bypass the
+        reference grants cache-hit requests (``response_cache.cc``:
+        cached responses skip the coordinator round-trip entirely).
+        """
+        participants = tuple(sub.participants) or (sub.producer,)
+        if set(participants) == {sub.producer}:
+            return [sub]
+        key = sub.program.signature()
+        with self._lock:
+            entry = self._pending.setdefault(key, {})
+            if not entry:
+                self._first_post[key] = time.monotonic()
+            entry[sub.producer] = sub
+            if not set(participants) <= set(entry):
+                metrics.set_gauge("svc.negotiations_pending",
+                                  len(self._pending))
+                return []
+            # Bitvector full: release every matching submission in
+            # participant-sorted order (deterministic across runs and
+            # across interleavings — the drain-determinism contract).
+            del self._pending[key]
+            t0 = self._first_post.pop(key, None)
+            metrics.set_gauge("svc.negotiations_pending",
+                              len(self._pending))
+        if t0 is not None:
+            metrics.observe("svc.negotiation_seconds",
+                            time.monotonic() - t0)
+        metrics.inc_counter("svc.negotiations")
+        return [entry[p] for p in sorted(entry)]
+
+    def pending_count(self) -> int:
+        with self._lock:
+            return len(self._pending)
+
+    def abandon(self) -> List[Submission]:
+        """Drop every pending entry (service drain/shutdown): returns
+        the orphaned submissions so the caller can resolve their
+        futures, and counts the abandonment — a negotiation that never
+        completed is a producer bug or a mid-flight drain, and both
+        deserve a counter, not silence."""
+        with self._lock:
+            orphans = [
+                s for entry in self._pending.values()
+                for s in entry.values()
+            ]
+            n = len(self._pending)
+            self._pending.clear()
+            self._first_post.clear()
+            metrics.set_gauge("svc.negotiations_pending", 0)
+        if n:
+            metrics.inc_counter("svc.negotiations_abandoned", n)
+        return sorted(orphans, key=lambda s: s.seq)

@@ -1,0 +1,168 @@
+"""TensorQueue: the service's submission channel.
+
+The reference's ``TensorQueue`` (``horovod/common/tensor_queue.{h,cc}``)
+is the single funnel every framework thread pushes ``TensorTableEntry``
+records through; the background loop pops a batch per cycle tick.  Ours
+carries :class:`Submission` records — an XIR
+:class:`~horovod_tpu.xir.ir.ExchangeProgram` plus its payloads and a
+:class:`SvcFuture` the producer blocks on — with the same contract:
+thread-safe, FIFO **per producer**, deterministic global order (the
+monotonic sequence number assigned under the lock), and observable
+depth (``svc.queue_depth{producer=}`` gauges the per-producer backlog
+the reference only exposed via timeline stalls).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, List, Optional, Sequence, Tuple
+
+from .. import metrics
+from ..exceptions import HorovodTpuError
+
+
+class SvcFuture:
+    """Result handle for one submission (the reference returns a
+    per-op ``std::shared_future`` resolved by ``PerformOperation``).
+
+    ``result()`` blocks until the service resolved the future —
+    outputs on success, the recorded exception re-raised on failure.
+    A future may also be resolved *synchronously* by the submitter
+    itself (the inline fallback path when the service is dead).
+    """
+
+    __slots__ = ("_event", "_value", "_error", "resolved_at")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._value: Any = None
+        self._error: Optional[BaseException] = None
+        self.resolved_at: Optional[float] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def set_result(self, value: Any) -> None:
+        self._value = value
+        self.resolved_at = time.monotonic()
+        self._event.set()
+
+    def set_exception(self, err: BaseException) -> None:
+        self._error = err
+        self.resolved_at = time.monotonic()
+        self._event.set()
+
+    def result(self, timeout: Optional[float] = None) -> Any:
+        if not self._event.wait(timeout):
+            raise TimeoutError("svc future not resolved in time")
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+@dataclasses.dataclass
+class Submission:
+    """One enqueued exchange: the (program, payloads) pair plus the
+    negotiation identity.  ``participants`` names every producer that
+    must post a matching program before it may dispatch (the
+    coordinator-bitvector readiness set); a single-element tuple —
+    the default — dispatches immediately, like a cache-hit request
+    bypassing the reference coordinator."""
+
+    seq: int
+    producer: str
+    program: Any  # xir.ir.ExchangeProgram
+    args: Sequence[Any]
+    future: SvcFuture
+    participants: Tuple[str, ...] = ()
+    axis_size: Optional[int] = None
+    process_set: Any = None
+    enqueued_at: float = 0.0
+
+
+class TensorQueue:
+    """Bounded, thread-safe submission queue with per-producer depth
+    gauges.  ``close()`` wakes the consumer and rejects later puts."""
+
+    def __init__(self, capacity: int = 4096):
+        self._lock = threading.Lock()
+        self._not_empty = threading.Condition(self._lock)
+        self._items: List[Submission] = []
+        self._seq = 0
+        self._closed = False
+        self._producers: set = set()
+        self.capacity = int(capacity)
+
+    def next_seq(self) -> int:
+        with self._lock:
+            self._seq += 1
+            return self._seq
+
+    def put(self, sub: Submission) -> None:
+        with self._not_empty:
+            if self._closed:
+                raise HorovodTpuError(
+                    "exchange service queue is closed (service shut "
+                    "down); submit falls back to inline dispatch"
+                )
+            if len(self._items) >= self.capacity:
+                raise HorovodTpuError(
+                    f"exchange service queue over capacity "
+                    f"({self.capacity}); a producer is outrunning the "
+                    "service loop"
+                )
+            sub.enqueued_at = time.monotonic()
+            self._items.append(sub)
+            self._publish_depth_locked()
+            self._not_empty.notify_all()
+
+    def pop_batch(self, timeout: Optional[float] = 0.05
+                  ) -> List[Submission]:
+        """Everything currently enqueued, in sequence order (one cycle
+        tick's worth — the ``RunLoopOnce`` pop).  Blocks up to
+        ``timeout`` when empty; an empty list means idle or closed."""
+        with self._not_empty:
+            if not self._items and not self._closed:
+                self._not_empty.wait(timeout)
+            batch = sorted(self._items, key=lambda s: s.seq)
+            self._items.clear()
+            self._publish_depth_locked()
+            return batch
+
+    def depth(self, producer: Optional[str] = None) -> int:
+        with self._lock:
+            if producer is None:
+                return len(self._items)
+            return sum(1 for s in self._items if s.producer == producer)
+
+    def close(self) -> List[Submission]:
+        """Reject future puts; return (and clear) whatever was still
+        queued so the caller can resolve those futures."""
+        with self._not_empty:
+            self._closed = True
+            left = sorted(self._items, key=lambda s: s.seq)
+            self._items.clear()
+            self._publish_depth_locked()
+            self._not_empty.notify_all()
+            return left
+
+    @property
+    def closed(self) -> bool:
+        with self._lock:
+            return self._closed
+
+    def _publish_depth_locked(self) -> None:
+        # Per-producer backlog, one labeled series per producer (the
+        # /metrics satellite).  Every producer ever seen keeps its
+        # series — a drained producer reads 0, not a stale last value.
+        per: dict = {}
+        for s in self._items:
+            per[s.producer] = per.get(s.producer, 0) + 1
+        self._producers.update(per)
+        metrics.set_gauge("svc.queue_depth", len(self._items))
+        for prod in self._producers:
+            metrics.set_gauge(
+                "svc.queue_depth", per.get(prod, 0), {"producer": prod}
+            )

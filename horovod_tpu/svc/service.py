@@ -1,0 +1,458 @@
+"""ExchangeService: the background controller that owns the wires.
+
+The reference's defining architecture (arXiv:1802.05799 §4,
+``operations.cc:381`` ``BackgroundThreadLoop`` / ``RunLoopOnce``) is an
+asynchronous service: framework threads enqueue tensors, a background
+thread negotiates readiness and dispatches fused collectives, callers
+block on futures.  Under XLA the *device* schedule is the compiler's,
+but the host-side architecture is worth reproducing exactly — one
+persistent executor that concurrent producers (the dense-gradient
+pipeline, MoE layers, multi-tenant jobs, the bounded-staleness
+pipeline) submit :class:`~horovod_tpu.xir.ir.ExchangeProgram`\\ s to,
+instead of every call site lowering and dispatching privately.
+
+Two dispatch paths share the negotiation/cache bookkeeping:
+
+* **traced** (:meth:`ExchangeService.submit_traced`) — called at trace
+  time from inside a jitted step (``sched/execute.py``,
+  ``xir/interp.py``): the service resolves the program through the
+  :class:`~horovod_tpu.svc.cache.ResponseCache` (a repeat signature
+  skips the whole lowering pass) and hands it back for inline
+  emission.  The emitted collectives are the ones the producer would
+  have emitted itself, so ``HVD_TPU_SVC`` on/off is **bitwise
+  identical** on this path by construction.
+* **host** (:meth:`ExchangeService.submit`) — concrete (eager)
+  payloads in the stacked one-row-per-rank convention of
+  ``ops/eager.py``: the submission rides the
+  :class:`~horovod_tpu.svc.queue.TensorQueue` to the background loop,
+  which negotiates readiness across producers
+  (:class:`~horovod_tpu.svc.negotiate.Negotiator`), executes through a
+  cached jitted ``shard_map`` emission of the interpreter, and
+  resolves the :class:`~horovod_tpu.svc.queue.SvcFuture`.  This is the
+  path the bounded-staleness pipeline (``svc/stale.py``) hides
+  cross-slice DCN hops behind subsequent steps with.
+
+Failure contract (the ``faults.py`` satellite): fault sites
+``svc.submit`` / ``svc.drain`` / ``svc.loop`` can kill the service
+mid-flight; a dead service **degrades to synchronous inline dispatch**
+(counter ``svc.fallback_sync``) — every outstanding future is resolved
+inline, no producer ever wedges on a dead loop.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, List, Optional, Sequence
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from .. import faults, metrics
+from ..exceptions import FaultInjected, HorovodTpuError
+from ..utils import env
+from ..utils.logging import get_logger
+from .cache import CachedResponse, ResponseCache
+from .negotiate import Negotiator
+from .queue import Submission, SvcFuture, TensorQueue
+
+# Trace/test-time overrides (the sched config-override pattern).
+_enabled_override: Optional[bool] = None
+_staleness_override: Optional[int] = None
+
+
+def set_enabled_override(value: Optional[bool]) -> None:
+    global _enabled_override
+    _enabled_override = value
+
+
+def set_staleness_override(value: Optional[int]) -> None:
+    global _staleness_override
+    _staleness_override = value
+
+
+def enabled() -> bool:
+    """``HVD_TPU_SVC`` policy (default **off**): whether exchanges
+    route through the service.  Off is the fully synchronous inline
+    path — and on with staleness 0 is bitwise identical to it (the
+    service only adds bookkeeping on the traced path)."""
+    if _enabled_override is not None:
+        return _enabled_override
+    return env.get_bool(env.SVC, False)
+
+
+def staleness() -> int:
+    """``HVD_TPU_SVC_STALENESS``: 0 (default) = synchronous dense
+    exchange; k >= 1 = the delayed-DCN-sync pipeline (``svc/stale.py``)
+    — step *i*'s cross-slice hop may complete during step *i+k*."""
+    if _staleness_override is not None:
+        return max(0, _staleness_override)
+    return max(0, env.get_int(env.SVC_STALENESS, 0))
+
+
+class ExchangeService:
+    """One process's persistent exchange executor (the
+    ``BackgroundThreadLoop`` + ``HorovodGlobalState`` pairing)."""
+
+    def __init__(self):
+        self.queue = TensorQueue()
+        self.negotiator = Negotiator()
+        self.cache = ResponseCache()
+        self._lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._stop = threading.Event()
+        self._dead = False
+        self._death_reason: Optional[str] = None
+        self._inflight = 0
+        self._cycle = 0
+
+    # ------------------------------------------------------ lifecycle
+
+    @property
+    def dead(self) -> bool:
+        return self._dead
+
+    def _ensure_loop(self) -> bool:
+        """Start the background loop lazily (first host-path submit);
+        False when the service is dead or stopping."""
+        with self._lock:
+            if self._dead or self._stop.is_set():
+                return False
+            if self._thread is None or not self._thread.is_alive():
+                self._thread = threading.Thread(
+                    target=self._run_loop, daemon=True,
+                    name="hvd_tpu_svc_loop",
+                )
+                self._thread.start()
+        return True
+
+    def _run_loop(self) -> None:
+        """The cycle loop: pop a batch, negotiate, dispatch ready
+        submissions in sequence order.  A fault (or any escape from
+        the dispatch machinery itself) kills the service — which
+        degrades every current and future submission to inline
+        dispatch rather than wedging producers."""
+        log = get_logger()
+        while not self._stop.is_set():
+            batch: List[Submission] = []
+            try:
+                batch = self.queue.pop_batch()
+                if not batch:
+                    continue
+                self._cycle += 1
+                metrics.inc_counter("svc.loop_cycles")
+                faults.inject("svc.loop", cycle=self._cycle)
+                ready: List[Submission] = []
+                for sub in batch:
+                    ready.extend(self.negotiator.post(sub))
+                for sub in sorted(ready, key=lambda s: s.seq):
+                    self._dispatch(sub)
+            except FaultInjected as e:
+                self._kill(f"fault injected in service loop: {e}")
+                self._resolve_inline(batch)
+                return
+            except Exception as e:  # pragma: no cover - defensive
+                log.warning("exchange service loop error: %s", e)
+                self._kill(f"loop error: {e}")
+                self._resolve_inline(batch)
+                return
+
+    def _resolve_inline(self, subs: Sequence[Submission]) -> None:
+        """Resolve any still-pending futures synchronously — the batch
+        a dying loop had already popped lives neither in the queue nor
+        the negotiator, so the kill path cannot see it."""
+        for sub in sorted(subs, key=lambda s: s.seq):
+            if not sub.future.done():
+                metrics.inc_counter("svc.fallback_sync")
+                self._dispatch(sub)
+
+    def _kill(self, reason: str) -> None:
+        """Mark the service dead and resolve everything outstanding
+        inline (``svc.fallback_sync``) so no producer wedges."""
+        with self._lock:
+            if self._dead:
+                return
+            self._dead = True
+            self._death_reason = reason
+        metrics.inc_counter("svc.deaths")
+        get_logger().warning(
+            "exchange service died (%s); degrading to synchronous "
+            "inline dispatch", reason,
+        )
+        leftovers = self.queue.close()
+        orphans = self.negotiator.abandon()
+        for sub in sorted(leftovers + orphans, key=lambda s: s.seq):
+            if sub.future.done():
+                continue
+            metrics.inc_counter("svc.fallback_sync")
+            self._dispatch(sub)
+
+    def stop(self) -> None:
+        """Stop the loop (clean shutdown — not a death): pending
+        submissions are still resolved inline so futures never hang."""
+        self._stop.set()
+        leftovers = self.queue.close()
+        orphans = self.negotiator.abandon()
+        for sub in sorted(leftovers + orphans, key=lambda s: s.seq):
+            if not sub.future.done():
+                self._dispatch(sub)
+        t = self._thread
+        if t is not None and t.is_alive() \
+                and t is not threading.current_thread():
+            t.join(timeout=5)
+
+    def drain(self, timeout_s: float = 30.0) -> bool:
+        """Block until every enqueued submission dispatched and nothing
+        is in flight (the remesh/fault-round quiesce point).  Pending
+        negotiations are abandoned — their futures resolve inline —
+        because a drain means the producers are pausing and the
+        missing participants will never post.  The ``svc.drain`` fault
+        site can kill the service here; True = drained clean."""
+        metrics.inc_counter("svc.drains")
+        try:
+            faults.inject("svc.drain", queued=self.queue.depth())
+        except FaultInjected as e:
+            self._kill(f"fault injected at svc.drain: {e}")
+            return False
+        deadline = time.monotonic() + timeout_s
+        while (self.queue.depth() > 0 or self._inflight > 0) \
+                and not self._dead:
+            if time.monotonic() > deadline:
+                get_logger().warning(
+                    "svc.drain timed out with %d queued / %d in flight",
+                    self.queue.depth(), self._inflight,
+                )
+                return False
+            time.sleep(0.002)
+        for sub in self.negotiator.abandon():
+            if not sub.future.done():
+                metrics.inc_counter("svc.fallback_sync")
+                self._dispatch(sub)
+        return not self._dead
+
+    # ------------------------------------------------------- dispatch
+
+    def _resolve_program(self, program, axis_size: Optional[int],
+                         store: bool = True):
+        """Cache-backed lowering: a repeat signature returns the stored
+        lowered program with **zero re-lowering** (the ResponseCache
+        fast path); a miss runs ``xir/lower.py`` once and stores it.
+        Already-lowered programs (the dense-grad ``from_schedule``
+        path) cache as-is — the hit still skips the per-bucket store
+        sync and negotiation bookkeeping."""
+        from ..xir import lower as lower_mod
+
+        key = ResponseCache.key(program, axis_size)
+        entry = self.cache.lookup(key)
+        if entry is not None:
+            return entry
+        if program.lowered:
+            lowered = program
+        else:
+            lowered = lower_mod.lower(program, axis_size, store=store)
+            metrics.inc_counter("svc.lowerings")
+        return self.cache.insert(key, CachedResponse(program=lowered))
+
+    def _build_executor(self, program, axis_size: Optional[int],
+                        process_set=None):
+        """Jitted host-path emission of one lowered program: payloads
+        arrive in the eager stacked convention (row *r* is rank *r*'s
+        tensor), the body peels the rank row, runs the interpreter,
+        and re-stacks — so reduce/shuffle shapes match the traced
+        producers' exactly."""
+        from ..runtime import WORLD_AXIS, get_runtime
+        from ..xir import interp
+
+        mesh = get_runtime().mesh
+        spec = P(WORLD_AXIS)
+
+        def body(args):
+            ins = [jax.tree.map(lambda x: x[0], a) for a in args]
+            outs = interp.execute(
+                program, ins, axis_size=axis_size,
+                process_set=process_set, store=False,
+            )
+            return tuple(
+                jax.tree.map(lambda y: y[None], o) for o in outs
+            )
+
+        return jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=(spec,), out_specs=spec,
+            check_vma=False,
+        ))
+
+    def _dispatch(self, sub: Submission) -> None:
+        """Execute one ready submission and resolve its future."""
+        try:
+            entry = self._resolve_program(sub.program, sub.axis_size)
+            if entry.executor is None:
+                entry.executor = self._build_executor(
+                    entry.program, sub.axis_size, sub.process_set
+                )
+            with self._inflight_guard():
+                outs = entry.executor(tuple(sub.args))
+            metrics.inc_counter("svc.dispatches")
+            metrics.inc_counter(f"svc.programs.{sub.program.kind}")
+            self._record_timeline(entry.program)
+            sub.future.set_result(list(outs))
+        except BaseException as e:  # noqa: BLE001 - future carries it
+            sub.future.set_exception(e)
+
+    def _inflight_guard(self):
+        svc = self
+
+        class _Guard:
+            def __enter__(self):
+                with svc._lock:
+                    svc._inflight += 1
+                metrics.set_gauge("svc.inflight", svc._inflight)
+
+            def __exit__(self, *exc):
+                with svc._lock:
+                    svc._inflight -= 1
+                metrics.set_gauge("svc.inflight", svc._inflight)
+                return False
+
+        return _Guard()
+
+    def _record_timeline(self, program) -> None:
+        from ..runtime import get_runtime_or_none
+
+        rt = get_runtime_or_none()
+        tl = rt.timeline if rt is not None else None
+        if tl is None:
+            return
+        from ..xir import lower as lower_mod
+
+        for op in program.ops:
+            tl.record_op(
+                f"{program.kind}.{op.op}{op.bucket}"
+                f"[wire={op.wire},lower={op.lowering}]",
+                "SVC_EXCHANGE", lower_mod.op_wire_nbytes(op),
+            )
+
+    # -------------------------------------------------------- submit
+
+    def submit(
+        self,
+        program,
+        args: Sequence[Any],
+        *,
+        producer: str = "default",
+        participants: Optional[Sequence[str]] = None,
+        axis_size: Optional[int] = None,
+        process_set=None,
+    ) -> SvcFuture:
+        """Enqueue one program with its payloads; returns the future
+        the producer collects outputs from.
+
+        Payloads are concrete arrays in the stacked one-row-per-rank
+        convention (``ops/eager.py``).  ``participants`` opts into
+        readiness negotiation: the program dispatches only once every
+        named producer has submitted a matching signature.  A dead
+        service (or a fault at the ``svc.submit`` site) resolves the
+        future synchronously inline instead (``svc.fallback_sync``).
+        """
+        if len(args) != len(program.ops):
+            raise HorovodTpuError(
+                f"program has {len(program.ops)} ops but {len(args)} "
+                "payloads were passed"
+            )
+        metrics.inc_counter("svc.submits")
+        metrics.inc_counter(f"svc.submits.{producer}")
+        future = SvcFuture()
+        sub = Submission(
+            seq=self.queue.next_seq(), producer=producer,
+            program=program, args=list(args), future=future,
+            participants=tuple(participants or ()),
+            axis_size=axis_size, process_set=process_set,
+        )
+        try:
+            faults.inject("svc.submit", producer=producer,
+                          kind=program.kind)
+        except FaultInjected as e:
+            self._kill(f"fault injected at svc.submit: {e}")
+        if self._dead or not self._ensure_loop():
+            metrics.inc_counter("svc.fallback_sync")
+            self._dispatch(sub)
+            return future
+        try:
+            self.queue.put(sub)
+        except HorovodTpuError:
+            metrics.inc_counter("svc.fallback_sync")
+            self._dispatch(sub)
+        return future
+
+    def submit_traced(self, program, *, producer: str = "sched",
+                      axis_size: Optional[int] = None,
+                      store: bool = True):
+        """The traced-producer entry: called at trace time from inside
+        a jitted step, returns the (cached) lowered program for the
+        caller to emit inline.  The emission is the caller's own — the
+        service contributes the ResponseCache fast path (repeat
+        signatures skip re-lowering entirely) and the accounting — so
+        this path is bitwise identical to ``HVD_TPU_SVC=off``.  A dead
+        service falls back to a local lowering pass
+        (``svc.fallback_sync``), never an error in the step."""
+        metrics.inc_counter("svc.submits")
+        metrics.inc_counter(f"svc.submits.{producer}")
+        try:
+            faults.inject("svc.submit", producer=producer,
+                          kind=program.kind, traced=1)
+        except FaultInjected as e:
+            self._kill(f"fault injected at svc.submit: {e}")
+        if self._dead:
+            from ..xir import lower as lower_mod
+
+            metrics.inc_counter("svc.fallback_sync")
+            if program.lowered:
+                return program
+            return lower_mod.lower(program, axis_size, store=store)
+        return self._resolve_program(program, axis_size, store).program
+
+
+# ------------------------------------------------- process singleton
+
+_service_lock = threading.Lock()
+_service: Optional[ExchangeService] = None
+
+
+def get_service() -> ExchangeService:
+    """The process-wide service (created on first use; restarted on
+    first use after :func:`reset_service`)."""
+    global _service
+    with _service_lock:
+        if _service is None:
+            _service = ExchangeService()
+        return _service
+
+
+def get_service_or_none() -> Optional[ExchangeService]:
+    return _service
+
+
+def reset_service() -> None:
+    """Stop and drop the process-wide service (shutdown, remesh, test
+    isolation).  The next :func:`get_service` builds a fresh one
+    against the current mesh — cached executors never outlive a
+    topology change."""
+    global _service
+    with _service_lock:
+        svc, _service = _service, None
+    if svc is not None:
+        svc.stop()
+
+
+def drain(timeout_s: float = 30.0) -> bool:
+    """Drain the process-wide service if one is running (the worker-
+    side quiesce hook remesh pause and elastic restarts call); True
+    when there was nothing to drain or the drain completed clean."""
+    svc = get_service_or_none()
+    if svc is None:
+        return True
+    return svc.drain(timeout_s=timeout_s)
+
+
+def submit(program, args, **kw) -> SvcFuture:
+    """Module-level convenience for :meth:`ExchangeService.submit`."""
+    return get_service().submit(program, args, **kw)

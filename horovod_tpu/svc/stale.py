@@ -1,0 +1,263 @@
+"""Bounded-staleness dense-gradient pipeline: delayed DCN sync.
+
+``HVD_TPU_SVC_STALENESS=k`` (k >= 1) opens the scenario the reference's
+background service gets for free and a fully-traced step cannot
+express: **the cross-slice hop of step i completes during step i+k**.
+The exchange splits along the topology's two rails —
+
+* the **ICI leg** stays synchronous inside the jitted step: gradients
+  are averaged *within each slice* (replica subgroups over the world
+  axis, the plain grouped mean);
+* the **DCN leg** leaves the step entirely: the per-slice mean
+  gradient is submitted to the :class:`~horovod_tpu.svc.service.
+  ExchangeService` as an ``all_reduce(mean)`` program, and its result
+  returns as a *correction* ``global_mean − slice_mean`` applied to
+  the update **k steps later**.
+
+Per-slice parameters therefore drift between syncs (local SGD,
+arXiv:1808.07217-family semantics) while the telescoping corrections
+guarantee every gradient's cross-slice contribution eventually lands —
+on a quadratic bowl the trajectory converges to the same optimum as
+synchronous SGD (the property ``tools/tier1_svc_smoke.sh`` pins).  The
+cross-step window is the DCN-latency hiding the PR 11 rail pipeliner
+achieves *within* a step, extended *across* steps: each collected
+correction increments ``svc.overlap_steps`` — the hop it carries
+completed while at least one later step was computing.
+
+``staleness=0`` never builds this pipeline:
+:func:`~horovod_tpu.optim.distributed_optimizer.distributed_train_step`
+returns the ordinary synchronous :class:`TrainStep`, whose service
+routing is bitwise identical to ``HVD_TPU_SVC=off``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .. import metrics
+from ..exceptions import HorovodTpuError
+from ..runtime import WORLD_AXIS, get_runtime
+from . import service as svc_service
+
+
+def eligible(axis=WORLD_AXIS) -> Optional[str]:
+    """Why the staleness pipeline cannot run (None = it can): it
+    delays the *cross-slice* hop, so it needs a multi-slice topology
+    whose world axis factors, and the canonical world axis (per-slice
+    parameter drift is meaningless on an arbitrary sub-axis)."""
+    from ..topo import model as topo_model
+
+    if axis != WORLD_AXIS:
+        return f"staleness pipeline serves the world axis, not {axis!r}"
+    topo = topo_model.current()
+    if not topo.multi_slice:
+        return "single-slice topology: there is no DCN hop to delay"
+    world = get_runtime().size
+    s, _ = topo.factor_axis(world)
+    if s <= 1:
+        return f"world of {world} does not factor across slices"
+    return None
+
+
+@dataclasses.dataclass
+class _Pending:
+    """One in-flight DCN hop: submitted at ``step``, carrying the
+    stacked per-slice mean gradients its correction subtracts."""
+
+    step: int
+    future: Any
+    slice_leaves: List[jax.Array]
+    treedef: Any
+
+
+class StaleTrainStep:
+    """Compiled SPMD training step with the DCN leg delayed ``k``
+    steps through the exchange service.
+
+    API mirrors :class:`~horovod_tpu.optim.distributed_optimizer.
+    TrainStep` — ``init(params)`` then ``step(params, opt_state,
+    batch) -> (params, opt_state, loss)`` — with one representational
+    difference: parameters and optimizer state are **stacked** with a
+    leading world dimension (row *r* is rank *r*'s copy; rows within a
+    slice stay identical, rows across slices drift between syncs).
+    ``consolidate(params)`` returns the row-mean as an ordinary
+    replicated pytree.
+    """
+
+    def __init__(self, loss_fn, inner_optimizer, *,
+                 k: Optional[int] = None, axis=WORLD_AXIS):
+        why = eligible(axis)
+        if why is not None:
+            raise HorovodTpuError(f"stale pipeline unavailable: {why}")
+        self.k = svc_service.staleness() if k is None else int(k)
+        if self.k < 1:
+            raise HorovodTpuError(
+                "StaleTrainStep requires staleness k >= 1; k=0 is the "
+                "synchronous TrainStep"
+            )
+        from ..topo import model as topo_model
+
+        rt = get_runtime()
+        self.axis = axis
+        self.mesh = rt.mesh
+        self.world = rt.size
+        topo = topo_model.current()
+        intra, _cross = topo.axis_groups(self.world)
+        self._intra = tuple(tuple(g) for g in intra)
+        self._group_size = len(intra[0])
+        self._inner = inner_optimizer
+        self._loss_fn = loss_fn
+        self._step_idx = 0
+        self._pending: List[_Pending] = []
+        self._lock = threading.Lock()
+        metrics.set_gauge("svc.staleness", self.k)
+
+        spec = P(axis)
+        groups = [list(g) for g in self._intra]
+        gsize = self._group_size
+
+        def init_body(params):
+            stack = lambda t: jax.tree.map(lambda x: x[None], t)
+            return stack(params), stack(inner_optimizer.init(params))
+
+        def step_body(params, opt_state, corr, batch):
+            unrow = lambda t: jax.tree.map(lambda x: x[0], t)
+            p, st, c = unrow(params), unrow(opt_state), unrow(corr)
+            loss, grads = jax.value_and_grad(loss_fn)(p, batch)
+            # ICI leg, synchronous: mean within this rank's slice.
+            slice_mean = jax.tree.map(
+                lambda g: _grouped_mean(g, axis, groups, gsize), grads
+            )
+            # DCN leg, delayed: the correction computed from step
+            # i-k's hop rides in as an input.
+            used = jax.tree.map(lambda s, d: s + d, slice_mean, c)
+            updates, st = inner_optimizer.update(used, st, p)
+            import optax
+
+            p = optax.apply_updates(p, updates)
+            stack = lambda t: jax.tree.map(lambda x: x[None], t)
+            return (stack(p), stack(st), lax.pmean(loss, axis),
+                    stack(slice_mean))
+
+        self._init_fn = jax.jit(jax.shard_map(
+            init_body, mesh=self.mesh, in_specs=(P(),),
+            out_specs=(spec, spec), check_vma=False,
+        ))
+        self._step_fn = jax.jit(jax.shard_map(
+            step_body, mesh=self.mesh,
+            in_specs=(spec, spec, spec, P(axis)),
+            out_specs=(spec, spec, P(), spec), check_vma=False,
+        ))
+
+    # ------------------------------------------------------------ API
+
+    def init(self, params):
+        """Stack replicated ``params`` into the per-rank layout and
+        build matching optimizer state: returns ``(stacked_params,
+        opt_state)`` — feed both back to every step call."""
+        stacked, inner = self._init_fn(params)
+        self._step_idx = 0
+        self._pending = []
+        return stacked, inner
+
+    def __call__(self, params, opt_state, batch):
+        with self._lock:
+            corr = self._collect_correction(params)
+            params, opt_state, loss, slice_mean = self._step_fn(
+                params, opt_state, corr, batch
+            )
+            self._submit_dcn(slice_mean)
+            self._step_idx += 1
+        return params, opt_state, loss
+
+    def consolidate(self, params):
+        """Row-mean of the stacked parameters: the single replicated
+        pytree a checkpoint or eval wants."""
+        return jax.tree.map(lambda x: jnp.mean(x, axis=0), params)
+
+    def stack(self, params):
+        """Stack a replicated pytree into the step's per-rank layout
+        (``init`` already returns stacked optimizer state)."""
+        return jax.tree.map(
+            lambda x: jnp.broadcast_to(
+                x[None], (self.world,) + jnp.shape(x)
+            ), params,
+        )
+
+    def drain(self, timeout_s: float = 30.0) -> None:
+        """Resolve every in-flight DCN hop without applying it (the
+        pre-checkpoint / pre-remesh quiesce; corrections in flight are
+        dropped like the accumulation window of a restarted
+        ``backward_passes_per_step`` run)."""
+        with self._lock:
+            for ent in self._pending:
+                try:
+                    ent.future.result(timeout=timeout_s)
+                except Exception:  # noqa: BLE001 - drain must not raise
+                    pass
+            self._pending = []
+
+    # ------------------------------------------------------- plumbing
+
+    def _collect_correction(self, params):
+        """The stacked correction pytree due this step: zeros until
+        step k, then ``global_mean − slice_mean`` of step i−k.  Each
+        collected hop provably completed during a *later* step's
+        compute — ``svc.overlap_steps`` counts exactly that."""
+        due = None
+        if self._pending and \
+                self._step_idx - self._pending[0].step >= self.k:
+            due = self._pending.pop(0)
+        if due is None:
+            return jax.tree.map(jnp.zeros_like, params)
+        global_leaves = due.future.result(timeout=60.0)
+        overlapped = self._step_idx - due.step
+        if overlapped >= 1:
+            metrics.inc_counter("svc.overlap_steps")
+            metrics.set_gauge("svc.overlap_depth", overlapped)
+        corr_leaves = [
+            g.astype(s.dtype) - s
+            for g, s in zip(global_leaves, due.slice_leaves)
+        ]
+        return jax.tree.unflatten(due.treedef, corr_leaves)
+
+    def _submit_dcn(self, slice_mean) -> None:
+        from .. import xir
+
+        leaves, treedef = jax.tree.flatten(slice_mean)
+        ops = [
+            xir.all_reduce(
+                self.axis, reduce="mean", bucket=i,
+                nbytes=int(x.size * x.dtype.itemsize),
+                dtype=str(x.dtype),
+            )
+            for i, x in enumerate(leaves)
+        ]
+        program = xir.program("svc_stale", ops)
+        future = svc_service.get_service().submit(
+            program, leaves, producer="stale", axis_size=self.world,
+        )
+        self._pending.append(_Pending(
+            step=self._step_idx, future=future,
+            slice_leaves=leaves, treedef=treedef,
+        ))
+
+
+def _grouped_mean(g, axis, groups, group_size):
+    from ..ops.traced import _grouped_sum
+
+    return _grouped_sum(g, axis, groups, group_size) / group_size
+
+
+def stale_train_step(loss_fn, inner_optimizer, *,
+                     k: Optional[int] = None,
+                     axis=WORLD_AXIS) -> StaleTrainStep:
+    """Build the bounded-staleness step; see :class:`StaleTrainStep`."""
+    return StaleTrainStep(loss_fn, inner_optimizer, k=k, axis=axis)

@@ -100,6 +100,18 @@ _fitted: Optional[FittedParams] = None
 _obs_count = 0
 _last_fit_at = 0
 _fit_failed_logged = False
+# Fit epoch: bumped every time the effective cost parameters change (a
+# successful refit, or a reset back to static pricing).  Consumers that
+# memoize lowering decisions — xir/lower.py's store-sync memo, the
+# svc/ ResponseCache — fold this into their keys so a refit invalidates
+# them instead of pinning pre-fit flat/hier choices forever.
+_fit_epoch = 0
+
+
+def fit_epoch() -> int:
+    """Monotonic epoch of the effective cost parameters (see above)."""
+    with _lock:
+        return _fit_epoch
 
 
 def enabled() -> bool:
@@ -291,8 +303,11 @@ def refresh(topo=None, force: bool = False) -> Optional[FittedParams]:
         return _fitted
     fp = fit_link_params(topo)
     if fp is not None:
+        global _fit_epoch
         with _lock:
             _fitted = fp
+            _fit_epoch += 1
+            metrics.set_gauge("topo.fit.epoch", _fit_epoch)
         _publish(fp)
         get_logger().info(
             "topo fit: %d cells / %d obs -> ici %.1f GB/s, dcn %.1f "
@@ -355,7 +370,14 @@ def reset() -> None:
     """Drop the fitted state and the observation cells (test isolation;
     called from ``topo.model.reset`` so one reset covers the package)."""
     global _fitted, _obs_count, _last_fit_at, _fit_failed_logged
+    global _fit_epoch
     with _lock:
+        # A reset changes effective pricing back to the static fields:
+        # that is a parameter change too, so the epoch advances (the
+        # memo-invalidation contract) — it never rewinds to 0, which
+        # would collide with keys cached before the reset.
+        if _fitted is not None:
+            _fit_epoch += 1
         _fitted = None
         _obs_count = 0
         _last_fit_at = 0

@@ -126,6 +126,25 @@ XIR_WIRE = "XIR_WIRE"
 # are identity on values and reordering never changes summation
 # grouping within a bucket.  See docs/exchange_ir.md.
 XIR_PIPELINE = "XIR_PIPELINE"
+# Async exchange service (svc/): the TPU-native BackgroundThreadLoop —
+# a persistent executor that accepts XIR programs from concurrent
+# producers through a TensorQueue submission API, negotiates readiness
+# across producers (the coordinator-bitvector analog), and serves
+# repeated program signatures from a ResponseCache without re-lowering.
+# off (default) = every exchange dispatches inline exactly as before
+# (bitwise identical by construction); on = producers submit plans and
+# the service owns the wires.  See docs/exchange_service.md.
+SVC = "SVC"  # off (default) | on
+# Bounded staleness for the service's dense-gradient pipeline
+# (svc/stale.py): 0 (default) = fully synchronous — losses bitwise
+# identical to SVC=off; k >= 1 = local SGD / delayed DCN sync — the
+# cross-slice hop of step i completes during step i+k's backward
+# (DCN-latency hiding across steps, riding the PR 11 rail model).
+SVC_STALENESS = "SVC_STALENESS"
+# ResponseCache capacity (entries).  Shares the reference's
+# HOROVOD_CACHE_CAPACITY knob (common.h:118, response_cache.cc);
+# 0 disables the cache (every submission renegotiates + re-lowers).
+# CACHE_CAPACITY is declared above with the legacy knob block.
 # Persistent schedule autotuning database (sched/store.py): JSON file
 # recording converged (bucket_bytes, wire, lowering) per (schedule
 # signature, topology, jax version, knob fingerprint); ScheduleTuner

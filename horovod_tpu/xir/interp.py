@@ -418,7 +418,19 @@ def execute(program: ir.ExchangeProgram,
             "payloads were passed"
         )
     if not program.lowered:
-        program = lower_mod.lower(program, axis_size, store=store)
+        # Service producer path (svc/): non-gradient workloads submit
+        # their plan at trace time too — a repeat signature resolves
+        # from the ResponseCache with zero re-lowering.  Emission
+        # stays right here, so SVC on/off is bitwise identical.
+        from .. import svc as _svc
+
+        if _svc.enabled():
+            program = _svc.get_service().submit_traced(
+                program, producer=f"xir.{program.kind}",
+                axis_size=axis_size, store=store,
+            )
+        else:
+            program = lower_mod.lower(program, axis_size, store=store)
     elif store:
         program = lower_mod._store_sync(program)
     account(program, axis_size)

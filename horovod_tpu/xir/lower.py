@@ -116,9 +116,13 @@ def resolve_lowering(op: ir.ExchangeOp,
 
 # One store lookup/record per distinct lowered program per process:
 # tracing re-runs per jit compile, and the JSON store should not be
-# re-read (or re-written) on every trace.
+# re-read (or re-written) on every trace.  Memo keys fold in the
+# topo-fit epoch (topo/fit.py:fit_epoch): when the measured cost model
+# refits, previously adopted entries must be re-validated against the
+# store (whose staleness check prices with the NEW parameters) instead
+# of serving pre-fit decisions forever.
 _seen_lock = threading.Lock()
-_seen_keys: Dict[str, Dict] = {}
+_seen_keys: Dict[tuple, Dict] = {}
 
 
 def reset() -> None:
@@ -142,9 +146,12 @@ def _store_sync(program: ir.ExchangeProgram) -> ir.ExchangeProgram:
     store = ScheduleStore.from_env()
     if store is None or not program.ops:
         return program
+    from ..topo import fit as topo_fit
+
     key = tuner_key(program)
+    memo_key = (key, topo_fit.fit_epoch())
     with _seen_lock:
-        cached = _seen_keys.get(key)
+        cached = _seen_keys.get(memo_key)
     if cached is not None:
         entry = cached
     else:
@@ -163,7 +170,7 @@ def _store_sync(program: ir.ExchangeProgram) -> ir.ExchangeProgram:
         else:
             metrics.inc_counter("xir.db_hit")
         with _seen_lock:
-            _seen_keys[key] = entry
+            _seen_keys[memo_key] = entry
     wire = str(entry.get("wire", "off"))
     lowering = str(entry.get("lowering", "flat"))
     if wire not in ir.WIRE_CHOICES:
