@@ -266,6 +266,7 @@ from .sched import SchedConfig  # noqa: F401,E402
 from . import topo  # noqa: F401,E402
 from . import xir  # noqa: F401,E402
 from . import svc  # noqa: F401,E402
+from . import trace  # noqa: F401,E402
 from . import elastic  # noqa: F401,E402
 from .sync_batch_norm import SyncBatchNorm  # noqa: F401,E402
 from . import metrics  # noqa: F401,E402

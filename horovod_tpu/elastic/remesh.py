@@ -619,6 +619,15 @@ def run_remesh(state: Any, manager: Any, request: RemeshRequest) -> None:
         np_old=request.np_old, np_new=request.np_new,
         old_rank=old_rank, new_rank=new_rank,
     )
+    # Flight-recorder anomaly trigger (trace/): a membership change is
+    # a step-time discontinuity — dump the pre-remesh span ring so the
+    # postmortem can see what the exchange path looked like before.
+    from .. import trace as _trace
+
+    _trace.trigger_dump(
+        "remesh", remesh_id=request.remesh_id,
+        np_old=request.np_old, np_new=request.np_new,
+    )
     store = KVShardStore(manager.kv_client(), request.remesh_id)
     try:
         with remesh_phase("pause", remesh_id=request.remesh_id,

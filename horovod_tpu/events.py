@@ -62,6 +62,11 @@ REMESH_PHASE = "remesh_phase"
 REMESH_OK = "remesh_ok"
 REMESH_FALLBACK = "remesh_fallback"
 REMESH_ABORT = "remesh_abort"
+# Exchange tracing (trace/): the flight recorder dumped its ring
+# (reason = slow_step / fault:<site> / remesh / svc_death), and the
+# async service's negotiation stall check named missing participants.
+TRACE_ANOMALY = "trace_anomaly"
+SVC_STALL = "svc_stall"
 
 
 class EventLog:

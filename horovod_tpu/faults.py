@@ -90,7 +90,18 @@ Registered sites (grep ``faults.inject`` for ground truth):
 ``svc.loop``                    each background-loop cycle tick
                                 (``cycle=`` context) — kill the service
                                 mid-flight between submissions
+``topo.dcn_phase``              inside each cross-slice DCN hop's trace
+                                span (``phase=``/``wire=`` context;
+                                host-side, fires at trace time) — a
+                                ``slow`` kind is the scripted straggler
+                                the trace smoke injects: the delay lands
+                                in that rank's DCN rail span and the
+                                driver's ``/trace`` summary names it
 ==============================  ==========================================
+
+Every fired fault also triggers a flight-recorder dump
+(``trace.on_fault`` — docs/tracing.md), so the span history around an
+injected failure survives even a ``crash`` kind.
 
 Worker scripts may add their own sites (``faults.inject("my.site")``)
 — the registry is open.  Every fired fault increments the
@@ -334,6 +345,15 @@ def inject(site: str, **context: Any):
     from . import metrics
 
     metrics.inc_counter(f"faults.injected.{site}.{spec.kind}")
+    # Flight-recorder anomaly trigger (trace/): an armed fault firing
+    # dumps the span ring BEFORE the fault takes effect, so even a
+    # 'crash' kind leaves the window around the injection on disk.
+    try:
+        from . import trace
+
+        trace.on_fault(site, spec.kind)
+    except Exception:  # observability must not change fault semantics
+        pass
     log = get_logger()
     if spec.kind == "error":
         log.warning("fault injection: error at %s %s", site, context)

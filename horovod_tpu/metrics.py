@@ -198,6 +198,23 @@ def get_histogram(name: str) -> Optional[Dict[str, Any]]:
         return hist.to_dict() if hist else None
 
 
+def histograms_by_prefix(
+    prefix: str, snap: Optional[Dict[str, Any]] = None
+) -> Dict[str, Dict[str, Any]]:
+    """All histograms whose name starts with ``prefix`` (from a
+    snapshot dict, or this process's live registry) — the extraction
+    the trace straggler detector reads per-phase summaries through
+    (``trace.phase_seconds.*``)."""
+    if snap is not None:
+        hists = snap.get("histograms", {})
+        return {k: v for k, v in hists.items() if k.startswith(prefix)}
+    with _counter_lock:
+        return {
+            k: h.to_dict() for k, h in sorted(_histograms.items())
+            if k.startswith(prefix)
+        }
+
+
 def quantile(name: str, q: float) -> Optional[float]:
     """Interpolated quantile of the named histogram (p50: ``q=0.5``,
     p99: ``q=0.99``); None when the histogram is absent or empty.  The

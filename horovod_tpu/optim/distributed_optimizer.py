@@ -975,8 +975,15 @@ class TrainStep:
             tl.begin("TrainStep", "STEP")
         import time as _time
 
-        from .. import metrics as _metrics
+        from .. import metrics as _metrics, trace as _trace
 
+        # Step span (trace/): the root every exchange/bucket/rail span
+        # emitted during this dispatch nests under; finalization feeds
+        # the flight recorder's slow-step check and derives the
+        # measured topo.rail_busy_frac gauges.  Host-side only — the
+        # traced computation is untouched.
+        _step_span = _trace.step(compiled=not built_here)
+        _step_span.__enter__()
         _t0 = _time.perf_counter()
         try:
             # Tracing for a new cache entry happens inside this call, so
@@ -1015,6 +1022,7 @@ class TrainStep:
             fusion.set_threshold_override(None)
             traced.set_hierarchical_override(None)
             set_quantized_override(None)
+            _step_span.__exit__(None, None, None)
             # Dispatch latency, not device latency: the step returns
             # futures (async dispatch); a cache miss shows the compile.
             _metrics.observe(

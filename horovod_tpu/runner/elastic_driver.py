@@ -496,9 +496,25 @@ class ElasticDriver:
                 "current_hosts": self.host_manager.current_hosts,
             }
 
+        def trace_fn():
+            # Cross-rank straggler detection (trace/straggler.py) over
+            # the per-rank phase summaries the workers' heartbeats
+            # already push: one pass per scrape, verdicts published as
+            # trace.straggler{rank=,phase=} gauges AND returned as the
+            # /trace body, with round context so an operator can line
+            # the summary up against /health.
+            from ..trace import straggler
+
+            per_rank = {rank: snap for rank, snap in workers_fn()}
+            payload = straggler.trace_payload(per_rank)
+            payload["round"] = self.rounds
+            payload["workers"] = len(self._last_assignments)
+            return payload
+
         return TelemetryServer(
             port=self.telemetry_port, health_fn=health_fn,
             workers_fn=workers_fn, schedule_store=self.schedule_store(),
+            trace_fn=trace_fn,
         )
 
     def _publish_schedules(self, control) -> None:

@@ -13,6 +13,14 @@ gives the driver process a scrapeable surface:
 * ``GET /health`` — JSON from ``health_fn`` (round number, live
   workers, blacklist, available slots), HTTP 200/503 by its
   ``"status"`` field.
+* ``GET /trace`` — the cross-rank exchange-tracing summary
+  (``trace/straggler.py``): per-rank phase p50/p99 from the
+  ``trace.phase_seconds.*`` histograms each worker's heartbeat
+  pushed, the straggler verdicts (which rank is slow, in which
+  phase), and each rank's flight-recorder anomaly-dump index.  One
+  detection pass per scrape; the verdicts also publish as
+  ``trace.straggler{rank=,phase=}`` gauges so a Prometheus scrape of
+  ``/metrics`` sees them too (docs/tracing.md).
 * ``GET/POST /schedules`` — the persistent autotuning database
   (``sched/store.py``): GET returns every stored (bucket_bytes, wire,
   lowering) winner (``?key=<hex>`` filters to one), POST merges a
@@ -69,10 +77,18 @@ class _Handler(BaseHTTPRequestHandler):
                     payload if payload is not None
                     else {"error": "no schedule store"}
                 ).encode(), "application/json")
+            elif route == "/trace":
+                payload = srv.render_trace()
+                code = 200 if payload is not None else 404
+                self._send(code, json.dumps(
+                    payload if payload is not None
+                    else {"error": "no trace summary"}
+                ).encode(), "application/json")
             else:
                 self._send(
                     404,
-                    b"not found: try /metrics, /health or /schedules\n",
+                    b"not found: try /metrics, /health, /schedules "
+                    b"or /trace\n",
                     "text/plain")
         except Exception as e:  # a scrape must never kill the server
             self._send(500, f"telemetry error: {e}\n".encode(),
@@ -144,10 +160,12 @@ class TelemetryServer:
             Callable[[], List[Tuple[int, Dict[str, Any]]]]
         ] = None,
         schedule_store=None,
+        trace_fn: Optional[Callable[[], Dict[str, Any]]] = None,
     ):
         self.health_fn = health_fn
         self.workers_fn = workers_fn
         self.schedule_store = schedule_store
+        self.trace_fn = trace_fn
         self._server = _QuietHTTPServer((bind_host, port), _Handler)
         self._server.telemetry = self  # type: ignore[attr-defined]
         self.port = self._server.server_address[1]
@@ -179,6 +197,21 @@ class TelemetryServer:
         if self.health_fn is None:
             return {"status": "ok"}
         return self.health_fn()
+
+    def render_trace(self) -> Optional[Dict[str, Any]]:
+        """``GET /trace`` payload: an explicit ``trace_fn`` (the
+        elastic driver installs the straggler-detection pass), else —
+        when worker snapshots are reachable — a detection pass run
+        right here, so any server with ``workers_fn`` serves the
+        summary.  None when neither exists (-> 404)."""
+        if self.trace_fn is not None:
+            return self.trace_fn()
+        if self.workers_fn is None:
+            return None
+        from ..trace import straggler
+
+        per_rank = {rank: snap for rank, snap in self.workers_fn()}
+        return straggler.trace_payload(per_rank)
 
     def render_schedules(
         self, key: Optional[str] = None

@@ -288,6 +288,7 @@ def _exchange_pipelined(
     barrier is identity and per-bucket op order never changes."""
     import dataclasses as _dc
 
+    from .. import trace
     from ..xir import pipeline as railpipe
 
     reduced: List[jax.Array] = list(wire)
@@ -304,7 +305,10 @@ def _exchange_pipelined(
         bi_, bucket_, meta_, pb_, mid_ = deferred
         deferred = None
         (mid_,) = rail.tie([mid_], ("ici",))
-        with jax.named_scope(
+        with trace.span(
+            f"bucket{bi_}.ag", "bucket", bucket=bi_,
+            nbytes=bucket_.nbytes,
+        ), jax.named_scope(
             f"hvd_sched_bucket{bi_}_{bucket_.nbytes}B_{bucket_.wire}"
             f"_{bucket_.lowering}_ag"
         ):
@@ -331,7 +335,11 @@ def _exchange_pipelined(
             if deferred is not None:
                 _flush()
             ins = rail.tie(ins, ("ici", "dcn"))
-            with jax.named_scope(
+            with trace.span(
+                f"bucket{bi}", "bucket", bucket=bi,
+                nbytes=bucket.nbytes, wire=bucket.wire,
+                lowering=bucket.lowering,
+            ), jax.named_scope(
                 f"hvd_sched_bucket{bi}_{bucket.nbytes}B_{bucket.wire}"
                 f"_{bucket.lowering}"
             ):
@@ -345,7 +353,10 @@ def _exchange_pipelined(
         else:
             ins = rail.tie(ins, ("ici",))
             flats, meta = fusion.flatten_group(ins)
-            with jax.named_scope(
+            with trace.span(
+                f"bucket{bi}.rs", "bucket", bucket=bi,
+                nbytes=bucket.nbytes,
+            ), jax.named_scope(
                 f"hvd_sched_bucket{bi}_{bucket.nbytes}B_{bucket.wire}"
                 f"_{bucket.lowering}_rs"
             ):
@@ -357,7 +368,10 @@ def _exchange_pipelined(
                 _flush()
                 overlaps += 1
             (shard,) = rail.tie([shard], ("dcn",))
-            with jax.named_scope(
+            with trace.span(
+                f"bucket{bi}.dcn", "bucket", bucket=bi,
+                nbytes=bucket.nbytes, wire=bucket.wire,
+            ), jax.named_scope(
                 f"hvd_sched_bucket{bi}_{bucket.nbytes}B_{bucket.wire}"
                 f"_{bucket.lowering}_dcn"
             ):
@@ -418,7 +432,7 @@ def exchange(
     f32 dense losses are bitwise identical to the serialized emission
     in every mode.
     """
-    from .. import xir
+    from .. import trace, xir
     from ..xir import pipeline as railpipe
 
     t0 = time.perf_counter()
@@ -426,6 +440,13 @@ def exchange(
         xir.from_schedule(schedule, kind=kind, axis=axis)
         if xir.enabled() else None
     )
+    if program is not None and program.trace is None and trace.enabled():
+        # Trace correlation for the whole submission: the context rides
+        # the program into the service (queue/negotiation/cache spans)
+        # and back out to the rail-phase spans emitted below.
+        program = program.with_trace(
+            trace.current_context() or trace.new_context(f"sched.{kind}")
+        )
     if program is not None:
         # Async exchange service (svc/): the bucketed pipeline is a
         # *producer* — the program is submitted to the service at
@@ -468,46 +489,55 @@ def exchange(
         "sched.pipeline.engaged", 1.0 if pipelined else 0.0,
         {"mode": railpipe.mode()},
     )
-    if pipelined:
-        reduced = _exchange_pipelined(
-            wire, schedule, reduce_flat, phases, program, timeline
-        )
-    else:
-        reduced = list(wire)
-        token: Optional[jax.Array] = None
-        for bi, bucket in enumerate(schedule.buckets):
-            if program is not None:
-                # Interpret the program: the op record drives the
-                # bucket's dispatch (equal to the plan's fields by
-                # construction).
-                op = program.ops[bi]
-                bucket = dataclasses.replace(
-                    bucket, wire=op.wire, lowering=op.lowering
-                )
-            ins = [wire[i] for i in bucket.indices]
-            if barriers:
-                ins, token = _chain(ins, token)
-            if timeline is not None:
-                _bucket_timeline(timeline, bi, bucket)
-            with jax.named_scope(
-                f"hvd_sched_bucket{bi}_{bucket.nbytes}B_{bucket.wire}"
-                f"_{bucket.lowering}"
-            ):
-                flats, meta = fusion.flatten_group(ins)
-                outs = [reduce_flat(f, bucket) for f in flats]
-            if barriers:
-                # Scalar carried out of this bucket's collective: the
-                # next bucket's inputs are barrier-tied to it, enforcing
-                # issue order without touching values.
-                token = outs[0].reshape(-1)[0]
-            for i, t in zip(
-                bucket.indices, fusion.unflatten_group(outs, meta)
-            ):
-                reduced[i] = t
-            metrics.observe(
-                "sched.bytes_per_bucket", bucket.nbytes,
-                buckets=metrics.BYTES_BUCKETS,
+    with trace.span(
+        f"exchange.{kind}", "exchange",
+        ctx=program.trace if program is not None else None,
+        kind=kind, buckets=len(schedule), pipelined=pipelined,
+    ):
+        if pipelined:
+            reduced = _exchange_pipelined(
+                wire, schedule, reduce_flat, phases, program, timeline
             )
+        else:
+            reduced = list(wire)
+            token: Optional[jax.Array] = None
+            for bi, bucket in enumerate(schedule.buckets):
+                if program is not None:
+                    # Interpret the program: the op record drives the
+                    # bucket's dispatch (equal to the plan's fields by
+                    # construction).
+                    op = program.ops[bi]
+                    bucket = dataclasses.replace(
+                        bucket, wire=op.wire, lowering=op.lowering
+                    )
+                ins = [wire[i] for i in bucket.indices]
+                if barriers:
+                    ins, token = _chain(ins, token)
+                if timeline is not None:
+                    _bucket_timeline(timeline, bi, bucket)
+                with trace.span(
+                    f"bucket{bi}", "bucket", bucket=bi,
+                    nbytes=bucket.nbytes, wire=bucket.wire,
+                    lowering=bucket.lowering,
+                ), jax.named_scope(
+                    f"hvd_sched_bucket{bi}_{bucket.nbytes}B_{bucket.wire}"
+                    f"_{bucket.lowering}"
+                ):
+                    flats, meta = fusion.flatten_group(ins)
+                    outs = [reduce_flat(f, bucket) for f in flats]
+                if barriers:
+                    # Scalar carried out of this bucket's collective:
+                    # the next bucket's inputs are barrier-tied to it,
+                    # enforcing issue order without touching values.
+                    token = outs[0].reshape(-1)[0]
+                for i, t in zip(
+                    bucket.indices, fusion.unflatten_group(outs, meta)
+                ):
+                    reduced[i] = t
+                metrics.observe(
+                    "sched.bytes_per_bucket", bucket.nbytes,
+                    buckets=metrics.BYTES_BUCKETS,
+                )
     metrics.inc_counter("sched.plans")
     metrics.inc_counter("sched.buckets", len(schedule))
     metrics.inc_counter("sched.exchange_bytes", schedule.total_bytes)

@@ -72,14 +72,25 @@ class ResponseCache:
         return (program.signature(), axis_size, topo_fit.fit_epoch())
 
     def lookup(self, key: Tuple) -> Optional[CachedResponse]:
+        import time
+
+        from .. import trace
+
+        t0 = time.monotonic()
         with self._lock:
             entry = self._entries.get(key)
             if entry is None:
                 metrics.inc_counter("svc.cache_miss")
+                # Trace correlation rides the thread context the caller
+                # installed (service loop / traced producer): a miss
+                # span is followed by a "lower" span, a hit span is not
+                # — the skip the propagation tests pin.
+                trace.record_complete("cache.miss", "cache", t0, hit=0)
                 return None
             self._entries.move_to_end(key)
             entry.hits += 1
         metrics.inc_counter("svc.cache_hit")
+        trace.record_complete("cache.hit", "cache", t0, hit=1)
         return entry
 
     def insert(self, key: Tuple, entry: CachedResponse) -> CachedResponse:

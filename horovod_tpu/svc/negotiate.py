@@ -25,10 +25,23 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Dict, List, Tuple
+from typing import Any, Dict, List, Tuple
 
 from .. import metrics
+from ..utils import env
 from .queue import Submission
+
+DEFAULT_STALL_TIMEOUT_S = 60.0
+
+
+def stall_timeout() -> float:
+    """``HVD_TPU_STALL_TIMEOUT``: seconds a negotiation may sit short
+    of its bitvector before the stall check warns with the missing
+    participants (the PR 2 stall inspector, one level up — the
+    reference's ``HOROVOD_STALL_CHECK_TIME_SECONDS`` semantics applied
+    to producer readiness instead of rank readiness)."""
+    return max(0.1, env.get_float(env.STALL_TIMEOUT,
+                                  DEFAULT_STALL_TIMEOUT_S))
 
 
 class Negotiator:
@@ -39,6 +52,10 @@ class Negotiator:
         # signature -> {producer: Submission}, plus first-post stamp
         self._pending: Dict[Tuple, Dict[str, Submission]] = {}
         self._first_post: Dict[Tuple, float] = {}
+        # signature -> union of participant sets named by posts (the
+        # "expected" half of the posted-vs-expected stall report).
+        self._expected: Dict[Tuple, set] = {}
+        self._stall_warned: set = set()
 
     def post(self, sub: Submission) -> List[Submission]:
         """Record one submission; return the ready batch (possibly just
@@ -58,7 +75,8 @@ class Negotiator:
             if not entry:
                 self._first_post[key] = time.monotonic()
             entry[sub.producer] = sub
-            if not set(participants) <= set(entry):
+            self._expected.setdefault(key, set()).update(participants)
+            if not self._expected[key] <= set(entry):
                 metrics.set_gauge("svc.negotiations_pending",
                                   len(self._pending))
                 return []
@@ -66,14 +84,88 @@ class Negotiator:
             # participant-sorted order (deterministic across runs and
             # across interleavings — the drain-determinism contract).
             del self._pending[key]
+            self._expected.pop(key, None)
+            self._stall_warned.discard(key)
             t0 = self._first_post.pop(key, None)
             metrics.set_gauge("svc.negotiations_pending",
                               len(self._pending))
         if t0 is not None:
+            from .. import trace
+
             metrics.observe("svc.negotiation_seconds",
                             time.monotonic() - t0)
+            # The negotiation-wait span, attributed to the request and
+            # naming the LAST-ARRIVING participant — the producer whose
+            # post completed the bitvector is who everyone waited on.
+            trace.record_complete(
+                f"negotiate.{sub.program.kind}", "negotiate",
+                t0, ctx=sub.trace,
+                last_arriver=sub.producer,
+                participants=",".join(sorted(entry)),
+            )
         metrics.inc_counter("svc.negotiations")
         return [entry[p] for p in sorted(entry)]
+
+    def check_stalls(
+        self, timeout_s: float = None, now: float = None,
+    ) -> List[Dict[str, Any]]:
+        """The stall inspector, service edition: every pending entry
+        older than ``timeout_s`` (``HVD_TPU_STALL_TIMEOUT``) yields one
+        report naming the missing participants — the negotiator knows
+        exactly who posted and who was named, so a stuck submission is
+        attributable instead of silent until ``_abandoned``.  Warns
+        once per entry (re-arming if the entry completes and a new one
+        stalls), counts ``svc.stall``, gauges the currently-stalled
+        total, and emits an :data:`~horovod_tpu.events.SVC_STALL`
+        event per fresh stall."""
+        from .. import events
+
+        timeout_s = stall_timeout() if timeout_s is None else timeout_s
+        now = time.monotonic() if now is None else now
+        reports: List[Dict[str, Any]] = []
+        fresh: List[Dict[str, Any]] = []
+        with self._lock:
+            for key, t0 in self._first_post.items():
+                age = now - t0
+                if age < timeout_s:
+                    continue
+                posted = sorted(self._pending.get(key, {}))
+                expected = sorted(self._expected.get(key, set()))
+                missing = sorted(set(expected) - set(posted))
+                report = {
+                    "age_s": age,
+                    "posted": posted,
+                    "expected": expected,
+                    "missing": missing,
+                    "kinds": sorted({
+                        s.program.kind
+                        for s in self._pending.get(key, {}).values()
+                    }),
+                }
+                reports.append(report)
+                if key not in self._stall_warned:
+                    self._stall_warned.add(key)
+                    fresh.append(report)
+            metrics.set_gauge("svc.stalled_negotiations", len(reports))
+        for report in fresh:
+            metrics.inc_counter("svc.stall")
+            from ..utils.logging import get_logger
+
+            get_logger().warning(
+                "svc.stall: negotiation of %s pending %.0fs — posted "
+                "%s, expected %s; missing participants: %s (a producer "
+                "died or never submitted; the entry resolves inline at "
+                "the next drain)",
+                "+".join(report["kinds"]) or "?", report["age_s"],
+                report["posted"], report["expected"],
+                ", ".join(report["missing"]) or "?",
+            )
+            events.emit(
+                events.SVC_STALL,
+                age_s=report["age_s"], missing=report["missing"],
+                posted=report["posted"], expected=report["expected"],
+            )
+        return reports
 
     def pending_count(self) -> int:
         with self._lock:
@@ -93,7 +185,10 @@ class Negotiator:
             n = len(self._pending)
             self._pending.clear()
             self._first_post.clear()
+            self._expected.clear()
+            self._stall_warned.clear()
             metrics.set_gauge("svc.negotiations_pending", 0)
+            metrics.set_gauge("svc.stalled_negotiations", 0)
         if n:
             metrics.inc_counter("svc.negotiations_abandoned", n)
         return sorted(orphans, key=lambda s: s.seq)

@@ -80,6 +80,11 @@ class Submission:
     axis_size: Optional[int] = None
     process_set: Any = None
     enqueued_at: float = 0.0
+    # Trace correlation (trace/context.py): stamped by submit() from
+    # the program's attached context (or minted fresh), so every span
+    # the service emits for this submission — queue wait, negotiation,
+    # cache, dispatch — carries one trace id end to end.
+    trace: Any = None
 
 
 class TensorQueue:
@@ -129,7 +134,20 @@ class TensorQueue:
             batch = sorted(self._items, key=lambda s: s.seq)
             self._items.clear()
             self._publish_depth_locked()
-            return batch
+        # Queue-wait spans (trace/): enqueue -> this pop, per
+        # submission, attributed to the submitting producer's trace.
+        if batch:
+            from .. import trace
+
+            if trace.enabled():
+                now = time.monotonic()
+                for s in batch:
+                    trace.record_complete(
+                        f"queue.{s.producer}", "queue",
+                        s.enqueued_at or now, now, ctx=s.trace,
+                        seq=s.seq, producer=s.producer,
+                    )
+        return batch
 
     def depth(self, producer: Optional[str] = None) -> int:
         with self._lock:

@@ -138,6 +138,13 @@ class ExchangeService:
             try:
                 batch = self.queue.pop_batch()
                 if not batch:
+                    if self.queue.closed or self._dead:
+                        return  # killed under us: don't spin hot
+                    # Stall inspector, async edition: a negotiation
+                    # short of its bitvector past HVD_TPU_STALL_TIMEOUT
+                    # warns with the missing participants instead of
+                    # staying silent until _abandoned.
+                    self.negotiator.check_stalls()
                     continue
                 self._cycle += 1
                 metrics.inc_counter("svc.loop_cycles")
@@ -147,6 +154,7 @@ class ExchangeService:
                     ready.extend(self.negotiator.post(sub))
                 for sub in sorted(ready, key=lambda s: s.seq):
                     self._dispatch(sub)
+                self.negotiator.check_stalls()
             except FaultInjected as e:
                 self._kill(f"fault injected in service loop: {e}")
                 self._resolve_inline(batch)
@@ -175,6 +183,9 @@ class ExchangeService:
             self._dead = True
             self._death_reason = reason
         metrics.inc_counter("svc.deaths")
+        from .. import trace
+
+        trace.trigger_dump("svc_death", death_reason=reason)
         get_logger().warning(
             "exchange service died (%s); degrading to synchronous "
             "inline dispatch", reason,
@@ -251,6 +262,10 @@ class ExchangeService:
         else:
             lowered = lower_mod.lower(program, axis_size, store=store)
             metrics.inc_counter("svc.lowerings")
+        # Cache entries are shared across submissions: store the shape,
+        # not the first submitter's trace identity.
+        if lowered.trace is not None:
+            lowered = lowered.with_trace(None)
         return self.cache.insert(key, CachedResponse(program=lowered))
 
     def _build_executor(self, program, axis_size: Optional[int],
@@ -283,14 +298,25 @@ class ExchangeService:
 
     def _dispatch(self, sub: Submission) -> None:
         """Execute one ready submission and resolve its future."""
+        from .. import trace
+
         try:
-            entry = self._resolve_program(sub.program, sub.axis_size)
-            if entry.executor is None:
-                entry.executor = self._build_executor(
-                    entry.program, sub.axis_size, sub.process_set
-                )
-            with self._inflight_guard():
-                outs = entry.executor(tuple(sub.args))
+            # Scope the submission's TraceContext to the dispatch so
+            # every span underneath (cache, lower, executor) carries
+            # its trace id — including on the inline-fallback path,
+            # where this runs on the producer's own thread.
+            with trace.use_context(sub.trace), trace.span(
+                f"dispatch.{sub.program.kind}", "dispatch",
+                ctx=sub.trace, producer=sub.producer, seq=sub.seq,
+                kind=sub.program.kind,
+            ):
+                entry = self._resolve_program(sub.program, sub.axis_size)
+                if entry.executor is None:
+                    entry.executor = self._build_executor(
+                        entry.program, sub.axis_size, sub.process_set
+                    )
+                with self._inflight_guard():
+                    outs = entry.executor(tuple(sub.args))
             metrics.inc_counter("svc.dispatches")
             metrics.inc_counter(f"svc.programs.{sub.program.kind}")
             self._record_timeline(entry.program)
@@ -360,12 +386,18 @@ class ExchangeService:
             )
         metrics.inc_counter("svc.submits")
         metrics.inc_counter(f"svc.submits.{producer}")
+        from .. import trace
+
+        ctx = program.trace or (
+            trace.new_context(producer) if trace.enabled() else None
+        )
         future = SvcFuture()
         sub = Submission(
             seq=self.queue.next_seq(), producer=producer,
             program=program, args=list(args), future=future,
             participants=tuple(participants or ()),
             axis_size=axis_size, process_set=process_set,
+            trace=ctx,
         )
         try:
             faults.inject("svc.submit", producer=producer,
@@ -396,6 +428,12 @@ class ExchangeService:
         (``svc.fallback_sync``), never an error in the step."""
         metrics.inc_counter("svc.submits")
         metrics.inc_counter(f"svc.submits.{producer}")
+        from .. import trace
+
+        if program.trace is None and trace.enabled():
+            program = program.with_trace(
+                trace.current_context() or trace.new_context(producer)
+            )
         try:
             faults.inject("svc.submit", producer=producer,
                           kind=program.kind, traced=1)
@@ -408,7 +446,16 @@ class ExchangeService:
             if program.lowered:
                 return program
             return lower_mod.lower(program, axis_size, store=store)
-        return self._resolve_program(program, axis_size, store).program
+        with trace.use_context(program.trace):
+            resolved = self._resolve_program(
+                program, axis_size, store
+            ).program
+        # The cached copy is trace-less (shared across submissions);
+        # hand it back carrying THIS request's context so the caller's
+        # emission spans correlate with the queue/cache spans above.
+        if program.trace is not None:
+            resolved = resolved.with_trace(program.trace)
+        return resolved
 
 
 # ------------------------------------------------- process singleton

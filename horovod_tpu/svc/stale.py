@@ -168,8 +168,11 @@ class StaleTrainStep:
         return stacked, inner
 
     def __call__(self, params, opt_state, batch):
-        with self._lock:
-            corr = self._collect_correction(params)
+        from .. import trace
+
+        with self._lock, trace.step(staleness=self.k):
+            with trace.span("collect_correction", "dispatch"):
+                corr = self._collect_correction(params)
             params, opt_state, loss, slice_mean = self._step_fn(
                 params, opt_state, corr, batch
             )
@@ -229,7 +232,7 @@ class StaleTrainStep:
         return jax.tree.unflatten(due.treedef, corr_leaves)
 
     def _submit_dcn(self, slice_mean) -> None:
-        from .. import xir
+        from .. import trace, xir
 
         leaves, treedef = jax.tree.flatten(slice_mean)
         ops = [
@@ -241,6 +244,14 @@ class StaleTrainStep:
             for i, x in enumerate(leaves)
         ]
         program = xir.program("svc_stale", ops)
+        if trace.enabled():
+            # One trace id per delayed hop: the queue/negotiation/
+            # dispatch spans on the service loop correlate back to the
+            # submitting step even though the hop completes k steps
+            # later on another thread.
+            program = program.with_trace(
+                trace.new_context("stale", tenant=str(self._step_idx))
+            )
         future = svc_service.get_service().submit(
             program, leaves, producer="stale", axis_size=self.world,
         )

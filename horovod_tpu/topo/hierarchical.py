@@ -48,6 +48,7 @@ ICI-resident quantized collectives go fused.
 
 from __future__ import annotations
 
+import contextlib as _contextlib
 from typing import Optional, Sequence, Tuple, Union
 
 import jax
@@ -91,22 +92,48 @@ def _hier_ctx(axis: Axis, topo: Optional[model.Topology]):
 
 
 def _ici_reduce_scatter(flat: jax.Array, ctx) -> jax.Array:
-    if ctx["mode"] == "axes":
+    from .. import trace
+
+    with trace.span("rs_ici", "rs_ici", rail="ici",
+                    nbytes=int(flat.size * flat.dtype.itemsize)):
+        if ctx["mode"] == "axes":
+            return lax.psum_scatter(
+                flat, ctx["inner"], scatter_dimension=0, tiled=True
+            )
         return lax.psum_scatter(
-            flat, ctx["inner"], scatter_dimension=0, tiled=True
+            flat, ctx["axis"], scatter_dimension=0,
+            axis_index_groups=ctx["intra"], tiled=True,
         )
-    return lax.psum_scatter(
-        flat, ctx["axis"], scatter_dimension=0,
-        axis_index_groups=ctx["intra"], tiled=True,
-    )
 
 
 def _ici_all_gather(shard: jax.Array, ctx) -> jax.Array:
-    if ctx["mode"] == "axes":
-        return lax.all_gather(shard, ctx["inner"], tiled=True)
-    return lax.all_gather(
-        shard, ctx["axis"], axis_index_groups=ctx["intra"], tiled=True
-    )
+    from .. import trace
+
+    with trace.span("ag_ici", "ag_ici", rail="ici",
+                    nbytes=int(shard.size * shard.dtype.itemsize)):
+        if ctx["mode"] == "axes":
+            return lax.all_gather(shard, ctx["inner"], tiled=True)
+        return lax.all_gather(
+            shard, ctx["axis"], axis_index_groups=ctx["intra"],
+            tiled=True,
+        )
+
+
+@_contextlib.contextmanager
+def _dcn_trace(name: str, shard: jax.Array, wire: str):
+    """The DCN-rail span every cross-slice hop wraps its emission in,
+    with the ``topo.dcn_phase`` fault site fired *inside* it: an armed
+    ``slow`` fault (the scripted straggler of the trace smoke) lands
+    its host-side delay within the span, so an injected straggler
+    shows as a long DCN rail span on exactly the injected rank."""
+    from .. import faults, trace
+
+    with trace.span(
+        name, "dcn", rail="dcn", wire=wire,
+        nbytes=int(shard.size * shard.dtype.itemsize),
+    ):
+        faults.inject("topo.dcn_phase", phase=name, wire=wire)
+        yield
 
 
 def _dcn_sum_dense(shard: jax.Array, ctx) -> jax.Array:
@@ -157,26 +184,28 @@ def dcn_reduce_scatter_phase(
 ) -> jax.Array:
     """Cross-slice reduce_scatter of the slice-summed 1/k shard (DCN
     rail) — the hier RS+AG exchange's first DCN leg."""
-    quant = (wire or "off").lower() in ("int8", "fp8") and \
-        jnp.issubdtype(shard_k.dtype, jnp.floating)
-    if quant:
-        from ..ops.quantized import quantized_reduce_scatter
+    with _dcn_trace("dcn_rs", shard_k, wire):
+        quant = (wire or "off").lower() in ("int8", "fp8") and \
+            jnp.issubdtype(shard_k.dtype, jnp.floating)
+        if quant:
+            from ..ops.quantized import quantized_reduce_scatter
 
-        if ctx["mode"] == "axes":
+            if ctx["mode"] == "axes":
+                return quantized_reduce_scatter(
+                    shard_k, ctx["outer"], op=Sum, wire=wire
+                ).astype(shard_k.dtype)
             return quantized_reduce_scatter(
-                shard_k, ctx["outer"], op=Sum, wire=wire
+                shard_k, ctx["axis"], op=Sum, wire=wire,
+                groups=ctx["cross"],
             ).astype(shard_k.dtype)
-        return quantized_reduce_scatter(
-            shard_k, ctx["axis"], op=Sum, wire=wire, groups=ctx["cross"],
-        ).astype(shard_k.dtype)
-    if ctx["mode"] == "axes":
+        if ctx["mode"] == "axes":
+            return lax.psum_scatter(
+                shard_k, ctx["outer"], scatter_dimension=0, tiled=True
+            )
         return lax.psum_scatter(
-            shard_k, ctx["outer"], scatter_dimension=0, tiled=True
+            shard_k, ctx["axis"], scatter_dimension=0,
+            axis_index_groups=ctx["cross"], tiled=True,
         )
-    return lax.psum_scatter(
-        shard_k, ctx["axis"], scatter_dimension=0,
-        axis_index_groups=ctx["cross"], tiled=True,
-    )
 
 
 def dcn_all_gather_phase(
@@ -184,23 +213,25 @@ def dcn_all_gather_phase(
 ) -> jax.Array:
     """Cross-slice all_gather (DCN rail) — the hier RS+AG exchange's
     second DCN leg, inverse of :func:`dcn_reduce_scatter_phase`."""
-    quant = (wire or "off").lower() in ("int8", "fp8") and \
-        jnp.issubdtype(shard.dtype, jnp.floating)
-    if quant:
-        from ..ops.quantized import quantized_all_gather
+    with _dcn_trace("dcn_ag", shard, wire):
+        quant = (wire or "off").lower() in ("int8", "fp8") and \
+            jnp.issubdtype(shard.dtype, jnp.floating)
+        if quant:
+            from ..ops.quantized import quantized_all_gather
 
-        if ctx["mode"] == "axes":
+            if ctx["mode"] == "axes":
+                return quantized_all_gather(
+                    shard, ctx["outer"], wire=wire
+                ).astype(shard.dtype)
             return quantized_all_gather(
-                shard, ctx["outer"], wire=wire
+                shard, ctx["axis"], wire=wire, groups=ctx["cross"]
             ).astype(shard.dtype)
-        return quantized_all_gather(
-            shard, ctx["axis"], wire=wire, groups=ctx["cross"]
-        ).astype(shard.dtype)
-    if ctx["mode"] == "axes":
-        return lax.all_gather(shard, ctx["outer"], tiled=True)
-    return lax.all_gather(
-        shard, ctx["axis"], axis_index_groups=ctx["cross"], tiled=True,
-    )
+        if ctx["mode"] == "axes":
+            return lax.all_gather(shard, ctx["outer"], tiled=True)
+        return lax.all_gather(
+            shard, ctx["axis"], axis_index_groups=ctx["cross"],
+            tiled=True,
+        )
 
 
 def dcn_all_reduce(
@@ -222,22 +253,24 @@ def dcn_all_reduce(
 
 def _dcn_sum(shard: jax.Array, ctx, wire: str) -> jax.Array:
     wire = (wire or "off").lower()
-    floating = jnp.issubdtype(shard.dtype, jnp.floating)
-    if wire in ("int8", "fp8") and floating:
-        from ..ops.quantized import quantized_allreduce
+    with _dcn_trace("dcn_ar", shard, wire):
+        floating = jnp.issubdtype(shard.dtype, jnp.floating)
+        if wire in ("int8", "fp8") and floating:
+            from ..ops.quantized import quantized_allreduce
 
-        if ctx["mode"] == "axes":
+            if ctx["mode"] == "axes":
+                return quantized_allreduce(
+                    shard, ctx["outer"], op=Sum, wire=wire
+                ).astype(shard.dtype)
             return quantized_allreduce(
-                shard, ctx["outer"], op=Sum, wire=wire
+                shard, ctx["axis"], op=Sum, wire=wire,
+                groups=ctx["cross"]
             ).astype(shard.dtype)
-        return quantized_allreduce(
-            shard, ctx["axis"], op=Sum, wire=wire, groups=ctx["cross"]
-        ).astype(shard.dtype)
-    if wire == "bf16" and floating and shard.dtype != jnp.bfloat16:
-        return _dcn_sum_dense(
-            shard.astype(jnp.bfloat16), ctx
-        ).astype(shard.dtype)
-    return _dcn_sum_dense(shard, ctx)
+        if wire == "bf16" and floating and shard.dtype != jnp.bfloat16:
+            return _dcn_sum_dense(
+                shard.astype(jnp.bfloat16), ctx
+            ).astype(shard.dtype)
+        return _dcn_sum_dense(shard, ctx)
 
 
 def _psum_all(v: jax.Array, ctx) -> jax.Array:
@@ -304,31 +337,33 @@ def _dcn_adasum(shard: jax.Array, ctx, wire: str) -> jax.Array:
     L = shard.shape[0]
     w = (wire or "off").lower()
     floating = jnp.issubdtype(dtype, jnp.floating)
-    if w in ("int8", "fp8") and floating:
-        from ..ops.quantized import quantized_all_gather
+    with _dcn_trace("dcn_adasum", shard, w):
+        if w in ("int8", "fp8") and floating:
+            from ..ops.quantized import quantized_all_gather
 
-        if ctx["mode"] == "axes":
-            gathered = quantized_all_gather(
-                shard.astype(jnp.float32), ctx["outer"], wire=w
-            )
+            if ctx["mode"] == "axes":
+                gathered = quantized_all_gather(
+                    shard.astype(jnp.float32), ctx["outer"], wire=w
+                )
+            else:
+                gathered = quantized_all_gather(
+                    shard.astype(jnp.float32), ctx["axis"], wire=w,
+                    groups=ctx["cross"],
+                )
+            gathered = gathered[: s * L]
         else:
-            gathered = quantized_all_gather(
-                shard.astype(jnp.float32), ctx["axis"], wire=w,
-                groups=ctx["cross"],
-            )
-        gathered = gathered[: s * L]
-    else:
-        g = shard
-        if w == "bf16" and floating and dtype != jnp.bfloat16:
-            g = g.astype(jnp.bfloat16)
-        if ctx["mode"] == "axes":
-            gathered = lax.all_gather(g, ctx["outer"], tiled=True)
-        else:
-            gathered = lax.all_gather(
-                g, ctx["axis"], axis_index_groups=ctx["cross"], tiled=True
-            )
-    parts = gathered.astype(jnp.float32).reshape(s, L)
-    out = _adasum_tree([parts[j] for j in range(s)], ctx)
+            g = shard
+            if w == "bf16" and floating and dtype != jnp.bfloat16:
+                g = g.astype(jnp.bfloat16)
+            if ctx["mode"] == "axes":
+                gathered = lax.all_gather(g, ctx["outer"], tiled=True)
+            else:
+                gathered = lax.all_gather(
+                    g, ctx["axis"], axis_index_groups=ctx["cross"],
+                    tiled=True,
+                )
+        parts = gathered.astype(jnp.float32).reshape(s, L)
+        out = _adasum_tree([parts[j] for j in range(s)], ctx)
     return out.astype(dtype)
 
 

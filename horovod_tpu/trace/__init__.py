@@ -1,0 +1,71 @@
+"""End-to-end exchange tracing + the cross-rank straggler flight
+recorder.
+
+PR 12 made exchange asynchronous — a submission passes through queue →
+negotiation → cache → lowering → rail execution, possibly completing k
+steps later — and this package is the telemetry that can say *where a
+slow step's time went* and *which rank held the bitvector*: the
+HOROVOD_TIMELINE per-request phase spans plus the stall check's
+rank-naming power (arXiv:1802.05799, PAPER.md L2), rebuilt over the
+XIR/svc pipeline.  Four pieces:
+
+* :mod:`~horovod_tpu.trace.context` — :class:`TraceContext`, the
+  (trace id, span id, producer/tenant) correlation key attached to
+  every ``svc`` Submission and XIR ExchangeProgram;
+* :mod:`~horovod_tpu.trace.tracer` — host-side spans at every station
+  (queue enqueue/dequeue, negotiation wait with the last-arriving
+  participant recorded, cache hit/miss, lowering, the ICI-RS / DCN /
+  ICI-AG rail phases at the RailChain boundaries), folded into
+  ``trace.phase_seconds.*`` histograms and — at level ``full`` — one
+  Chrome-trace file per rank; step spans also derive the measured
+  ``topo.rail_busy_frac{rail=ici|dcn}`` gauges;
+* :mod:`~horovod_tpu.trace.recorder` — the flight recorder: a bounded
+  ring of the last N steps' span trees, dumped to
+  ``HVD_TPU_TRACE_DIR`` on anomaly (slow step vs the rolling p50,
+  fault-site fire, remesh, service death);
+* :mod:`~horovod_tpu.trace.straggler` — the elastic driver aggregates
+  per-rank phase summaries from the existing heartbeat KV pushes and
+  names stragglers by (rank, phase): ``trace.straggler{rank=,phase=}``
+  gauges + the ``/trace`` HTTP endpoint.
+
+``HVD_TPU_TRACE=off`` reduces every instrumentation point to a shared
+no-op (zero allocation in the traced path); all levels are bitwise-
+neutral to losses — spans wrap host work and never insert ops.  See
+docs/tracing.md.
+"""
+
+from . import context, export, recorder, straggler, tracer  # noqa: F401
+from .context import (  # noqa: F401
+    TraceContext,
+    current as current_context,
+    new_context,
+    set_current as set_current_context,
+    use_context,
+)
+from .recorder import (  # noqa: F401
+    FlightRecorder,
+    get_recorder,
+    trigger_dump,
+)
+from .tracer import (  # noqa: F401
+    Span,
+    Tracer,
+    enabled,
+    get_tracer,
+    level,
+    record_complete,
+    reset,
+    set_level_override,
+    span,
+    step,
+)
+
+
+def on_fault(site: str, kind: str) -> None:
+    """Fault-site anomaly hook (called by :func:`horovod_tpu.faults.
+    inject` whenever an armed fault fires): dump the flight ring so
+    the injected failure's surrounding span history survives — before
+    a ``crash`` kind hard-exits the process.  Never raises."""
+    if level() == "off":
+        return
+    trigger_dump(f"fault:{site}", site=site, fault_kind=kind)

@@ -1,0 +1,110 @@
+"""Trace identity: who submitted this exchange, and which request is it.
+
+The reference timeline keys every span by tensor name because a tensor
+*is* the unit of work in Horovod's queue.  Our unit of work is a
+submission — an :class:`~horovod_tpu.xir.ir.ExchangeProgram` handed to
+the async service (or emitted inline by a traced producer) — and one
+submission fans out into many spans across threads (producer thread at
+enqueue, background loop at negotiation/dispatch, trace thread at rail
+emission).  :class:`TraceContext` is the correlation key that survives
+the fan-out: a ``(trace_id, span_id, producer, tenant)`` tuple attached
+to every ``svc`` Submission and every ExchangeProgram, copied — never
+hashed — so it can ride a frozen program without perturbing the
+signature the ResponseCache and tune DB key on.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import os
+import threading
+from typing import Optional
+
+_counter = itertools.count(1)
+_tl = threading.local()
+
+
+def _rank() -> int:
+    """Best-effort rank for trace ids and per-rank file names.  The
+    launcher env (``HVD_TPU_CROSS_RANK``) wins when set — it is unique
+    per *process*, which is what one-trace-file-per-rank needs — with
+    the runtime rank as the single-process fallback."""
+    raw = os.environ.get("HVD_TPU_CROSS_RANK")
+    if raw not in (None, ""):
+        try:
+            return int(raw)
+        except ValueError:
+            pass
+    try:
+        from ..runtime import get_runtime_or_none
+
+        rt = get_runtime_or_none()
+        if rt is not None:
+            return rt.rank
+    except Exception:
+        pass
+    return 0
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """Correlation identity of one traced request.
+
+    ``trace_id`` names the whole request (a submission end to end);
+    ``span_id`` names the position in its span tree a child should
+    attach under; ``producer`` and ``tenant`` label the submitting
+    pipeline (``sched.dense_grad``, ``stale``, a tenant's job name) for
+    per-producer attribution in the straggler summary.
+    """
+
+    trace_id: str
+    span_id: str = "0"
+    producer: str = "default"
+    tenant: str = ""
+
+    def child(self, span_id: str) -> "TraceContext":
+        return dataclasses.replace(self, span_id=span_id)
+
+
+def new_context(producer: str = "default",
+                tenant: str = "") -> TraceContext:
+    """Mint a fresh trace id: ``r<rank>-<seq>`` — unique per process,
+    attributable to a rank in a merged cross-rank view."""
+    return TraceContext(
+        trace_id=f"r{_rank()}-{next(_counter)}",
+        producer=producer, tenant=tenant,
+    )
+
+
+def current() -> Optional[TraceContext]:
+    """The context attached to this thread (or None).  Producers set it
+    around a submission so spans emitted downstream — including by
+    other modules that never saw the Submission object — correlate."""
+    return getattr(_tl, "ctx", None)
+
+
+def set_current(ctx: Optional[TraceContext]) -> Optional[TraceContext]:
+    """Install (or clear, with None) this thread's context; returns the
+    previous one so callers can restore it."""
+    prev = getattr(_tl, "ctx", None)
+    _tl.ctx = ctx
+    return prev
+
+
+class use_context:
+    """``with use_context(ctx): ...`` — scope a TraceContext to a block
+    (the service loop wraps each dispatch in the submission's)."""
+
+    __slots__ = ("_ctx", "_prev")
+
+    def __init__(self, ctx: Optional[TraceContext]):
+        self._ctx = ctx
+
+    def __enter__(self):
+        self._prev = set_current(self._ctx)
+        return self._ctx
+
+    def __exit__(self, *exc):
+        set_current(self._prev)
+        return False

@@ -1,0 +1,188 @@
+"""Perfetto/Chrome-trace export of span trees, one file per rank.
+
+Same on-disk convention as the PR 2 mergeable timeline
+(``utils/timeline.py``): a bare JSON array written one event per line
+(salvageable after a crash mid-write), opened by process-metadata
+events plus the ``HVD_PROC_META`` instant carrying this process's rank
+and wall-clock epoch base — so ``tools/merge_timeline.py`` re-bases N
+per-rank trace files onto one shared clock exactly as it does timeline
+files, and the two kinds of file merge together into one Perfetto
+view.
+
+Spans land on per-phase lanes (``tid`` + ``thread_name`` metadata):
+the step lane on top, then exchange/bucket structure, the two rails,
+and the service stations — so the Perfetto picture reads top-down the
+way the pipeline flows.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import threading
+from typing import Optional
+
+# Lane layout (Chrome tid + display name).  Unknown phases fall into
+# the service lane rather than growing unbounded lanes.
+_LANES = (
+    ("step", 0, "step"),
+    ("exchange", 1, "exchange"),
+    ("bucket", 1, "exchange"),
+    ("rs_ici", 2, "ici rail"),
+    ("ag_ici", 2, "ici rail"),
+    ("dcn", 3, "dcn rail"),
+    ("queue", 4, "svc"),
+    ("negotiate", 4, "svc"),
+    ("cache", 4, "svc"),
+    ("lower", 4, "svc"),
+    ("dispatch", 4, "svc"),
+)
+_PHASE_TID = {p: tid for p, tid, _ in _LANES}
+_TID_NAME = {tid: name for _, tid, name in _LANES}
+_DEFAULT_TID = 4
+
+
+class TraceWriter:
+    """Line-buffered Chrome-trace JSON writer for finalized span trees
+    (no background thread: trees arrive a handful per step, off the
+    device hot path)."""
+
+    def __init__(self, path: str, rank: int, mono0: float,
+                 epoch_wall_us: float):
+        self.path = path
+        self.rank = int(rank)
+        self._mono0 = mono0
+        self._epoch_wall_us = epoch_wall_us
+        self._lock = threading.Lock()
+        self._fh = open(path, "w", buffering=1)
+        self._fh.write("[\n")
+        self._first = True
+        self._closed = False
+        self._emit_metadata()
+
+    def _emit_metadata(self) -> None:
+        pid = os.getpid()
+        hostname = socket.gethostname()
+        self._write({"name": "process_name", "ph": "M", "pid": pid,
+                     "args": {"name": f"rank {self.rank} ({hostname})"}})
+        self._write({"name": "process_sort_index", "ph": "M", "pid": pid,
+                     "args": {"sort_index": self.rank}})
+        for tid in sorted(_TID_NAME):
+            self._write({"name": "thread_name", "ph": "M", "pid": pid,
+                         "tid": tid,
+                         "args": {"name": _TID_NAME[tid]}})
+        self._write({
+            "name": "HVD_PROC_META", "ph": "i", "ts": 0.0, "s": "p",
+            "pid": pid, "tid": 0,
+            "args": {
+                "rank": self.rank, "hostname": hostname, "pid": pid,
+                "epoch_wall_us": self._epoch_wall_us,
+                "writer": "trace",
+            },
+        })
+
+    def _ts_us(self, mono_t: float) -> float:
+        return (mono_t - self._mono0) * 1e6
+
+    def write_tree(self, span) -> None:
+        """One complete ``X`` event per span in the tree."""
+        pid = os.getpid()
+        with self._lock:
+            if self._closed:
+                return
+            for s in span.walk():
+                args = {
+                    "phase": s.phase,
+                    "trace_id": s.trace_id,
+                    "span_id": s.span_id,
+                }
+                if s.parent_id:
+                    args["parent_id"] = s.parent_id
+                if s.producer:
+                    args["producer"] = s.producer
+                if s.attrs:
+                    args.update({
+                        k: v for k, v in s.attrs.items()
+                        if isinstance(v, (int, float, str, bool))
+                    })
+                self._write({
+                    "name": s.name,
+                    "cat": f"TRACE_{s.phase.upper()}",
+                    "ph": "X",
+                    "ts": self._ts_us(s.t0),
+                    "dur": max(s.dur * 1e6, 0.001),
+                    "pid": pid,
+                    "tid": _PHASE_TID.get(s.phase, _DEFAULT_TID),
+                    "args": args,
+                })
+
+    def _write(self, event: dict) -> None:
+        if not self._first:
+            self._fh.write(",\n")
+        self._first = False
+        self._fh.write(json.dumps(event))
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            try:
+                self._fh.write("\n]\n")
+                self._fh.close()
+            except (OSError, ValueError):  # pragma: no cover
+                pass
+
+
+def dump_to_events(dump: dict) -> list:
+    """Render one flight-recorder dump's span trees as Chrome-trace
+    events (so a dump merges into ``tools/merge_timeline.py``'s
+    postmortem view alongside timeline and full-level trace files)."""
+    rank = int(dump.get("rank", 0))
+    mono0 = float(dump.get("mono0", 0.0))
+    events = [
+        {"name": "process_name", "ph": "M", "pid": rank,
+         "args": {"name": f"rank {rank} (flight dump)"}},
+        # The merge anchor: rank + wall epoch, so a dump re-bases onto
+        # the shared clock exactly like a timeline/trace file.
+        {"name": "HVD_PROC_META", "ph": "i", "ts": 0.0, "s": "p",
+         "pid": rank, "tid": 0,
+         "args": {"rank": rank,
+                  "epoch_wall_us": float(dump.get("epoch_wall_us", 0.0)),
+                  "writer": "flight_dump"}},
+    ]
+
+    def _walk(d: dict):
+        yield d
+        for c in d.get("children", ()):
+            yield from _walk(c)
+
+    for rec in list(dump.get("steps", ())) + list(
+            dump.get("background", ())):
+        tree = rec.get("spans") or {}
+        for s in _walk(tree):
+            events.append({
+                "name": s.get("name", "?"),
+                "cat": f"TRACE_{str(s.get('phase', '?')).upper()}",
+                "ph": "X",
+                "ts": (float(s.get("t0", 0.0)) - mono0) * 1e6,
+                "dur": max(float(s.get("dur", 0.0)) * 1e6, 0.001),
+                "pid": rank,
+                "tid": _PHASE_TID.get(s.get("phase"), _DEFAULT_TID),
+                "args": {k: v for k, v in s.items()
+                         if k not in ("children",)
+                         and isinstance(v, (int, float, str))},
+            })
+    return events
+
+
+def write_dump_as_chrome_trace(dump: dict, path: str) -> None:
+    """Render one flight-recorder dump as a standalone Chrome trace
+    (for loading an anomaly in Perfetto without the full-level
+    stream)."""
+    with open(path, "w") as fh:
+        json.dump(
+            {"traceEvents": dump_to_events(dump),
+             "displayTimeUnit": "ms"}, fh,
+        )

@@ -1,0 +1,138 @@
+"""Cross-rank straggler detection from per-rank phase summaries.
+
+The reference's stall check (``stall_inspector.cc``) is the only place
+Horovod *names the ranks* a tensor is waiting on; everything else in
+its telemetry is rank-local.  This module is that naming power applied
+to the whole exchange path: every rank's tracer folds its spans into
+``trace.phase_seconds.<phase>`` histograms, the existing heartbeat KV
+push ships each rank's metrics snapshot to the elastic driver, and the
+driver aggregates them here — per rank, per phase — to answer *which
+rank is holding everyone up, and in which phase*.
+
+Detection is a median test: for each phase, take the p50 across ranks;
+a rank whose own p50 exceeds ``HVD_TPU_TRACE_STRAGGLER_Z`` x the
+median-rank p50 (default 2x, with a 0.1 ms absolute floor so idle-fast
+phases cannot flag on jitter) is a straggler.  Results publish as
+``trace.straggler{rank=,phase=}`` gauges (value = the ratio) and as
+the ``/trace`` endpoint's summary (``runner/telemetry_http.py``).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from .. import metrics
+from ..utils import env
+
+PHASE_PREFIX = "trace.phase_seconds."
+DEFAULT_Z = 2.0
+# Absolute floor (seconds): a phase whose p50 is under this never
+# flags — sub-0.1ms spans are measurement noise, not stragglers.
+_MIN_P50_S = 1e-4
+
+
+def straggler_z() -> float:
+    return max(1.0, env.get_float(env.TRACE_STRAGGLER_Z, DEFAULT_Z))
+
+
+def phase_summary(snapshot: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+    """Per-phase {p50, p99, count, sum} extracted from one rank's
+    metrics snapshot (the JSON form workers push over the KV store)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for name, hist in (snapshot.get("histograms") or {}).items():
+        if not name.startswith(PHASE_PREFIX):
+            continue
+        phase = name[len(PHASE_PREFIX):]
+        count = int(hist.get("count", 0))
+        if count <= 0:
+            continue
+        out[phase] = {
+            "p50": metrics.hist_quantile(hist, 0.5),
+            "p99": metrics.hist_quantile(hist, 0.99),
+            "count": count,
+            "sum": float(hist.get("sum", 0.0)),
+        }
+    return out
+
+
+def _counter(snapshot: Dict[str, Any], name: str) -> int:
+    return int((snapshot.get("counters") or {}).get(name, 0))
+
+
+def _gauge(snapshot: Dict[str, Any], name: str) -> Optional[float]:
+    for g in snapshot.get("gauges") or ():
+        if g.get("name") == name and not g.get("labels"):
+            return float(g.get("value"))
+    return None
+
+
+def detect(per_rank: Dict[int, Dict[str, Any]],
+           z: Optional[float] = None) -> List[Dict[str, Any]]:
+    """Find (rank, phase) stragglers across rank snapshots.  Returns a
+    list sorted worst-first: ``{"rank", "phase", "p50",
+    "median_p50", "ratio"}``.  Needs >= 2 ranks reporting a phase —
+    there is no median to be slower than otherwise."""
+    z = straggler_z() if z is None else float(z)
+    summaries = {r: phase_summary(s) for r, s in per_rank.items()}
+    phases = sorted({p for s in summaries.values() for p in s})
+    found: List[Dict[str, Any]] = []
+    for phase in phases:
+        p50s = {
+            r: s[phase]["p50"] for r, s in summaries.items()
+            if phase in s and s[phase]["p50"] is not None
+        }
+        if len(p50s) < 2:
+            continue
+        # Lower median: with two ranks the baseline must be the OTHER
+        # rank, not the straggler itself.
+        ordered = sorted(p50s.values())
+        median = ordered[(len(ordered) - 1) // 2]
+        for rank, p50 in p50s.items():
+            if p50 <= _MIN_P50_S:
+                continue
+            baseline = max(median, _MIN_P50_S)
+            if p50 > z * baseline:
+                found.append({
+                    "rank": rank,
+                    "phase": phase,
+                    "p50": p50,
+                    "median_p50": median,
+                    "ratio": p50 / baseline,
+                })
+    return sorted(found, key=lambda f: -f["ratio"])
+
+
+def publish(stragglers: List[Dict[str, Any]]) -> None:
+    """Publish ``trace.straggler{rank=,phase=}`` gauges (value = the
+    p50 ratio over the median rank).  The family is cleared first so a
+    recovered rank's series disappears instead of pinning its last
+    ratio."""
+    metrics.clear_gauge("trace.straggler")
+    metrics.set_gauge("trace.stragglers", len(stragglers))
+    for f in stragglers:
+        metrics.set_gauge(
+            "trace.straggler", f["ratio"],
+            {"rank": str(f["rank"]), "phase": f["phase"]},
+        )
+
+
+def trace_payload(per_rank: Dict[int, Dict[str, Any]],
+                  z: Optional[float] = None) -> Dict[str, Any]:
+    """The ``/trace`` endpoint body: per-rank phase summaries + anomaly
+    dump indices (from each rank's own flight-recorder counters) + the
+    cross-rank straggler verdicts, one detection pass per scrape."""
+    stragglers = detect(per_rank, z=z)
+    publish(stragglers)
+    ranks = {}
+    for rank, snap in sorted(per_rank.items()):
+        ranks[str(rank)] = {
+            "phases": phase_summary(snap),
+            "anomaly_dumps": _counter(snap, "trace.anomaly_dumps"),
+            "last_anomaly_dump": _gauge(snap, "trace.last_anomaly_dump"),
+            "steps": _counter(snap, "trace.steps"),
+        }
+    return {
+        "stragglers": stragglers,
+        "straggler_z": straggler_z() if z is None else float(z),
+        "ranks": ranks,
+    }

@@ -154,6 +154,37 @@ TUNE_DB = "TUNE_DB"
 # model's price for it disagrees with the recorded one by more than
 # this factor in either direction.
 TUNE_STALE_FACTOR = "TUNE_STALE_FACTOR"  # default 4.0
+# End-to-end exchange tracing (trace/): span-based host-side tracing of
+# the whole submission path (queue -> negotiation -> cache -> lowering
+# -> rail phases) plus the per-rank flight recorder.
+#   off     = every span call is a shared no-op (zero allocation);
+#   summary = (default) spans feed the trace.phase_seconds.* histograms
+#             and the flight-recorder ring, no per-span file output;
+#   full    = summary + each rank streams its span trees as Chrome-
+#             trace JSON (trace_rank<r>.json under HVD_TPU_TRACE_DIR,
+#             mergeable by tools/merge_timeline.py).
+# Tracing is host-side only: it inserts no ops into a traced step, so
+# losses are bitwise identical at every level.  See docs/tracing.md.
+TRACE = "TRACE"
+# Directory the tracer and flight recorder write to (per-rank Chrome
+# traces at level=full; anomaly dump JSON at any non-off level).
+# Unset = dumps stay in memory (the last one is queryable), no file IO.
+TRACE_DIR = "TRACE_DIR"
+# Flight-recorder ring capacity: the last N steps' span trees kept per
+# rank for anomaly dumps (default 16).
+TRACE_RING = "TRACE_RING"
+# Anomaly threshold: a step slower than z x the rolling p50 of recent
+# step times dumps the ring (default 3.0).
+TRACE_ANOMALY_Z = "TRACE_ANOMALY_Z"
+# Cross-rank straggler threshold on the driver: a rank whose per-phase
+# p50 exceeds z x the median rank's p50 is flagged in the /trace
+# summary and the trace.straggler{rank=,phase=} gauges (default 2.0).
+TRACE_STRAGGLER_Z = "TRACE_STRAGGLER_Z"
+# Async-service negotiation stall timeout (seconds, default 60): a
+# submission stuck in negotiation past this emits a svc.stall warning
+# naming the missing participants (the PR 2 stall inspector extended to
+# the service's producer-level bitvector).
+STALL_TIMEOUT = "STALL_TIMEOUT"
 
 # Launcher-provided rendezvous env (analog of reference gloo_run.py:65-103).
 RANK = "RANK"

@@ -218,19 +218,32 @@ def stop_timeline() -> None:
 # ---- cross-rank merge (tools/merge_timeline.py CLI) ----------------------
 
 
-def _load_trace_events(path: str) -> list:
-    """Read one trace file: a bare JSON array (this writer's format) or
-    a ``{"traceEvents": [...]}`` object (Chrome's).
+def _load_trace_events(path: str, status: Optional[dict] = None) -> list:
+    """Read one trace file: a bare JSON array (this writer's and the
+    trace exporter's format), a ``{"traceEvents": [...]}`` object
+    (Chrome's), or a flight-recorder dump (``{"steps": [...]}`` —
+    rendered to events via ``trace/export.py``).
 
     A trace whose writer died mid-job (worker crash, driver terminate)
     has no closing bracket; the Chrome trace format itself permits that
     for exactly this reason, so fall back to salvaging the complete
-    events line by line (this writer emits one event per line)."""
-    with open(path) as fh:
-        text = fh.read()
+    events line by line (this writer emits one event per line).
+
+    ``status`` (a dict, mutated in place) reports how the file parsed:
+    ``ok`` | ``salvaged`` (line-by-line recovery) | ``empty`` (parsed
+    but no events) | ``error`` (unreadable / zero events recovered) —
+    the per-file parse report ``tools/merge_timeline.py`` prints
+    instead of silently dropping a rank."""
+    status = status if status is not None else {}
+    try:
+        with open(path) as fh:
+            text = fh.read()
+    except OSError as e:
+        status.update(status="error", detail=str(e), events=0)
+        return []
     try:
         data = json.loads(text)
-    except json.JSONDecodeError:
+    except json.JSONDecodeError as e:
         events = []
         for line in text.splitlines():
             line = line.strip().rstrip(",").strip()
@@ -240,13 +253,50 @@ def _load_trace_events(path: str) -> list:
                 events.append(json.loads(line))
             except json.JSONDecodeError:
                 continue  # the torn tail of the last write
+        if events:
+            status.update(status="salvaged", detail=str(e),
+                          events=len(events))
+        else:
+            status.update(status="error",
+                          detail=f"no events salvageable: {e}", events=0)
         return events
     if isinstance(data, dict):
-        return list(data.get("traceEvents", []))
-    return list(data)
+        if "traceEvents" in data:
+            events = list(data["traceEvents"])
+        elif "steps" in data or "background" in data:
+            # A flight-recorder dump: render its span trees as events
+            # so an anomaly dump merges into the postmortem view.
+            from ..trace.export import dump_to_events
+
+            events = dump_to_events(data)
+        else:
+            events = []
+    else:
+        events = list(data)
+    status.update(
+        status="ok" if events else "empty",
+        detail="", events=len(events),
+    )
+    return events
 
 
-def merge_timeline_files(paths) -> dict:
+# Categories that get their own named lane in the merged view: the
+# scheduler's per-bucket dispatch lane, the async service's submission
+# lane, the hierarchical phase lane, and every per-workload
+# <KIND>_EXCHANGE lane the XIR interpreter emits.  TRACE_* categories
+# (the trace exporter) already carry their own thread_name metadata.
+_LANE_CATS = ("SCHED_EXCHANGE", "SVC_EXCHANGE", "TOPO_PHASE")
+
+
+def _lane_cat(cat: Optional[str]) -> Optional[str]:
+    if not cat:
+        return None
+    if cat in _LANE_CATS or cat.endswith("_EXCHANGE"):
+        return cat
+    return None
+
+
+def merge_timeline_files(paths, report: Optional[list] = None) -> dict:
     """Align N per-rank traces into one Chrome trace with per-rank
     lanes.
 
@@ -256,22 +306,33 @@ def merge_timeline_files(paths) -> dict:
     per-process ``perf_counter`` zeros (and wall clocks) are skewed.
     Lanes: ``pid`` is rewritten to the rank (with matching
     ``process_sort_index``), so Perfetto orders lanes rank 0..N-1
-    top-down.  Files without metadata (pre-merge traces) fall back to
-    their position in ``paths`` with a zero epoch, and merge with a
-    warning rather than failing the whole postmortem.
+    top-down; events in the known activity lanes (SCHED_EXCHANGE /
+    SVC_EXCHANGE / TOPO_PHASE / <KIND>_EXCHANGE) get a named thread
+    lane per rank instead of piling onto the dispatch thread.  Files
+    without metadata (pre-merge traces) fall back to their position in
+    ``paths`` with a zero epoch, and merge with a warning rather than
+    failing the whole postmortem.
+
+    ``report`` (a list, appended in ``paths`` order) collects one
+    per-file parse record: ``{"path", "status", "events", "rank",
+    "detail"}`` with status ``ok``/``salvaged``/``empty``/``error`` —
+    the CLI's per-file report, so an unparseable rank is named, not
+    silently dropped.
     """
     from .logging import get_logger
 
-    loaded = []  # (rank, epoch_wall_us, events)
+    loaded = []  # (rank, epoch_wall_us, events, source_index)
     for i, path in enumerate(paths):
-        events = _load_trace_events(path)
+        status: dict = {}
+        events = _load_trace_events(path, status)
         meta = next(
             (e for e in events if e.get("name") == "HVD_PROC_META"), None
         )
         if meta is not None:
             args = meta["args"]
         else:
-            # Native-core traces carry the merge metadata in a JSON
+            # Native-core traces (and the trace exporter's sidecar-less
+            # crashed writers) carry the merge metadata in a JSON
             # sidecar (the C writer's event ABI has no args payload).
             args = None
             try:
@@ -280,29 +341,61 @@ def merge_timeline_files(paths) -> dict:
             except (OSError, ValueError):
                 pass
         if args is None:
-            get_logger().warning(
-                "%s has no HVD_PROC_META event or .hvdmeta.json "
-                "sidecar; assuming rank %d with epoch 0 (timestamps "
-                "will not align across files)", path, i,
-            )
+            if events:
+                get_logger().warning(
+                    "%s has no HVD_PROC_META event or .hvdmeta.json "
+                    "sidecar; assuming rank %d with epoch 0 (timestamps "
+                    "will not align across files)", path, i,
+                )
+                if status.get("status") == "ok":
+                    status["status"] = "no_meta"
             rank, epoch = i, 0.0
         else:
             rank = int(args.get("rank", i))
             epoch = float(args.get("epoch_wall_us", 0.0))
-        loaded.append((rank, epoch, events))
+        if report is not None:
+            report.append({
+                "path": path, "rank": rank,
+                "status": status.get("status", "error"),
+                "events": status.get("events", len(events)),
+                "detail": status.get("detail", ""),
+            })
+        loaded.append((rank, epoch, events, i))
 
-    base = min((epoch for _, epoch, _ in loaded), default=0.0)
+    base = min((epoch for _, epoch, _, _ in loaded), default=0.0)
     merged: list = []
-    for rank, epoch, events in sorted(loaded, key=lambda t: t[0]):
+    lane_tids: dict = {}  # (rank, cat) -> tid
+    files_per_rank: dict = {}  # rank -> files merged so far
+    for rank, epoch, events, _src in sorted(
+            loaded, key=lambda t: (t[0], t[3])):
+        # Multiple files may legitimately share a rank (a timeline AND
+        # a trace export): offset the later files' thread ids so their
+        # lanes coexist instead of interleaving on tid 0.
+        tid_off = 100 * files_per_rank.get(rank, 0)
+        files_per_rank[rank] = files_per_rank.get(rank, 0) + 1
         offset = epoch - base
         for e in events:
             e = dict(e)
             e["pid"] = rank
+            if tid_off and "tid" in e:
+                e["tid"] = int(e.get("tid", 0)) + tid_off
             if e.get("ph") == "M":
                 if e.get("name") == "process_sort_index":
                     e["args"] = {"sort_index": rank}
             elif "ts" in e:
                 e["ts"] = float(e["ts"]) + offset
+            cat = _lane_cat(e.get("cat"))
+            if cat is not None and e.get("ph") != "M":
+                key = (rank, cat)
+                tid = lane_tids.get(key)
+                if tid is None:
+                    tid = 10 + len([k for k in lane_tids if k[0] == rank])
+                    lane_tids[key] = tid
+                    merged.append({
+                        "name": "thread_name", "ph": "M", "pid": rank,
+                        "tid": tid, "args": {"name": cat},
+                    })
+                e["tid"] = tid
             merged.append(e)
     return {"traceEvents": merged, "displayTimeUnit": "ms"}
 

@@ -380,24 +380,38 @@ def execute_merged(programs: Sequence[ir.ExchangeProgram],
                     store=store)
             for p, a in zip(programs, args_lists)
         ]
+    from .. import trace
+
     metrics.inc_counter("xir.pipeline.merged_programs", len(programs))
     for p in programs:
         account(p, axis_size)
     rail = pipeline.RailChain()
     outs: List[List[Any]] = [[None] * len(p.ops) for p in programs]
-    for pi, oi in pipeline.merge_order(programs, axis_size):
-        op = programs[pi].ops[oi]
-        r = pipeline.op_rail(op, axis_size)
-        x = args_lists[pi][oi]
-        leaves = list(x) if isinstance(x, tuple) else [x]
-        leaves = rail.tie(leaves, (r,))
-        x = tuple(leaves) if isinstance(x, tuple) else leaves[0]
-        with jax.named_scope(
-            f"hvd_xir_merged_{programs[pi].kind}_{op.op}{op.bucket}_{r}"
-        ):
-            out = run_op(op, x, process_set=process_set)
-        rail.bump(out[0] if isinstance(out, tuple) else out, (r,))
-        outs[pi][oi] = out
+    with trace.span(
+        "exchange.merged", "exchange",
+        kind="+".join(p.kind for p in programs),
+    ):
+        for pi, oi in pipeline.merge_order(programs, axis_size):
+            op = programs[pi].ops[oi]
+            r = pipeline.op_rail(op, axis_size)
+            x = args_lists[pi][oi]
+            leaves = list(x) if isinstance(x, tuple) else [x]
+            leaves = rail.tie(leaves, (r,))
+            x = tuple(leaves) if isinstance(x, tuple) else leaves[0]
+            # The merged op's span is rail-attributed at the RailChain
+            # boundary it chains on: the measured rail_busy_frac sees
+            # the rider's traffic on the rail the merge placed it on.
+            with trace.span(
+                f"{programs[pi].kind}.{op.op}{op.bucket}",
+                "merged_op", rail=r,
+                ctx=programs[pi].trace, kind=programs[pi].kind,
+            ), jax.named_scope(
+                f"hvd_xir_merged_{programs[pi].kind}_{op.op}"
+                f"{op.bucket}_{r}"
+            ):
+                out = run_op(op, x, process_set=process_set)
+            rail.bump(out[0] if isinstance(out, tuple) else out, (r,))
+            outs[pi][oi] = out
     return outs
 
 
@@ -412,10 +426,17 @@ def execute(program: ir.ExchangeProgram,
     gradient workloads use — the bucketed dense-gradient path drives
     the interpreter through ``sched/execute.py`` instead (its payloads
     interleave with backward compute and EF state)."""
+    from .. import trace
+
     if len(args) != len(program.ops):
         raise HorovodTpuError(
             f"program has {len(program.ops)} ops but {len(args)} "
             "payloads were passed"
+        )
+    if program.trace is None and trace.enabled():
+        program = program.with_trace(
+            trace.current_context()
+            or trace.new_context(f"xir.{program.kind}")
         )
     if not program.lowered:
         # Service producer path (svc/): non-gradient workloads submit
@@ -435,10 +456,14 @@ def execute(program: ir.ExchangeProgram,
         program = lower_mod._store_sync(program)
     account(program, axis_size)
     outs = []
-    for op, x in zip(program.ops, args):
-        with jax.named_scope(
-            f"hvd_xir_{program.kind}_{op.op}{op.bucket}_{op.wire}"
-            f"_{op.lowering}"
-        ):
-            outs.append(run_op(op, x, process_set=process_set))
+    with trace.span(
+        f"exchange.{program.kind}", "exchange", ctx=program.trace,
+        kind=program.kind, ops=len(program.ops),
+    ):
+        for op, x in zip(program.ops, args):
+            with jax.named_scope(
+                f"hvd_xir_{program.kind}_{op.op}{op.bucket}_{op.wire}"
+                f"_{op.lowering}"
+            ):
+                outs.append(run_op(op, x, process_set=process_set))
     return outs

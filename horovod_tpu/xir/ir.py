@@ -173,6 +173,13 @@ class ExchangeProgram:
 
     kind: str
     ops: Tuple[ExchangeOp, ...]
+    # Trace correlation (trace/context.py), attached by the producer
+    # that built the program.  Excluded from equality and signature():
+    # trace ids differ per submission, while the signature must stay
+    # the ResponseCache/tune-DB identity of the exchange *shape*.
+    trace: Any = dataclasses.field(
+        default=None, compare=False, repr=False
+    )
 
     def __post_init__(self):
         object.__setattr__(self, "ops", tuple(self.ops))
@@ -185,6 +192,12 @@ class ExchangeProgram:
         subgraphs (the determinism contract plan signatures already
         carry, extended with the workload kind)."""
         return (self.kind, tuple(op.signature() for op in self.ops))
+
+    def with_trace(self, ctx) -> "ExchangeProgram":
+        """Copy carrying a :class:`~horovod_tpu.trace.context.
+        TraceContext` — signature/equality (and thus every cache key)
+        unchanged."""
+        return dataclasses.replace(self, trace=ctx)
 
     @property
     def lowered(self) -> bool:

@@ -186,7 +186,8 @@ def _store_sync(program: ir.ExchangeProgram) -> ir.ExchangeProgram:
         ops.append(_with_backend(
             op.replace(wire=new_wire, lowering=new_lower)
         ))
-    return ir.program(program.kind, ops)
+    synced = ir.program(program.kind, ops)
+    return synced.with_trace(program.trace) if program.trace else synced
 
 
 def lower(program: ir.ExchangeProgram,
@@ -197,16 +198,24 @@ def lower(program: ir.ExchangeProgram,
     model when known at plan time (``None`` prices the full world).
     ``store=False`` skips the persistent-DB sync (the dense-gradient
     path owns its own store handshake through ``ScheduleTuner``)."""
-    ops = []
-    for op in program.ops:
-        wire = ir.eligible_wire(op.op, op.wire, op.attr("dtype"))
-        lowering = resolve_lowering(op, axis_size)
-        ops.append(_with_backend(
-            op.replace(wire=wire, lowering=lowering)
-        ))
-    lowered = ir.program(program.kind, ops)
-    if store:
-        lowered = _store_sync(lowered)
+    from .. import trace
+
+    with trace.span(
+        f"lower.{program.kind}", "lower",
+        ctx=program.trace, kind=program.kind, ops=len(program.ops),
+    ):
+        ops = []
+        for op in program.ops:
+            wire = ir.eligible_wire(op.op, op.wire, op.attr("dtype"))
+            lowering = resolve_lowering(op, axis_size)
+            ops.append(_with_backend(
+                op.replace(wire=wire, lowering=lowering)
+            ))
+        lowered = ir.program(program.kind, ops)
+        if program.trace is not None:
+            lowered = lowered.with_trace(program.trace)
+        if store:
+            lowered = _store_sync(lowered)
     return lowered
 
 

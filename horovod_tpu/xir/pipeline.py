@@ -268,6 +268,23 @@ class RailChain:
             self._tok[r] = tok
 
 
+def measured_rail_busy() -> Dict[str, Optional[float]]:
+    """The measured per-rail utilization this process last published:
+    ``{"ici": frac, "dcn": frac}`` from the ``topo.rail_busy_frac``
+    gauges the tracer derives out of the rail-phase spans emitted at
+    the RailChain boundaries (``trace/tracer.py``).  ``None`` per rail
+    until a traced step with hier buckets has run — this is the
+    *measured* counterpart to :func:`estimate_schedule_cost`'s modeled
+    overlap, the gauge the pipeliner's speedup claims are checked
+    against (docs/tracing.md)."""
+    from .. import metrics
+
+    return {
+        r: metrics.get_gauge("topo.rail_busy_frac", {"rail": r})
+        for r in RailChain.RAILS
+    }
+
+
 # --------------------------------------------------- workload merging
 
 def _op_rail_split(op, axis_size: Optional[int]) -> Tuple[float, float]:

@@ -378,6 +378,121 @@ class TestServiceFaults:
         assert lowered.lowered
         assert metrics.get_counter("svc.fallback_sync") >= 1
 
+    def _assert_depth_gauges_zero(self, producers):
+        # The PR 13 satellite contract: after ANY fault-injection path,
+        # every queue-depth gauge — global and per-producer — decays to
+        # 0: a submission that degraded to inline dispatch after a
+        # service death must not leave the gauge it incremented.
+        assert metrics.get_gauge("svc.queue_depth") in (0, 0.0), \
+            "global svc.queue_depth did not decay to 0"
+        for prod in producers:
+            g = metrics.get_gauge("svc.queue_depth", {"producer": prod})
+            assert g in (None, 0, 0.0), \
+                f"svc.queue_depth{{producer={prod}}} leaked at {g}"
+
+    def test_queue_depth_decays_to_zero_after_loop_fault(self):
+        faults.set_plan("svc.loop:error:nth=1")
+        s = svc.get_service()
+        x = jnp.ones((N, 4), jnp.float32)
+        futs = [
+            s.submit(_ar_program(nbytes=16, bucket=i), [x],
+                     producer=f"p{i % 2}")
+            for i in range(4)
+        ]
+        for f in futs:
+            f.result(timeout=60)
+        assert s.dead
+        self._assert_depth_gauges_zero(["p0", "p1"])
+        # submissions AFTER the death take the closed-queue fallback
+        # and must not resurrect any depth series
+        out = s.submit(_ar_program(nbytes=8), [x],
+                       producer="late").result(timeout=60)
+        assert out is not None
+        self._assert_depth_gauges_zero(["p0", "p1", "late"])
+
+    def test_queue_depth_decays_to_zero_after_submit_and_drain_faults(self):
+        for site in ("svc.submit", "svc.drain"):
+            svc.reset_service()
+            metrics.reset_counters("svc.")
+            faults.set_plan(f"{site}:error:nth=1")
+            s = svc.get_service()
+            x = jnp.ones((N, 2), jnp.float32)
+            if site == "svc.drain":
+                s.submit(_ar_program(nbytes=8), [x], producer="a")
+                s.drain(timeout_s=5)
+            else:
+                s.submit(_ar_program(nbytes=8), [x],
+                         producer="a").result(timeout=60)
+            assert s.dead
+            self._assert_depth_gauges_zero(["a"])
+            faults.set_plan(None)
+
+    def test_dead_service_loop_thread_terminates(self):
+        # The loop must EXIT after a kill, not spin hot on the closed
+        # queue (the pre-PR-13 behavior burned a core per dead service).
+        faults.set_plan("svc.loop:error:nth=1")
+        s = svc.get_service()
+        x = jnp.ones((N, 2), jnp.float32)
+        s.submit(_ar_program(nbytes=8), [x], producer="t").result(
+            timeout=60)
+        assert s.dead
+        t = s._thread
+        if t is not None:
+            t.join(timeout=10)
+            assert not t.is_alive(), "dead service loop still running"
+
+
+class TestNegotiationStallInspector:
+    def test_stall_names_missing_participants(self, caplog):
+        neg = Negotiator()
+        prog = _ar_program(kind="stallk")
+        sub = _sub(prog, producer="a", participants=("a", "b", "ghost"))
+        assert neg.post(sub) == []
+        # nothing stalls before the timeout
+        assert neg.check_stalls(timeout_s=60.0) == []
+        reports = neg.check_stalls(timeout_s=0.0)
+        assert len(reports) == 1
+        assert reports[0]["missing"] == ["b", "ghost"]
+        assert reports[0]["posted"] == ["a"]
+        assert sorted(reports[0]["expected"]) == ["a", "b", "ghost"]
+        assert metrics.get_counter("svc.stall") == 1
+        assert metrics.get_gauge("svc.stalled_negotiations") == 1
+        # warn-once: a second sweep reports but does not re-count
+        neg.check_stalls(timeout_s=0.0)
+        assert metrics.get_counter("svc.stall") == 1
+        # completion clears the stall bookkeeping
+        for prod in ("b", "ghost"):
+            neg.post(_sub(prog, producer=prod,
+                          participants=("a", "b", "ghost")))
+        assert neg.check_stalls(timeout_s=0.0) == []
+        assert metrics.get_gauge("svc.stalled_negotiations") == 0
+
+    def test_service_loop_runs_stall_check(self):
+        import time as _time
+
+        from horovod_tpu.utils import env as hvd_env
+
+        # A 2-participant program with one producer missing: the live
+        # service loop itself must emit the svc.stall warning once the
+        # (tiny) timeout passes — no drain needed to see it.
+        hvd_env.set_env(hvd_env.STALL_TIMEOUT, "0.2")
+        try:
+            s = svc.get_service()
+            x = jnp.ones((N, 2), jnp.float32)
+            s.submit(_ar_program(nbytes=8), [x], producer="a",
+                     participants=("a", "never"))
+            deadline = _time.monotonic() + 15
+            while metrics.get_counter("svc.stall") == 0 \
+                    and _time.monotonic() < deadline:
+                _time.sleep(0.05)
+            assert metrics.get_counter("svc.stall") >= 1, \
+                "service loop never flagged the stalled negotiation"
+        finally:
+            import os
+
+            os.environ.pop("HVD_TPU_STALL_TIMEOUT", None)
+            svc.reset_service()
+
 
 def _train(svc_on, iters=6, lr=0.05):
     svc.set_enabled_override(svc_on)

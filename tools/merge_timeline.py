@@ -1,49 +1,97 @@
 #!/usr/bin/env python
-"""Merge N per-rank timeline traces into one Chrome trace.
+"""Merge N per-rank timeline/trace files into one Chrome trace.
 
-Each worker writes its own ``HVD_TPU_TIMELINE`` file with relative
-timestamps; the ``HVD_PROC_META`` event stamped at the head of every
-trace carries the rank and wall-clock epoch base that let this CLI
-re-base them onto one shared clock with per-rank lanes::
+Each worker writes its own ``HVD_TPU_TIMELINE`` file (and, with
+``HVD_TPU_TRACE=full``, a ``trace_rank<r>.json`` span export under
+``HVD_TPU_TRACE_DIR``) with relative timestamps; the ``HVD_PROC_META``
+event stamped at the head of every file — or the ``.hvdmeta.json``
+sidecar next to native-core traces — carries the rank and wall-clock
+epoch base that let this CLI re-base them onto one shared clock with
+per-rank lanes::
 
-    python tools/merge_timeline.py /tmp/timeline.rank*.json -o merged.json
+    python tools/merge_timeline.py /tmp/timeline.rank*.json \
+        /tmp/traces/trace_rank*.json -o merged.json
 
 Load ``merged.json`` in Perfetto / chrome://tracing: one lane per rank,
-ordered rank 0..N-1, concurrent collectives aligned.
+ordered rank 0..N-1, concurrent collectives aligned, with named
+sub-lanes for the SCHED_EXCHANGE / SVC_EXCHANGE / TOPO_PHASE /
+<KIND>_EXCHANGE activities and the trace exporter's span lanes.
+Flight-recorder dumps (``flight_rank<r>_<n>.json``) merge too — their
+span trees render as events.
+
+Every input file gets a line in the parse report; a file that yields
+zero events (unreadable, torn beyond salvage, or empty) makes the exit
+code non-zero so a postmortem script cannot silently lose a rank.
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
+
+# Runnable straight from a checkout (python tools/merge_timeline.py):
+# put the repo root on the path when horovod_tpu isn't installed.
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
 
 
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
-        description="Merge per-rank horovod_tpu timeline traces into "
-        "one Chrome trace with per-rank lanes."
+        description="Merge per-rank horovod_tpu timeline/trace files "
+        "into one Chrome trace with per-rank lanes."
     )
     parser.add_argument("traces", nargs="+",
-                        help="per-rank timeline JSON files")
+                        help="per-rank timeline/trace/flight-dump "
+                        "JSON files")
     parser.add_argument("-o", "--output", default="merged_timeline.json",
                         help="merged Chrome trace path "
                         "(default: %(default)s)")
     parser.add_argument("--indent", type=int, default=None,
                         help="pretty-print the merged JSON")
+    parser.add_argument("--strict", action="store_true",
+                        help="also fail (exit 2) on files that needed "
+                        "line-by-line salvage or lacked merge metadata")
     args = parser.parse_args(argv)
 
     from horovod_tpu.utils.timeline import merge_timeline_files
 
-    merged = merge_timeline_files(args.traces)
+    report: list = []
+    merged = merge_timeline_files(args.traces, report=report)
     with open(args.output, "w") as fh:
         json.dump(merged, fh, indent=args.indent)
-    ranks = sorted({e.get("pid") for e in merged["traceEvents"]})
+
+    bad_statuses = {"error", "empty"}
+    if args.strict:
+        bad_statuses |= {"salvaged", "no_meta"}
+    failed = [r for r in report if r["status"] in bad_statuses]
+    for r in report:
+        line = (
+            f"  [{r['status']:>8}] {r['path']} "
+            f"(rank {r['rank']}, {r['events']} events)"
+        )
+        if r["detail"]:
+            line += f" — {r['detail']}"
+        print(line, file=sys.stderr if r["status"] in bad_statuses
+              else sys.stdout)
+
+    ranks = sorted({
+        e.get("pid") for e in merged["traceEvents"]
+        if e.get("pid") is not None
+    })
     print(
-        f"merged {len(args.traces)} trace(s), "
+        f"merged {len(args.traces)} file(s), "
         f"{len(merged['traceEvents'])} events, lanes {ranks} "
         f"-> {args.output}"
     )
+    if failed:
+        print(
+            f"ERROR: {len(failed)} of {len(report)} input file(s) "
+            "contributed no usable events (see the report above)",
+            file=sys.stderr,
+        )
+        return 2
     return 0
 
 
