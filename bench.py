@@ -495,6 +495,45 @@ def _device_free_records(result: dict, deadline_s: float,
     _maybe_quant_backend(result, deadline_s, t_start)
     _maybe_adasum(result, deadline_s, t_start)
     _maybe_railpipe(result, deadline_s, t_start)
+    _maybe_svc_fusion(result, deadline_s, t_start)
+
+
+def _maybe_svc_fusion(result: dict, deadline_s: float,
+                      t_start: float) -> None:
+    """Append the ``svc_fusion_amortization`` record
+    (HVD_BENCH_FUSION=0 skips): the service-side fusion buffer's
+    step-time speedup on the N=32 small-program workload, fused vs
+    serial dispatch, via ``tools/topo_bench.py --fusion`` in a
+    scrubbed 8-device CPU subprocess (docs/exchange_service.md
+    "Fusion buffers").  Structured-skip on deadline pressure like the
+    other device-free records."""
+    if os.environ.get("HVD_BENCH_FUSION", "1") == "0":
+        return
+    if deadline_s - (time.monotonic() - t_start) < 75:
+        result["svc_fusion_amortization"] = {
+            "error": "skipped: deadline too close"
+        }
+        return
+    try:
+        import subprocess as sp
+
+        repo = os.path.dirname(os.path.abspath(__file__))
+        env = _scrubbed_cpu_env()
+        env.setdefault("HVD_TPU_TOPO", "2x4")
+        out = sp.run(
+            [sys.executable, os.path.join(repo, "tools", "topo_bench.py"),
+             "--fusion"],
+            capture_output=True, text=True, timeout=300, env=env, cwd=repo,
+        )
+        line = (out.stdout or "").strip().splitlines()
+        result["svc_fusion_amortization"] = (
+            json.loads(line[-1]) if out.returncode == 0 and line
+            else {"error": f"rc={out.returncode}: {(out.stderr or '')[-300:]}"}
+        )
+    except Exception as e:
+        result["svc_fusion_amortization"] = {
+            "error": f"{type(e).__name__}: {e}"
+        }
 
 
 def _maybe_railpipe(result: dict, deadline_s: float,

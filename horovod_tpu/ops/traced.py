@@ -411,9 +411,18 @@ def grouped_allreduce(
     (reference ``EnqueueTensorAllreduces`` + GroupTable,
     ``operations.cc:1487-1492``).
 
-    Tensors are flattened and concatenated per dtype into single flat
-    buffers — the explicit analog of the reference's fusion buffer — so
-    the group completes as one XLA collective per dtype.
+    Tensors pack through the service-side FusionPacker
+    (``svc/fuse.pack_leaves``): flattened and concatenated per dtype
+    into single flat buffers at block-size-aligned offsets — the
+    explicit analog of the reference's fusion buffer, and the SAME
+    layout rule the exchange service packs cycle batches with — so the
+    group completes as one XLA collective per dtype (one fused wire
+    buffer instead of per-tensor collectives).  Values are bitwise
+    identical to per-tensor dispatch (elementwise reductions commute
+    with concatenation; padding lanes never reach a member's slice),
+    and the eager layer's ``topo.obs`` dispatch tagging is untouched —
+    the fused buffer's latency feeds the measured cost model exactly
+    as before.
     """
     if env.get_bool(env.DISABLE_GROUP_FUSION):
         # Reference HOROVOD_DISABLE_GROUP_FUSION: keep the group atomic
@@ -426,21 +435,27 @@ def grouped_allreduce(
             )
             for x in xs
         ]
-    from .fusion import flatten_group, unflatten_group
+    from ..svc import fuse as svc_fuse
 
-    flats, meta = flatten_group(xs)
+    packed = svc_fuse.pack_leaves(xs)
+    from .. import metrics as _metrics
+
+    _metrics.inc_counter("svc.fusion.grouped_buffers", len(packed))
+    _metrics.inc_counter("svc.fusion.grouped_members", len(xs))
     reduced = [
         allreduce(
-            f,
+            buf,
             axis=axis,
             op=op,
             prescale_factor=prescale_factor,
             postscale_factor=postscale_factor,
             process_set=process_set,
         )
-        for f in flats
+        for buf, _ in packed
     ]
-    return unflatten_group(reduced, meta)
+    return svc_fuse.unpack_leaves(
+        reduced, [meta for _, meta in packed], len(xs)
+    )
 
 
 def allgather(

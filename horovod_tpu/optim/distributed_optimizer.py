@@ -763,8 +763,10 @@ class TrainStep:
     """
 
     def __init__(
-        self, loss_fn, optimizer, *, axis=WORLD_AXIS, has_aux=False, stateful=False
+        self, loss_fn, optimizer, *, axis=WORLD_AXIS, has_aux=False,
+        stateful=False, donate=True,
     ):
+        self._donate = bool(donate)
         if stateful and has_aux:
             raise ValueError(
                 "stateful=True and has_aux=True are mutually exclusive: a "
@@ -921,6 +923,11 @@ class TrainStep:
         out_specs += (specs, P())
         if self.has_aux and not self.stateful:
             out_specs += (P(),)
+        # Donate params / model state / optimizer state — the pytrees
+        # the step returns updated — so XLA aliases them in place
+        # instead of copying the full parameter set in HBM every step.
+        # ``donate=False`` (the numerics-parity test hook) keeps the
+        # inputs alive and must produce bitwise-identical losses.
         return jax.jit(
             jax.shard_map(
                 self._step_body,
@@ -929,7 +936,7 @@ class TrainStep:
                 out_specs=out_specs,
                 check_vma=False,
             ),
-            donate_argnums=(0, 1, 2),
+            donate_argnums=(0, 1, 2) if self._donate else (),
         )
 
     def __call__(self, params, *args):
@@ -1045,6 +1052,7 @@ def distributed_train_step(
     axis=WORLD_AXIS,
     has_aux: bool = False,
     stateful: bool = False,
+    donate: bool = True,
 ) -> TrainStep:
     """Build the compiled SPMD train step; see ``TrainStep``.
 
@@ -1074,6 +1082,7 @@ def distributed_train_step(
         if why is None:
             return _stale.StaleTrainStep(
                 loss_fn, optimizer.update._hvd_inner, axis=axis,
+                donate=donate,
             )
         from ..utils.logging import get_logger
 
@@ -1082,5 +1091,6 @@ def distributed_train_step(
             "running the synchronous step", _svc.staleness(), why,
         )
     return TrainStep(
-        loss_fn, optimizer, axis=axis, has_aux=has_aux, stateful=stateful
+        loss_fn, optimizer, axis=axis, has_aux=has_aux,
+        stateful=stateful, donate=donate,
     )

@@ -54,7 +54,7 @@ _warned_paths: Set[str] = set()
 _warn_lock = threading.Lock()
 
 
-def knob_fingerprint() -> str:
+def knob_fingerprint(include_svc: bool = True) -> str:
     """Stable digest of every ``HVD_TPU_SCHED*/WIRE*/TOPO*/QUANT*``
     env knob (and its legacy ``HOROVOD_`` spelling): two processes with
     the same fingerprint plan identical schedules from identical
@@ -64,7 +64,14 @@ def knob_fingerprint() -> str:
     just the raw env var): an unset ``HVD_TPU_QUANT_BACKEND`` and an
     explicit ``phase`` mean the same schedules and must share entries,
     while ``fused`` winners — whose exchange wall time has different
-    constants — must never collide with phase ones."""
+    constants — must never collide with phase ones.  The resolved
+    service-fusion pair (``HVD_TPU_SVC_CYCLE_TIME`` /
+    ``HVD_TPU_SVC_FUSION_THRESHOLD``, svc/fuse.py + svc/params.py)
+    folds in the same resolved form — schedules tuned under different
+    coalescing regimes have different wall-clock constants —
+    EXCEPT when ``include_svc=False``: the service tuner's own DB
+    entry records the pair as its *payload* and must stay addressable
+    after pinning its winner into those very knobs."""
     items = []
     for k in sorted(os.environ):
         for head in ("HVD_TPU_", "HOROVOD_"):
@@ -93,6 +100,17 @@ def knob_fingerprint() -> str:
         items.append(("HVD_TPU_XIR_PIPELINE(resolved)", _railpipe.mode()))
     except Exception:
         pass
+    if include_svc:
+        try:
+            from ..svc import fuse as _svc_fuse, params as _svc_params
+
+            items.append((
+                "HVD_TPU_SVC_FUSION(resolved)",
+                f"{_svc_fuse.fusion_threshold()}"
+                f":{_svc_params.cycle_time_ms()!r}",
+            ))
+        except Exception:
+            pass
     return hashlib.sha256(
         json.dumps(items, sort_keys=True).encode()
     ).hexdigest()[:16]

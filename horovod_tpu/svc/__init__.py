@@ -28,15 +28,39 @@ one persistent executor owns the wires, everyone else submits plans:
 * :mod:`~horovod_tpu.svc.stale` — bounded staleness
   (``HVD_TPU_SVC_STALENESS=k``): local SGD / delayed DCN sync, where
   the cross-slice hop of step *i* completes during step *i+k*
-  (``svc.overlap_steps``).
+  (``svc.overlap_steps``);
+* :mod:`~horovod_tpu.svc.fuse` — the FusionPacker: a cycle's released
+  submissions coalesce into one padded, block-aligned wire buffer per
+  compatibility class and dispatch as ONE collective (the reference
+  FusionBufferManager), bounded by ``HVD_TPU_SVC_FUSION_THRESHOLD``
+  (0 = off); f32 dense fused is bitwise identical to unfused;
+* :mod:`~horovod_tpu.svc.params` — the ParameterManager-style online
+  tuner for (``HVD_TPU_SVC_CYCLE_TIME``, fusion threshold): window-
+  scored from the metrics registry, persisted in the tune DB, warm-
+  started by later jobs (``HVD_TPU_SVC_TUNE=on``).
 
 ``HVD_TPU_SVC=off`` (the default) keeps every exchange inline exactly
 as before.  See docs/exchange_service.md.
 """
 
-from . import cache, negotiate, queue, service, stale  # noqa: F401
+from . import (  # noqa: F401
+    cache,
+    fuse,
+    negotiate,
+    params,
+    queue,
+    service,
+    stale,
+)
 from .cache import CachedResponse, ResponseCache  # noqa: F401
+from .fuse import (  # noqa: F401
+    FusedBuffer,
+    FusedMember,
+    fusion_threshold,
+    set_threshold_override,
+)
 from .negotiate import Negotiator  # noqa: F401
+from .params import ServiceParameterManager  # noqa: F401
 from .queue import Submission, SvcFuture, TensorQueue  # noqa: F401
 from .service import (  # noqa: F401
     ExchangeService,

@@ -1,0 +1,458 @@
+"""FusionPacker: coalesce a cycle's submissions into one wire dispatch.
+
+The reference's single biggest latency-amortization win is the fusion
+buffer (``fusion_buffer_manager.{h,cc}`` + ``Controller::FuseResponses``,
+arXiv:1802.05799 §4): every tensor the cycle's negotiation released is
+packed into one 64 MiB staging buffer and shipped as ONE collective, so
+N small tensors pay one wire latency instead of N.  Our service loop
+(PR 12) reproduced the *architecture* — queue, negotiation, cache — but
+dispatched each submission separately: N small programs per cycle paid
+N DCN latencies, exactly the regime where per-op dispatch overhead
+dominates (arXiv:1810.11112's small-message analysis).
+
+This module is the packing half of the fix (``svc/service.py`` drives
+it from the cycle loop; ``svc/params.py`` autotunes the knobs):
+
+* **Classification** (:func:`class_key`): two ops may share a buffer
+  only when fusing is *provably* value-preserving — same op kind
+  (``all_reduce`` only: elementwise reductions commute with
+  concatenation), same axis / replica groups / wire format / lowering /
+  reduce semantics / dtype / quantized backend, no error feedback, and
+  never ``hier_adasum`` (its pair coefficients are full-*vector* norms,
+  so fusing would change the algorithm, not just the schedule).
+* **Packing** (:func:`plan_cycle` / :func:`pack_group`): members
+  flatten and concatenate with **block-size-aligned offsets** — the
+  quantization block for int8/fp8 wires, the
+  ``FUSION_BUFFER_ATOMIC_UNIT`` lane tile otherwise — so fp32 block
+  scales never straddle two members and every member's blocks quantize
+  exactly as they would unfused.  Buffers are bounded by
+  ``HVD_TPU_SVC_FUSION_THRESHOLD`` (default 64 MiB, 0 = off);
+  oversize programs pass through unfused.
+* **Determinism**: members pack in ``(producer, seq)`` order — each
+  producer's own program order, producers tie-broken by name — so the
+  fused layout is a pure function of *what* was released, never of the
+  thread interleaving that released it (the cross-process agreement
+  contract the negotiation tests pin).
+
+f32 dense fused is **bitwise identical** to unfused: an elementwise sum
+neither reorders nor regroups per-element contributions when payloads
+are concatenated, and the padding lanes are zeros that never reach a
+member's slice.  Quantized wires are bitwise too (aligned offsets =
+identical blocks = identical scales); the 1e-3 test bound only covers
+accumulated fp noise on the composed train loop.
+
+See docs/exchange_service.md ("Fusion buffers").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .. import metrics
+from ..utils import env
+
+# Op kinds the packer may coalesce.  all_reduce only: reduce_scatter /
+# all_gather change output *shapes* per member (the shard layout of a
+# concatenated buffer is not the concatenation of the members' shard
+# layouts), and the shuffle ops (all_to_all / permute / sparse gather)
+# interleave chunks positionally, so concatenation changes where bytes
+# land.  They all pass through unfused.
+FUSABLE_OPS = ("all_reduce",)
+
+_threshold_override: Optional[int] = None
+
+
+def set_threshold_override(value: Optional[int]) -> None:
+    """Trace/test-time threshold override (the sched config-override
+    pattern); ``None`` restores the env knob."""
+    global _threshold_override
+    _threshold_override = value
+
+
+def fusion_threshold() -> int:
+    """``HVD_TPU_SVC_FUSION_THRESHOLD``: bytes one fused buffer may
+    hold (default 64 MiB — the reference fusion-buffer size).  0
+    disables fusion entirely (every submission dispatches separately,
+    bitwise and metric-identical to the pre-fusion service)."""
+    if _threshold_override is not None:
+        return max(0, int(_threshold_override))
+    return max(0, env.get_int(env.SVC_FUSION_THRESHOLD,
+                              env.DEFAULT_FUSION_THRESHOLD))
+
+
+def align_elems(wire: str, dtype: Any) -> int:
+    """Member alignment in *elements*: quantized wires align to the
+    quantization block so fp32 block scales never straddle members;
+    dense/bf16 wires align to the ``FUSION_BUFFER_ATOMIC_UNIT`` byte
+    tile (reference ``common.h:146``)."""
+    import jax.numpy as jnp
+
+    if (wire or "off") in ("int8", "fp8"):
+        from ..ops.quantized import quant_block
+
+        return quant_block()
+    itemsize = jnp.dtype(dtype or "float32").itemsize
+    return max(1, env.FUSION_BUFFER_ATOMIC_UNIT // itemsize)
+
+
+def class_key(op, axis_size: Optional[int] = None,
+              process_set: Any = None) -> Optional[Tuple]:
+    """Fusion-class identity of one *lowered* op, or ``None`` when the
+    op must not fuse.  Ops with equal keys coalesce into one buffer;
+    the key is everything that must agree for a single collective to
+    serve all members: (op kind, axis, groups, wire, lowering, reduce
+    semantics, dtype, quantized backend, axis size) — the "rail
+    signature" rides on (axis, lowering), which fix the op's ICI/DCN
+    occupancy in the cost model."""
+    if process_set is not None:
+        return None
+    if op.op not in FUSABLE_OPS:
+        return None
+    if op.lowering in ("auto", "hier_adasum"):
+        # auto: not lowered yet (callers classify post-lowering);
+        # hier_adasum: the adaptive combine divides by full-vector
+        # norms — fusing members would change the mathematics.
+        return None
+    if op.ef:
+        return None  # residual threading is per-member state
+    return (
+        op.op, op.axis, op.groups, op.wire, op.lowering,
+        op.attr("reduce") or "sum", op.attr("dtype") or "float32",
+        op.attr("qbackend"), axis_size,
+    )
+
+
+def classify_program(program, axis_size: Optional[int] = None,
+                     process_set: Any = None) -> Optional[Tuple]:
+    """A whole program's fusion class: the shared :func:`class_key` of
+    ALL its ops, or ``None`` when any op is unfusable or the ops
+    disagree (mixed-dtype / mixed-wire programs pass through — fusing
+    a submission partially would split its future across dispatch
+    paths)."""
+    if not program.ops:
+        return None
+    keys = {
+        class_key(op, axis_size, process_set) for op in program.ops
+    }
+    if len(keys) != 1:
+        return None
+    return keys.pop()
+
+
+# ------------------------------------------------------- flat packing
+
+def pack_group(xs: Sequence[Any], align: int):
+    """Concatenate arrays into ONE aligned flat buffer (trace-time or
+    eager): each member flattens, zero-pads up to a multiple of
+    ``align`` elements, and lands at its aligned offset.  Returns
+    ``(buffer, layout)`` with layout entries ``(offset, size, shape)``
+    in input order — :func:`unpack_group` inverts exactly."""
+    import jax.numpy as jnp
+
+    parts = []
+    layout = []
+    offset = 0
+    for x in xs:
+        flat = x.reshape(-1)
+        n = int(flat.shape[0])
+        padded = -(-max(n, 1) // align) * align
+        if padded != n:
+            flat = jnp.pad(flat, (0, padded - n))
+        parts.append(flat)
+        layout.append((offset, n, tuple(x.shape)))
+        offset += padded
+    buf = parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+    return buf, layout
+
+
+def unpack_group(buf, layout) -> List[Any]:
+    """Slice the members back out of a fused buffer (inverse of
+    :func:`pack_group`): padding lanes are dropped, shapes restored."""
+    import jax.lax as lax
+
+    out = []
+    for offset, size, shape in layout:
+        out.append(
+            lax.dynamic_slice_in_dim(buf, offset, size, 0).reshape(shape)
+        )
+    return out
+
+
+def group_layout(shapes: Sequence[Tuple[int, ...]], align: int,
+                 itemsize: int):
+    """The layout :func:`pack_group` would produce for ``shapes``,
+    plus byte accounting — computed host-side so the packer can plan
+    (and meter padding) without touching payloads:
+    ``(layout, total_elems, payload_bytes, padding_bytes)``."""
+    import math
+
+    layout = []
+    offset = 0
+    payload = 0
+    for shape in shapes:
+        n = int(math.prod(shape)) if shape else 1
+        padded = -(-max(n, 1) // align) * align
+        layout.append((offset, n, tuple(shape)))
+        offset += padded
+        payload += n * itemsize
+    return layout, offset, payload, offset * itemsize - payload
+
+
+def pack_leaves(xs: Sequence[Any], align_bytes: Optional[int] = None):
+    """Group a tensor list by dtype into block-aligned fusion buffers —
+    the trace-time packer behind the eager GROUPED dispatch
+    (``ops/traced.grouped_allreduce``): one wire buffer per dtype class
+    instead of one collective per tensor.  Returns
+    ``[(buffer, [(input_index, offset, size, shape)])]`` in
+    first-appearance dtype order."""
+    import jax.numpy as jnp
+
+    by_dtype: Dict[str, List[int]] = {}
+    for i, x in enumerate(xs):
+        by_dtype.setdefault(jnp.dtype(x.dtype).name, []).append(i)
+    packed = []
+    for dt, idxs in by_dtype.items():
+        itemsize = jnp.dtype(dt).itemsize
+        align = (
+            max(1, align_bytes // itemsize) if align_bytes
+            else align_elems("off", dt)
+        )
+        buf, layout = pack_group([xs[i] for i in idxs], align)
+        packed.append(
+            (buf, [(i,) + entry for i, entry in zip(idxs, layout)])
+        )
+    return packed
+
+
+def unpack_leaves(bufs: Sequence[Any], metas, count: int) -> List[Any]:
+    """Inverse of :func:`pack_leaves` over the reduced buffers."""
+    import jax.lax as lax
+
+    out: List[Any] = [None] * count
+    for buf, entries in zip(bufs, metas):
+        for i, offset, size, shape in entries:
+            out[i] = lax.dynamic_slice_in_dim(
+                buf, offset, size, 0
+            ).reshape(shape)
+    return out
+
+
+# ----------------------------------------------------- fused programs
+
+@dataclasses.dataclass
+class FusedMember:
+    """One submission's contribution to a fused buffer: the submission,
+    its lowered program, and one ``(offset, size, shape)`` segment per
+    op (the per-rank layout inside the fused flat buffer)."""
+
+    sub: Any  # svc.queue.Submission
+    program: Any  # lowered xir.ir.ExchangeProgram
+    segments: List[Tuple[int, int, Tuple[int, ...]]]
+
+
+@dataclasses.dataclass
+class FusedBuffer:
+    """One planned wire dispatch: every member's every op coalesced
+    into a single padded flat buffer behind one fused op."""
+
+    key: Tuple
+    members: List[FusedMember]
+    total_elems: int
+    payload_bytes: int
+    padding_bytes: int
+
+    @property
+    def axis_size(self) -> Optional[int]:
+        return self.members[0].sub.axis_size
+
+    def segment_layout(self) -> List[Tuple[int, int, Tuple[int, ...]]]:
+        return [seg for m in self.members for seg in m.segments]
+
+
+def _per_rank_shape(x) -> Tuple[int, ...]:
+    """Per-rank payload shape of a stacked host-path array (row r is
+    rank r's tensor — the eager stacked convention)."""
+    return tuple(x.shape[1:])
+
+
+def plan_cycle(resolved: Sequence[Tuple[Any, Any]],
+               threshold: int):
+    """Partition one cycle's released submissions into fused buffers
+    and unfused passthroughs.
+
+    ``resolved`` is ``[(submission, lowered_program), ...]``.  A
+    submission fuses when its whole program classifies into one
+    :func:`class_key` and its per-rank payload fits the threshold;
+    classes fill greedily in ``(producer, seq)`` order, opening a new
+    buffer whenever the padded total would exceed ``threshold``.
+    Returns ``(buffers, passthrough)`` — passthrough in seq order.
+    """
+    import math
+
+    import jax.numpy as jnp
+
+    passthrough: List[Any] = []
+    candidates: List[Tuple[Tuple, Any, Any, int]] = []
+    for sub, program in resolved:
+        key = classify_program(program, sub.axis_size, sub.process_set)
+        if key is None:
+            passthrough.append(sub)
+            continue
+        itemsize = jnp.dtype(key[6]).itemsize
+        per_rank = sum(
+            max(1, math.prod(_per_rank_shape(x) or (1,)))
+            for x in sub.args
+        ) * itemsize
+        if threshold and per_rank > threshold:
+            metrics.inc_counter("svc.fusion.oversize")
+            passthrough.append(sub)
+            continue
+        candidates.append((key, sub, program, per_rank))
+    # Deterministic pack order: per-producer program order, producers
+    # tie-broken by name — NOT arrival order (seq interleaving differs
+    # per run; (producer, seq) does not, because seq is monotonic
+    # within a producer).
+    candidates.sort(key=lambda c: (c[1].producer, c[1].seq))
+    buffers: List[FusedBuffer] = []
+    open_buffers: Dict[Tuple, FusedBuffer] = {}
+    for key, sub, program, per_rank in candidates:
+        align = align_elems(key[3], key[6])
+        itemsize = jnp.dtype(key[6]).itemsize
+        shapes = [_per_rank_shape(x) for x in sub.args]
+        segs, elems, payload, padding = group_layout(
+            shapes, align, itemsize
+        )
+        fb = open_buffers.get(key)
+        if fb is not None and threshold and \
+                (fb.total_elems + elems) * itemsize > threshold:
+            fb = None  # buffer full: the next member opens a new one
+        if fb is None:
+            fb = FusedBuffer(key=key, members=[], total_elems=0,
+                             payload_bytes=0, padding_bytes=0)
+            open_buffers[key] = fb
+            buffers.append(fb)
+        base = fb.total_elems
+        fb.members.append(FusedMember(
+            sub=sub, program=program,
+            segments=[(base + off, n, shape) for off, n, shape in segs],
+        ))
+        fb.total_elems += elems
+        fb.payload_bytes += payload
+        fb.padding_bytes += padding
+    passthrough.sort(key=lambda s: s.seq)
+    return buffers, passthrough
+
+
+def build_fused_op(fb: FusedBuffer):
+    """The single :class:`~horovod_tpu.xir.ir.ExchangeOp` serving one
+    fused buffer: the class template with the concatenated payload's
+    byte count and a layout digest folded into its attrs — so two
+    cycles with different member layouts never share a ResponseCache
+    entry (and two with identical layouts always do)."""
+    from ..xir import ir
+
+    (opk, axis, groups, wire, lowering, reduce, dtype, qbackend,
+     _axis_size) = fb.key
+    import jax.numpy as jnp
+
+    itemsize = jnp.dtype(dtype).itemsize
+    attrs = {
+        "reduce": reduce,
+        "nbytes": fb.total_elems * itemsize,
+        "dtype": dtype,
+        "fused_layout": tuple(
+            (off, n) for off, n, _ in fb.segment_layout()
+        ),
+    }
+    if qbackend is not None:
+        attrs["qbackend"] = qbackend
+    return ir.ExchangeOp(
+        opk, axis, wire=wire, lowering=lowering, bucket=0,
+        groups=groups, attrs=tuple(sorted(attrs.items())),
+    )
+
+
+def build_fused_program(fb: FusedBuffer):
+    """The one-op program a fused buffer dispatches as (kind
+    ``"fused"`` — its own metric series and timeline lane)."""
+    from ..xir import ir
+
+    return ir.program("fused", [build_fused_op(fb)])
+
+
+def concat_ops(ops: Sequence[Any], nbytes_list: Sequence[int]):
+    """Trace-time fused op over already-lowered same-class ops (the
+    ``execute_merged`` concatenation mode): the class template with the
+    summed byte count.  Caller packs/unpacks payloads with
+    :func:`pack_group`/:func:`unpack_group` at the matching alignment."""
+    lead = ops[0]
+    total = int(sum(nbytes_list))
+    return lead.replace(
+        bucket=0, attrs={"nbytes": total, "fused_members": len(ops)}
+    )
+
+
+# ------------------------------------------------------------ pricing
+
+def estimate_gain(nbytes_list: Sequence[int], lowering: str = "flat",
+                  axis_size: Optional[int] = None) -> Dict[str, float]:
+    """Cost-model seconds for dispatching ``nbytes_list`` as separate
+    all_reduce collectives vs one fused buffer — the amortization the
+    packer exists for, priced through
+    :meth:`~horovod_tpu.topo.model.Topology.fused_dispatch_cost` (the
+    fitted parameters when a measured fit exists).  The fused price can
+    only win on the per-op latency/overhead terms; the byte terms are
+    identical by construction."""
+    from ..topo import model as topo_model
+
+    topo = topo_model.current()
+    serial, fused = topo.fused_dispatch_cost(
+        "all_reduce", list(nbytes_list), lowering, axis_size
+    )
+    return {
+        "serial_s": serial,
+        "fused_s": fused,
+        "gain_s": serial - fused,
+    }
+
+
+def estimate_concat_gain(programs: Sequence[Any],
+                         axis_size: Optional[int] = None
+                         ) -> Dict[str, float]:
+    """Price the ``execute_merged`` concatenation mode for a set of
+    lowered programs through ``xir/lower.estimate_program_cost``:
+    serialized = sum of the individual program prices; fused = the
+    price of the class-concatenated program set (unfusable ops ride
+    along unchanged)."""
+    from ..xir import ir, lower as lower_mod
+
+    serial = sum(
+        lower_mod.estimate_program_cost(p, axis_size, pipelined=False)
+        for p in programs
+    )
+    classes: Dict[Tuple, List[Any]] = {}
+    solo: List[Any] = []
+    for p in programs:
+        for op in p.ops:
+            key = class_key(op, axis_size)
+            if key is None:
+                solo.append(op)
+            else:
+                classes.setdefault(key, []).append(op)
+    fused_ops = list(solo)
+    for ops in classes.values():
+        if len(ops) == 1:
+            fused_ops.append(ops[0])
+        else:
+            fused_ops.append(concat_ops(
+                ops, [int(op.attr("nbytes") or 0) for op in ops]
+            ))
+    fused_prog = ir.program(
+        "fused", [op.replace(bucket=i) for i, op in enumerate(fused_ops)]
+    )
+    fused = lower_mod.estimate_program_cost(
+        fused_prog, axis_size, pipelined=False
+    )
+    return {"serial_s": serial, "fused_s": fused,
+            "gain_s": serial - fused}

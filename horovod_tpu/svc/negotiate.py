@@ -65,6 +65,16 @@ class Negotiator:
         own producer is ready immediately — the negotiation bypass the
         reference grants cache-hit requests (``response_cache.cc``:
         cached responses skip the coordinator round-trip entirely).
+
+        The release order is **participant-sorted, never
+        arrival-sorted**: a full bitvector releases its submissions in
+        producer-name order regardless of which producer's post
+        completed it.  This is the fusion-layout contract — the
+        FusionPacker (``svc/fuse.py``) packs a released class in
+        ``(producer, seq)`` order, and every process must compute the
+        identical fused buffer layout even when their producer threads
+        interleaved differently (the cross-producer property test in
+        tests/test_svc.py permutes post orders and pins this).
         """
         participants = tuple(sub.participants) or (sub.producer,)
         if set(participants) == {sub.producer}:

@@ -1,0 +1,267 @@
+"""Online (cycle_time, fusion_threshold) tuning for the service loop.
+
+The reference ``ParameterManager`` (``parameter_manager.{h,cc}``)
+autotunes ``HOROVOD_CYCLE_TIME`` and ``HOROVOD_FUSION_THRESHOLD``
+online: each tuning window runs one candidate pair, is scored by
+observed throughput, and the Bayesian loop freezes the winner.  The
+two knobs trade against each other — a longer cycle coalesces more
+submissions per fusion buffer but adds queue latency; a bigger buffer
+amortizes more dispatches but delays the first byte — so they are
+explored *as a pair*, never independently.
+
+:class:`ServiceParameterManager` is that loop for our service knobs
+(``HVD_TPU_SVC_CYCLE_TIME`` / ``HVD_TPU_SVC_FUSION_THRESHOLD``),
+driven from the cycle loop itself (``ExchangeService._run_loop`` calls
+:meth:`on_cycle` once per cycle — no caller involvement):
+
+* **scoring** comes from the PR 2 metrics registry: a window's score
+  is submissions retired per second (``svc.submits`` over wall clock)
+  — the throughput the fusion buffer exists to raise;
+* **search** reuses the ``FusionAutotuner`` machinery: the cycle-time
+  dimension explores a small candidate menu (one window each, best
+  freezes — the categorical pattern of ``ScheduleTuner``'s wire
+  exploration), then the threshold dimension runs the tuner's
+  suggest/observe grid, both applied process-wide through the env
+  knobs (the loop re-reads them every cycle);
+* **persistence** rides the PR 7 tune DB (``sched/store.py``): the
+  converged pair records under a key whose knob fingerprint
+  deliberately EXCLUDES the resolved pair itself
+  (``knob_fingerprint(include_svc=False)`` — the entry must stay
+  addressable after its own winner is pinned), and later jobs
+  warm-start frozen at window 0 (``svc.tune.db_hit``).
+
+``HVD_TPU_SVC_TUNE=off`` (default) keeps both knobs static env reads —
+the deterministic behavior every parity test pins.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, Optional, Tuple
+
+from .. import metrics
+from ..utils import env
+from ..utils.autotune import FusionAutotuner
+from ..utils.logging import get_logger
+from . import fuse
+
+DEFAULT_CYCLE_TIME_MS = 1.0
+DEFAULT_WINDOW_S = 0.25
+DEFAULT_CYCLE_CANDIDATES_MS = (0.0, 1.0, 5.0)
+
+
+def cycle_time_ms() -> float:
+    """``HVD_TPU_SVC_CYCLE_TIME`` (ms; legacy ``CYCLE_TIME`` /
+    ``HOROVOD_CYCLE_TIME`` accepted): how long the loop lingers after
+    the first submission of a cycle before draining the queue, so a
+    burst of producers coalesces into one fusion pass.  0 drains
+    immediately (the PR 12 behavior)."""
+    raw = env.get_float(env.SVC_CYCLE_TIME, -1.0)
+    if raw < 0:
+        raw = env.get_float(env.CYCLE_TIME, DEFAULT_CYCLE_TIME_MS)
+    return max(0.0, raw)
+
+
+def tune_enabled() -> bool:
+    return env.get_bool(env.SVC_TUNE, False)
+
+
+def registry_view() -> Dict[str, float]:
+    """Snapshot the registry series a window score derives from."""
+    return {
+        "submits": float(metrics.get_counter("svc.submits")),
+        "mono": time.monotonic(),
+    }
+
+
+def window_score(before: Dict[str, float],
+                 after: Dict[str, float]) -> float:
+    """Submissions retired per second over one window — 0.0 when the
+    window was idle (not observed, so an idle service cannot poison
+    the search)."""
+    subs = after["submits"] - before["submits"]
+    if subs <= 0:
+        return 0.0
+    return subs / max(after["mono"] - before["mono"], 1e-9)
+
+
+class ServiceParameterManager:
+    """The service's two-knob window tuner; see the module docstring.
+
+    Constructor arguments exist for tests (tiny windows, pinned
+    candidate menus); production use is zero-config — the service
+    builds one and calls :meth:`on_cycle`.
+    """
+
+    def __init__(self, *,
+                 tune: Optional[bool] = None,
+                 cycle_candidates_ms: Tuple[float, ...] = None,
+                 window_s: Optional[float] = None,
+                 warmup_windows: int = 4,
+                 store="env"):
+        self._tune = tune_enabled() if tune is None else bool(tune)
+        self._window_s = (
+            env.get_float(env.SVC_TUNE_WINDOW, DEFAULT_WINDOW_S)
+            if window_s is None else float(window_s)
+        )
+        self._cycle_candidates = tuple(
+            cycle_candidates_ms if cycle_candidates_ms is not None
+            else DEFAULT_CYCLE_CANDIDATES_MS
+        )
+        self._cycle_scores: Dict[float, float] = {}
+        self._cycle_frozen: Optional[float] = None
+        self.tuner = FusionAutotuner(
+            low_bytes=1 << 16, high_bytes=1 << 27,
+            warmup_windows=warmup_windows,
+        )
+        self._baseline: Optional[Dict[str, float]] = None
+        self._window_opened = 0.0
+        self._best_score = 0.0
+        self._db_written = False
+        self._store = None
+        self._store_key: Optional[str] = None
+        if not self._tune:
+            return
+        if store == "env":
+            from ..sched.store import ScheduleStore
+
+            store = ScheduleStore.from_env()
+        self._store = store
+        if self._store is not None:
+            self._store_key = self.store_key()
+            entry = self._store.lookup(self._store_key)
+            if entry is not None:
+                self._warm_start(entry)
+            else:
+                metrics.inc_counter("svc.tune.db_miss")
+
+    # -------------------------------------------------------- resolve
+
+    def cycle_linger_s(self) -> float:
+        """Seconds the loop lingers per cycle — the env knob, which the
+        tuner writes candidate/winner values through."""
+        return cycle_time_ms() / 1e3
+
+    def fusion_threshold(self) -> int:
+        """Bytes per fused buffer this cycle (``svc/fuse.py`` reads the
+        same knob; exposed here so the loop has one params surface)."""
+        return fuse.fusion_threshold()
+
+    def store_key(self) -> str:
+        """The pair's tune-DB identity.  The knob fingerprint excludes
+        the resolved (cycle_time, fusion_threshold) pair itself: the
+        entry must still be found after its own winner was pinned into
+        the env (a self-referential fingerprint would orphan it)."""
+        from ..sched.store import knob_fingerprint, make_key
+
+        return make_key(
+            ("svc_params", "cycle_time+fusion_threshold"),
+            knobs=knob_fingerprint(include_svc=False),
+            kind="svc_params",
+        )
+
+    @property
+    def converged(self) -> bool:
+        if not self._tune:
+            return True
+        return self._cycle_frozen is not None and self.tuner.converged
+
+    # ------------------------------------------------------- windows
+
+    def _apply(self, cycle_ms: float, threshold: int) -> None:
+        env.set_env("SVC_CYCLE_TIME", repr(float(cycle_ms)))
+        env.set_env("SVC_FUSION_THRESHOLD", str(int(threshold)))
+        metrics.set_gauge("svc.cycle_time_ms", float(cycle_ms))
+        metrics.set_gauge("svc.fusion.threshold", float(threshold))
+
+    def _suggest(self) -> Tuple[float, int]:
+        if self._cycle_frozen is None:
+            for c in self._cycle_candidates:
+                if c not in self._cycle_scores:
+                    return c, self.tuner.threshold_bytes()
+        cycle = (
+            self._cycle_frozen if self._cycle_frozen is not None
+            else self._cycle_candidates[0]
+        )
+        return cycle, self.tuner.threshold_bytes()
+
+    def _warm_start(self, entry: Dict) -> None:
+        meta = entry.get("meta") or {}
+        cycle = float(meta.get("cycle_time_ms", DEFAULT_CYCLE_TIME_MS))
+        threshold = int(entry["bucket_bytes"])
+        self._cycle_frozen = cycle
+        self.tuner.freeze(threshold)
+        self._best_score = float(entry.get("score", 0.0))
+        self._db_written = True
+        self._apply(cycle, threshold)
+        metrics.inc_counter("svc.tune.db_hit")
+        metrics.set_gauge("svc.tune.warm_start", 1.0)
+        get_logger().info(
+            "service params warm start: cycle_time=%.3gms "
+            "fusion_threshold=%d (stored score %.3g)",
+            cycle, threshold, self._best_score,
+        )
+
+    def _maybe_store(self) -> None:
+        if (self._db_written or self._store is None
+                or self._store_key is None or not self.converged):
+            return
+        self._db_written = True
+        self._store.record(
+            self._store_key,
+            bucket_bytes=self.tuner.threshold_bytes(),
+            wire="off",
+            lowering="flat",
+            score=self._best_score,
+            meta={
+                "svc": "params",
+                "cycle_time_ms": self._cycle_frozen,
+                "fusion_threshold": self.tuner.threshold_bytes(),
+            },
+        )
+        metrics.inc_counter("svc.tune.db_store")
+
+    def on_cycle(self, now: Optional[float] = None) -> None:
+        """One cycle tick from the service loop: open a scoring window
+        if none is open, close and score it once ``window_s`` elapsed,
+        and on convergence pin the winning pair into the env knobs and
+        persist it.  No-op when tuning is off or already converged —
+        the loop pays one time read per cycle."""
+        if not self._tune or self.converged:
+            return
+        now = time.monotonic() if now is None else now
+        if self._baseline is None:
+            cycle, threshold = self._suggest()
+            self._apply(cycle, threshold)
+            self._baseline = registry_view()
+            self._window_opened = now
+            return
+        if now - self._window_opened < self._window_s:
+            return
+        score = window_score(self._baseline, registry_view())
+        self._baseline = None
+        if score <= 0.0:
+            return  # idle window: re-run the same candidate
+        metrics.inc_counter("svc.tune.windows")
+        metrics.set_gauge("svc.tune.score", score)
+        self._best_score = max(self._best_score, score)
+        if self._cycle_frozen is None:
+            c = self._suggest()[0]
+            self._cycle_scores[c] = max(
+                self._cycle_scores.get(c, 0.0), score
+            )
+            if all(x in self._cycle_scores
+                   for x in self._cycle_candidates):
+                self._cycle_frozen = max(
+                    self._cycle_scores, key=self._cycle_scores.get
+                )
+                get_logger().info(
+                    "service params: cycle_time frozen at %.3gms",
+                    self._cycle_frozen,
+                )
+        else:
+            self.tuner.observe(score)
+        if self.converged:
+            self._apply(self._cycle_frozen, self.tuner.threshold_bytes())
+            metrics.set_gauge("svc.tune.converged", 1.0)
+            self._maybe_store()

@@ -123,14 +123,28 @@ class TensorQueue:
             self._publish_depth_locked()
             self._not_empty.notify_all()
 
-    def pop_batch(self, timeout: Optional[float] = 0.05
-                  ) -> List[Submission]:
+    def pop_batch(self, timeout: Optional[float] = 0.05,
+                  linger: float = 0.0) -> List[Submission]:
         """Everything currently enqueued, in sequence order (one cycle
         tick's worth — the ``RunLoopOnce`` pop).  Blocks up to
-        ``timeout`` when empty; an empty list means idle or closed."""
+        ``timeout`` when empty; an empty list means idle or closed.
+
+        ``linger`` is the cycle time (``HVD_TPU_SVC_CYCLE_TIME``, the
+        reference ``HOROVOD_CYCLE_TIME`` semantics): once a first
+        submission is visible the pop waits that much longer before
+        draining, so a burst of producers lands in ONE cycle batch —
+        and one fusion pass (``svc/fuse.py``) — instead of one cycle
+        each.  A close wakes the linger immediately."""
         with self._not_empty:
             if not self._items and not self._closed:
                 self._not_empty.wait(timeout)
+            if self._items and not self._closed and linger > 0:
+                deadline = time.monotonic() + linger
+                while not self._closed:
+                    left = deadline - time.monotonic()
+                    if left <= 0:
+                        break
+                    self._not_empty.wait(left)
             batch = sorted(self._items, key=lambda s: s.seq)
             self._items.clear()
             self._publish_depth_locked()

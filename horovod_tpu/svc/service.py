@@ -52,6 +52,7 @@ from .. import faults, metrics
 from ..exceptions import FaultInjected, HorovodTpuError
 from ..utils import env
 from ..utils.logging import get_logger
+from . import fuse, params as svc_params
 from .cache import CachedResponse, ResponseCache
 from .negotiate import Negotiator
 from .queue import Submission, SvcFuture, TensorQueue
@@ -98,6 +99,7 @@ class ExchangeService:
         self.queue = TensorQueue()
         self.negotiator = Negotiator()
         self.cache = ResponseCache()
+        self.params = svc_params.ServiceParameterManager()
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -136,7 +138,9 @@ class ExchangeService:
         while not self._stop.is_set():
             batch: List[Submission] = []
             try:
-                batch = self.queue.pop_batch()
+                batch = self.queue.pop_batch(
+                    linger=self.params.cycle_linger_s()
+                )
                 if not batch:
                     if self.queue.closed or self._dead:
                         return  # killed under us: don't spin hot
@@ -152,8 +156,8 @@ class ExchangeService:
                 ready: List[Submission] = []
                 for sub in batch:
                     ready.extend(self.negotiator.post(sub))
-                for sub in sorted(ready, key=lambda s: s.seq):
-                    self._dispatch(sub)
+                self._dispatch_ready(ready)
+                self.params.on_cycle()
                 self.negotiator.check_stalls()
             except FaultInjected as e:
                 self._kill(f"fault injected in service loop: {e}")
@@ -293,6 +297,148 @@ class ExchangeService:
 
         return jax.jit(jax.shard_map(
             body, mesh=mesh, in_specs=(spec,), out_specs=spec,
+            check_vma=False,
+        ))
+
+    def _dispatch_ready(self, ready: Sequence[Submission]) -> None:
+        """Dispatch one cycle's released submissions, coalescing
+        compatible programs into fused wire buffers (``svc/fuse.py`` —
+        the reference FusionBufferManager's cycle behavior).  With the
+        threshold at 0 this is exactly the pre-fusion loop: every
+        submission dispatches separately in sequence order."""
+        threshold = self.params.fusion_threshold()
+        subs = sorted(ready, key=lambda s: s.seq)
+        if threshold <= 0 or len(subs) == 0:
+            for sub in subs:
+                self._dispatch(sub)
+            return
+        from .. import trace
+
+        metrics.inc_counter("svc.fusion.programs_in", len(subs))
+        resolved = []
+        for sub in subs:
+            try:
+                # Resolve under the submission's trace context so the
+                # cache/lower spans carry its trace id even when the
+                # emission happens in a fused buffer.
+                with trace.use_context(sub.trace):
+                    program = self._resolve_program(
+                        sub.program, sub.axis_size
+                    ).program
+            except Exception:
+                # An unlowerable program still resolves its future
+                # through the ordinary dispatch (which records the
+                # exception there) — the packer never wedges a cycle.
+                program = None
+            resolved.append((sub, program))
+        buffers, passthrough = fuse.plan_cycle(
+            [(s, p) for s, p in resolved if p is not None], threshold
+        )
+        passthrough = sorted(
+            passthrough + [s for s, p in resolved if p is None],
+            key=lambda s: s.seq,
+        )
+        for sub in passthrough:
+            metrics.inc_counter("svc.fusion.buffers_out")
+            self._dispatch(sub)
+        for fb in buffers:
+            self._dispatch_fused(fb)
+
+    def _dispatch_fused(self, fb) -> None:
+        """Execute one fused buffer — every member's payloads packed
+        into a single aligned flat buffer behind ONE collective — and
+        scatter the slices back to each member's future.  Any failure
+        degrades to per-member unfused dispatch (``svc.fusion.
+        fallback``): fusion is a performance lever, never a new way to
+        wedge a producer."""
+        from .. import trace
+
+        try:
+            t0 = time.monotonic()
+            fused_prog = fuse.build_fused_program(fb)
+            n_ops = sum(len(m.segments) for m in fb.members)
+            with trace.span(
+                "fuse.pack", "fuse",
+                members=len(fb.members), ops=n_ops,
+                nbytes=fb.payload_bytes, padding=fb.padding_bytes,
+            ):
+                entry = self._resolve_program(fused_prog, fb.axis_size)
+                if entry.executor is None:
+                    entry.executor = self._build_fused_executor(
+                        fb, entry.program
+                    )
+                args = tuple(
+                    x for m in fb.members for x in m.sub.args
+                )
+                with self._inflight_guard():
+                    outs = entry.executor(*args)
+            metrics.inc_counter("svc.dispatches")
+            metrics.inc_counter("svc.fusion.buffers_out")
+            metrics.inc_counter("svc.fusion.members", len(fb.members))
+            metrics.inc_counter("svc.fusion.bytes", fb.payload_bytes)
+            metrics.inc_counter(
+                "svc.fusion.padding_bytes", fb.padding_bytes
+            )
+            self._record_timeline(entry.program)
+            pos = 0
+            for m in fb.members:
+                take = len(m.segments)
+                m.sub.future.set_result(list(outs[pos:pos + take]))
+                metrics.inc_counter("svc.dispatches.fused_members")
+                metrics.inc_counter(
+                    f"svc.programs.{m.program.kind}"
+                )
+                # Each member still gets its own dispatch-phase span,
+                # attributed to ITS trace id — the fused emission must
+                # not blind the per-submission trace (the propagation
+                # contract tests/test_trace.py pins).
+                trace.record_complete(
+                    f"dispatch.{m.program.kind}", "dispatch", t0,
+                    ctx=m.sub.trace, producer=m.sub.producer,
+                    seq=m.sub.seq, kind=m.program.kind, fused=1,
+                )
+                pos += take
+        except BaseException:  # noqa: BLE001 - degrade, never wedge
+            metrics.inc_counter("svc.fusion.fallback")
+            for m in fb.members:
+                if not m.sub.future.done():
+                    self._dispatch(m.sub)
+
+    def _build_fused_executor(self, fb, fused_program):
+        """Jitted emission of one fused buffer: ONE dispatch packs the
+        members (peel rank rows → flatten → aligned concat), runs the
+        single fused collective through the interpreter, and slices
+        every member back out — so the host pays one executor call per
+        buffer per cycle instead of one per member program."""
+        from ..runtime import WORLD_AXIS, get_runtime
+        from ..xir import interp
+
+        mesh = get_runtime().mesh
+        spec = P(WORLD_AXIS)
+        fused_op = fused_program.ops[0]
+        layout = fb.segment_layout()
+        align = fuse.align_elems(fused_op.wire, fused_op.attr("dtype"))
+        axis_size = fb.axis_size
+        n_in = sum(len(m.segments) for m in fb.members)
+
+        def body(*args):
+            ins = [a[0] for a in args]
+            buf, pack_layout = fuse.pack_group(ins, align)
+            out = interp.execute(
+                fused_program, [buf], axis_size=axis_size, store=False,
+            )[0]
+            return tuple(
+                y[None] for y in fuse.unpack_group(out, pack_layout)
+            )
+
+        # The trace-time pack layout must equal the planned one (same
+        # shapes, same alignment) — the signature the ResponseCache
+        # keyed this executor under folds it in via `fused_layout`.
+        del layout
+        return jax.jit(jax.shard_map(
+            body, mesh=mesh,
+            in_specs=tuple(spec for _ in range(n_in)),
+            out_specs=tuple(spec for _ in range(n_in)),
             check_vma=False,
         ))
 

@@ -92,7 +92,8 @@ class StaleTrainStep:
     """
 
     def __init__(self, loss_fn, inner_optimizer, *,
-                 k: Optional[int] = None, axis=WORLD_AXIS):
+                 k: Optional[int] = None, axis=WORLD_AXIS,
+                 donate: bool = True):
         why = eligible(axis)
         if why is not None:
             raise HorovodTpuError(f"stale pipeline unavailable: {why}")
@@ -150,11 +151,20 @@ class StaleTrainStep:
             init_body, mesh=self.mesh, in_specs=(P(),),
             out_specs=(spec, spec), check_vma=False,
         ))
-        self._step_fn = jax.jit(jax.shard_map(
-            step_body, mesh=self.mesh,
-            in_specs=(spec, spec, spec, P(axis)),
-            out_specs=(spec, spec, P(), spec), check_vma=False,
-        ))
+        # Donate the stacked params + optimizer state (args 0/1, the
+        # same pytrees the step returns updated) so XLA updates them
+        # in place instead of copying the full parameter set in HBM
+        # every step — the donation TrainStep._build_step already
+        # performs for the synchronous path.  The correction and batch
+        # (args 2/3) are read-only and never donated.
+        self._step_fn = jax.jit(
+            jax.shard_map(
+                step_body, mesh=self.mesh,
+                in_specs=(spec, spec, spec, P(axis)),
+                out_specs=(spec, spec, P(), spec), check_vma=False,
+            ),
+            donate_argnums=(0, 1) if donate else (),
+        )
 
     # ------------------------------------------------------------ API
 
@@ -269,6 +279,8 @@ def _grouped_mean(g, axis, groups, group_size):
 
 def stale_train_step(loss_fn, inner_optimizer, *,
                      k: Optional[int] = None,
-                     axis=WORLD_AXIS) -> StaleTrainStep:
+                     axis=WORLD_AXIS,
+                     donate: bool = True) -> StaleTrainStep:
     """Build the bounded-staleness step; see :class:`StaleTrainStep`."""
-    return StaleTrainStep(loss_fn, inner_optimizer, k=k, axis=axis)
+    return StaleTrainStep(loss_fn, inner_optimizer, k=k, axis=axis,
+                          donate=donate)

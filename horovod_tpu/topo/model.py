@@ -244,6 +244,31 @@ class Topology:
         hier = self.estimate_cost(collective, nbytes, "hier", n)
         return "hier" if hier < flat else "flat"
 
+    def fused_dispatch_cost(
+        self,
+        collective: str,
+        nbytes_list,
+        lowering: str = "flat",
+        axis_size: Optional[int] = None,
+    ) -> Tuple[float, float]:
+        """``(serial_s, fused_s)`` for a batch of same-class exchanges:
+        serial is the sum of each member priced alone; fused prices the
+        concatenated payload as ONE collective.  The byte terms are
+        identical by construction — the gap is the per-dispatch
+        latency/phase-overhead terms the service-side fusion buffer
+        (``svc/fuse.py``) amortizes, so ``fused_s <= serial_s`` always,
+        with the gap widening as members shrink (the small-message
+        regime of arXiv:1810.11112)."""
+        sizes = [int(b) for b in nbytes_list]
+        serial = sum(
+            self.estimate_cost(collective, b, lowering, axis_size)
+            for b in sizes
+        )
+        fused = self.estimate_cost(
+            collective, sum(sizes), lowering, axis_size
+        )
+        return serial, fused
+
     def lowering_bytes(
         self,
         collective: str,

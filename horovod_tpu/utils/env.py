@@ -141,6 +141,30 @@ SVC = "SVC"  # off (default) | on
 # cross-slice hop of step i completes during step i+k's backward
 # (DCN-latency hiding across steps, riding the PR 11 rail model).
 SVC_STALENESS = "SVC_STALENESS"
+# Service-side fusion buffers (svc/fuse.py): bytes one fused wire
+# buffer may hold.  The cycle's negotiated submissions coalesce into
+# one padded buffer per compatibility class — (op kind, axis/groups,
+# wire, lowering, reduce, dtype) — and dispatch as ONE collective (the
+# reference FusionBufferManager's 64 MiB staging buffer,
+# fusion_buffer_manager.{h,cc}).  0 disables fusion: every submission
+# dispatches separately, exactly the PR 12/13 behavior.  Oversize
+# programs (> threshold) always pass through unfused.
+SVC_FUSION_THRESHOLD = "SVC_FUSION_THRESHOLD"  # bytes; default 64 MiB
+# Service cycle time in milliseconds (the reference HOROVOD_CYCLE_TIME,
+# common.h:110): after the loop sees a first submission it lingers this
+# long before draining the queue, so a burst of producers lands in ONE
+# cycle batch (and one fusion pass) instead of one cycle each.  Falls
+# back to the legacy CYCLE_TIME knob; default 1.0 ms, 0 = drain
+# immediately (the PR 12 behavior).
+SVC_CYCLE_TIME = "SVC_CYCLE_TIME"
+# Online (cycle_time, fusion_threshold) tuning for the service loop
+# (svc/params.py, the reference ParameterManager applied to the two
+# service knobs): off (default) = static env values; on = window-score
+# candidate pairs from the metrics registry, freeze the winner, pin it
+# into the env knobs, and persist it in the tune DB for warm starts.
+SVC_TUNE = "SVC_TUNE"  # off (default) | on
+# Seconds per service-tuner scoring window (default 0.25).
+SVC_TUNE_WINDOW = "SVC_TUNE_WINDOW"
 # ResponseCache capacity (entries).  Shares the reference's
 # HOROVOD_CACHE_CAPACITY knob (common.h:118, response_cache.cc);
 # 0 disables the cache (every submission renegotiates + re-lowers).

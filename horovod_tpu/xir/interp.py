@@ -359,8 +359,15 @@ def execute_merged(programs: Sequence[ir.ExchangeProgram],
     Values are identical to executing each program separately (the
     chains are ordering-only and the programs share no payloads);
     ineligible combinations — pipelining off, overlapping rails —
-    fall back to exactly that, so the entry point is always safe to
-    call.  Returns one output list per program, in input order."""
+    fall back to the **same-rail concatenation mode** instead when the
+    service fusion buffer is on (``svc/fuse.py``): ops in the same
+    fusion class coalesce into one padded buffer behind ONE collective
+    (elementwise reductions commute with concatenation, so f32 dense
+    values stay bitwise identical), still rail-interleaved with the
+    remaining solo ops.  Only when neither mode applies does the call
+    degrade to plain sequential execution, so the entry point is
+    always safe to call.  Returns one output list per program, in
+    input order."""
     from . import pipeline
 
     programs = [
@@ -375,6 +382,12 @@ def execute_merged(programs: Sequence[ir.ExchangeProgram],
             )
     merged = pipeline.merge(programs, axis_size)
     if merged is None:
+        units = pipeline.merge_concat(programs, axis_size)
+        if units is not None:
+            return _execute_concat(
+                programs, args_lists, units,
+                axis_size=axis_size, process_set=process_set,
+            )
         return [
             execute(p, a, axis_size=axis_size, process_set=process_set,
                     store=store)
@@ -412,6 +425,78 @@ def execute_merged(programs: Sequence[ir.ExchangeProgram],
                 out = run_op(op, x, process_set=process_set)
             rail.bump(out[0] if isinstance(out, tuple) else out, (r,))
             outs[pi][oi] = out
+    return outs
+
+
+def _execute_concat(programs, args_lists, units, *,
+                    axis_size=None, process_set=None):
+    """The same-rail concatenation emission: each ``("fused", members)``
+    unit packs its members' payloads into one block-aligned flat buffer
+    (``svc/fuse.pack_group``) and runs ONE collective; solo units run
+    as-is.  All units chain through a shared :class:`~horovod_tpu.xir.
+    pipeline.RailChain` on their dominant rail, so the fused buffers
+    compose with PR 11 rail interleaving — and the whole emission is
+    priced by ``lower.estimate_program_cost`` via
+    ``svc/fuse.estimate_concat_gain``.  Values are identical to
+    sequential execution (bitwise for dense reductions): concatenation
+    commutes with elementwise reduction and the chains are ordering-
+    only."""
+    from .. import trace
+    from ..svc import fuse
+    from . import pipeline
+
+    metrics.inc_counter("xir.fusion.merged_programs", len(programs))
+    for p in programs:
+        account(p, axis_size)
+    rail = pipeline.RailChain()
+    outs: List[List[Any]] = [[None] * len(p.ops) for p in programs]
+    with trace.span(
+        "exchange.fused", "exchange",
+        kind="+".join(p.kind for p in programs),
+    ):
+        for kind, members in units:
+            ops = [programs[pi].ops[oi] for pi, oi in members]
+            xs = [args_lists[pi][oi] for pi, oi in members]
+            if kind == "solo" or len(members) == 1:
+                op, x = ops[0], xs[0]
+                r = pipeline.op_rail(op, axis_size)
+                leaves = list(x) if isinstance(x, tuple) else [x]
+                leaves = rail.tie(leaves, (r,))
+                x = tuple(leaves) if isinstance(x, tuple) else leaves[0]
+                with trace.span(
+                    f"{programs[members[0][0]].kind}.{op.op}{op.bucket}",
+                    "merged_op", rail=r,
+                ), jax.named_scope(
+                    f"hvd_xir_concat_solo_{op.op}{op.bucket}_{r}"
+                ):
+                    out = run_op(op, x, process_set=process_set)
+                rail.bump(out[0] if isinstance(out, tuple) else out, (r,))
+                outs[members[0][0]][members[0][1]] = out
+                continue
+            fused_op = fuse.concat_ops(
+                ops, [int(op.attr("nbytes") or 0) for op in ops]
+            )
+            align = fuse.align_elems(
+                fused_op.wire, fused_op.attr("dtype")
+            )
+            r = pipeline.op_rail(fused_op, axis_size)
+            with trace.span(
+                "fuse.concat", "fuse", rail=r, members=len(members),
+            ), jax.named_scope(
+                f"hvd_xir_concat_{fused_op.op}_{r}_m{len(members)}"
+            ):
+                buf, layout = fuse.pack_group(xs, align)
+                buf = rail.tie([buf], (r,))[0]
+                fused_out = run_op(
+                    fused_op, buf, process_set=process_set
+                )
+                rail.bump(fused_out, (r,))
+                metrics.inc_counter("xir.fusion.buffers")
+                metrics.inc_counter("xir.fusion.members", len(members))
+                for (pi, oi), out in zip(
+                    members, fuse.unpack_group(fused_out, layout)
+                ):
+                    outs[pi][oi] = out
     return outs
 
 

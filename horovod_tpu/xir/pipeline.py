@@ -372,6 +372,59 @@ def merge_order(
     return order
 
 
+def merge_concat(
+    programs: Sequence,
+    axis_size: Optional[int] = None,
+    threshold: Optional[int] = None,
+) -> Optional[List[Tuple[str, List[Tuple[int, int]]]]]:
+    """Same-rail concatenation plan for co-scheduled programs whose
+    rails OVERLAP (the case :func:`merge` declines): ops in the same
+    fusion class (``svc/fuse.class_key`` — same kind/axis/wire/
+    lowering/reduce/dtype) coalesce into ONE padded buffer and
+    dispatch as one collective, bounded by the service fusion
+    threshold; everything else emits solo.  Returns emission units
+    ``[("fused", [(pi, oi), ...]) | ("solo", [(pi, oi)]), ...]`` in
+    deterministic first-appearance order, or ``None`` when no class
+    has two members (nothing to concatenate — callers fall back to
+    sequential execution).  ``xir.interp.execute_merged`` gives the
+    plan meaning through one :class:`RailChain` emission, so the fused
+    buffers still interleave with solo ops across rails."""
+    from ..svc import fuse
+
+    threshold = fuse.fusion_threshold() if threshold is None else threshold
+    if threshold <= 0 or len(programs) < 1:
+        return None
+    units: List[Tuple[str, List[Tuple[int, int]]]] = []
+    open_classes: dict = {}
+    open_bytes: dict = {}
+    for pi, p in enumerate(programs):
+        for oi, op in enumerate(p.ops):
+            key = fuse.class_key(op, axis_size)
+            nbytes = int(op.attr("nbytes") or 0)
+            if key is None or nbytes > threshold:
+                units.append(("solo", [(pi, oi)]))
+                continue
+            members = open_classes.get(key)
+            if members is not None and \
+                    open_bytes[key] + nbytes > threshold:
+                members = None  # class buffer full: open a new unit
+            if members is None:
+                members = []
+                unit = ("fused", members)
+                units.append(unit)
+                open_classes[key] = members
+                open_bytes[key] = 0
+            members.append((pi, oi))
+            open_bytes[key] += nbytes
+    if not any(kind == "fused" and len(m) > 1 for kind, m in units):
+        return None
+    # Singleton "fused" units emit solo — no packing for one member.
+    return [
+        ("solo", m) if kind == "fused" and len(m) == 1 else (kind, m)
+        for kind, m in units
+    ]
+
+
 def merge(programs: Sequence, axis_size: Optional[int] = None):
     """Merge several lowered programs into one co-scheduled
     :class:`~horovod_tpu.xir.ir.ExchangeProgram` (kind =

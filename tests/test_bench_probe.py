@@ -129,7 +129,7 @@ class TestSkipPathStillRecords:
         )
         for rec in ("_maybe_scaling", "_maybe_topo",
                     "_maybe_quant_backend", "_maybe_adasum",
-                    "_maybe_railpipe"):
+                    "_maybe_railpipe", "_maybe_svc_fusion"):
             monkeypatch.setattr(bench, rec, fake_record(rec))
 
         result = {
@@ -144,7 +144,7 @@ class TestSkipPathStillRecords:
         bench._device_free_records(result, 480, time.monotonic())
         assert ran == ["cpu_fallback", "_maybe_scaling", "_maybe_topo",
                        "_maybe_quant_backend", "_maybe_adasum",
-                       "_maybe_railpipe"]
+                       "_maybe_railpipe", "_maybe_svc_fusion"]
         assert result["reason"]
         assert result["cpu_fallback"]["value"] == 1.0
 
@@ -162,7 +162,7 @@ class TestSkipPathStillRecords:
         monkeypatch.setattr(bench, "_cpu_resnet_fallback", fake)
         for rec in ("_maybe_scaling", "_maybe_topo",
                     "_maybe_quant_backend", "_maybe_adasum",
-                    "_maybe_railpipe"):
+                    "_maybe_railpipe", "_maybe_svc_fusion"):
             monkeypatch.setattr(bench, rec, noop)
         bench._device_free_records(
             {"value": 123.0}, 480, time.monotonic()

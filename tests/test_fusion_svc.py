@@ -1,0 +1,576 @@
+"""Service-side fusion buffers (svc/fuse.py + svc/params.py).
+
+Contracts under test:
+
+* **Packer units** — fusion-class keys admit only provably
+  value-preserving coalescing (all_reduce, no EF, never hier_adasum);
+  pack/unpack round-trips with block-aligned offsets; plan_cycle packs
+  in deterministic (producer, seq) order, splits at the threshold, and
+  passes oversize programs through.
+* **Service dispatch** — a cycle's submissions coalesce into one wire
+  dispatch per class: fused == unfused **bitwise** at f32 dense (and
+  within 1e-3 on the int8 wire, where aligned offsets make the blocks
+  identical); mixed dense + MoE a2a + sparse submissions fuse only
+  within class; ``svc.fusion.buffers_out`` < ``programs_in``; padding
+  is metered and bounded; threshold=0 restores the PR 13 behavior
+  exactly (zero fusion counters, bitwise-identical results).
+* **Concat merged mode** — ``xir.interp.execute_merged`` concatenates
+  same-class ops of rail-sharing programs into one collective, bitwise
+  equal to sequential execution, priced through
+  ``lower.estimate_program_cost``.
+* **Grouped eager path** — ``grouped_allreduce`` routes through the
+  same packer: one fused buffer per dtype, bitwise equal to the
+  per-tensor path.
+* **Donation** — TrainStep and StaleTrainStep donate params/opt-state;
+  ``donate=False`` produces bitwise-identical losses (the parity
+  guard).
+* **Params tuner** — the (cycle_time, fusion_threshold) window loop
+  converges, pins the env knobs, persists to the tune DB, and
+  warm-starts with zero exploration windows; its store key survives
+  its own winner being pinned.
+"""
+
+import itertools
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import faults, metrics, sched, svc, topo, xir
+from horovod_tpu.runtime import WORLD_AXIS
+from horovod_tpu.svc import fuse, params as svc_params
+from horovod_tpu.svc.queue import Submission, SvcFuture, TensorQueue
+from horovod_tpu.topo import model as topo_model
+
+pytestmark = [pytest.mark.svc, pytest.mark.fusion]
+
+N = 8
+T24 = topo_model.Topology(num_slices=2, slice_size=4)
+
+
+@pytest.fixture(autouse=True)
+def _fusion_isolation(monkeypatch):
+    metrics.reset_counters("svc.")
+    metrics.reset_counters("xir.fusion")
+    for knob in ("HVD_TPU_SVC_CYCLE_TIME", "HVD_TPU_SVC_FUSION_THRESHOLD",
+                 "HVD_TPU_SVC_TUNE", "HVD_TPU_TUNE_DB"):
+        monkeypatch.delenv(knob, raising=False)
+    yield
+    svc.set_enabled_override(None)
+    svc.set_staleness_override(None)
+    svc.set_threshold_override(None)
+    svc.reset_service()
+    sched.set_config_override(None)
+    topo.set_topology_override(None)
+    faults.set_plan(None)
+    xir.lower.reset()
+
+
+def _ar_op(nbytes=64, wire="off", lowering="flat", reduce="mean",
+           dtype="float32", bucket=0, ef=False):
+    return xir.ExchangeOp(
+        "all_reduce", WORLD_AXIS, wire=wire, lowering=lowering,
+        bucket=bucket, ef=ef,
+        attrs=(("dtype", dtype), ("nbytes", nbytes), ("reduce", reduce)),
+    )
+
+
+def _ar_program(nbytes=64, reduce="mean", wire="off", lowering="flat",
+                kind="dense_grad", n_ops=1):
+    return xir.program(kind, [
+        _ar_op(nbytes=nbytes, wire=wire, lowering=lowering,
+               reduce=reduce, bucket=i)
+        for i in range(n_ops)
+    ])
+
+
+def _sub(program, args, producer="p", seq=1, participants=()):
+    return Submission(
+        seq=seq, producer=producer, program=program, args=list(args),
+        future=SvcFuture(), participants=tuple(participants),
+    )
+
+
+class TestClassKey:
+    def test_dense_all_reduce_classifies(self):
+        key = fuse.class_key(_ar_op())
+        assert key is not None
+        assert key == fuse.class_key(_ar_op(nbytes=4096, bucket=3))
+
+    def test_wire_lowering_dtype_split_classes(self):
+        base = fuse.class_key(_ar_op())
+        assert fuse.class_key(_ar_op(wire="int8")) != base
+        assert fuse.class_key(_ar_op(lowering="hier")) != base
+        assert fuse.class_key(_ar_op(dtype="bfloat16")) != base
+        assert fuse.class_key(_ar_op(reduce="sum")) != base
+
+    def test_unfusable_ops_return_none(self):
+        a2a = xir.all_to_all(WORLD_AXIS, split_axis=0, concat_axis=0,
+                             nbytes=64, dtype="float32")
+        assert fuse.class_key(a2a) is None
+        assert fuse.class_key(_ar_op(ef=True)) is None
+        assert fuse.class_key(_ar_op(lowering="hier_adasum")) is None
+        assert fuse.class_key(_ar_op(lowering="auto")) is None
+        assert fuse.class_key(_ar_op(), process_set=object()) is None
+
+    def test_mixed_program_does_not_classify(self):
+        mixed = xir.program("dense_grad", [
+            _ar_op(dtype="float32"), _ar_op(dtype="bfloat16", bucket=1),
+        ])
+        assert fuse.classify_program(mixed) is None
+        uniform = _ar_program(n_ops=3)
+        assert fuse.classify_program(uniform) is not None
+
+
+class TestPackGroup:
+    def test_roundtrip_with_aligned_offsets(self):
+        rng = np.random.RandomState(0)
+        xs = [jnp.asarray(rng.randn(*s).astype(np.float32))
+              for s in [(5,), (3, 7), (1,), (2, 2, 2)]]
+        buf, layout = fuse.pack_group(xs, align=128)
+        for off, _n, _shape in layout:
+            assert off % 128 == 0
+        outs = fuse.unpack_group(buf, layout)
+        for x, o in zip(xs, outs):
+            assert (np.asarray(x) == np.asarray(o)).all()
+
+    def test_group_layout_padding_accounting(self):
+        layout, elems, payload, padding = fuse.group_layout(
+            [(5,), (130,)], align=128, itemsize=4
+        )
+        assert elems == 128 + 256
+        assert payload == (5 + 130) * 4
+        assert padding == elems * 4 - payload
+        assert [e[0] for e in layout] == [0, 128]
+
+    def test_quant_wire_aligns_to_quant_block(self):
+        from horovod_tpu.ops.quantized import quant_block
+
+        assert fuse.align_elems("int8", "float32") == quant_block()
+        assert fuse.align_elems("off", "float32") == 512 // 4
+
+
+class TestPlanCycle:
+    def _resolved(self, sizes, producer="p", start_seq=1, threshold=None):
+        subs = []
+        for i, per_rank in enumerate(sizes):
+            x = jnp.zeros((N, per_rank // 4), jnp.float32)
+            prog = _ar_program(nbytes=per_rank)
+            subs.append((_sub(prog, [x], producer=producer,
+                              seq=start_seq + i), prog))
+        return subs
+
+    def test_oversize_passes_through(self):
+        resolved = self._resolved([1 << 20])
+        buffers, passthrough = fuse.plan_cycle(resolved, threshold=4096)
+        assert buffers == [] and len(passthrough) == 1
+        assert metrics.get_counter("svc.fusion.oversize") == 1
+
+    def test_threshold_splits_buffers(self):
+        resolved = self._resolved([2048] * 4)
+        buffers, passthrough = fuse.plan_cycle(resolved, threshold=4096)
+        assert passthrough == []
+        assert len(buffers) == 2
+        assert all(len(b.members) == 2 for b in buffers)
+        assert all(
+            b.payload_bytes + b.padding_bytes <= 4096 for b in buffers
+        )
+
+    def test_pack_order_invariant_under_arrival_permutation(self):
+        """The fused layout is a pure function of WHAT was released,
+        never of the thread interleaving that released it."""
+        def plan(order):
+            subs = []
+            for seq, producer in enumerate(order, start=1):
+                x = jnp.zeros((N, 16), jnp.float32)
+                prog = _ar_program(nbytes=64)
+                subs.append((_sub(prog, [x], producer=producer,
+                                  seq=seq), prog))
+            buffers, _ = fuse.plan_cycle(subs, threshold=1 << 20)
+            assert len(buffers) == 1
+            return [m.sub.producer for m in buffers[0].members]
+
+        orders = list(itertools.permutations(("a", "b", "c")))
+        layouts = [plan(o) for o in orders]
+        assert all(lo == ["a", "b", "c"] for lo in layouts), layouts
+
+
+@pytest.mark.usefixtures("hvd_module")
+class TestServiceFusion:
+    def _submit_many(self, s, count=6, nbytes_rows=16, wire="off",
+                     reduce="mean"):
+        rng = np.random.RandomState(3)
+        xs = [
+            jnp.asarray(rng.randn(N, nbytes_rows).astype(np.float32))
+            for _ in range(count)
+        ]
+        futs = [
+            s.submit(
+                _ar_program(nbytes=nbytes_rows * 4, wire=wire,
+                            reduce=reduce),
+                [x], producer=f"p{i % 2}",
+            )
+            for i, x in enumerate(xs)
+        ]
+        outs = [np.asarray(f.result(timeout=60)[0]) for f in futs]
+        return xs, outs
+
+    def test_fused_bitwise_equals_unfused_f32(self):
+        svc.set_threshold_override(64 << 20)
+        s = svc.get_service()
+        xs, fused = self._submit_many(s)
+        assert metrics.get_counter("svc.fusion.programs_in") >= 6
+        assert metrics.get_counter("svc.fusion.buffers_out") < \
+            metrics.get_counter("svc.fusion.programs_in")
+        assert metrics.get_counter("svc.fusion.fallback") == 0
+        svc.reset_service()
+        svc.set_threshold_override(0)
+        s2 = svc.get_service()
+        _, serial = self._submit_many(s2)
+        for a, b in zip(fused, serial):
+            assert (a == b).all(), "fused diverged from unfused at f32"
+
+    def test_fused_int8_wire_close_to_unfused(self):
+        # 512-element rows = one quant block per member: the aligned
+        # offsets make fused blocks identical to unfused ones.
+        svc.set_threshold_override(64 << 20)
+        s = svc.get_service()
+        xs, fused = self._submit_many(s, count=4, nbytes_rows=512,
+                                      wire="int8", reduce="sum")
+        svc.reset_service()
+        svc.set_threshold_override(0)
+        s2 = svc.get_service()
+        _, serial = self._submit_many(s2, count=4, nbytes_rows=512,
+                                      wire="int8", reduce="sum")
+        for a, b in zip(fused, serial):
+            np.testing.assert_allclose(a, b, atol=1e-3)
+
+    def test_mixed_workloads_fuse_only_within_class(self):
+        svc.set_threshold_override(64 << 20)
+        s = svc.get_service()
+        rng = np.random.RandomState(5)
+        dense = [
+            jnp.asarray(rng.randn(N, 16).astype(np.float32))
+            for _ in range(3)
+        ]
+        shuf = jnp.asarray(rng.randn(N, N).astype(np.float32))
+        idx = jnp.tile(jnp.arange(4, dtype=jnp.int32)[None], (N, 1))
+        vals = jnp.asarray(rng.randn(N, 4, 2).astype(np.float32))
+        futs = [
+            s.submit(_ar_program(nbytes=64), [x], producer="dense")
+            for x in dense
+        ]
+        a2a = s.submit(
+            xir.program("moe", [
+                xir.all_to_all(WORLD_AXIS, split_axis=0, concat_axis=0,
+                               nbytes=int(shuf.nbytes), dtype="float32"),
+            ]), [shuf], producer="moe",
+        )
+        sparse = s.submit(
+            xir.program("sparse_embed", [
+                xir.gather_dense_from_sparse(
+                    WORLD_AXIS, nbytes=int(vals.nbytes),
+                    dtype="float32",
+                ),
+            ]), [(idx, vals)], producer="sparse",
+        )
+        for f, x in zip(futs, dense):
+            np.testing.assert_allclose(
+                np.asarray(f.result(timeout=60)[0]),
+                np.broadcast_to(np.asarray(x).mean(0), (N, 16)),
+                rtol=1e-6,
+            )
+        out = np.asarray(a2a.result(timeout=60)[0])
+        np.testing.assert_array_equal(out, np.asarray(shuf).T)
+        gi, gv = sparse.result(timeout=60)[0]
+        assert np.asarray(gi).shape == (N, N * 4)
+        # only the dense class fused: members counted for dense only
+        assert metrics.get_counter("svc.fusion.members") == 3
+        assert metrics.get_counter("svc.fusion.buffers_out") < \
+            metrics.get_counter("svc.fusion.programs_in")
+
+    def test_padding_accounted_and_bounded(self):
+        svc.set_threshold_override(1 << 20)
+        s = svc.get_service()
+        self._submit_many(s, count=4, nbytes_rows=5)  # ragged: pads
+        padding = metrics.get_counter("svc.fusion.padding_bytes")
+        buffers = metrics.get_counter("svc.fusion.buffers_out")
+        assert padding > 0
+        assert padding <= buffers * (1 << 20), \
+            "per-buffer padding exceeded the threshold"
+
+    def test_threshold_zero_restores_prefusion_behavior(self):
+        svc.set_threshold_override(0)
+        s = svc.get_service()
+        xs, outs = self._submit_many(s)
+        for x, o in zip(xs, outs):
+            np.testing.assert_allclose(
+                o, np.broadcast_to(np.asarray(x).mean(0), (N, 16)),
+                rtol=1e-6,
+            )
+        for counter in ("svc.fusion.programs_in",
+                        "svc.fusion.buffers_out",
+                        "svc.fusion.members",
+                        "svc.fusion.padding_bytes",
+                        "svc.fusion.fallback"):
+            assert metrics.get_counter(counter) == 0, counter
+
+    def test_oversize_program_passes_through_service(self):
+        svc.set_threshold_override(4096)
+        s = svc.get_service()
+        x = jnp.ones((N, 4096), jnp.float32)  # 16 KiB per rank
+        out = s.submit(
+            _ar_program(nbytes=4096 * 4), [x], producer="big",
+        ).result(timeout=60)[0]
+        np.testing.assert_allclose(np.asarray(out), 1.0)
+        assert metrics.get_counter("svc.fusion.oversize") >= 1
+        assert metrics.get_counter("svc.fusion.members") == 0
+
+    def test_negotiated_release_fuses_across_producers(self):
+        svc.set_threshold_override(64 << 20)
+        s = svc.get_service()
+        x = jnp.ones((N, 8), jnp.float32)
+        prog = _ar_program(nbytes=32, reduce="sum")
+        fa = s.submit(prog, [x], producer="a", participants=("a", "b"))
+        fb = s.submit(prog, [x * 2], producer="b",
+                      participants=("a", "b"))
+        np.testing.assert_allclose(
+            np.asarray(fa.result(timeout=60)[0]), N * 1.0
+        )
+        np.testing.assert_allclose(
+            np.asarray(fb.result(timeout=60)[0]), N * 2.0
+        )
+        assert metrics.get_counter("svc.fusion.members") == 2
+        assert metrics.get_counter("svc.fusion.buffers_out") == 1
+
+
+@pytest.mark.usefixtures("hvd_module")
+class TestConcatMergedMode:
+    def test_concat_bitwise_equals_sequential(self):
+        from horovod_tpu.xir import interp
+        from tests.test_xir import _shard_run
+
+        rng = np.random.RandomState(0)
+        a = jnp.asarray(rng.randn(N, 32).astype(np.float32))
+        b = jnp.asarray(rng.randn(N, 24).astype(np.float32))
+        p1 = _ar_program(nbytes=128, kind="dense_grad")
+        p2 = _ar_program(nbytes=96, kind="fsdp")
+
+        def merged(va, vb):
+            outs = interp.execute_merged(
+                [p1, p2], [[va], [vb]], store=False
+            )
+            return outs[0][0], outs[1][0]
+
+        def sequential(va, vb):
+            return (
+                interp.execute(p1, [va], store=False)[0],
+                interp.execute(p2, [vb], store=False)[0],
+            )
+
+        ma, mb = _shard_run(merged, a, b, n_out=2)
+        sa, sb = _shard_run(sequential, a, b, n_out=2)
+        assert (np.asarray(ma) == np.asarray(sa)).all()
+        assert (np.asarray(mb) == np.asarray(sb)).all()
+        assert metrics.get_counter("xir.fusion.buffers") >= 1
+        assert metrics.get_counter("xir.fusion.members") >= 2
+
+    def test_threshold_zero_disables_concat_mode(self):
+        from horovod_tpu.xir import pipeline
+
+        p1 = _ar_program(nbytes=128)
+        p2 = _ar_program(nbytes=96)
+        svc.set_threshold_override(0)
+        assert pipeline.merge_concat([p1, p2]) is None
+        svc.set_threshold_override(1 << 20)
+        units = pipeline.merge_concat([p1, p2])
+        assert units is not None
+        fused = [u for u in units if u[0] == "fused"]
+        assert fused and len(fused[0][1]) == 2
+
+    def test_concat_prices_through_program_cost(self):
+        p1 = _ar_program(nbytes=4096)
+        p2 = _ar_program(nbytes=4096)
+        gain = fuse.estimate_concat_gain([p1, p2])
+        assert gain["fused_s"] <= gain["serial_s"]
+        assert gain["gain_s"] >= 0
+
+    def test_fused_dispatch_cost_property(self):
+        topo.set_topology_override(T24)
+        serial, fused = topo_model.current().fused_dispatch_cost(
+            "all_reduce", [4096] * 16, "flat", N
+        )
+        assert fused < serial  # 16 dispatch overheads amortize to one
+
+
+@pytest.mark.usefixtures("hvd_module")
+class TestGroupedEagerPath:
+    def test_grouped_fused_bitwise_equals_per_tensor(self, monkeypatch):
+        from horovod_tpu.ops import eager
+
+        rng = np.random.RandomState(11)
+        xs = [
+            jnp.asarray(rng.randn(N, 5).astype(np.float32)),
+            jnp.asarray(rng.randn(N, 129).astype(np.float32)),
+            jnp.asarray((rng.randn(N, 3) * 9).astype(np.int32)),
+        ]
+        fused = eager.grouped_allreduce(xs, op=eager.Sum)
+        assert metrics.get_counter("svc.fusion.grouped_buffers") >= 2
+        monkeypatch.setenv("HVD_TPU_DISABLE_GROUP_FUSION", "1")
+        serial = eager.grouped_allreduce(xs, op=eager.Sum)
+        for f, s in zip(fused, serial):
+            assert (np.asarray(f) == np.asarray(s)).all(), \
+                "grouped fused wire diverged from per-tensor dispatch"
+
+    def test_grouped_shapes_and_dtypes_roundtrip(self):
+        from horovod_tpu.ops import eager
+
+        xs = [jnp.ones((N, 2, 3), jnp.float32),
+              jnp.ones((N, 4), jnp.bfloat16)]
+        outs = eager.grouped_allreduce(xs, op=eager.Sum)
+        assert outs[0].shape == (N, 2, 3) and outs[0].dtype == jnp.float32
+        assert outs[1].shape == (N, 4) and outs[1].dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(outs[0]), float(N))
+
+
+@pytest.mark.usefixtures("hvd_module")
+class TestDonation:
+    def _losses(self, donate, iters=6):
+        rng = np.random.RandomState(0)
+        X = rng.randn(16, 32).astype(np.float32)
+        Y = (X @ rng.randn(32, 4).astype(np.float32)).astype(np.float32)
+
+        def lf(p, b):
+            x, y = b
+            return jnp.mean((x @ p["w"] - y) ** 2)
+
+        p = {"w": jnp.asarray(rng.randn(32, 4).astype(np.float32) * 0.1)}
+        tx = hvd.DistributedOptimizer(optax.sgd(0.05))
+        step = hvd.distributed_train_step(lf, tx, donate=donate)
+        st = step.init(p)
+        batch = (jnp.asarray(X), jnp.asarray(Y))
+        losses = []
+        for _ in range(iters):
+            p, st, loss = step(p, st, batch)
+            losses.append(float(loss))
+        return losses
+
+    def test_train_step_donation_numerics_parity(self):
+        assert self._losses(True) == self._losses(False)
+
+    def _stale_losses(self, donate, iters=10):
+        from horovod_tpu.svc.stale import StaleTrainStep
+
+        def lf(p, b):
+            return jnp.sum((p["w"] - 3.0) ** 2) + 0.0 * jnp.sum(b)
+
+        step = StaleTrainStep(lf, optax.sgd(0.2), k=1, donate=donate)
+        sp, st = step.init({"w": jnp.zeros((4,), jnp.float32)})
+        batch = jnp.zeros((N, 1), jnp.float32)
+        losses = []
+        for _ in range(iters):
+            sp, st, loss = step(sp, st, batch)
+            losses.append(float(loss))
+        step.drain()
+        return losses
+
+    def test_stale_step_donation_numerics_parity(self):
+        topo.set_topology_override(T24)
+        svc.set_enabled_override(True)
+        svc.set_staleness_override(1)
+        donated = self._stale_losses(True)
+        svc.reset_service()
+        undonated = self._stale_losses(False)
+        assert donated == undonated, \
+            f"stale donation changed numerics: {donated} vs {undonated}"
+
+
+class TestServiceParams:
+    def test_cycle_time_env_and_legacy_fallback(self, monkeypatch):
+        assert svc_params.cycle_time_ms() == 1.0
+        monkeypatch.setenv("HOROVOD_CYCLE_TIME", "7.5")
+        assert svc_params.cycle_time_ms() == 7.5
+        monkeypatch.setenv("HVD_TPU_SVC_CYCLE_TIME", "2.5")
+        assert svc_params.cycle_time_ms() == 2.5
+        monkeypatch.setenv("HVD_TPU_SVC_CYCLE_TIME", "0")
+        assert svc_params.cycle_time_ms() == 0.0
+
+    def _drive(self, mgr, cycles=40):
+        t = 0.0
+        for _ in range(cycles):
+            metrics.inc_counter("svc.submits", 10)
+            mgr.on_cycle(now=t)
+            t += 1.0
+            if mgr.converged:
+                break
+        return mgr
+
+    def test_window_loop_converges_and_pins_env(self, monkeypatch):
+        import os
+
+        mgr = svc_params.ServiceParameterManager(
+            tune=True, cycle_candidates_ms=(0.0, 2.0), window_s=0.0,
+            warmup_windows=2, store=None,
+        )
+        assert not mgr.converged
+        self._drive(mgr)
+        assert mgr.converged
+        assert mgr._cycle_frozen in (0.0, 2.0)
+        assert "HVD_TPU_SVC_CYCLE_TIME" in os.environ
+        assert "HVD_TPU_SVC_FUSION_THRESHOLD" in os.environ
+        assert metrics.get_counter("svc.tune.windows") >= 4
+        for knob in ("HVD_TPU_SVC_CYCLE_TIME",
+                     "HVD_TPU_SVC_FUSION_THRESHOLD"):
+            monkeypatch.delenv(knob, raising=False)
+
+    def test_store_roundtrip_and_warm_start(self, tmp_path, monkeypatch):
+        from horovod_tpu.sched.store import ScheduleStore
+
+        db = tmp_path / "tune.json"
+        store = ScheduleStore(str(db))
+        mgr = svc_params.ServiceParameterManager(
+            tune=True, cycle_candidates_ms=(0.0, 2.0), window_s=0.0,
+            warmup_windows=2, store=store,
+        )
+        self._drive(mgr)
+        assert mgr.converged
+        assert metrics.get_counter("svc.tune.db_store") == 1
+        entry = store.lookup(mgr.store_key())
+        assert entry is not None
+        assert entry["meta"]["cycle_time_ms"] == mgr._cycle_frozen
+        # A second job warm-starts frozen at window 0.
+        metrics.reset_counters("svc.tune")
+        warm = svc_params.ServiceParameterManager(
+            tune=True, cycle_candidates_ms=(0.0, 2.0), window_s=0.0,
+            warmup_windows=2, store=ScheduleStore(str(db)),
+        )
+        assert warm.converged
+        assert metrics.get_counter("svc.tune.db_hit") == 1
+        assert metrics.get_counter("svc.tune.windows") == 0
+        assert warm.tuner.threshold_bytes() == mgr.tuner.threshold_bytes()
+        for knob in ("HVD_TPU_SVC_CYCLE_TIME",
+                     "HVD_TPU_SVC_FUSION_THRESHOLD"):
+            monkeypatch.delenv(knob, raising=False)
+
+    def test_store_key_survives_pinned_winner(self, monkeypatch):
+        from horovod_tpu.sched.store import knob_fingerprint
+
+        mgr = svc_params.ServiceParameterManager(tune=False)
+        before = mgr.store_key()
+        fp_before = knob_fingerprint()
+        monkeypatch.setenv("HVD_TPU_SVC_FUSION_THRESHOLD", "123456")
+        monkeypatch.setenv("HVD_TPU_SVC_CYCLE_TIME", "9.0")
+        # The full fingerprint sees the pinned pair (schedules tuned
+        # under different coalescing regimes key distinctly)...
+        assert knob_fingerprint() != fp_before
+        # ...but the params entry's own key deliberately does not.
+        assert mgr.store_key() == before
+
+    def test_disabled_manager_is_static(self):
+        mgr = svc_params.ServiceParameterManager(tune=False)
+        assert mgr.converged
+        before = metrics.get_counter("svc.tune.windows")
+        mgr.on_cycle()
+        assert metrics.get_counter("svc.tune.windows") == before

@@ -148,6 +148,45 @@ class TestNegotiator:
         assert orphans == [s]
         assert metrics.get_counter("svc.negotiations_abandoned") == 1
 
+    def test_release_order_invariant_under_post_permutations(self):
+        """Cross-producer property (the fusion-layout contract): a
+        released class — which the FusionPacker will pack into ONE
+        buffer — must come out in deterministic global order no matter
+        which order the producers posted in.  Release is participant-
+        sorted (never arrival-sorted), and the packer's (producer, seq)
+        member order is invariant under arrival permutations, so every
+        process computes the identical fused layout."""
+        import itertools
+
+        from horovod_tpu.svc import fuse
+
+        producers = ("a", "b", "c")
+        releases, layouts = [], []
+        for perm in itertools.permutations(producers):
+            neg = Negotiator()
+            prog = xir.program("test", [
+                xir.all_reduce(WORLD_AXIS, reduce="mean",
+                               lowering="flat", nbytes=64,
+                               dtype="float32"),
+            ])
+            ready = []
+            for seq, producer in enumerate(perm, start=1):
+                sub = _sub(prog, args=[jnp.zeros((N, 16), jnp.float32)],
+                           producer=producer, participants=producers,
+                           seq=seq)
+                ready = neg.post(sub)
+            assert [s.producer for s in ready] == list(producers)
+            releases.append([s.producer for s in ready])
+            buffers, passthrough = fuse.plan_cycle(
+                [(s, s.program) for s in ready], threshold=1 << 20
+            )
+            assert passthrough == [] and len(buffers) == 1
+            layouts.append(
+                [m.sub.producer for m in buffers[0].members]
+            )
+        assert all(r == releases[0] for r in releases), releases
+        assert all(lo == layouts[0] for lo in layouts), layouts
+
 
 class TestResponseCache:
     def test_miss_insert_hit_counters(self):

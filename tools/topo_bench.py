@@ -30,6 +30,15 @@ summation across slices — stays in the stable region without LR
 retuning).  Also reports each run's DCN bytes so the record doubles as
 the hier_adasum ≤ hier wire-cost proof.
 
+``--fusion`` record — ``svc_fusion_amortization``: the service-side
+fusion buffer (``svc/fuse.py``) on the latency-dominated workload it
+exists for — N=32 small dense-gradient programs submitted per step.
+Serial (``HVD_TPU_SVC_FUSION_THRESHOLD=0``, the PR 12/13 loop) pays 32
+executor dispatches per cycle; fused coalesces the cycle into one wire
+buffer per class.  The headline value is serial/fused step-time
+speedup (acceptance bar ≥ 1.2x), with fused==serial results proven
+bitwise and ``svc.fusion.buffers_out`` < ``programs_in`` riding along.
+
 ``--pipeline`` record — ``railpipe_overlap``: the XIR rail pipeliner
 (``HVD_TPU_XIR_PIPELINE``, xir/pipeline.py) on the hier multi-bucket
 exchange — serialized per-bucket chains vs the reorder-only per-rail
@@ -518,16 +527,118 @@ def main_pipeline() -> dict:
     }
 
 
+def main_fusion() -> dict:
+    """The ``svc_fusion_amortization`` record: one "step" = submit
+    N=32 small dense-grad programs to the exchange service and wait on
+    every future — the many-small-submissions-per-cycle workload.  A
+    cycle linger (5 ms) lets the burst coalesce; serial and fused runs
+    share it, so the only difference is the packer.  Fused results are
+    asserted BITWISE equal to serial, and the fused run must retire
+    strictly fewer wire buffers than programs."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu import metrics, svc, xir
+    from horovod_tpu.runtime import WORLD_AXIS
+
+    jax.config.update("jax_platforms", "cpu")
+    os.environ["HVD_TPU_SVC_CYCLE_TIME"] = "5.0"
+    hvd.init()
+
+    n_programs = 32
+    rows = 256  # 1 KiB per rank per program: latency-dominated
+    rng = np.random.RandomState(7)
+    payloads = [
+        jnp.asarray(rng.randn(hvd.size(), rows).astype(np.float32))
+        for _ in range(n_programs)
+    ]
+
+    def program(i):
+        return xir.program("dense_grad", [
+            xir.all_reduce(WORLD_AXIS, reduce="mean",
+                           lowering="flat", nbytes=rows * 4,
+                           dtype="float32"),
+        ])
+
+    def run(threshold, iters=20, warmup=3):
+        svc.reset_service()
+        svc.set_threshold_override(threshold)
+        metrics.reset_counters("svc.fusion")
+        try:
+            s = svc.get_service()
+
+            def step():
+                futs = [
+                    s.submit(program(i), [payloads[i]],
+                             producer=f"p{i % 4}")
+                    for i in range(n_programs)
+                ]
+                return [f.result(timeout=120)[0] for f in futs]
+
+            for _ in range(warmup):
+                outs = step()
+            jax.block_until_ready(outs)
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                outs = step()
+            jax.block_until_ready(outs)
+            dt = (time.perf_counter() - t0) / iters
+            return {
+                "step_time_ms": round(dt * 1000.0, 3),
+                "programs_in": metrics.get_counter(
+                    "svc.fusion.programs_in"),
+                "buffers_out": metrics.get_counter(
+                    "svc.fusion.buffers_out"),
+                "padding_bytes": metrics.get_counter(
+                    "svc.fusion.padding_bytes"),
+                "outs": [np.asarray(o) for o in outs],
+            }
+        finally:
+            svc.set_threshold_override(None)
+
+    serial = run(0)
+    fused = run(64 * 1024 * 1024)
+    bitwise = all(
+        (a == b).all() for a, b in zip(serial["outs"], fused["outs"])
+    )
+    assert bitwise, "fused diverged from serial — contract broken"
+    assert fused["buffers_out"] < fused["programs_in"], (
+        f"fusion never engaged: {fused['buffers_out']} buffers for "
+        f"{fused['programs_in']} programs"
+    )
+    speedup = serial["step_time_ms"] / max(fused["step_time_ms"], 1e-9)
+    return {
+        "metric": "svc_fusion_amortization",
+        "unit": "serial_over_fused_step_time",
+        "value": round(speedup, 3),
+        "topo": os.environ["HVD_TPU_TOPO"],
+        "n_programs": n_programs,
+        "program_bytes": rows * 4,
+        "step_time_ms": {
+            "serial": serial["step_time_ms"],
+            "fused": fused["step_time_ms"],
+        },
+        "programs_in": fused["programs_in"],
+        "buffers_out": fused["buffers_out"],
+        "padding_bytes": fused["padding_bytes"],
+        "bitwise_serial_vs_fused": bitwise,
+    }
+
+
 if __name__ == "__main__":
     args = sys.argv[1:]
     which = ("quant" if "--quant" in args
              else "adasum" if "--adasum" in args
-             else "pipeline" if "--pipeline" in args else "topo")
+             else "pipeline" if "--pipeline" in args
+             else "fusion" if "--fusion" in args else "topo")
     mains = {"quant": main_quant, "adasum": main_adasum, "topo": main,
-             "pipeline": main_pipeline}
+             "pipeline": main_pipeline, "fusion": main_fusion}
     names = {"quant": "quant_fused_vs_phase", "adasum": "adasum_vs_sum",
              "topo": "topo_hier_vs_flat",
-             "pipeline": "railpipe_overlap"}
+             "pipeline": "railpipe_overlap",
+             "fusion": "svc_fusion_amortization"}
     try:
         print(json.dumps(mains[which]()))
     except Exception as e:  # degraded-run hardening: always emit a line
