@@ -28,6 +28,7 @@ import os
 import subprocess
 import sys
 import time
+from typing import Optional
 
 BASELINE_IMG_PER_SEC_PER_ACCEL = 1656.82 / 16  # docs/benchmarks.rst:32-43
 
@@ -496,6 +497,7 @@ def _device_free_records(result: dict, deadline_s: float,
     _maybe_adasum(result, deadline_s, t_start)
     _maybe_railpipe(result, deadline_s, t_start)
     _maybe_svc_fusion(result, deadline_s, t_start)
+    _maybe_tenant(result, deadline_s, t_start)
 
 
 def _maybe_svc_fusion(result: dict, deadline_s: float,
@@ -532,6 +534,45 @@ def _maybe_svc_fusion(result: dict, deadline_s: float,
         )
     except Exception as e:
         result["svc_fusion_amortization"] = {
+            "error": f"{type(e).__name__}: {e}"
+        }
+
+
+def _maybe_tenant(result: dict, deadline_s: float,
+                  t_start: float) -> None:
+    """Append the ``svc_tenant_interference`` record
+    (HVD_BENCH_TENANT=0 skips): two tenants sharing one service — A's
+    small ICI-local exchanges vs B's DCN-heavy buckets — measured
+    three ways (B off / FIFO / arbiter) via ``tools/topo_bench.py
+    --tenant`` in a scrubbed 8-device CPU subprocess
+    (docs/multitenant.md).  The headline is tenant A's step-time p99
+    shift when B turns on: the arbiter must hold it under the 10%
+    bound the FIFO baseline measurably breaks."""
+    if os.environ.get("HVD_BENCH_TENANT", "1") == "0":
+        return
+    if deadline_s - (time.monotonic() - t_start) < 75:
+        result["svc_tenant_interference"] = {
+            "error": "skipped: deadline too close"
+        }
+        return
+    try:
+        import subprocess as sp
+
+        repo = os.path.dirname(os.path.abspath(__file__))
+        env = _scrubbed_cpu_env()
+        env.setdefault("HVD_TPU_TOPO", "2x4")
+        out = sp.run(
+            [sys.executable, os.path.join(repo, "tools", "topo_bench.py"),
+             "--tenant"],
+            capture_output=True, text=True, timeout=300, env=env, cwd=repo,
+        )
+        line = (out.stdout or "").strip().splitlines()
+        result["svc_tenant_interference"] = (
+            json.loads(line[-1]) if out.returncode == 0 and line
+            else {"error": f"rc={out.returncode}: {(out.stderr or '')[-300:]}"}
+        )
+    except Exception as e:
+        result["svc_tenant_interference"] = {
             "error": f"{type(e).__name__}: {e}"
         }
 
@@ -859,6 +900,51 @@ def _probe_cache_key() -> str:
     return f"{sys.executable}:{jax_version}:{_knob_fingerprint()}"
 
 
+def emit_structured_abort(e: BaseException,
+                          grace_s: Optional[int] = None) -> dict:
+    """Last-resort primary record: structured skip, never a raw error
+    blob (the BENCH_r05 failure mode — an escape that reached the
+    outer handler printed ``{"error": "TimeoutExpired: ..."}`` with
+    value 0.0 and no sim records).  Builds the same structured-skip
+    shape the probe path emits, re-arms a bounded grace alarm, and
+    still runs every device-free record — the CPU-sim resnet fallback
+    fills the primary metric with a real measured number whenever the
+    subprocess path survives.  Prints the JSON line and returns it."""
+    import signal
+
+    result = {
+        "metric": "resnet50_synthetic_train_throughput",
+        "value": 0.0,
+        "unit": "images/sec/chip",
+        "vs_baseline": 0.0,
+        "status": "skipped",
+        "reason": (
+            f"bench aborted before a primary measurement: "
+            f"{type(e).__name__}: {e}".strip()
+        ),
+    }
+    if grace_s is None:
+        grace_s = int(os.environ.get("HVD_BENCH_GRACE_S", "240"))
+    try:
+        # The one-shot deadline alarm may already have fired; the
+        # device-free records run in their own subprocesses, so a fresh
+        # bounded alarm keeps THIS pass from hanging without touching
+        # the wedged device.
+        if hasattr(signal, "alarm"):
+            signal.alarm(0)
+            signal.alarm(max(1, int(grace_s)))
+        _device_free_records(result, grace_s, time.monotonic())
+    except BaseException as e2:  # records are best-effort here
+        if isinstance(e2, (KeyboardInterrupt, SystemExit)):
+            raise
+        result["records_error"] = f"{type(e2).__name__}: {e2}"
+    finally:
+        if hasattr(signal, "alarm"):
+            signal.alarm(0)
+    print(json.dumps(result))
+    return result
+
+
 def run_device_probe(deadline_s: float, armed_at: float,
                      retry=None):
     """Prove the device runtime boots before paying compiles in-process
@@ -1019,11 +1105,8 @@ if __name__ == "__main__":
             )
             print(json.dumps(_PARTIAL))
         else:
-            print(json.dumps({
-                "metric": "resnet50_synthetic_train_throughput",
-                "value": 0.0,
-                "unit": "images/sec/chip",
-                "vs_baseline": 0.0,
-                "error": f"{type(e).__name__}: {e}",
-            }))
+            # No primary measurement at all: the structured-skip path
+            # (status/reason + CPU-sim fallback + the device-free
+            # records), never a raw {"error": ...} value-0.0 blob.
+            emit_structured_abort(e)
         sys.exit(0)

@@ -85,6 +85,11 @@ Registered sites (grep ``faults.inject`` for ground truth):
                                 the service and the submission degrades
                                 to synchronous inline dispatch
                                 (``svc.fallback_sync``)
+``svc.admit``                   each tenant-lane admission
+                                (``tenant=`` context; svc/arbiter.py)
+                                — an ``error`` kills the service
+                                before the slot is taken, degrading
+                                the submission to inline dispatch
 ``svc.drain``                   each service drain (remesh pause,
                                 elastic restart, shutdown)
 ``svc.loop``                    each background-loop cycle tick

@@ -511,10 +511,24 @@ class ElasticDriver:
             payload["workers"] = len(self._last_assignments)
             return payload
 
+        def tenants_fn():
+            # Per-tenant accounting for the multi-tenant arbiter
+            # (svc/arbiter.py, docs/multitenant.md): queue depth, rail
+            # bytes, and wait quantiles per tenant aggregated from the
+            # same per-rank KV pushes, with round context so share
+            # shifts can be lined up against membership changes.
+            from ..svc.arbiter import tenants_payload
+
+            per_rank = {rank: snap for rank, snap in workers_fn()}
+            payload = tenants_payload(per_rank)
+            payload["round"] = self.rounds
+            payload["workers"] = len(self._last_assignments)
+            return payload
+
         return TelemetryServer(
             port=self.telemetry_port, health_fn=health_fn,
             workers_fn=workers_fn, schedule_store=self.schedule_store(),
-            trace_fn=trace_fn,
+            trace_fn=trace_fn, tenants_fn=tenants_fn,
         )
 
     def _publish_schedules(self, control) -> None:

@@ -21,6 +21,11 @@ gives the driver process a scrapeable surface:
   detection pass per scrape; the verdicts also publish as
   ``trace.straggler{rank=,phase=}`` gauges so a Prometheus scrape of
   ``/metrics`` sees them too (docs/tracing.md).
+* ``GET /tenants`` — per-tenant accounting for the multi-tenant
+  exchange arbiter (``svc/arbiter.py``): queue depth, in-flight count,
+  ICI/DCN rail bytes, admission/queue wait p50/p99, and configured
+  share vs observed usage per tenant, aggregated from the same worker
+  KV metric pushes ``/metrics`` renders (docs/multitenant.md).
 * ``GET/POST /schedules`` — the persistent autotuning database
   (``sched/store.py``): GET returns every stored (bucket_bytes, wire,
   lowering) winner (``?key=<hex>`` filters to one), POST merges a
@@ -84,11 +89,18 @@ class _Handler(BaseHTTPRequestHandler):
                     payload if payload is not None
                     else {"error": "no trace summary"}
                 ).encode(), "application/json")
+            elif route == "/tenants":
+                payload = srv.render_tenants()
+                code = 200 if payload is not None else 404
+                self._send(code, json.dumps(
+                    payload if payload is not None
+                    else {"error": "no tenant accounting"}
+                ).encode(), "application/json")
             else:
                 self._send(
                     404,
-                    b"not found: try /metrics, /health, /schedules "
-                    b"or /trace\n",
+                    b"not found: try /metrics, /health, /schedules, "
+                    b"/trace or /tenants\n",
                     "text/plain")
         except Exception as e:  # a scrape must never kill the server
             self._send(500, f"telemetry error: {e}\n".encode(),
@@ -161,11 +173,13 @@ class TelemetryServer:
         ] = None,
         schedule_store=None,
         trace_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+        tenants_fn: Optional[Callable[[], Dict[str, Any]]] = None,
     ):
         self.health_fn = health_fn
         self.workers_fn = workers_fn
         self.schedule_store = schedule_store
         self.trace_fn = trace_fn
+        self.tenants_fn = tenants_fn
         self._server = _QuietHTTPServer((bind_host, port), _Handler)
         self._server.telemetry = self  # type: ignore[attr-defined]
         self.port = self._server.server_address[1]
@@ -212,6 +226,23 @@ class TelemetryServer:
 
         per_rank = {rank: snap for rank, snap in self.workers_fn()}
         return straggler.trace_payload(per_rank)
+
+    def render_tenants(self) -> Optional[Dict[str, Any]]:
+        """``GET /tenants`` payload: an explicit ``tenants_fn`` (the
+        elastic driver installs one with round context), else — when
+        worker snapshots are reachable — the aggregation run right
+        here; a driver-less process serves its OWN registry snapshot so
+        a single-process job still has the surface.  None only when
+        nothing can be aggregated (-> 404)."""
+        if self.tenants_fn is not None:
+            return self.tenants_fn()
+        from ..svc.arbiter import tenants_payload
+
+        if self.workers_fn is not None:
+            per_rank = {rank: snap for rank, snap in self.workers_fn()}
+            if per_rank:
+                return tenants_payload(per_rank)
+        return tenants_payload({0: metrics.snapshot()})
 
     def render_schedules(
         self, key: Optional[str] = None

@@ -443,10 +443,18 @@ def exchange(
     if program is not None and program.trace is None and trace.enabled():
         # Trace correlation for the whole submission: the context rides
         # the program into the service (queue/negotiation/cache spans)
-        # and back out to the rail-phase spans emitted below.
-        program = program.with_trace(
-            trace.current_context() or trace.new_context(f"sched.{kind}")
-        )
+        # and back out to the rail-phase spans emitted below.  A caller
+        # context that predates tenant tagging is back-filled with the
+        # process tenant so the per-tenant phase attribution
+        # (docs/multitenant.md) covers the dense-grad pipeline too.
+        ctx = trace.current_context() or trace.new_context(f"sched.{kind}")
+        if not ctx.tenant:
+            default = trace.context.default_tenant()
+            if default:
+                import dataclasses as _dc
+
+                ctx = _dc.replace(ctx, tenant=default)
+        program = program.with_trace(ctx)
     if program is not None:
         # Async exchange service (svc/): the bucketed pipeline is a
         # *producer* — the program is submitted to the service at

@@ -37,13 +37,22 @@ one persistent executor owns the wires, everyone else submits plans:
 * :mod:`~horovod_tpu.svc.params` — the ParameterManager-style online
   tuner for (``HVD_TPU_SVC_CYCLE_TIME``, fusion threshold): window-
   scored from the metrics registry, persisted in the tune DB, warm-
-  started by later jobs (``HVD_TPU_SVC_TUNE=on``).
+  started by later jobs (``HVD_TPU_SVC_TUNE=on``);
+* :mod:`~horovod_tpu.svc.arbiter` — the multi-tenant exchange arbiter
+  (``HVD_TPU_SVC_ARBITER=on``): every submission carries a tenant,
+  each tenant gets an admission-bounded lane
+  (``HVD_TPU_SVC_TENANT_INFLIGHT`` backpressure), and the cycle loop's
+  FIFO dispatch becomes deficit round robin over tenants, each batch
+  priced by its ICI/DCN occupancy through the fitted per-rail cost
+  model — one tenant's DCN-heavy buckets can no longer head-of-line-
+  block another's ICI-local exchanges (docs/multitenant.md).
 
 ``HVD_TPU_SVC=off`` (the default) keeps every exchange inline exactly
 as before.  See docs/exchange_service.md.
 """
 
 from . import (  # noqa: F401
+    arbiter,
     cache,
     fuse,
     negotiate,
@@ -51,6 +60,12 @@ from . import (  # noqa: F401
     queue,
     service,
     stale,
+)
+from .arbiter import (  # noqa: F401
+    Arbiter,
+    TenantLane,
+    tenant_of,
+    tenants_payload,
 )
 from .cache import CachedResponse, ResponseCache  # noqa: F401
 from .fuse import (  # noqa: F401

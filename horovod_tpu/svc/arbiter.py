@@ -1,0 +1,636 @@
+"""Multi-tenant exchange arbiter: weighted-fair rail scheduling.
+
+Horovod's coordinator only negotiates *within* one job — every rank of
+one training run votes its bitvector, and the background loop dispatches
+whatever is ready, FIFO (arXiv:1802.05799 §4).  One pod serving many
+concurrent jobs has a problem the reference never had to solve: the
+jobs share the cross-slice DCN rails, and bandwidth contention between
+overlapping collectives is exactly the characterized cost cliff of
+arXiv:1810.11112 — one tenant's 64 MiB cross-slice buckets head-of-line
+block another tenant's sub-millisecond ICI-local exchanges for tens of
+milliseconds per cycle.
+
+PRs 12–14 built the single service that owns the wires; this module
+makes that service *arbitrate* them:
+
+* **Tenants** (:func:`tenant_of`): every Submission carries a tenant —
+  the ``TraceContext.tenant`` field when the producer set one, the
+  ``HVD_TPU_SVC_TENANT`` env knob, or a name derived from the
+  submission's process set (the disjoint ``tiling_groups()`` worlds of
+  the ROADMAP's multi-job pod) — defaulting to ``"default"`` so a
+  single-job world is exactly one lane.
+* **Admission lanes** (:meth:`Arbiter.admit`): each tenant's in-flight
+  submissions (queued, negotiating, or dispatching) are bounded by
+  ``HVD_TPU_SVC_TENANT_INFLIGHT``; a producer over its cap *blocks* —
+  backpressure instead of unbounded queue growth — until the loop
+  retires its backlog (or ``HVD_TPU_SVC_ADMIT_TIMEOUT`` expires, which
+  admits anyway with a counter: backpressure slows a producer, never
+  wedges it).
+* **Deficit round robin** (:meth:`Arbiter.schedule`): the cycle loop's
+  FIFO dispatch is replaced by classic DRR over tenant lanes.  Each
+  ready submission is priced by its ICI/DCN rail *occupancy* — wire
+  bytes split by network class (``xir/lower.program_bytes``) converted
+  to seconds through the fitted per-rail cost-model parameters
+  (``topo/model.rail_occupancy_seconds``, the PR 7/11 fit) — and
+  charged against its lane's deficit, which refills by
+  ``quantum × weight`` per round (``HVD_TPU_SVC_TENANT_WEIGHTS``).  A
+  tenant's big cross-slice DCN batches therefore drain at its weighted
+  share while another tenant's cheap ICI-local exchanges dispatch every
+  round, and batches from different tenants that occupy *disjoint*
+  rails land adjacently in the emission order (the PR 11/14 merged-rail
+  interleave).  The arbiter is work-conserving and ordering-only: every
+  released submission still dispatches in the same cycle, so values are
+  bitwise identical to FIFO — only *who waits* changes.
+* **Preemption** (:meth:`Arbiter.request_preempt`): a high-priority
+  tenant (priority = weight) can gate lower-priority lanes' admission
+  until its own backlog drains, bounded by ``HVD_TPU_SVC_PREEMPT_CYCLES``
+  service cycles — drain a neighbour's lane, never starve it.
+
+Accounting: per-tenant queue depth / in-flight / rail-byte gauges
+(labelled ``{tenant=}``), wait and cost histograms
+(``svc.tenant.wait_seconds.<tenant>``), and share-vs-usage gauges; the
+elastic driver aggregates the worker KV pushes into the ``/tenants``
+endpoint (:func:`tenants_payload`, ``runner/telemetry_http.py``).
+
+``HVD_TPU_SVC_ARBITER=off`` (default) keeps the FIFO cycle dispatch —
+and with one tenant, ``on`` degenerates to seq order, so single-tenant
+worlds are bitwise identical either way.  See docs/multitenant.md.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .. import faults, metrics
+from ..exceptions import FaultInjected
+from ..utils import env
+from ..utils.logging import get_logger
+
+DEFAULT_QUANTUM_US = 500.0
+DEFAULT_ADMIT_TIMEOUT_S = 30.0
+DEFAULT_PREEMPT_CYCLES = 50
+
+_enabled_override: Optional[bool] = None
+_inflight_override: Optional[int] = None
+
+
+def set_enabled_override(value: Optional[bool]) -> None:
+    """Trace/test-time arbiter toggle (the sched config-override
+    pattern); ``None`` restores the env knob."""
+    global _enabled_override
+    _enabled_override = value
+
+
+def set_inflight_override(value: Optional[int]) -> None:
+    global _inflight_override
+    _inflight_override = value
+
+
+def enabled() -> bool:
+    """``HVD_TPU_SVC_ARBITER`` policy (default **off** = FIFO cycle
+    dispatch, the PR 14 behavior exactly)."""
+    if _enabled_override is not None:
+        return _enabled_override
+    return env.get_bool(env.SVC_ARBITER, False)
+
+
+def tenant_inflight_cap() -> int:
+    """``HVD_TPU_SVC_TENANT_INFLIGHT``: per-tenant in-flight bound
+    (0 = unbounded, the PR 14 behavior)."""
+    if _inflight_override is not None:
+        return max(0, int(_inflight_override))
+    return max(0, env.get_int(env.SVC_TENANT_INFLIGHT, 0))
+
+
+def admit_timeout_s() -> float:
+    return max(0.0, env.get_float(env.SVC_ADMIT_TIMEOUT,
+                                  DEFAULT_ADMIT_TIMEOUT_S))
+
+
+def quantum_s() -> float:
+    """DRR deficit refill per lane per scheduling round, in priced
+    rail seconds (``HVD_TPU_SVC_ARBITER_QUANTUM_US``)."""
+    return max(1e-6, env.get_float(env.SVC_ARBITER_QUANTUM_US,
+                                   DEFAULT_QUANTUM_US)) * 1e-6
+
+
+def preempt_cycles() -> int:
+    return max(1, env.get_int(env.SVC_PREEMPT_CYCLES,
+                              DEFAULT_PREEMPT_CYCLES))
+
+
+def tenant_weights() -> Dict[str, float]:
+    """``HVD_TPU_SVC_TENANT_WEIGHTS="a:2,b:1"`` parsed; malformed
+    entries are skipped (a bad weight must not kill the loop)."""
+    raw = env.get_env(env.SVC_TENANT_WEIGHTS, "") or ""
+    out: Dict[str, float] = {}
+    for part in raw.split(","):
+        if ":" not in part:
+            continue
+        name, _, w = part.partition(":")
+        try:
+            val = float(w)
+        except ValueError:
+            continue
+        if name.strip() and val > 0:
+            out[name.strip()] = val
+    return out
+
+
+def tenant_weight(tenant: str) -> float:
+    return tenant_weights().get(tenant, 1.0)
+
+
+def current_tenant() -> str:
+    """The env-configured tenant of this process (``HVD_TPU_SVC_TENANT``;
+    empty when unset — producers then derive one per submission)."""
+    return (env.get_env(env.SVC_TENANT, "") or "").strip()
+
+
+def tenant_of(producer: str = "default", process_set: Any = None,
+              ctx: Any = None) -> str:
+    """Resolve a submission's tenant: the attached TraceContext's
+    tenant wins, then the process env knob, then a name derived from
+    the process set (disjoint sets = disjoint tenants, the
+    ``tiling_groups()`` multi-job partition), else ``"default"``."""
+    t = getattr(ctx, "tenant", "") or ""
+    if t:
+        return t
+    t = current_tenant()
+    if t:
+        return t
+    ranks = getattr(process_set, "ranks", None)
+    if ranks:
+        return f"ps:{min(ranks)}-{max(ranks)}"
+    return "default"
+
+
+class TenantLane:
+    """One tenant's admission/accounting lane."""
+
+    __slots__ = ("name", "deficit", "inflight", "admitted", "retired",
+                 "cost_s", "preempt_gate_until")
+
+    def __init__(self, name: str):
+        self.name = name
+        self.deficit = 0.0
+        self.inflight = 0
+        self.admitted = 0
+        self.retired = 0
+        self.cost_s = 0.0
+        # cycle number (exclusive) until which this lane's admission is
+        # gated by a preemption request; 0 = not gated.
+        self.preempt_gate_until = 0
+
+    @property
+    def weight(self) -> float:
+        return tenant_weight(self.name)
+
+
+class Arbiter:
+    """Per-service tenant lanes + the DRR cycle scheduler (one per
+    :class:`~horovod_tpu.svc.service.ExchangeService`)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._lanes: Dict[str, TenantLane] = {}
+        self._aborted = False
+        self._cycle = 0
+        # active preemption: (requesting tenant, expiry cycle) or None
+        self._preempt: Optional[Tuple[str, int]] = None
+        # (program signature, axis_size) -> (ici_s, dcn_s): steady
+        # state re-submits the same shapes every cycle, and the pricing
+        # pass sits on the latency-critical dispatch path.  Invalidated
+        # wholesale on a topo-fit epoch bump (re-fit = new prices).
+        self._cost_memo: Dict[Tuple, Tuple[float, float]] = {}
+        self._cost_epoch: Optional[int] = None
+
+    # ------------------------------------------------------------ lanes
+
+    def lane(self, tenant: str) -> TenantLane:
+        with self._lock:
+            return self._lane_locked(tenant)
+
+    def _lane_locked(self, tenant: str) -> TenantLane:
+        ln = self._lanes.get(tenant)
+        if ln is None:
+            ln = self._lanes[tenant] = TenantLane(tenant)
+        return ln
+
+    def tenants(self) -> List[str]:
+        with self._lock:
+            return sorted(self._lanes)
+
+    def engaged(self) -> bool:
+        return enabled()
+
+    # -------------------------------------------------------- admission
+
+    def admit(self, tenant: str, timeout_s: Optional[float] = None) -> bool:
+        """Admit one submission into ``tenant``'s lane, blocking while
+        the lane is at its in-flight cap or preempt-gated.  Returns
+        True when admitted cleanly; an expired wait admits anyway
+        (``svc.tenant.admission_timeouts``) and a dead/aborted service
+        admits immediately — backpressure must never wedge a producer.
+        The ``svc.admit`` fault site fires here (fault-plan tests gate
+        a tenant's admission deterministically)."""
+        faults.inject("svc.admit", tenant=tenant)
+        cap = tenant_inflight_cap()
+        timeout_s = admit_timeout_s() if timeout_s is None else timeout_s
+        deadline = time.monotonic() + timeout_s
+        waited = False
+        t0 = time.monotonic()
+        clean = True
+        with self._cond:
+            ln = self._lane_locked(tenant)
+            while not self._aborted:
+                gated = self._preempt_gated_locked(ln)
+                over = cap > 0 and ln.inflight >= cap
+                if not over and not gated:
+                    break
+                if not waited:
+                    waited = True
+                    metrics.inc_counter("svc.tenant.throttled")
+                    metrics.inc_counter(f"svc.tenant.throttled.{tenant}")
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    metrics.inc_counter("svc.tenant.admission_timeouts")
+                    clean = False
+                    break
+                self._cond.wait(min(left, 0.25))
+            ln.inflight += 1
+            ln.admitted += 1
+            self._publish_lane_locked(ln)
+        if waited:
+            metrics.observe(
+                f"svc.tenant.admission_wait_seconds.{tenant}",
+                time.monotonic() - t0,
+            )
+        return clean
+
+    def release(self, sub: Any) -> None:
+        """Retire one admitted submission (idempotent — every future
+        resolution path calls it, including inline fallbacks; a
+        never-admitted submission is a no-op)."""
+        tenant = getattr(sub, "tenant", "") or "default"
+        if not getattr(sub, "admitted", False) \
+                or getattr(sub, "lane_released", False):
+            return
+        sub.lane_released = True
+        with self._cond:
+            ln = self._lane_locked(tenant)
+            ln.inflight = max(0, ln.inflight - 1)
+            ln.retired += 1
+            self._publish_lane_locked(ln)
+            self._cond.notify_all()
+
+    def wake_all(self, abort: bool = False) -> None:
+        """Wake every admission waiter (service death/stop): a blocked
+        producer must fall through to inline dispatch, not sleep on a
+        lane no loop will ever drain."""
+        with self._cond:
+            if abort:
+                self._aborted = True
+            self._cond.notify_all()
+
+    def reset_abort(self) -> None:
+        with self._cond:
+            self._aborted = False
+
+    def _preempt_gated_locked(self, ln: TenantLane) -> bool:
+        if self._preempt is None:
+            return False
+        high, until = self._preempt
+        if ln.name == high:
+            return False
+        if self._cycle >= until:
+            return False
+        if ln.weight >= tenant_weight(high):
+            return False
+        return True
+
+    # ------------------------------------------------------- preemption
+
+    def request_preempt(self, tenant: str,
+                        cycles: Optional[int] = None) -> None:
+        """Gate every lower-priority (lower-weight) lane's admission so
+        ``tenant``'s backlog drains first — for at most ``cycles``
+        service cycles (``HVD_TPU_SVC_PREEMPT_CYCLES``), after which
+        the gates lift unconditionally: preemption is bounded, never a
+        starvation primitive."""
+        cycles = preempt_cycles() if cycles is None else max(1, cycles)
+        with self._cond:
+            self._preempt = (tenant, self._cycle + cycles)
+            for ln in self._lanes.values():
+                if ln.name != tenant and ln.weight < tenant_weight(tenant):
+                    ln.preempt_gate_until = self._cycle + cycles
+                    metrics.set_gauge("svc.tenant.preempted", 1.0,
+                                      {"tenant": ln.name})
+        metrics.inc_counter("svc.tenant.preemptions")
+        get_logger().info(
+            "svc arbiter: tenant %s preempting lower-priority lanes "
+            "for <= %d cycles", tenant, cycles,
+        )
+
+    def preempting(self) -> Optional[str]:
+        with self._lock:
+            if self._preempt is None or self._cycle >= self._preempt[1]:
+                return None
+            return self._preempt[0]
+
+    def on_cycle(self, cycle: int) -> None:
+        """Cycle tick from the service loop: advance the preemption
+        clock, lifting expired (or drained) gates."""
+        with self._cond:
+            self._cycle = cycle
+            if self._preempt is not None:
+                high, until = self._preempt
+                ln = self._lanes.get(high)
+                drained = ln is None or (
+                    ln.inflight == 0 and self._queue_depth(high) == 0
+                )
+                if cycle >= until or drained:
+                    self._preempt = None
+                    for lane in self._lanes.values():
+                        if lane.preempt_gate_until:
+                            lane.preempt_gate_until = 0
+                            metrics.set_gauge(
+                                "svc.tenant.preempted", 0.0,
+                                {"tenant": lane.name},
+                            )
+                    self._cond.notify_all()
+
+    def _queue_depth(self, tenant: str) -> int:
+        return int(metrics.get_gauge(
+            "svc.tenant.queue_depth", {"tenant": tenant}) or 0)
+
+    # ------------------------------------------------------------- DRR
+
+    def submission_cost(self, sub: Any) -> Tuple[float, float]:
+        """Priced ``(ici_s, dcn_s)`` rail occupancy of one submission:
+        wire bytes split per network class through the XIR byte model,
+        converted to seconds by the fitted per-rail parameters.  Memoized
+        per (program signature, axis size) — steady state re-prices
+        nothing — and invalidated when the topo fit refits.  A
+        submission that cannot be priced (exotic program) charges the
+        quantum — it still participates in fairness, just coarsely."""
+        try:
+            from ..topo import fit as topo_fit
+            from ..topo import model as topo_model
+            from ..xir import lower as lower_mod
+
+            epoch = topo_fit.fit_epoch()
+            if epoch != self._cost_epoch:
+                self._cost_memo.clear()
+                self._cost_epoch = epoch
+            key = (sub.program.signature(),
+                   getattr(sub, "axis_size", None))
+            hit = self._cost_memo.get(key)
+            if hit is not None:
+                return hit
+            _, net = lower_mod.program_bytes(
+                sub.program, getattr(sub, "axis_size", None)
+            )
+            topo = topo_model.current()
+            cost = topo.rail_occupancy_seconds(net)
+            if len(self._cost_memo) > 4096:
+                self._cost_memo.clear()
+            self._cost_memo[key] = cost
+            return cost
+        except Exception:
+            q = quantum_s()
+            return (q, q)
+
+    def schedule(self, ready: Sequence[Any],
+                 cycle: int = 0) -> List[Tuple[str, List[Any]]]:
+        """Order one cycle's released submissions into per-tenant
+        dispatch groups by deficit round robin.  Work-conserving: every
+        submission appears in the output exactly once, this cycle — the
+        arbiter reorders, it never defers.  One tenant (or an empty
+        cycle) returns the input order unchanged, which is what makes
+        single-tenant arbiter-on bitwise identical to off."""
+        by_tenant: Dict[str, List[Any]] = {}
+        for s in ready:
+            by_tenant.setdefault(
+                getattr(s, "tenant", "") or "default", []
+            ).append(s)
+        if len(by_tenant) <= 1:
+            return [(t, list(subs)) for t, subs in by_tenant.items()]
+        names = sorted(by_tenant)
+        costs: Dict[int, float] = {}
+        rails: Dict[int, Tuple[float, float]] = {}
+        for subs in by_tenant.values():
+            for s in subs:
+                ici, dcn = self.submission_cost(s)
+                rails[id(s)] = (ici, dcn)
+                costs[id(s)] = ici + dcn
+        q = quantum_s()
+        out: List[Tuple[str, List[Any]]] = []
+        with self._lock:
+            pending = {t: list(subs) for t, subs in by_tenant.items()}
+            lanes = {t: self._lane_locked(t) for t in names}
+            while any(pending.values()):
+                emitted = False
+                # Visit lanes cheapest-head-first (ties by name): the
+                # whole point of the arbiter is that a tenant's small
+                # exchange never queues behind a neighbour's bulk, and
+                # the *share* fairness lives in the deficit accounting,
+                # not the visit order — a heavy lane still drains its
+                # quantum's worth every round.
+                order = sorted(
+                    (t for t in names if pending[t]),
+                    key=lambda t: (costs[id(pending[t][0])], t),
+                )
+                for t in order:
+                    queue = pending[t]
+                    if not queue:
+                        continue
+                    ln = lanes[t]
+                    ln.deficit += q * ln.weight
+                    batch: List[Any] = []
+                    while queue and costs[id(queue[0])] <= ln.deficit:
+                        s = queue.pop(0)
+                        ln.deficit -= costs[id(s)]
+                        ln.cost_s += costs[id(s)]
+                        ici, dcn = rails[id(s)]
+                        self._charge_rails_locked(t, ici, dcn)
+                        batch.append(s)
+                    if batch:
+                        emitted = True
+                        out.append((t, batch))
+                    if not queue:
+                        # DRR rule: an idle lane carries no credit into
+                        # the next busy period.
+                        ln.deficit = 0.0
+                if not emitted:
+                    # No head fits any deficit yet: loop — deficits grow
+                    # by quantum*weight per round, so the cheapest head
+                    # dispatches after finitely many rounds.
+                    continue
+        metrics.inc_counter("svc.arbiter.cycles")
+        metrics.inc_counter("svc.arbiter.groups", len(out))
+        self._publish_usage()
+        return out
+
+    def _charge_rails_locked(self, tenant: str, ici_s: float,
+                             dcn_s: float) -> None:
+        for rail, val in (("ici", ici_s), ("dcn", dcn_s)):
+            prev = metrics.get_gauge(
+                "svc.tenant.rail_seconds", {"tenant": tenant, "rail": rail}
+            ) or 0.0
+            metrics.set_gauge("svc.tenant.rail_seconds", prev + val,
+                              {"tenant": tenant, "rail": rail})
+
+    # ------------------------------------------------------ accounting
+
+    def charge_dispatch(self, sub: Any, program: Any,
+                        axis_size: Optional[int] = None) -> None:
+        """Post-dispatch accounting: the submission's wire bytes land
+        in the per-tenant rail-byte gauges and its queue wait in the
+        per-tenant wait histogram (the ``/tenants`` p50/p99)."""
+        tenant = getattr(sub, "tenant", "") or "default"
+        metrics.inc_counter(f"svc.tenant.dispatches.{tenant}")
+        try:
+            from ..xir import lower as lower_mod
+
+            _, net = lower_mod.program_bytes(program, axis_size)
+        except Exception:
+            net = {"ici": 0, "dcn": 0}
+        for rail in ("ici", "dcn"):
+            if net.get(rail):
+                prev = metrics.get_gauge(
+                    f"svc.tenant.{rail}_bytes", {"tenant": tenant}
+                ) or 0.0
+                metrics.set_gauge(f"svc.tenant.{rail}_bytes",
+                                  prev + net[rail], {"tenant": tenant})
+        enq = getattr(sub, "enqueued_at", 0.0)
+        if enq:
+            metrics.observe(f"svc.tenant.wait_seconds.{tenant}",
+                            max(0.0, time.monotonic() - enq))
+
+    def _publish_lane_locked(self, ln: TenantLane) -> None:
+        metrics.set_gauge("svc.tenant.inflight", ln.inflight,
+                          {"tenant": ln.name})
+
+    def _publish_usage(self) -> None:
+        """``svc.tenant.share`` (configured weight fraction) vs
+        ``svc.tenant.usage`` (observed priced-cost fraction) — the pair
+        the ``/tenants`` endpoint reports per tenant."""
+        with self._lock:
+            lanes = list(self._lanes.values())
+        total_w = sum(ln.weight for ln in lanes) or 1.0
+        total_c = sum(ln.cost_s for ln in lanes)
+        for ln in lanes:
+            metrics.set_gauge("svc.tenant.share", ln.weight / total_w,
+                              {"tenant": ln.name})
+            if total_c > 0:
+                metrics.set_gauge("svc.tenant.usage",
+                                  ln.cost_s / total_c,
+                                  {"tenant": ln.name})
+
+    def lane_stats(self) -> Dict[str, Dict[str, Any]]:
+        """Local per-tenant accounting snapshot (tests + the in-process
+        half of ``/tenants``)."""
+        with self._lock:
+            return {
+                ln.name: {
+                    "inflight": ln.inflight,
+                    "admitted": ln.admitted,
+                    "retired": ln.retired,
+                    "weight": ln.weight,
+                    "cost_s": ln.cost_s,
+                    "preempt_gated": self._preempt_gated_locked(ln),
+                }
+                for ln in self._lanes.values()
+            }
+
+
+# ---------------------------------------------------- /tenants payload
+
+_TENANT_GAUGES = ("svc.tenant.queue_depth", "svc.tenant.inflight",
+                  "svc.tenant.dcn_bytes", "svc.tenant.ici_bytes",
+                  "svc.tenant.share", "svc.tenant.usage")
+_WAIT_PREFIX = "svc.tenant.wait_seconds."
+_ADMIT_PREFIX = "svc.tenant.admission_wait_seconds."
+
+
+def _tenant_gauges(snapshot: Dict[str, Any]) -> Dict[str, Dict[str, float]]:
+    out: Dict[str, Dict[str, float]] = {}
+    for g in snapshot.get("gauges") or ():
+        name = g.get("name")
+        labels = g.get("labels") or {}
+        tenant = labels.get("tenant")
+        if not tenant or name not in _TENANT_GAUGES:
+            continue
+        short = name[len("svc.tenant."):]
+        out.setdefault(tenant, {})[short] = float(g.get("value") or 0.0)
+    for g in snapshot.get("gauges") or ():
+        if g.get("name") != "svc.tenant.rail_seconds":
+            continue
+        labels = g.get("labels") or {}
+        tenant, rail = labels.get("tenant"), labels.get("rail")
+        if tenant and rail:
+            out.setdefault(tenant, {})[f"rail_seconds_{rail}"] = float(
+                g.get("value") or 0.0
+            )
+    return out
+
+
+def _tenant_waits(snapshot: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
+    out: Dict[str, Dict[str, Any]] = {}
+    for name, hist in (snapshot.get("histograms") or {}).items():
+        for prefix, key in ((_WAIT_PREFIX, "wait"),
+                            (_ADMIT_PREFIX, "admission_wait")):
+            if not name.startswith(prefix):
+                continue
+            tenant = name[len(prefix):]
+            count = int(hist.get("count", 0))
+            if count <= 0:
+                continue
+            out.setdefault(tenant, {})[key] = {
+                "p50": metrics.hist_quantile(hist, 0.5),
+                "p99": metrics.hist_quantile(hist, 0.99),
+                "count": count,
+            }
+    return out
+
+
+def tenants_payload(per_rank: Dict[int, Dict[str, Any]]) -> Dict[str, Any]:
+    """The ``GET /tenants`` body: per-tenant accounting aggregated from
+    each rank's pushed metrics snapshot — queue depth and rail bytes
+    summed across ranks, wait quantiles per rank, share/usage from the
+    max reporter (every rank's arbiter computes the same fractions).
+    Shape: ``{"tenants": {name: {...}}, "ranks": {rank: {tenants}}}``.
+    """
+    tenants: Dict[str, Dict[str, Any]] = {}
+    ranks: Dict[str, Dict[str, Any]] = {}
+    for rank, snap in sorted(per_rank.items()):
+        gauges = _tenant_gauges(snap)
+        waits = _tenant_waits(snap)
+        rank_view: Dict[str, Any] = {}
+        for tenant in sorted(set(gauges) | set(waits)):
+            entry = dict(gauges.get(tenant, {}))
+            entry.update(waits.get(tenant, {}))
+            rank_view[tenant] = entry
+            agg = tenants.setdefault(tenant, {
+                "queue_depth": 0.0, "inflight": 0.0,
+                "dcn_bytes": 0.0, "ici_bytes": 0.0,
+                "share": 0.0, "usage": 0.0, "ranks": 0,
+            })
+            agg["ranks"] += 1
+            for k in ("queue_depth", "inflight", "dcn_bytes",
+                      "ici_bytes"):
+                agg[k] += float(entry.get(k, 0.0) or 0.0)
+            for k in ("share", "usage"):
+                agg[k] = max(agg[k], float(entry.get(k, 0.0) or 0.0))
+            w = entry.get("wait")
+            if w:
+                worst = agg.get("wait_p99_s") or 0.0
+                agg["wait_p50_s"] = w.get("p50")
+                agg["wait_p99_s"] = max(worst, w.get("p99") or 0.0)
+        if rank_view:
+            ranks[str(rank)] = rank_view
+    return {"tenants": tenants, "ranks": ranks}

@@ -323,14 +323,21 @@ def plan_cycle(resolved: Sequence[Tuple[Any, Any]],
         segs, elems, payload, padding = group_layout(
             shapes, align, itemsize
         )
-        fb = open_buffers.get(key)
+        # Tenant isolation (svc/arbiter.py): two tenants' submissions
+        # never share a wire buffer, so one tenant's fused payload — and
+        # therefore its results — is a pure function of its OWN traffic
+        # (the "arbiter on ≡ off bitwise per tenant" contract).  With
+        # one tenant the extra key element is constant: layouts are
+        # identical to the pre-tenant packer.
+        bucket_key = (key, getattr(sub, "tenant", "") or "default")
+        fb = open_buffers.get(bucket_key)
         if fb is not None and threshold and \
                 (fb.total_elems + elems) * itemsize > threshold:
             fb = None  # buffer full: the next member opens a new one
         if fb is None:
             fb = FusedBuffer(key=key, members=[], total_elems=0,
                              payload_bytes=0, padding_bytes=0)
-            open_buffers[key] = fb
+            open_buffers[bucket_key] = fb
             buffers.append(fb)
         base = fb.total_elems
         fb.members.append(FusedMember(

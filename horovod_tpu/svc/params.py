@@ -147,6 +147,21 @@ class ServiceParameterManager:
         same knob; exposed here so the loop has one params surface)."""
         return fuse.fusion_threshold()
 
+    def arbiter_enabled(self) -> bool:
+        """Whether the multi-tenant arbiter re-orders this cycle
+        (``svc/arbiter.py`` owns the knob; exposed here so the loop has
+        one params surface for every per-cycle policy read)."""
+        from . import arbiter
+
+        return arbiter.enabled()
+
+    def tenant_inflight(self) -> int:
+        """Per-tenant admission cap (``HVD_TPU_SVC_TENANT_INFLIGHT``;
+        0 = unbounded)."""
+        from . import arbiter
+
+        return arbiter.tenant_inflight_cap()
+
     def store_key(self) -> str:
         """The pair's tune-DB identity.  The knob fingerprint excludes
         the resolved (cycle_time, fusion_threshold) pair itself: the

@@ -80,11 +80,42 @@ class Submission:
     axis_size: Optional[int] = None
     process_set: Any = None
     enqueued_at: float = 0.0
+    # Multi-tenant identity (svc/arbiter.py): which job this exchange
+    # belongs to — the arbiter's lane key.  Stamped by submit() from
+    # the trace context / env knob / process set; "" reads as the
+    # single "default" lane everywhere.
+    tenant: str = ""
+    # Admission bookkeeping (svc/arbiter.py): ``admitted`` is set by
+    # submit() once the lane slot is taken; ``lane_released`` once by
+    # Arbiter.release() so every resolution path (loop dispatch, fused
+    # member, inline fallback, kill) can release it idempotently.
+    admitted: bool = False
+    lane_released: bool = False
     # Trace correlation (trace/context.py): stamped by submit() from
     # the program's attached context (or minted fresh), so every span
     # the service emits for this submission — queue wait, negotiation,
     # cache, dispatch — carries one trace id end to end.
     trace: Any = None
+
+
+def _round_robin(items: Sequence[Submission]) -> List[Submission]:
+    """Interleave pending submissions one-per-producer per round (the
+    pop-fairness order): producers keep their own seq order and are
+    visited in oldest-pending-seq order, so the result is a pure
+    function of what is queued — deterministic across runs — and a
+    single producer degenerates to plain seq order."""
+    per: dict = {}
+    for s in sorted(items, key=lambda s: s.seq):
+        per.setdefault(s.producer, []).append(s)
+    lanes = sorted(per.values(), key=lambda subs: subs[0].seq)
+    out: List[Submission] = []
+    round_idx = 0
+    while len(out) < len(items):
+        for subs in lanes:
+            if round_idx < len(subs):
+                out.append(subs[round_idx])
+        round_idx += 1
+    return out
 
 
 class TensorQueue:
@@ -98,6 +129,7 @@ class TensorQueue:
         self._seq = 0
         self._closed = False
         self._producers: set = set()
+        self._tenants: set = set()
         self.capacity = int(capacity)
 
     def next_seq(self) -> int:
@@ -134,7 +166,16 @@ class TensorQueue:
         submission is visible the pop waits that much longer before
         draining, so a burst of producers lands in ONE cycle batch —
         and one fusion pass (``svc/fuse.py``) — instead of one cycle
-        each.  A close wakes the linger immediately."""
+        each.  A close wakes the linger immediately.
+
+        The batch order is **round-robin across producers**, not pure
+        arrival order: each producer's own submissions stay in seq
+        order, but the cycle interleaves one submission per producer
+        per round (producers ordered by their oldest pending seq).  A
+        chatty producer that lingered 30 submissions into the cycle can
+        therefore no longer starve a quiet producer's single submission
+        to the back of the batch — it dispatches within one round.
+        With one producer this IS seq order, unchanged."""
         with self._not_empty:
             if not self._items and not self._closed:
                 self._not_empty.wait(timeout)
@@ -145,7 +186,7 @@ class TensorQueue:
                     if left <= 0:
                         break
                     self._not_empty.wait(left)
-            batch = sorted(self._items, key=lambda s: s.seq)
+            batch = _round_robin(self._items)
             self._items.clear()
             self._publish_depth_locked()
         # Queue-wait spans (trace/): enqueue -> this pop, per
@@ -189,12 +230,23 @@ class TensorQueue:
         # Per-producer backlog, one labeled series per producer (the
         # /metrics satellite).  Every producer ever seen keeps its
         # series — a drained producer reads 0, not a stale last value.
+        # Per-tenant backlog mirrors it for the arbiter's lanes and the
+        # driver's /tenants endpoint (same decay-to-0 contract).
         per: dict = {}
+        per_tenant: dict = {}
         for s in self._items:
             per[s.producer] = per.get(s.producer, 0) + 1
+            tenant = s.tenant or "default"
+            per_tenant[tenant] = per_tenant.get(tenant, 0) + 1
         self._producers.update(per)
+        self._tenants.update(per_tenant)
         metrics.set_gauge("svc.queue_depth", len(self._items))
         for prod in self._producers:
             metrics.set_gauge(
                 "svc.queue_depth", per.get(prod, 0), {"producer": prod}
+            )
+        for tenant in self._tenants:
+            metrics.set_gauge(
+                "svc.tenant.queue_depth", per_tenant.get(tenant, 0),
+                {"tenant": tenant},
             )

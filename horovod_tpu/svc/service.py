@@ -52,6 +52,7 @@ from .. import faults, metrics
 from ..exceptions import FaultInjected, HorovodTpuError
 from ..utils import env
 from ..utils.logging import get_logger
+from . import arbiter as arbiter_mod
 from . import fuse, params as svc_params
 from .cache import CachedResponse, ResponseCache
 from .negotiate import Negotiator
@@ -100,6 +101,7 @@ class ExchangeService:
         self.negotiator = Negotiator()
         self.cache = ResponseCache()
         self.params = svc_params.ServiceParameterManager()
+        self.arbiter = arbiter_mod.Arbiter()
         self._lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._stop = threading.Event()
@@ -156,7 +158,29 @@ class ExchangeService:
                 ready: List[Submission] = []
                 for sub in batch:
                     ready.extend(self.negotiator.post(sub))
-                self._dispatch_ready(ready)
+                if self.arbiter.engaged():
+                    # Weighted-fair dispatch (svc/arbiter.py): the
+                    # cycle's released submissions are re-ordered into
+                    # per-tenant DRR groups — fusion then runs per
+                    # group, so one tenant's wire buffers never depend
+                    # on another tenant's presence.  One tenant = one
+                    # group in seq order = the FIFO path exactly.
+                    groups = self.arbiter.schedule(ready, self._cycle)
+                    for gi, (_tenant, subs) in enumerate(groups):
+                        self._dispatch_ready(subs)
+                        if gi + 1 < len(groups):
+                            # Bounded GIL handoff between tenant
+                            # groups: the tenant whose futures just
+                            # resolved must actually WAKE before the
+                            # next tenant's bulk dispatch holds the
+                            # interpreter for several switch intervals
+                            # — 100 µs here beats ~5 ms of default
+                            # switch-interval starvation on the
+                            # latency-sensitive lane.
+                            time.sleep(1e-4)
+                else:
+                    self._dispatch_ready(ready)
+                self.arbiter.on_cycle(self._cycle)
                 self.params.on_cycle()
                 self.negotiator.check_stalls()
             except FaultInjected as e:
@@ -187,6 +211,9 @@ class ExchangeService:
             self._dead = True
             self._death_reason = reason
         metrics.inc_counter("svc.deaths")
+        # Admission waiters must not sleep on a lane no loop will ever
+        # drain: wake them into the inline-fallback path.
+        self.arbiter.wake_all(abort=True)
         from .. import trace
 
         trace.trigger_dump("svc_death", death_reason=reason)
@@ -206,6 +233,7 @@ class ExchangeService:
         """Stop the loop (clean shutdown — not a death): pending
         submissions are still resolved inline so futures never hang."""
         self._stop.set()
+        self.arbiter.wake_all(abort=True)
         leftovers = self.queue.close()
         orphans = self.negotiator.abandon()
         for sub in sorted(leftovers + orphans, key=lambda s: s.seq):
@@ -305,9 +333,11 @@ class ExchangeService:
         compatible programs into fused wire buffers (``svc/fuse.py`` —
         the reference FusionBufferManager's cycle behavior).  With the
         threshold at 0 this is exactly the pre-fusion loop: every
-        submission dispatches separately in sequence order."""
+        submission dispatches separately, in the order the cycle
+        produced (the queue's producer round-robin, then the arbiter's
+        DRR groups — one producer/tenant worlds reduce to seq order)."""
         threshold = self.params.fusion_threshold()
-        subs = sorted(ready, key=lambda s: s.seq)
+        subs = list(ready)
         if threshold <= 0 or len(subs) == 0:
             for sub in subs:
                 self._dispatch(sub)
@@ -334,9 +364,10 @@ class ExchangeService:
         buffers, passthrough = fuse.plan_cycle(
             [(s, p) for s, p in resolved if p is not None], threshold
         )
+        pos = {id(s): i for i, s in enumerate(subs)}
         passthrough = sorted(
             passthrough + [s for s, p in resolved if p is None],
-            key=lambda s: s.seq,
+            key=lambda s: pos[id(s)],
         )
         for sub in passthrough:
             metrics.inc_counter("svc.fusion.buffers_out")
@@ -384,6 +415,9 @@ class ExchangeService:
             for m in fb.members:
                 take = len(m.segments)
                 m.sub.future.set_result(list(outs[pos:pos + take]))
+                self.arbiter.charge_dispatch(m.sub, m.program,
+                                             m.sub.axis_size)
+                self.arbiter.release(m.sub)
                 metrics.inc_counter("svc.dispatches.fused_members")
                 metrics.inc_counter(
                     f"svc.programs.{m.program.kind}"
@@ -463,12 +497,16 @@ class ExchangeService:
                     )
                 with self._inflight_guard():
                     outs = entry.executor(tuple(sub.args))
+            sub.future.set_result(list(outs))
             metrics.inc_counter("svc.dispatches")
             metrics.inc_counter(f"svc.programs.{sub.program.kind}")
             self._record_timeline(entry.program)
-            sub.future.set_result(list(outs))
+            self.arbiter.charge_dispatch(sub, entry.program,
+                                         sub.axis_size)
         except BaseException as e:  # noqa: BLE001 - future carries it
             sub.future.set_exception(e)
+        finally:
+            self.arbiter.release(sub)
 
     def _inflight_guard(self):
         svc = self
@@ -514,6 +552,7 @@ class ExchangeService:
         participants: Optional[Sequence[str]] = None,
         axis_size: Optional[int] = None,
         process_set=None,
+        tenant: Optional[str] = None,
     ) -> SvcFuture:
         """Enqueue one program with its payloads; returns the future
         the producer collects outputs from.
@@ -524,6 +563,12 @@ class ExchangeService:
         named producer has submitted a matching signature.  A dead
         service (or a fault at the ``svc.submit`` site) resolves the
         future synchronously inline instead (``svc.fallback_sync``).
+
+        ``tenant`` names the submission's arbiter lane (default:
+        resolved from the trace context / ``HVD_TPU_SVC_TENANT`` / the
+        process set — :func:`~horovod_tpu.svc.arbiter.tenant_of`).  A
+        lane at its ``HVD_TPU_SVC_TENANT_INFLIGHT`` cap blocks here —
+        admission backpressure — until the loop retires its backlog.
         """
         if len(args) != len(program.ops):
             raise HorovodTpuError(
@@ -537,13 +582,21 @@ class ExchangeService:
         ctx = program.trace or (
             trace.new_context(producer) if trace.enabled() else None
         )
+        tenant = tenant or arbiter_mod.tenant_of(
+            producer, process_set=process_set, ctx=ctx
+        )
+        if ctx is not None and not ctx.tenant:
+            import dataclasses as _dc
+
+            ctx = _dc.replace(ctx, tenant=tenant)
+        metrics.inc_counter(f"svc.tenant.submits.{tenant}")
         future = SvcFuture()
         sub = Submission(
             seq=self.queue.next_seq(), producer=producer,
             program=program, args=list(args), future=future,
             participants=tuple(participants or ()),
             axis_size=axis_size, process_set=process_set,
-            trace=ctx,
+            trace=ctx, tenant=tenant,
         )
         try:
             faults.inject("svc.submit", producer=producer,
@@ -551,6 +604,19 @@ class ExchangeService:
         except FaultInjected as e:
             self._kill(f"fault injected at svc.submit: {e}")
         if self._dead or not self._ensure_loop():
+            metrics.inc_counter("svc.fallback_sync")
+            self._dispatch(sub)
+            return future
+        # Admission backpressure (svc/arbiter.py): blocks while the
+        # tenant's lane is at its in-flight cap or preempt-gated.  The
+        # slot is released by whichever path resolves the future — the
+        # loop, a fused buffer, or the inline fallbacks below.
+        try:
+            self.arbiter.admit(tenant)
+            sub.admitted = True
+        except FaultInjected as e:
+            self._kill(f"fault injected at svc.admit: {e}")
+        if self._dead:
             metrics.inc_counter("svc.fallback_sync")
             self._dispatch(sub)
             return future

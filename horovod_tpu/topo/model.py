@@ -269,6 +269,25 @@ class Topology:
         )
         return serial, fused
 
+    def rail_occupancy_seconds(
+        self, net_bytes: dict
+    ) -> Tuple[float, float]:
+        """Priced ``(ici_s, dcn_s)`` occupancy of a per-network byte
+        split (the ``{"ici": ..., "dcn": ...}`` shape
+        ``xir/lower.op_network_bytes`` produces): bytes over the fitted
+        per-rail bandwidth plus one launch overhead per touched rail.
+        This is the multi-tenant arbiter's fairness price
+        (``svc/arbiter.py``) — coarse by design (per-hop latency terms
+        are folded into the overhead), but it rides the same fitted
+        parameters as :meth:`estimate_cost`, so a measured fit reprices
+        tenant shares automatically."""
+        po, _ici_lat, _dcn_lat, ici_bw, dcn_bw = self._cost_params()
+        ici = int(net_bytes.get("ici") or 0)
+        dcn = int(net_bytes.get("dcn") or 0)
+        ici_s = (po + ici / max(ici_bw, 1.0)) if ici > 0 else 0.0
+        dcn_s = (po + dcn / max(dcn_bw, 1.0)) if dcn > 0 else 0.0
+        return ici_s, dcn_s
+
     def lowering_bytes(
         self,
         collective: str,

@@ -67,13 +67,26 @@ class TraceContext:
         return dataclasses.replace(self, span_id=span_id)
 
 
+def default_tenant() -> str:
+    """This process's configured tenant (``HVD_TPU_SVC_TENANT``, the
+    multi-tenant arbiter's lane key — docs/multitenant.md); "" when the
+    process is not tenant-tagged (submission-time derivation from the
+    process set then applies, ``svc/arbiter.tenant_of``)."""
+    from ..utils import env
+
+    return (env.get_env(env.SVC_TENANT, "") or "").strip()
+
+
 def new_context(producer: str = "default",
                 tenant: str = "") -> TraceContext:
     """Mint a fresh trace id: ``r<rank>-<seq>`` — unique per process,
-    attributable to a rank in a merged cross-rank view."""
+    attributable to a rank in a merged cross-rank view.  ``tenant``
+    defaults to the process's ``HVD_TPU_SVC_TENANT`` tag so every
+    producer-minted context is tenant-attributable without call-site
+    changes."""
     return TraceContext(
         trace_id=f"r{_rank()}-{next(_counter)}",
-        producer=producer, tenant=tenant,
+        producer=producer, tenant=tenant or default_tenant(),
     )
 
 

@@ -25,6 +25,7 @@ from .. import metrics
 from ..utils import env
 
 PHASE_PREFIX = "trace.phase_seconds."
+TENANT_PREFIX = "trace.tenant_seconds."
 DEFAULT_Z = 2.0
 # Absolute floor (seconds): a phase whose p50 is under this never
 # flags — sub-0.1ms spans are measurement noise, not stragglers.
@@ -53,6 +54,44 @@ def phase_summary(snapshot: Dict[str, Any]) -> Dict[str, Dict[str, Any]]:
             "sum": float(hist.get("sum", 0.0)),
         }
     return out
+
+
+def tenant_summary(
+    snapshot: Dict[str, Any]
+) -> Dict[str, Dict[str, Dict[str, Any]]]:
+    """Per-tenant per-phase {p50, p99, count} from one rank's snapshot
+    (the ``trace.tenant_seconds.<tenant>.<phase>`` histograms the
+    tracer folds tenant-tagged spans into) — the attribution half of
+    the multi-tenant arbiter: a slow phase names its tenant, not just
+    its rank."""
+    out: Dict[str, Dict[str, Dict[str, Any]]] = {}
+    for name, hist in (snapshot.get("histograms") or {}).items():
+        if not name.startswith(TENANT_PREFIX):
+            continue
+        tenant, _, phase = name[len(TENANT_PREFIX):].rpartition(".")
+        if not tenant:
+            continue
+        count = int(hist.get("count", 0))
+        if count <= 0:
+            continue
+        out.setdefault(tenant, {})[phase] = {
+            "p50": metrics.hist_quantile(hist, 0.5),
+            "p99": metrics.hist_quantile(hist, 0.99),
+            "count": count,
+        }
+    return out
+
+
+def _slowest_tenant(snapshot: Dict[str, Any],
+                    phase: str) -> Optional[str]:
+    """The tenant with the largest p50 for ``phase`` on this rank —
+    the per-tenant attribution attached to a straggler verdict."""
+    worst, worst_p50 = None, 0.0
+    for tenant, phases in tenant_summary(snapshot).items():
+        p50 = (phases.get(phase) or {}).get("p50")
+        if p50 is not None and p50 > worst_p50:
+            worst, worst_p50 = tenant, p50
+    return worst
 
 
 def _counter(snapshot: Dict[str, Any], name: str) -> int:
@@ -98,6 +137,9 @@ def detect(per_rank: Dict[int, Dict[str, Any]],
                     "p50": p50,
                     "median_p50": median,
                     "ratio": p50 / baseline,
+                    # Which tenant's traffic dominates the slow phase
+                    # on this rank (None in untagged worlds).
+                    "tenant": _slowest_tenant(per_rank[rank], phase),
                 })
     return sorted(found, key=lambda f: -f["ratio"])
 
@@ -125,12 +167,16 @@ def trace_payload(per_rank: Dict[int, Dict[str, Any]],
     publish(stragglers)
     ranks = {}
     for rank, snap in sorted(per_rank.items()):
-        ranks[str(rank)] = {
+        entry = {
             "phases": phase_summary(snap),
             "anomaly_dumps": _counter(snap, "trace.anomaly_dumps"),
             "last_anomaly_dump": _gauge(snap, "trace.last_anomaly_dump"),
             "steps": _counter(snap, "trace.steps"),
         }
+        tenants = tenant_summary(snap)
+        if tenants:
+            entry["tenants"] = tenants
+        ranks[str(rank)] = entry
     return {
         "stragglers": stragglers,
         "straggler_z": straggler_z() if z is None else float(z),

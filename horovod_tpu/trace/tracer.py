@@ -95,11 +95,12 @@ class Span:
     back to back at startup, the Timeline scheme)."""
 
     __slots__ = ("name", "phase", "t0", "t1", "trace_id", "span_id",
-                 "parent_id", "producer", "attrs", "children")
+                 "parent_id", "producer", "tenant", "attrs", "children")
 
     def __init__(self, name: str, phase: str, t0: float,
                  trace_id: str = "", span_id: str = "",
                  parent_id: str = "", producer: str = "",
+                 tenant: str = "",
                  attrs: Optional[Dict[str, Any]] = None):
         self.name = name
         self.phase = phase
@@ -109,6 +110,7 @@ class Span:
         self.span_id = span_id
         self.parent_id = parent_id
         self.producer = producer
+        self.tenant = tenant
         self.attrs = attrs or {}
         self.children: List["Span"] = []
 
@@ -129,6 +131,8 @@ class Span:
             d["parent_id"] = self.parent_id
         if self.producer:
             d["producer"] = self.producer
+        if self.tenant:
+            d["tenant"] = self.tenant
         if self.attrs:
             d["attrs"] = dict(self.attrs)
         if self.children:
@@ -217,6 +221,8 @@ class Tracer:
             if not span.trace_id:
                 span.trace_id = st[-1].trace_id
                 span.producer = span.producer or st[-1].producer
+        if st and not span.tenant:
+            span.tenant = st[-1].tenant
         st.append(span)
 
     def _pop(self, span: Span, step: bool = False) -> None:
@@ -246,6 +252,7 @@ class Tracer:
             trace_id=getattr(ctx, "trace_id", ""),
             parent_id=getattr(ctx, "span_id", "") if ctx else "",
             producer=getattr(ctx, "producer", ""),
+            tenant=getattr(ctx, "tenant", ""),
             span_id=f"s{next(_span_counter)}",
             attrs=attrs or None,
         )
@@ -279,6 +286,7 @@ class Tracer:
             trace_id=getattr(ctx, "trace_id", ""),
             parent_id=getattr(ctx, "span_id", "") if ctx else "",
             producer=getattr(ctx, "producer", ""),
+            tenant=getattr(ctx, "tenant", ""),
             span_id=f"s{next(_span_counter)}",
             attrs=attrs or None,
         )
@@ -302,6 +310,16 @@ class Tracer:
         for s in span.walk():
             n += 1
             metrics.observe(f"trace.phase_seconds.{s.phase}", s.dur)
+            # Per-tenant phase attribution (the multi-tenant arbiter's
+            # observability half, docs/multitenant.md): tenant-tagged
+            # spans additionally fold into trace.tenant_seconds.<tenant>
+            # .<phase> so the driver's straggler detector can say WHICH
+            # tenant a slow phase belongs to.  Untagged worlds pay
+            # nothing.
+            if s.tenant:
+                metrics.observe(
+                    f"trace.tenant_seconds.{s.tenant}.{s.phase}", s.dur
+                )
         metrics.inc_counter("trace.spans", n)
         if step:
             self._publish_rail_utilization(span)

@@ -163,6 +163,45 @@ SVC_CYCLE_TIME = "SVC_CYCLE_TIME"
 # candidate pairs from the metrics registry, freeze the winner, pin it
 # into the env knobs, and persist it in the tune DB for warm starts.
 SVC_TUNE = "SVC_TUNE"  # off (default) | on
+# Multi-tenant exchange arbiter (svc/arbiter.py): weighted-fair rail
+# scheduling of one cycle's released submissions across tenants.
+#   off = (default) FIFO cycle dispatch, the PR 14 behavior exactly;
+#   on  = deficit-round-robin across tenant lanes, each batch priced
+#         by its ICI/DCN occupancy through the fitted per-rail cost
+#         model and charged against the tenant's weighted share.
+# Single-tenant worlds are bitwise-identical either way (one lane
+# degenerates to seq order).  See docs/multitenant.md.
+SVC_ARBITER = "SVC_ARBITER"  # off (default) | on
+# This process's tenant name (stamped into every TraceContext and
+# Submission).  Unset = derived from the submission's process set
+# (``ps:<r0>-<rN>``) when one is attached, else "default".
+SVC_TENANT = "SVC_TENANT"
+# Per-tenant in-flight cap: how many submissions one tenant may have
+# queued/negotiating/dispatching at once before its submit() calls
+# block (admission backpressure instead of unbounded queue growth).
+# 0 (default) = unbounded, the PR 14 behavior.
+SVC_TENANT_INFLIGHT = "SVC_TENANT_INFLIGHT"
+# Seconds an admission-throttled submit() waits before being admitted
+# anyway (with svc.tenant.admission_timeouts counted) — backpressure
+# must slow a producer, never wedge it.  Default 30.
+SVC_ADMIT_TIMEOUT = "SVC_ADMIT_TIMEOUT"
+# Tenant weights for the deficit-round-robin scheduler:
+# "tenantA:2,tenantB:1" (unlisted tenants weigh 1).  A tenant's share
+# of the priced rail seconds per scheduling round is proportional to
+# its weight.
+SVC_TENANT_WEIGHTS = "SVC_TENANT_WEIGHTS"
+# DRR quantum in microseconds of priced rail time added to each lane's
+# deficit per scheduling round (default 500).  Smaller = finer
+# interleaving; any single batch still dispatches once its lane's
+# deficit accumulates past its price, so progress is unconditional.
+SVC_ARBITER_QUANTUM_US = "SVC_ARBITER_QUANTUM_US"
+# Priority preemption bound: when a high-priority tenant requests
+# preemption (Arbiter.request_preempt), lower-priority lanes' admission
+# stays gated for at most this many service cycles (default 50) even
+# if the high-priority backlog never drains — preemption is bounded,
+# never a starvation primitive.  Priorities ride the weights knob:
+# "tenantA:4" outranks "tenantB:1" (higher weight = higher priority).
+SVC_PREEMPT_CYCLES = "SVC_PREEMPT_CYCLES"
 # Seconds per service-tuner scoring window (default 0.25).
 SVC_TUNE_WINDOW = "SVC_TUNE_WINDOW"
 # ResponseCache capacity (entries).  Shares the reference's

@@ -129,7 +129,8 @@ class TestSkipPathStillRecords:
         )
         for rec in ("_maybe_scaling", "_maybe_topo",
                     "_maybe_quant_backend", "_maybe_adasum",
-                    "_maybe_railpipe", "_maybe_svc_fusion"):
+                    "_maybe_railpipe", "_maybe_svc_fusion",
+                    "_maybe_tenant"):
             monkeypatch.setattr(bench, rec, fake_record(rec))
 
         result = {
@@ -144,7 +145,8 @@ class TestSkipPathStillRecords:
         bench._device_free_records(result, 480, time.monotonic())
         assert ran == ["cpu_fallback", "_maybe_scaling", "_maybe_topo",
                        "_maybe_quant_backend", "_maybe_adasum",
-                       "_maybe_railpipe", "_maybe_svc_fusion"]
+                       "_maybe_railpipe", "_maybe_svc_fusion",
+                       "_maybe_tenant"]
         assert result["reason"]
         assert result["cpu_fallback"]["value"] == 1.0
 
@@ -162,9 +164,56 @@ class TestSkipPathStillRecords:
         monkeypatch.setattr(bench, "_cpu_resnet_fallback", fake)
         for rec in ("_maybe_scaling", "_maybe_topo",
                     "_maybe_quant_backend", "_maybe_adasum",
-                    "_maybe_railpipe", "_maybe_svc_fusion"):
+                    "_maybe_railpipe", "_maybe_svc_fusion",
+                    "_maybe_tenant"):
             monkeypatch.setattr(bench, rec, noop)
         bench._device_free_records(
             {"value": 123.0}, 480, time.monotonic()
         )
         assert ran == []
+
+
+class TestStructuredAbort:
+    def test_outer_escape_emits_structured_skip(self, monkeypatch,
+                                                capsys):
+        """Satellite regression (BENCH_r05): an exception that escapes
+        main() — e.g. a TimeoutExpired racing past the probe — must
+        produce the structured-skip primary record (status/reason, no
+        raw "error" blob) AND still run the device-free records so the
+        CPU-sim resnet fallback can fill the primary metric."""
+        ran = []
+
+        def fake_records(result, deadline_s, t_start):
+            ran.append(True)
+            result["value"] = 42.0  # the cpu_sim fallback's job
+
+        monkeypatch.setattr(bench, "_device_free_records", fake_records)
+        err = subprocess.TimeoutExpired(["python"], 150)
+        record = bench.emit_structured_abort(err, grace_s=60)
+        out = capsys.readouterr().out.strip().splitlines()[-1]
+        import json
+
+        emitted = json.loads(out)
+        assert emitted == record
+        assert record["status"] == "skipped"
+        assert "TimeoutExpired" in record["reason"]
+        assert "error" not in record
+        assert ran == [True]
+        assert record["value"] == 42.0
+
+    def test_records_failure_stays_structured(self, monkeypatch,
+                                              capsys):
+        """Even when the device-free pass itself dies, the emitted line
+        keeps the structured shape (records_error, never "error")."""
+
+        def exploding(result, deadline_s, t_start):
+            raise RuntimeError("records pass died")
+
+        monkeypatch.setattr(bench, "_device_free_records", exploding)
+        record = bench.emit_structured_abort(
+            RuntimeError("boom"), grace_s=30
+        )
+        assert record["status"] == "skipped"
+        assert "records pass died" in record["records_error"]
+        assert "error" not in record
+        assert capsys.readouterr().out.strip()

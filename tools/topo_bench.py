@@ -47,9 +47,21 @@ pipelined emission whose bucket split comes from the fitted per-rail
 bandwidths; the headline value is the serialized/pipelined step-time
 speedup.
 
+``--tenant`` record — ``svc_tenant_interference``: the multi-tenant
+arbiter (``svc/arbiter.py``) on the contention workload it exists for
+— tenant A submits one tiny ICI-local exchange per step while tenant
+B floods the shared service with DCN-heavy flat buckets.  Tenant A's
+submit→result latency is measured three ways: B off (baseline), B on
+under FIFO dispatch (``HVD_TPU_SVC_ARBITER=off`` — the head-of-line
+interference), and B on under the deficit-round-robin arbiter.  The
+headline value is the FIFO/arbiter p99 ratio; the record also reports
+whether the arbiter held A's p99 within the 10% interference bound
+the FIFO baseline measurably breaks.
+
 Run standalone or through ``bench.py`` (which embeds the lines under
 its ``"topo_hier_vs_flat"`` / ``"quant_fused_vs_phase"`` /
-``"adasum_vs_sum"`` / ``"railpipe_overlap"`` keys).
+``"adasum_vs_sum"`` / ``"railpipe_overlap"`` /
+``"svc_tenant_interference"`` keys).
 """
 
 import json
@@ -627,18 +639,176 @@ def main_fusion() -> dict:
     }
 
 
+def main_tenant() -> dict:
+    """The ``svc_tenant_interference`` record: tenant A's small
+    ICI-local exchange latency while tenant B's DCN-heavy buckets
+    share the service, FIFO vs the DRR arbiter.  Fusion is pinned off
+    so the measurement isolates *scheduling* (a fused B still
+    head-of-line blocks with one big buffer; the arbiter's win is the
+    same either way).  Values are checked equal across all three runs
+    — the arbiter is ordering-only."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu import metrics, svc, xir
+    from horovod_tpu.runtime import WORLD_AXIS
+    from horovod_tpu.svc import arbiter
+
+    # 4 ms linger: wide enough that one producer burst (5 submissions)
+    # reliably lands in ONE cycle even when the submitting thread loses
+    # the interpreter mid-burst — a split burst strands tenant A behind
+    # a cycle of B-only dispatches in every mode.
+    os.environ["HVD_TPU_SVC_CYCLE_TIME"] = "4.0"
+    # The latency being measured is millisecond-scale and the waiter
+    # shares the interpreter with the dispatch loop: the default 5 ms
+    # GIL switch interval IS the noise floor otherwise.  Applies to all
+    # three runs equally.
+    import sys as _sys
+
+    _sys.setswitchinterval(0.001)
+    hvd.init()
+    n = hvd.size()
+    half = n // 2
+    slice_groups = tuple(
+        tuple(range(s * half, (s + 1) * half)) for s in range(2)
+    )
+    rng = np.random.RandomState(11)
+    small = jnp.asarray(rng.randn(n, 128).astype(np.float32))
+    big_rows = 1 << 19  # 2 MiB per rank per program: DCN-dominated
+    big = jnp.asarray(rng.randn(n, big_rows).astype(np.float32))
+    n_big = 4
+
+    def a_program():
+        return xir.program("dense_grad", [
+            xir.all_reduce(WORLD_AXIS, reduce="mean", lowering="flat",
+                           groups=slice_groups, nbytes=128 * 4,
+                           dtype="float32"),
+        ])
+
+    def b_program(i):
+        return xir.program("dense_grad", [
+            xir.all_reduce(WORLD_AXIS, reduce="mean", lowering="flat",
+                           bucket=i, nbytes=big_rows * 4,
+                           dtype="float32"),
+        ])
+
+    def run(arbiter_on, b_on, steps=100, warmup=5):
+        svc.reset_service()
+        svc.fuse.set_threshold_override(0)
+        arbiter.set_enabled_override(bool(arbiter_on))
+        try:
+            s = svc.get_service()
+            served = []  # submit -> future resolved (service-side)
+            e2e = []  # submit -> waiter woke with ready payload
+            a_out = None
+            for it in range(warmup + steps):
+                futs_b = []
+                if b_on:
+                    futs_b = [
+                        s.submit(b_program(i), [big],
+                                 producer=f"pb{i}", tenant="b")
+                        for i in range(n_big)
+                    ]
+                t_mono = time.monotonic()
+                t0 = time.perf_counter()
+                fut_a = s.submit(a_program(), [small],
+                                 producer="pa", tenant="a")
+                a_out = fut_a.result(timeout=120)[0]
+                jax.block_until_ready(a_out)
+                dt = time.perf_counter() - t0
+                # Quiesce B's async compute OUTSIDE A's window so the
+                # next step starts from an idle backend: the record
+                # isolates the *scheduling* interference, not CPU-sim
+                # compute contention both modes pay equally.
+                for f in futs_b:
+                    jax.block_until_ready(f.result(timeout=120))
+                if it >= warmup:
+                    # The bound is on the SERVICE-side latency (when
+                    # the arbiter resolved A's future): the extra
+                    # interpreter hop before this waiter thread wakes
+                    # is harness noise the scheduler cannot control,
+                    # reported separately as e2e.
+                    served.append(fut_a.resolved_at - t_mono)
+                    e2e.append(dt)
+            served.sort(), e2e.sort()
+
+            def q(xs, frac):
+                return round(xs[int(frac * (len(xs) - 1))] * 1e3, 3)
+
+            return {
+                "p50_ms": q(served, 0.5),
+                "p99_ms": q(served, 0.99),
+                "e2e_p50_ms": q(e2e, 0.5),
+                "e2e_p99_ms": q(e2e, 0.99),
+                "a_out": np.asarray(a_out),
+            }
+        finally:
+            arbiter.set_enabled_override(None)
+            svc.fuse.set_threshold_override(None)
+
+    baseline = run(arbiter_on=False, b_on=False)
+    fifo = run(arbiter_on=False, b_on=True)
+    fair = run(arbiter_on=True, b_on=True)
+    assert (baseline["a_out"] == fifo["a_out"]).all() and \
+        (baseline["a_out"] == fair["a_out"]).all(), (
+            "arbiter changed tenant A's values — ordering-only "
+            "contract broken"
+        )
+    fifo_shift = fifo["p99_ms"] / max(baseline["p99_ms"], 1e-9) - 1.0
+    fair_shift = fair["p99_ms"] / max(baseline["p99_ms"], 1e-9) - 1.0
+    ratio = fifo["p99_ms"] / max(fair["p99_ms"], 1e-9)
+    assert fifo["p99_ms"] > fair["p99_ms"], (
+        f"FIFO not measurably worse: fifo p99 {fifo['p99_ms']}ms vs "
+        f"arbiter {fair['p99_ms']}ms"
+    )
+    # The headline bound: the arbiter holds tenant A's served p99
+    # within 10% of its B-off baseline (plus 1 ms absolute grace — one
+    # interpreter timeslice, which on the shared-CPU sim is >10% of a
+    # millisecond-scale latency; real pod step times dwarf it).
+    bound_met = fair["p99_ms"] <= baseline["p99_ms"] * 1.10 + 1.0
+    assert bound_met, (
+        f"arbiter interference bound broken: A p99 {fair['p99_ms']}ms "
+        f"vs baseline {baseline['p99_ms']}ms"
+    )
+    keys = ("p50_ms", "p99_ms", "e2e_p50_ms", "e2e_p99_ms")
+    return {
+        "metric": "svc_tenant_interference",
+        "unit": "fifo_over_arbiter_a_p99",
+        "value": round(ratio, 3),
+        "topo": os.environ["HVD_TPU_TOPO"],
+        "tenant_a": {"program_bytes": 128 * 4, "rail": "ici",
+                     "per_step": 1},
+        "tenant_b": {"program_bytes": big_rows * 4, "rail": "dcn",
+                     "per_step": n_big},
+        "a_latency_ms": {
+            "baseline": {k: baseline[k] for k in keys},
+            "fifo": {k: fifo[k] for k in keys},
+            "arbiter": {k: fair[k] for k in keys},
+        },
+        "p99_shift_fifo": round(fifo_shift, 3),
+        "p99_shift_arbiter": round(fair_shift, 3),
+        "interference_bound_met": bool(bound_met),
+        "bitwise_across_modes": True,
+    }
+
+
 if __name__ == "__main__":
     args = sys.argv[1:]
     which = ("quant" if "--quant" in args
              else "adasum" if "--adasum" in args
              else "pipeline" if "--pipeline" in args
-             else "fusion" if "--fusion" in args else "topo")
+             else "fusion" if "--fusion" in args
+             else "tenant" if "--tenant" in args else "topo")
     mains = {"quant": main_quant, "adasum": main_adasum, "topo": main,
-             "pipeline": main_pipeline, "fusion": main_fusion}
+             "pipeline": main_pipeline, "fusion": main_fusion,
+             "tenant": main_tenant}
     names = {"quant": "quant_fused_vs_phase", "adasum": "adasum_vs_sum",
              "topo": "topo_hier_vs_flat",
              "pipeline": "railpipe_overlap",
-             "fusion": "svc_fusion_amortization"}
+             "fusion": "svc_fusion_amortization",
+             "tenant": "svc_tenant_interference"}
     try:
         print(json.dumps(mains[which]()))
     except Exception as e:  # degraded-run hardening: always emit a line
