@@ -1015,7 +1015,7 @@ def run_device_probe(deadline_s: float, armed_at: float,
         # never the outer raw-error blob
         if isinstance(e, (KeyboardInterrupt, SystemExit)):
             raise
-        return {
+        skip = {
             "status": "skipped",
             "reason": (
                 f"device probe exhausted retries: "
@@ -1024,8 +1024,48 @@ def run_device_probe(deadline_s: float, armed_at: float,
             ),
             "probe_stderr": stderr_tail["text"],
         }
+        diagnosis = _probe_diagnosis(deadline_s, armed_at)
+        if diagnosis is not None:
+            skip["probe_diagnosis"] = diagnosis
+        return skip
     _probe_cache_store()
     return None
+
+
+def _probe_diagnosis(deadline_s: float, armed_at: float):
+    """Best-effort root-cause pass over a dead probe: run the staged
+    doctor (``tools/probe_doctor.py`` — import vs backend-init vs
+    compute, each its own bounded subprocess) so the skip record names
+    the sick layer instead of just "exhausted retries".  Bounded to
+    the remaining alarm budget minus the device-free-records reserve;
+    any failure (or no budget) returns None — the doctor must never
+    sink the bench."""
+    try:
+        remaining = deadline_s - (time.monotonic() - armed_at)
+        budget = min(
+            float(os.environ.get("HVD_BENCH_DOCTOR_TIMEOUT_S", "30")),
+            (remaining - 120) / len_doctor_stages(),
+        )
+        if budget < 5:
+            return None
+        import importlib.util
+
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "tools", "probe_doctor.py")
+        spec = importlib.util.spec_from_file_location(
+            "hvd_tpu_probe_doctor", path
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod.diagnose(timeout_s=budget)
+    except Exception:
+        return None
+
+
+def len_doctor_stages() -> int:
+    # the doctor's three stages (import / backend_init / compute); kept
+    # as a function so the budget math above reads as intent
+    return 3
 
 
 def _probe_cached_ok() -> bool:

@@ -1,0 +1,418 @@
+"""SLO remediation policy: the escalation ladder, executed safely.
+
+The acting half of the self-healing control plane (the sensing half is
+``runner/slo.py``).  A confirmed SLO breach picks a rung from an
+escalation ladder, cheapest first:
+
+``preempt``
+    arbiter reweight/preempt (:meth:`~horovod_tpu.svc.arbiter.Arbiter.
+    request_preempt`): gate lower-priority lanes so the breaching
+    tenant's backlog drains first — bounded, reversible, no state
+    moves;
+``degrade``
+    degraded mode: bump ``HVD_TPU_SVC_STALENESS`` (hide the sick DCN
+    rail behind more steps of the bounded-staleness pipeline) and
+    downgrade hier→flat lowering (``HVD_TPU_TOPO_LOWER=flat``) so
+    cross-slice staging stops touching the slow rail;
+``handoff``
+    slice handoff: shrink a donor tenant at a commit boundary, reshard
+    its state through the PR 6 remesh pipeline
+    (:func:`~horovod_tpu.elastic.remesh.reshard_shards` — the same
+    ``plan_moves``/``apply_moves`` math, so the exchange is a
+    permutation with checksums preserved), grow the breaching tenant.
+    **No restarts** — the move happens inside the running processes.
+
+Every rung runs under a :class:`~horovod_tpu.utils.retry.RetryPolicy`
+(per-phase timeout ``HVD_TPU_REMEDIATE_TIMEOUT``, exponential backoff,
+``HVD_TPU_REMEDIATE_RETRIES`` attempts), counts ``slo.*`` metrics, and
+emits ``remediate_start``/``remediate_phase``/``remediate_ok``/
+``remediate_abort`` event-log entries.  Fault sites (``faults.py``):
+``remediate.plan`` fires while the action is planned (nothing changed
+yet), ``remediate.handoff`` inside the handoff execution, and
+``remediate.rollback`` inside the rollback.  The abort contract
+extends PR 6's: any fault mid-handoff rolls the placement back to the
+pre-handoff state and dumps the flight recorder; only a fault in the
+*rollback itself* leaves ``stable=False`` in the abort record — the
+caller's signal to fall back to the respawn path.  A tenant's ladder
+escalates only while its breach persists past ``HVD_TPU_SLO_COOLDOWN``
+seconds per rung, and re-arms from the cheapest rung on
+:meth:`Remediator.reset`.
+
+See docs/fault_tolerance.md (remediation ladder) and
+docs/multitenant.md (SLO specs + ``/slo``).
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from .. import events, faults, metrics
+from ..exceptions import HorovodTpuError
+from ..utils import env
+from ..utils.logging import get_logger
+from ..utils.retry import RetryPolicy
+
+DEFAULT_COOLDOWN_S = 30.0
+DEFAULT_TIMEOUT_S = 30.0
+DEFAULT_RETRIES = 2
+
+# The escalation ladder, cheapest rung first.
+RUNGS = ("preempt", "degrade", "handoff")
+
+
+class RemediationError(HorovodTpuError):
+    """A remediation rung failed (after retries)."""
+
+
+def cooldown_s() -> float:
+    """``HVD_TPU_SLO_COOLDOWN``: seconds a tenant's ladder holds at a
+    rung before a still-confirmed breach escalates (default 30)."""
+    return max(0.0, env.get_float(env.SLO_COOLDOWN, DEFAULT_COOLDOWN_S))
+
+
+def phase_timeout_s() -> float:
+    return max(0.1, env.get_float(env.REMEDIATE_TIMEOUT,
+                                  DEFAULT_TIMEOUT_S))
+
+
+def phase_retries() -> int:
+    return max(1, env.get_int(env.REMEDIATE_RETRIES, DEFAULT_RETRIES))
+
+
+# ------------------------------------------------------------ placement
+
+
+def plan_handoff(placement: Dict[str, int], donor: str, recipient: str,
+                 slices: int = 1) -> Dict[str, int]:
+    """The handoff plan: move ``slices`` from donor to recipient.  Pure
+    — validation errors raise :class:`RemediationError` before anything
+    changed (the abort-before-mutation half of the contract)."""
+    if donor == recipient:
+        raise RemediationError("handoff donor == recipient "
+                               f"({donor!r})")
+    have = placement.get(donor, 0)
+    if have - slices < 1:
+        raise RemediationError(
+            f"donor {donor!r} has {have} slice(s); moving {slices} "
+            "would starve it (donors keep >= 1)"
+        )
+    out = dict(placement)
+    out[donor] = have - slices
+    out[recipient] = out.get(recipient, 0) + slices
+    return out
+
+
+def pick_donor(placement: Dict[str, int],
+               recipient: str) -> Optional[str]:
+    """The donor policy: the tenant holding the most slices (ties by
+    name) that can spare one; None when nobody can."""
+    candidates = [
+        (count, name) for name, count in placement.items()
+        if name != recipient and count >= 2
+    ]
+    if not candidates:
+        return None
+    candidates.sort(key=lambda c: (-c[0], c[1]))
+    return candidates[0][1]
+
+
+# ---------------------------------------------------- default actuators
+
+
+def _default_preempt(tenant: str, breach: Dict[str, Any]) -> None:
+    """Rung (a) against the in-process exchange service; a world with
+    no service has nothing to preempt — the rung fails and the ladder
+    escalates."""
+    from ..svc import service as service_mod
+
+    svc = service_mod.get_service_or_none()
+    if svc is None:
+        raise RemediationError(
+            "no in-process exchange service to preempt through"
+        )
+    svc.arbiter.request_preempt(tenant)
+
+
+def _default_degrade(tenant: str,
+                     breach: Dict[str, Any]) -> Dict[str, str]:
+    """Rung (b): bump the bounded-staleness depth one step (hide the
+    sick DCN rail behind one more step of the PR 12 pipeline) and pin
+    the lowering to flat (stop staging through the slow rail).
+    Returns the knob changes so the record — and an operator — can see
+    exactly what degraded mode means here."""
+    old = max(0, env.get_int(env.SVC_STALENESS, 0))
+    changes = {
+        env.SVC_STALENESS: str(old + 1),
+        env.TOPO_LOWER: "flat",
+    }
+    for name, value in changes.items():
+        env.set_env(name, value)
+    return {f"HVD_TPU_{k}": v for k, v in changes.items()}
+
+
+# ------------------------------------------------------------ remediator
+
+
+class Remediator:
+    """Executes the escalation ladder over a tenant→slice placement.
+
+    ``actuators`` plugs the environment in: ``preempt(tenant, breach)``,
+    ``degrade(tenant, breach) -> changes``, ``handoff(old_placement,
+    new_placement, breach)`` and ``rollback(old_placement,
+    new_placement, breach)`` — the elastic driver wires KV-backed ones,
+    tests wire in-process ones that move real shard buffers through
+    :func:`~horovod_tpu.elastic.remesh.reshard_shards`.  Omitted
+    actuators fall back to the defaults above (handoff/rollback default
+    to the placement commit itself).  ``sleep`` injects the retry
+    backoff clock for tests."""
+
+    def __init__(
+        self,
+        placement: Optional[Dict[str, int]] = None,
+        actuators: Optional[Dict[str, Callable]] = None,
+        cooldown_s_: Optional[float] = None,
+        retry_timeout_s: Optional[float] = None,
+        retry_attempts: Optional[int] = None,
+        history_cap: int = 64,
+        clock: Callable[[], float] = time.monotonic,
+        sleep: Optional[Callable[[float], None]] = None,
+    ):
+        self._placement = dict(placement or {})
+        self._actuators = dict(actuators or {})
+        self.cooldown_s = (cooldown_s() if cooldown_s_ is None
+                           else max(0.0, cooldown_s_))
+        self._timeout_s = (phase_timeout_s() if retry_timeout_s is None
+                           else retry_timeout_s)
+        self._attempts = (phase_retries() if retry_attempts is None
+                          else max(1, retry_attempts))
+        self._sleep = sleep
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._rung_idx: Dict[str, int] = {}
+        self._last_action: Dict[str, float] = {}
+        self._history: collections.deque = collections.deque(
+            maxlen=max(1, history_cap)
+        )
+
+    # ----------------------------------------------------------- state
+
+    def placement(self) -> Dict[str, int]:
+        with self._lock:
+            return dict(self._placement)
+
+    def set_placement(self, placement: Dict[str, int]) -> None:
+        with self._lock:
+            self._placement = dict(placement)
+
+    def history(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return list(self._history)
+
+    def reset(self, tenant: Optional[str] = None) -> None:
+        """Re-arm the ladder from the cheapest rung (SLO recovered, or
+        test isolation); ``None`` resets every tenant."""
+        with self._lock:
+            if tenant is None:
+                self._rung_idx.clear()
+                self._last_action.clear()
+            else:
+                self._rung_idx.pop(tenant, None)
+                self._last_action.pop(tenant, None)
+
+    def _retry(self, name: str) -> RetryPolicy:
+        kw: Dict[str, Any] = dict(
+            max_attempts=self._attempts,
+            base_delay_s=0.1, multiplier=2.0, max_delay_s=5.0,
+            attempt_timeout_s=self._timeout_s,
+            name=f"remediate.{name}", seed=0,
+        )
+        if self._sleep is not None:
+            kw["sleep"] = self._sleep
+        return RetryPolicy(**kw)
+
+    @contextlib.contextmanager
+    def _phase(self, record: Dict[str, Any], phase: str,
+               fault_site: Optional[str] = None, **ctx: Any):
+        """Instrument one remediation phase (the ``remesh_phase``
+        pattern): counter, event-log entry, per-phase wall clock in the
+        record — and the registered fault site, where the chaos tests
+        fail any phase on demand."""
+        if fault_site is not None:
+            faults.inject(fault_site, **ctx)
+        metrics.inc_counter(f"slo.remediate.phase.{phase}")
+        events.emit(events.REMEDIATE_PHASE, phase=phase, **ctx)
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            record["phases"].append({
+                "phase": phase,
+                "seconds": time.perf_counter() - t0,
+            })
+            metrics.observe("slo.remediate.phase_seconds",
+                            time.perf_counter() - t0)
+
+    # ---------------------------------------------------------- policy
+
+    def consider(self, breach: Dict[str, Any],
+                 now: Optional[float] = None
+                 ) -> Optional[Dict[str, Any]]:
+        """The policy gate: act on a confirmed breach unless the
+        tenant's last rung is still inside its cooldown.  Each action
+        advances the tenant's ladder one rung (capped at handoff), so
+        a breach that persists *escalates* instead of hammering the
+        cheapest rung forever."""
+        tenant = breach.get("tenant") or "default"
+        now = self._clock() if now is None else now
+        with self._lock:
+            last = self._last_action.get(tenant)
+            if last is not None and now - last < self.cooldown_s:
+                return None
+            rung_i = min(self._rung_idx.get(tenant, 0), len(RUNGS) - 1)
+            # Claim the slot before releasing the lock: concurrent
+            # ticks must not double-fire a rung.
+            self._last_action[tenant] = now
+            self._rung_idx[tenant] = rung_i + 1
+        return self.remediate(breach, RUNGS[rung_i])
+
+    # ------------------------------------------------------- execution
+
+    def remediate(self, breach: Dict[str, Any],
+                  rung: str) -> Dict[str, Any]:
+        """Execute one rung for one breach; returns the history record
+        (``outcome`` = ok | abort | abort_unstable).  Never raises —
+        failures land in the record and the event log, and the
+        flight recorder dumps around every abort."""
+        if rung not in RUNGS:
+            raise ValueError(f"unknown rung {rung!r} (one of {RUNGS})")
+        tenant = breach.get("tenant") or "default"
+        record: Dict[str, Any] = {
+            "tenant": tenant, "rung": rung,
+            "kind": breach.get("kind"),
+            "observed": breach.get("observed"),
+            "target": breach.get("target"),
+            "wall_ts": time.time(),
+            "phases": [], "outcome": "ok", "error": None,
+            "stable": True,
+        }
+        metrics.inc_counter("slo.remediations")
+        metrics.inc_counter(f"slo.remediations.{rung}")
+        events.emit(events.REMEDIATE_START, tenant=tenant, rung=rung,
+                    kind=breach.get("kind"),
+                    observed=breach.get("observed"),
+                    target=breach.get("target"))
+        old_placement = self.placement()
+        new_placement: Optional[Dict[str, int]] = None
+        handoff_started = False
+        try:
+            # -- plan: decide the concrete action; nothing mutates yet.
+            with self._phase(record, "plan", "remediate.plan",
+                             tenant=tenant, rung=rung):
+                if rung == "handoff":
+                    donor = breach.get("donor") or pick_donor(
+                        old_placement, tenant
+                    )
+                    if donor is None:
+                        raise RemediationError(
+                            f"no donor tenant can spare a slice for "
+                            f"{tenant!r} (placement {old_placement})"
+                        )
+                    new_placement = plan_handoff(
+                        old_placement, donor, tenant,
+                        slices=int(breach.get("slices", 1)),
+                    )
+                    record["donor"] = donor
+                    record["placement_before"] = old_placement
+                    record["placement_after"] = new_placement
+            # -- execute the rung under its RetryPolicy.
+            if rung == "preempt":
+                act = self._actuators.get("preempt", _default_preempt)
+                with self._phase(record, "preempt", tenant=tenant):
+                    self._retry("preempt").call(act, tenant, breach)
+            elif rung == "degrade":
+                act = self._actuators.get("degrade", _default_degrade)
+                with self._phase(record, "degrade", tenant=tenant):
+                    record["changes"] = self._retry("degrade").call(
+                        act, tenant, breach
+                    ) or {}
+            else:  # handoff
+                act = self._actuators.get("handoff")
+                with self._phase(record, "handoff",
+                                 tenant=tenant,
+                                 donor=record.get("donor")):
+                    handoff_started = True
+
+                    def run_handoff():
+                        faults.inject("remediate.handoff",
+                                      tenant=tenant,
+                                      donor=record.get("donor"))
+                        if act is not None:
+                            act(old_placement, new_placement, breach)
+
+                    self._retry("handoff").call(run_handoff)
+                self.set_placement(new_placement)
+                metrics.inc_counter("slo.handoffs")
+            events.emit(events.REMEDIATE_OK, tenant=tenant, rung=rung)
+            metrics.inc_counter("slo.remediation_ok")
+            get_logger().info(
+                "SLO remediation ok: tenant %s rung %s", tenant, rung,
+            )
+        except Exception as e:
+            record["outcome"] = "abort"
+            record["error"] = str(e)
+            metrics.inc_counter("slo.remediation_abort")
+            from .. import trace
+
+            trace.trigger_dump("remediate", tenant=tenant, rung=rung,
+                               error=str(e))
+            stable = True
+            if handoff_started:
+                stable = self._rollback(record, old_placement,
+                                        new_placement, breach)
+            record["stable"] = stable
+            events.emit(events.REMEDIATE_ABORT, tenant=tenant,
+                        rung=rung, error=str(e), stable=stable)
+            if not stable:
+                metrics.inc_counter("slo.remediation_unstable")
+            get_logger().warning(
+                "SLO remediation aborted: tenant %s rung %s (%s); "
+                "placement %s", tenant, rung, e,
+                "restored" if stable else "UNSTABLE — escalate to "
+                "respawn",
+            )
+        with self._lock:
+            self._history.append(record)
+        return record
+
+    def _rollback(self, record: Dict[str, Any],
+                  old_placement: Dict[str, int],
+                  new_placement: Optional[Dict[str, int]],
+                  breach: Dict[str, Any]) -> bool:
+        """Abort a mid-flight handoff back to the pre-handoff
+        placement (the PR 6 abort contract).  True = stable (placement
+        restored); False = the rollback itself failed and the caller
+        must treat the placement as dirty."""
+        act = self._actuators.get("rollback")
+        tenant = record["tenant"]
+        try:
+            # The remediate.rollback site fires inside run_rollback so
+            # each retry attempt re-arms it, like the handoff site.
+            with self._phase(record, "rollback", tenant=tenant):
+
+                def run_rollback():
+                    faults.inject("remediate.rollback", tenant=tenant)
+                    if act is not None:
+                        act(old_placement, new_placement, breach)
+
+                self._retry("rollback").call(run_rollback)
+            self.set_placement(old_placement)
+            metrics.inc_counter("slo.rollbacks")
+            return True
+        except Exception as e:
+            record["rollback_error"] = str(e)
+            self.set_placement(old_placement)
+            return False

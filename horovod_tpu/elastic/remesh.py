@@ -257,6 +257,42 @@ def apply_moves(
     return out
 
 
+def reshard_shards(
+    shards: Sequence[np.ndarray],
+    old: ShardLayout,
+    new: ShardLayout,
+) -> List[np.ndarray]:
+    """Re-partition one buffer's per-rank shard arrays from ``old`` to
+    ``new`` in process — the slice-handoff executor of the SLO
+    remediation ladder (``elastic/remediate.py``): a donor tenant's
+    shrink and a recipient's grow are each ONE call through the same
+    :func:`plan_moves`/:func:`apply_moves` pipeline the cross-process
+    remesh rides, so the handoff inherits its permutation guarantee —
+    every valid element lands exactly once, checksums preserved by
+    construction.  Raises :class:`RemeshError` (caller rolls back) when
+    the supplied shards do not match the old layout."""
+    if len(shards) != old.shards:
+        raise RemeshError(
+            f"have {len(shards)} shard(s) for a {old.shards}-shard "
+            "layout"
+        )
+    srcs = [np.asarray(s).reshape(-1) for s in shards]
+    for r, s in enumerate(srcs):
+        if s.size < old.shard_len:
+            raise RemeshError(
+                f"source shard {r} too short: {s.size} < "
+                f"{old.shard_len}"
+            )
+    dtype = srcs[0].dtype if srcs else np.float32
+    return [
+        apply_moves(
+            plan_moves(old, new, dst), new.shard_len, dtype,
+            lambda src_rank: srcs[src_rank],
+        )
+        for dst in range(new.shards)
+    ]
+
+
 # =====================================================================
 # 2. Bucket-schedule resharding (ZeRO-1 optimizer shards + EF state)
 # =====================================================================

@@ -67,6 +67,31 @@ REMESH_ABORT = "remesh_abort"
 # async service's negotiation stall check named missing participants.
 TRACE_ANOMALY = "trace_anomaly"
 SVC_STALL = "svc_stall"
+# Stall escalation (svc/negotiate.py): after HVD_TPU_STALL_ABANDON
+# consecutive stalled check intervals the entry is abandoned and every
+# posted participant's future resolves inline.
+SVC_STALL_ABANDON = "svc_stall_abandon"
+# Arbiter admission telemetry (svc/arbiter.py): an admission wait
+# expired (the submission was admitted anyway — backpressure never
+# wedges), and a preemption gate lifted (reason = expired | drained) —
+# the event-log entries the /slo remediation history attributes rung
+# (a) actions against.
+SVC_ADMIT_TIMEOUT = "svc_admit_timeout"
+SVC_PREEMPT_EXPIRED = "svc_preempt_expired"
+# SLO watchdog (runner/slo.py): a tenant's target stayed breached for
+# HVD_TPU_SLO_WINDOWS consecutive evaluation windows (BREACH), or a
+# confirmed breach's metric went green again (RECOVERED).
+SLO_BREACH = "slo_breach"
+SLO_RECOVERED = "slo_recovered"
+# Remediation lifecycle (elastic/remediate.py): an escalation-ladder
+# action emits START, one PHASE entry per executed phase (plan /
+# preempt / degrade / handoff / rollback), then OK — or ABORT with
+# ``stable`` telling whether the rollback restored the pre-handoff
+# placement (stable=False escalates to the respawn path).
+REMEDIATE_START = "remediate_start"
+REMEDIATE_PHASE = "remediate_phase"
+REMEDIATE_OK = "remediate_ok"
+REMEDIATE_ABORT = "remediate_abort"
 
 
 class EventLog:

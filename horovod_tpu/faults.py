@@ -102,6 +102,18 @@ Registered sites (grep ``faults.inject`` for ground truth):
                                 the trace smoke injects: the delay lands
                                 in that rank's DCN rail span and the
                                 driver's ``/trace`` summary names it
+``remediate.plan``              while an SLO remediation plans its
+                                action (``tenant=``/``rung=`` context;
+                                elastic/remediate.py) — a failure here
+                                aborts before anything changed
+``remediate.handoff``           inside the slice-handoff execution
+                                (shrink donor / reshard / grow
+                                recipient) — any fault mid-handoff
+                                rolls back to the pre-handoff placement
+``remediate.rollback``          inside that rollback itself — a fault
+                                here leaves the placement UNSTABLE and
+                                the abort record says so (the caller
+                                escalates to the respawn path)
 ==============================  ==========================================
 
 Every fired fault also triggers a flight-recorder dump

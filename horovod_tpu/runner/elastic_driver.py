@@ -174,6 +174,11 @@ class ElasticDriver:
         # round state read by the /health endpoint
         self._last_assignments: List[hosts_mod.SlotInfo] = []
         self._round_active = False
+        # SLO self-healing (runner/slo.py + elastic/remediate.py):
+        # built with the telemetry server when HVD_TPU_SLO_SPEC names
+        # any tenant, ticked from the round watch loop, served as /slo.
+        self._slo = None
+        self._slo_workers_fn = None
 
     def schedule_store(self):
         """The driver-side schedule store (lazy: first use reads
@@ -525,11 +530,83 @@ class ElasticDriver:
             payload["workers"] = len(self._last_assignments)
             return payload
 
+        self._slo = self._build_slo(control)
+        self._slo_workers_fn = workers_fn
+        slo_fn = None
+        if self._slo is not None:
+            controller = self._slo
+
+            def slo_fn():
+                # GET /slo: the watchdog's last window + remediation
+                # history, with round context like /trace and /tenants.
+                payload = controller.payload()
+                payload["round"] = self.rounds
+                payload["workers"] = len(self._last_assignments)
+                return payload
+
         return TelemetryServer(
             port=self.telemetry_port, health_fn=health_fn,
             workers_fn=workers_fn, schedule_store=self.schedule_store(),
-            trace_fn=trace_fn, tenants_fn=tenants_fn,
+            trace_fn=trace_fn, tenants_fn=tenants_fn, slo_fn=slo_fn,
         )
+
+    def _build_slo(self, control):
+        """Build the SLO controller (watchdog + remediation ladder)
+        when ``HVD_TPU_SLO_SPEC`` names any tenant; None otherwise.
+
+        The driver's actuators act through the channels it already
+        owns: rung (a) preempts the in-process arbiter when an
+        exchange service lives in this process and always publishes
+        the request on the KV store (``__slo__/preempt``) so every
+        worker's service can honor it; rung (b) is the default
+        degraded-mode knob flip plus a KV advisory; rung (c) publishes
+        the NEW placement (``__slo__/placement``) — workers pick it up
+        at their next commit boundary and reshard through the remesh
+        pipeline, no restarts — and rollback republishes the old one.
+        """
+        import json as _json
+
+        from ..elastic import remediate
+        from . import slo as slo_mod
+
+        def publish(key: str, payload: Dict) -> None:
+            # Advisory channel: a KV hiccup must fail the RUNG (so its
+            # RetryPolicy retries), not the driver loop — hence raise.
+            control.put("__slo__", key, _json.dumps(payload).encode())
+
+        def preempt(tenant, breach):
+            from ..svc import service as service_mod
+
+            svc = service_mod.get_service_or_none()
+            if svc is not None:
+                svc.arbiter.request_preempt(tenant)
+            publish("preempt", {"tenant": tenant,
+                                "kind": breach.get("kind")})
+
+        def degrade(tenant, breach):
+            changes = remediate._default_degrade(tenant, breach)
+            publish("degrade", {"tenant": tenant, "changes": changes})
+            return changes
+
+        def handoff(old_placement, new_placement, breach):
+            publish("placement", {
+                "placement": new_placement,
+                "tenant": breach.get("tenant"),
+                "previous": old_placement,
+            })
+
+        def rollback(old_placement, new_placement, breach):
+            publish("placement", {
+                "placement": old_placement,
+                "tenant": breach.get("tenant"),
+                "rollback": True,
+            })
+
+        remediator = remediate.Remediator(actuators={
+            "preempt": preempt, "degrade": degrade,
+            "handoff": handoff, "rollback": rollback,
+        })
+        return slo_mod.SLOController.from_env(remediator)
 
     def _publish_schedules(self, control) -> None:
         """Seed the round's workers with the schedule DB: the store's
@@ -707,6 +784,13 @@ class ElasticDriver:
                     workers[j].wait()
                 pending = set()
                 saw_failure = saw_failure or RESTART_CODE
+            if self._slo is not None and self._slo_workers_fn is not None:
+                # SLO watchdog tick (runner/slo.py): rate-limited to
+                # HVD_TPU_SLO_CHECK_INTERVAL internally and never
+                # raises — a breach remediates, it never ends a round.
+                self._slo.maybe_tick(lambda: {
+                    r: snap for r, snap in self._slo_workers_fn()
+                })
             time.sleep(0.1)
         for w in workers:
             w.wait()

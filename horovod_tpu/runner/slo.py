@@ -1,0 +1,344 @@
+"""Per-tenant SLO specs + the driver-side breach watchdog.
+
+The observability stack can already *name* a problem — ``/tenants``
+shows share-vs-usage (svc/arbiter.py), ``trace.tenant_seconds``
+histograms attribute slow phases to tenants, and the straggler detector
+names the slow rank — but through PR 15 an SLO violation was a gauge,
+not an action.  This module is the sensing half of the self-healing
+loop (ROADMAP item 2): parse per-tenant targets from
+``HVD_TPU_SLO_SPEC``, fold the three signals above into per-window
+breach verdicts, and confirm a breach only after
+``HVD_TPU_SLO_WINDOWS`` *consecutive* breaching windows — hysteresis,
+so one noisy sample never triggers a remediation.  The acting half is
+:mod:`horovod_tpu.elastic.remediate` (the escalation ladder);
+:class:`SLOController` pairs the two for the elastic driver, which
+ticks it from the round watch loop and serves its state as ``GET /slo``
+(``runner/telemetry_http.py``).
+
+Spec syntax (``HVD_TPU_SLO_SPEC``)::
+
+    tenantA:step=0.5,p99=0.05;tenantB:p99=0.1
+
+``step``
+    target per-step exchange seconds — compared against the sum of the
+    tenant's per-phase p50s from its ``trace.tenant_seconds.<t>.*``
+    histograms, worst rank (``trace/straggler.tenant_observed``);
+``p99``
+    target served-latency p99 seconds — compared against the tenant's
+    ``svc.tenant.wait_seconds`` p99 (the ``/tenants`` aggregation),
+    falling back to the worst tenant-phase p99 when no arbiter wait
+    histogram exists (arbiter off / untagged world).
+
+Malformed entries are warned and skipped — a bad spec must not kill
+the driver.  See docs/multitenant.md for the endpoint and
+docs/fault_tolerance.md for the remediation ladder downstream.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .. import events, metrics
+from ..utils import env
+from ..utils.logging import get_logger
+
+DEFAULT_WINDOWS = 3
+DEFAULT_CHECK_INTERVAL_S = 5.0
+
+# Breach kinds (the ``kind`` field of every breach record/event).
+KIND_STEP = "step"
+KIND_P99 = "p99"
+
+
+def slo_windows() -> int:
+    """``HVD_TPU_SLO_WINDOWS``: consecutive breaching windows before a
+    breach is confirmed (default 3, floor 1)."""
+    return max(1, env.get_int(env.SLO_WINDOWS, DEFAULT_WINDOWS))
+
+
+def check_interval_s() -> float:
+    """``HVD_TPU_SLO_CHECK_INTERVAL``: seconds between driver-side
+    evaluations (default 5)."""
+    return max(0.0, env.get_float(env.SLO_CHECK_INTERVAL,
+                                  DEFAULT_CHECK_INTERVAL_S))
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOSpec:
+    """One tenant's targets; ``None`` = that dimension unconstrained."""
+
+    tenant: str
+    step_s: Optional[float] = None
+    p99_s: Optional[float] = None
+
+    def targets(self) -> List[Tuple[str, float]]:
+        out: List[Tuple[str, float]] = []
+        if self.step_s is not None:
+            out.append((KIND_STEP, self.step_s))
+        if self.p99_s is not None:
+            out.append((KIND_P99, self.p99_s))
+        return out
+
+
+def parse_slo_spec(raw: str) -> Dict[str, SLOSpec]:
+    """Parse the ``HVD_TPU_SLO_SPEC`` syntax; malformed entries are
+    skipped with a warning (same forgiveness as the tenant-weights
+    knob — a bad spec degrades to "unwatched", never to a dead
+    driver)."""
+    out: Dict[str, SLOSpec] = {}
+    for entry in (raw or "").split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        tenant, sep, body = entry.partition(":")
+        tenant = tenant.strip()
+        if not sep or not tenant:
+            get_logger().warning("bad SLO spec entry %r (skipped): "
+                                 "want 'tenant:key=val[,...]'", entry)
+            continue
+        fields: Dict[str, float] = {}
+        ok = True
+        for kv in body.split(","):
+            if not kv.strip():
+                continue
+            key, sep2, val = kv.partition("=")
+            key = key.strip()
+            try:
+                num = float(val)
+            except ValueError:
+                num = -1.0
+            if not sep2 or key not in (KIND_STEP, KIND_P99) or num <= 0:
+                get_logger().warning(
+                    "bad SLO target %r for tenant %s (entry skipped)",
+                    kv, tenant,
+                )
+                ok = False
+                break
+            fields[key] = num
+        if ok and fields:
+            out[tenant] = SLOSpec(
+                tenant=tenant,
+                step_s=fields.get(KIND_STEP),
+                p99_s=fields.get(KIND_P99),
+            )
+    return out
+
+
+def specs_from_env() -> Dict[str, SLOSpec]:
+    return parse_slo_spec(env.get_env(env.SLO_SPEC, "") or "")
+
+
+def observe_tenants(
+    per_rank: Dict[int, Dict[str, Any]]
+) -> Dict[str, Dict[str, Any]]:
+    """One evaluation window's observed values per tenant, folded from
+    the three existing signals: the tenant phase histograms
+    (``step_s`` / fallback ``phase_p99_s``), the ``/tenants``
+    aggregation (``p99_s`` from the wait histogram, plus share/usage),
+    and the straggler verdicts that name the tenant."""
+    from ..svc.arbiter import tenants_payload
+    from ..trace import straggler
+
+    observed = straggler.tenant_observed(per_rank)
+    tenants = tenants_payload(per_rank).get("tenants", {})
+    verdicts = straggler.detect(per_rank)
+    out: Dict[str, Dict[str, Any]] = {}
+    for tenant in sorted(set(observed) | set(tenants)):
+        obs = observed.get(tenant, {})
+        agg = tenants.get(tenant, {})
+        p99 = agg.get("wait_p99_s")
+        if p99 is None:
+            p99 = obs.get("phase_p99_s") or None
+        out[tenant] = {
+            "step_s": obs.get("step_s"),
+            "p99_s": p99,
+            "share": agg.get("share"),
+            "usage": agg.get("usage"),
+            "stragglers": [
+                {"rank": v["rank"], "phase": v["phase"],
+                 "ratio": v["ratio"]}
+                for v in verdicts if v.get("tenant") == tenant
+            ],
+        }
+    return out
+
+
+class SLOWatchdog:
+    """Breach detection with N-consecutive-window hysteresis.
+
+    Each :meth:`evaluate` call is one window: every (tenant, kind)
+    target is compared against its observed value; a target must
+    breach for ``windows`` consecutive calls before it lands in the
+    confirmed list (and emits :data:`~horovod_tpu.events.SLO_BREACH`).
+    A confirmed breach whose metric goes green emits
+    :data:`~horovod_tpu.events.SLO_RECOVERED` and re-arms the counter
+    — never one noisy sample in either direction beyond the first.
+    """
+
+    def __init__(self, specs: Dict[str, SLOSpec],
+                 windows: Optional[int] = None):
+        self.specs = dict(specs)
+        self.windows = slo_windows() if windows is None else max(1, windows)
+        self._lock = threading.Lock()
+        self._consec: Dict[Tuple[str, str], int] = {}
+        self._confirmed: set = set()
+
+    def confirmed(self) -> List[Tuple[str, str]]:
+        with self._lock:
+            return sorted(self._confirmed)
+
+    def evaluate(self, per_rank: Dict[int, Dict[str, Any]]
+                 ) -> Dict[str, Any]:
+        """Run one window; returns the ``/slo`` status body:
+        ``{"specs", "tenants", "breaches"}`` where ``breaches`` holds
+        only CONFIRMED breaches (>= ``windows`` consecutive)."""
+        metrics.inc_counter("slo.windows")
+        observed = observe_tenants(per_rank)
+        breaches: List[Dict[str, Any]] = []
+        tenants_out: Dict[str, Any] = {}
+        for tenant, spec in sorted(self.specs.items()):
+            obs = observed.get(tenant, {})
+            entry: Dict[str, Any] = {
+                "observed": {k: obs.get(k) for k in
+                             ("step_s", "p99_s", "share", "usage")},
+                "stragglers": obs.get("stragglers", []),
+                "targets": {}, "windows": {},
+            }
+            for kind, target in spec.targets():
+                value = obs.get(f"{kind}_s")
+                breaching = value is not None and value > target
+                key = (tenant, kind)
+                with self._lock:
+                    if breaching:
+                        self._consec[key] = self._consec.get(key, 0) + 1
+                    else:
+                        self._consec[key] = 0
+                    consec = self._consec[key]
+                    was_confirmed = key in self._confirmed
+                    now_confirmed = consec >= self.windows
+                    if now_confirmed:
+                        self._confirmed.add(key)
+                    elif was_confirmed and not breaching:
+                        self._confirmed.discard(key)
+                entry["targets"][kind] = target
+                entry["windows"][kind] = consec
+                if breaching:
+                    metrics.inc_counter("slo.breach_windows")
+                metrics.set_gauge(
+                    "slo.breached", 1.0 if now_confirmed else 0.0,
+                    {"tenant": tenant, "kind": kind},
+                )
+                if now_confirmed and not was_confirmed:
+                    metrics.inc_counter("slo.breaches")
+                    metrics.inc_counter(f"slo.breaches.{tenant}.{kind}")
+                    events.emit(
+                        events.SLO_BREACH, tenant=tenant, kind=kind,
+                        observed=value, target=target, windows=consec,
+                    )
+                    get_logger().warning(
+                        "SLO breach confirmed: tenant %s %s %.4fs > "
+                        "target %.4fs for %d consecutive windows",
+                        tenant, kind, value, target, consec,
+                    )
+                elif was_confirmed and not breaching:
+                    metrics.inc_counter("slo.recoveries")
+                    events.emit(
+                        events.SLO_RECOVERED, tenant=tenant, kind=kind,
+                        observed=value, target=target,
+                    )
+                if now_confirmed:
+                    breaches.append({
+                        "tenant": tenant, "kind": kind,
+                        "observed": value, "target": target,
+                        "ratio": (value / target) if target else None,
+                        "windows": consec,
+                        "share": obs.get("share"),
+                        "usage": obs.get("usage"),
+                        "stragglers": obs.get("stragglers", []),
+                    })
+            tenants_out[tenant] = entry
+        return {
+            "specs": {
+                t: {"step_s": s.step_s, "p99_s": s.p99_s}
+                for t, s in sorted(self.specs.items())
+            },
+            "hysteresis_windows": self.windows,
+            "tenants": tenants_out,
+            "breaches": breaches,
+        }
+
+
+class SLOController:
+    """The watchdog + remediator pair the elastic driver ticks.
+
+    ``maybe_tick`` rate-limits to ``HVD_TPU_SLO_CHECK_INTERVAL``
+    seconds, evaluates one window from the per-rank KV snapshots, and
+    hands every confirmed breach to the remediation policy
+    (:class:`~horovod_tpu.elastic.remediate.Remediator`); ``payload``
+    is the ``GET /slo`` body — current status plus the bounded
+    remediation history."""
+
+    def __init__(self, watchdog: SLOWatchdog, remediator=None,
+                 check_interval_s_: Optional[float] = None):
+        self.watchdog = watchdog
+        self.remediator = remediator
+        self.check_interval_s = (
+            check_interval_s() if check_interval_s_ is None
+            else max(0.0, check_interval_s_)
+        )
+        self._lock = threading.Lock()
+        self._last_tick = 0.0
+        self._last_status: Optional[Dict[str, Any]] = None
+
+    @classmethod
+    def from_env(cls, remediator=None) -> Optional["SLOController"]:
+        """Build the controller when ``HVD_TPU_SLO_SPEC`` names any
+        tenant; None (no watchdog, no endpoint) otherwise."""
+        specs = specs_from_env()
+        if not specs:
+            return None
+        return cls(SLOWatchdog(specs), remediator=remediator)
+
+    def maybe_tick(
+        self,
+        per_rank_fn: Callable[[], Dict[int, Dict[str, Any]]],
+        now: Optional[float] = None,
+    ) -> Optional[Dict[str, Any]]:
+        """One rate-limited window; returns the fresh status dict, or
+        None when inside the check interval.  Never raises — the SLO
+        loop must not take down the round it watches."""
+        now = time.monotonic() if now is None else now
+        with self._lock:
+            if now - self._last_tick < self.check_interval_s:
+                return None
+            self._last_tick = now
+        try:
+            status = self.watchdog.evaluate(per_rank_fn())
+            if self.remediator is not None:
+                for breach in status["breaches"]:
+                    self.remediator.consider(breach)
+            with self._lock:
+                self._last_status = status
+            return status
+        except Exception as e:  # pragma: no cover - defensive
+            get_logger().warning("SLO tick failed: %s", e)
+            return None
+
+    def payload(self) -> Dict[str, Any]:
+        with self._lock:
+            status = dict(self._last_status or {
+                "specs": {
+                    t: {"step_s": s.step_s, "p99_s": s.p99_s}
+                    for t, s in sorted(self.watchdog.specs.items())
+                },
+                "hysteresis_windows": self.watchdog.windows,
+                "tenants": {}, "breaches": [],
+            })
+        status["check_interval_s"] = self.check_interval_s
+        if self.remediator is not None:
+            status["remediations"] = self.remediator.history()
+            status["placement"] = self.remediator.placement()
+        return status

@@ -26,6 +26,12 @@ gives the driver process a scrapeable surface:
   ICI/DCN rail bytes, admission/queue wait p50/p99, and configured
   share vs observed usage per tenant, aggregated from the same worker
   KV metric pushes ``/metrics`` renders (docs/multitenant.md).
+* ``GET /slo`` — the SLO watchdog's view (``runner/slo.py``): the
+  per-tenant specs parsed from ``HVD_TPU_SLO_SPEC``, the latest
+  observed step-time/p99 per tenant with breach hysteresis state, and
+  the remediation history the self-healing ladder
+  (``elastic/remediate.py``) has taken — which rung, which phases,
+  outcome, and current slice placement (docs/fault_tolerance.md).
 * ``GET/POST /schedules`` — the persistent autotuning database
   (``sched/store.py``): GET returns every stored (bucket_bytes, wire,
   lowering) winner (``?key=<hex>`` filters to one), POST merges a
@@ -96,11 +102,18 @@ class _Handler(BaseHTTPRequestHandler):
                     payload if payload is not None
                     else {"error": "no tenant accounting"}
                 ).encode(), "application/json")
+            elif route == "/slo":
+                payload = srv.render_slo()
+                code = 200 if payload is not None else 404
+                self._send(code, json.dumps(
+                    payload if payload is not None
+                    else {"error": "no SLO watchdog"}
+                ).encode(), "application/json")
             else:
                 self._send(
                     404,
                     b"not found: try /metrics, /health, /schedules, "
-                    b"/trace or /tenants\n",
+                    b"/trace, /tenants or /slo\n",
                     "text/plain")
         except Exception as e:  # a scrape must never kill the server
             self._send(500, f"telemetry error: {e}\n".encode(),
@@ -174,12 +187,14 @@ class TelemetryServer:
         schedule_store=None,
         trace_fn: Optional[Callable[[], Dict[str, Any]]] = None,
         tenants_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+        slo_fn: Optional[Callable[[], Dict[str, Any]]] = None,
     ):
         self.health_fn = health_fn
         self.workers_fn = workers_fn
         self.schedule_store = schedule_store
         self.trace_fn = trace_fn
         self.tenants_fn = tenants_fn
+        self.slo_fn = slo_fn
         self._server = _QuietHTTPServer((bind_host, port), _Handler)
         self._server.telemetry = self  # type: ignore[attr-defined]
         self.port = self._server.server_address[1]
@@ -243,6 +258,15 @@ class TelemetryServer:
             if per_rank:
                 return tenants_payload(per_rank)
         return tenants_payload({0: metrics.snapshot()})
+
+    def render_slo(self) -> Optional[Dict[str, Any]]:
+        """``GET /slo`` payload: whatever ``slo_fn`` renders (the
+        elastic driver installs the SLO controller's ``payload()``).
+        None when no watchdog is wired — no ``HVD_TPU_SLO_SPEC``
+        means no SLO surface (-> 404)."""
+        if self.slo_fn is None:
+            return None
+        return self.slo_fn()
 
     def render_schedules(
         self, key: Optional[str] = None

@@ -269,6 +269,17 @@ class Arbiter:
                 f"svc.tenant.admission_wait_seconds.{tenant}",
                 time.monotonic() - t0,
             )
+        if not clean:
+            # Event-log entry (not just the counter): the /slo
+            # remediation history attributes admission pressure to the
+            # tenant and moment it hit.
+            from .. import events
+
+            events.emit(
+                events.SVC_ADMIT_TIMEOUT, tenant=tenant,
+                waited_s=time.monotonic() - t0, cap=cap,
+                timeout_s=timeout_s,
+            )
         return clean
 
     def release(self, sub: Any) -> None:
@@ -344,6 +355,7 @@ class Arbiter:
     def on_cycle(self, cycle: int) -> None:
         """Cycle tick from the service loop: advance the preemption
         clock, lifting expired (or drained) gates."""
+        lifted = None
         with self._cond:
             self._cycle = cycle
             if self._preempt is not None:
@@ -354,6 +366,8 @@ class Arbiter:
                 )
                 if cycle >= until or drained:
                     self._preempt = None
+                    lifted = (high,
+                              "drained" if drained else "expired")
                     for lane in self._lanes.values():
                         if lane.preempt_gate_until:
                             lane.preempt_gate_until = 0
@@ -362,6 +376,13 @@ class Arbiter:
                                 {"tenant": lane.name},
                             )
                     self._cond.notify_all()
+        if lifted is not None:
+            from .. import events
+
+            events.emit(
+                events.SVC_PREEMPT_EXPIRED, tenant=lifted[0],
+                reason=lifted[1], cycle=cycle,
+            )
 
     def _queue_depth(self, tenant: str) -> int:
         return int(metrics.get_gauge(

@@ -44,6 +44,13 @@ def stall_timeout() -> float:
                                   DEFAULT_STALL_TIMEOUT_S))
 
 
+def stall_abandon_checks() -> int:
+    """``HVD_TPU_STALL_ABANDON``: consecutive stalled check intervals
+    after which the entry is abandoned and its posted futures resolve
+    inline (0 = warn forever, the pre-PR 16 behavior)."""
+    return max(0, env.get_int(env.STALL_ABANDON, 0))
+
+
 class Negotiator:
     """Per-signature readiness bitvector over producer names."""
 
@@ -56,6 +63,12 @@ class Negotiator:
         # "expected" half of the posted-vs-expected stall report).
         self._expected: Dict[Tuple, set] = {}
         self._stall_warned: set = set()
+        # signature -> consecutive stalled check intervals (the
+        # HVD_TPU_STALL_ABANDON escalation clock; reset on completion).
+        self._stall_checks: Dict[Tuple, int] = {}
+        # entries the stall check abandoned, awaiting inline resolution
+        # by the service loop (take_abandoned drains this).
+        self._abandoned_out: List[Submission] = []
 
     def post(self, sub: Submission) -> List[Submission]:
         """Record one submission; return the ready batch (possibly just
@@ -96,6 +109,7 @@ class Negotiator:
             del self._pending[key]
             self._expected.pop(key, None)
             self._stall_warned.discard(key)
+            self._stall_checks.pop(key, None)
             t0 = self._first_post.pop(key, None)
             metrics.set_gauge("svc.negotiations_pending",
                               len(self._pending))
@@ -132,10 +146,12 @@ class Negotiator:
 
         timeout_s = stall_timeout() if timeout_s is None else timeout_s
         now = time.monotonic() if now is None else now
+        abandon_after = stall_abandon_checks()
         reports: List[Dict[str, Any]] = []
         fresh: List[Dict[str, Any]] = []
+        abandoned: List[Dict[str, Any]] = []
         with self._lock:
-            for key, t0 in self._first_post.items():
+            for key, t0 in list(self._first_post.items()):
                 age = now - t0
                 if age < timeout_s:
                     continue
@@ -156,7 +172,33 @@ class Negotiator:
                 if key not in self._stall_warned:
                     self._stall_warned.add(key)
                     fresh.append(report)
-            metrics.set_gauge("svc.stalled_negotiations", len(reports))
+                # Stall escalation (HVD_TPU_STALL_ABANDON): after N
+                # consecutive stalled checks the missing participant is
+                # declared permanently gone — drop the entry and hand
+                # its posted submissions to the inline-fallback path,
+                # so a dead producer can never wedge the others.
+                self._stall_checks[key] = (
+                    self._stall_checks.get(key, 0) + 1
+                )
+                if abandon_after and (
+                    self._stall_checks[key] >= abandon_after
+                ):
+                    entry = self._pending.pop(key, {})
+                    self._expected.pop(key, None)
+                    self._first_post.pop(key, None)
+                    self._stall_warned.discard(key)
+                    self._stall_checks.pop(key, None)
+                    subs = [entry[p] for p in sorted(entry)]
+                    self._abandoned_out.extend(subs)
+                    report["abandoned"] = True
+                    report["checks"] = abandon_after
+                    abandoned.append(report)
+            metrics.set_gauge("svc.negotiations_pending",
+                              len(self._pending))
+            metrics.set_gauge(
+                "svc.stalled_negotiations",
+                len(reports) - len(abandoned),
+            )
         for report in fresh:
             metrics.inc_counter("svc.stall")
             from ..utils.logging import get_logger
@@ -175,7 +217,33 @@ class Negotiator:
                 age_s=report["age_s"], missing=report["missing"],
                 posted=report["posted"], expected=report["expected"],
             )
+        for report in abandoned:
+            metrics.inc_counter("svc.stall_abandoned")
+            from ..utils.logging import get_logger
+
+            get_logger().warning(
+                "svc.stall_abandoned: negotiation of %s abandoned "
+                "after %d stalled checks (%.0fs) — missing %s never "
+                "posted; resolving %s inline",
+                "+".join(report["kinds"]) or "?", report["checks"],
+                report["age_s"],
+                ", ".join(report["missing"]) or "?", report["posted"],
+            )
+            events.emit(
+                events.SVC_STALL_ABANDON,
+                age_s=report["age_s"], checks=report["checks"],
+                missing=report["missing"], posted=report["posted"],
+                expected=report["expected"],
+            )
         return reports
+
+    def take_abandoned(self) -> List[Submission]:
+        """Drain the submissions the stall escalation abandoned since
+        the last call — the service loop resolves each through the
+        inline-fallback path (``svc.fallback_sync``), in seq order."""
+        with self._lock:
+            out, self._abandoned_out = self._abandoned_out, []
+        return sorted(out, key=lambda s: s.seq)
 
     def pending_count(self) -> int:
         with self._lock:
@@ -192,11 +260,17 @@ class Negotiator:
                 s for entry in self._pending.values()
                 for s in entry.values()
             ]
+            # Escalation-abandoned entries not yet drained by the loop
+            # ride along: their futures must resolve through the same
+            # path when the service dies before take_abandoned ran.
+            orphans.extend(self._abandoned_out)
+            self._abandoned_out = []
             n = len(self._pending)
             self._pending.clear()
             self._first_post.clear()
             self._expected.clear()
             self._stall_warned.clear()
+            self._stall_checks.clear()
             metrics.set_gauge("svc.negotiations_pending", 0)
             metrics.set_gauge("svc.stalled_negotiations", 0)
         if n:

@@ -151,6 +151,7 @@ class ExchangeService:
                     # warns with the missing participants instead of
                     # staying silent until _abandoned.
                     self.negotiator.check_stalls()
+                    self._resolve_abandoned()
                     continue
                 self._cycle += 1
                 metrics.inc_counter("svc.loop_cycles")
@@ -183,6 +184,7 @@ class ExchangeService:
                 self.arbiter.on_cycle(self._cycle)
                 self.params.on_cycle()
                 self.negotiator.check_stalls()
+                self._resolve_abandoned()
             except FaultInjected as e:
                 self._kill(f"fault injected in service loop: {e}")
                 self._resolve_inline(batch)
@@ -192,6 +194,17 @@ class ExchangeService:
                 self._kill(f"loop error: {e}")
                 self._resolve_inline(batch)
                 return
+
+    def _resolve_abandoned(self) -> None:
+        """Resolve the submissions the stall escalation abandoned
+        (``HVD_TPU_STALL_ABANDON`` consecutive stalled checks): each
+        posted participant's future resolves through the inline-
+        fallback path — a permanently missing participant slows its
+        peers, it never wedges them."""
+        for sub in self.negotiator.take_abandoned():
+            if not sub.future.done():
+                metrics.inc_counter("svc.fallback_sync")
+                self._dispatch(sub)
 
     def _resolve_inline(self, subs: Sequence[Submission]) -> None:
         """Resolve any still-pending futures synchronously — the batch

@@ -82,6 +82,39 @@ def tenant_summary(
     return out
 
 
+def tenant_observed(
+    per_rank: Dict[int, Dict[str, Any]]
+) -> Dict[str, Dict[str, Any]]:
+    """Per-tenant observed SLO inputs aggregated across rank snapshots
+    (the SLO watchdog's view, ``runner/slo.py``):
+
+    * ``step_s`` — the tenant's per-step exchange residency: the sum of
+      its per-phase p50s, taken from the WORST rank (the rank a
+      straggler verdict would name);
+    * ``phase_p99_s`` — the worst per-phase p99 across ranks, the
+      fallback served-latency signal when no arbiter wait histogram
+      exists;
+    * ``ranks`` — how many ranks reported the tenant.
+    """
+    out: Dict[str, Dict[str, Any]] = {}
+    for _rank, snap in sorted(per_rank.items()):
+        for tenant, phases in tenant_summary(snap).items():
+            step = sum(
+                (p.get("p50") or 0.0) for p in phases.values()
+            )
+            p99 = max(
+                ((p.get("p99") or 0.0) for p in phases.values()),
+                default=0.0,
+            )
+            agg = out.setdefault(tenant, {
+                "step_s": 0.0, "phase_p99_s": 0.0, "ranks": 0,
+            })
+            agg["ranks"] += 1
+            agg["step_s"] = max(agg["step_s"], step)
+            agg["phase_p99_s"] = max(agg["phase_p99_s"], p99)
+    return out
+
+
 def _slowest_tenant(snapshot: Dict[str, Any],
                     phase: str) -> Optional[str]:
     """The tenant with the largest p50 for ``phase`` on this rank —

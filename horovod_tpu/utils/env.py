@@ -248,6 +248,35 @@ TRACE_STRAGGLER_Z = "TRACE_STRAGGLER_Z"
 # naming the missing participants (the PR 2 stall inspector extended to
 # the service's producer-level bitvector).
 STALL_TIMEOUT = "STALL_TIMEOUT"
+# Stall escalation: after this many CONSECUTIVE stalled check intervals
+# the negotiator abandons the entry and every posted participant's
+# future resolves through the inline-fallback path (counter
+# svc.stall_abandoned + a svc_stall_abandon event) — a permanently
+# missing participant can never wedge multi-participant producers.
+# 0 (default) = warn forever, never abandon (the pre-PR 16 behavior).
+STALL_ABANDON = "STALL_ABANDON"
+# Per-tenant SLO specs for the driver-side watchdog (runner/slo.py):
+#   "tenantA:step=0.5,p99=0.05;tenantB:p99=0.1"
+# step = target per-step exchange seconds (sum of the tenant's
+# per-phase p50s from trace.tenant_seconds); p99 = target served-
+# latency p99 (the arbiter's svc.tenant.wait_seconds histogram).
+# Unset/empty = no watchdog, no remediation.  See docs/multitenant.md.
+SLO_SPEC = "SLO_SPEC"
+# Breach hysteresis: a tenant must breach the same target for this many
+# CONSECUTIVE evaluation windows before the watchdog confirms it
+# (default 3) — one noisy sample never triggers a remediation.
+SLO_WINDOWS = "SLO_WINDOWS"
+# Seconds between driver-side SLO evaluations (default 5).
+SLO_CHECK_INTERVAL = "SLO_CHECK_INTERVAL"
+# Seconds a tenant's remediation ladder holds at a rung before a
+# still-confirmed breach escalates to the next rung (default 30) —
+# every rung gets time to take effect before a costlier one fires.
+SLO_COOLDOWN = "SLO_COOLDOWN"
+# Remediation execution bounds (elastic/remediate.py): per-phase
+# attempt timeout in seconds (default 30) and attempts per phase
+# (default 2) for the RetryPolicy every escalation rung runs under.
+REMEDIATE_TIMEOUT = "REMEDIATE_TIMEOUT"
+REMEDIATE_RETRIES = "REMEDIATE_RETRIES"
 
 # Launcher-provided rendezvous env (analog of reference gloo_run.py:65-103).
 RANK = "RANK"

@@ -56,10 +56,17 @@ class TestHungProbe:
         assert skip["reason"]  # non-empty, always
         assert "TimeoutExpired" in skip["reason"]
         assert "boom" in skip["probe_stderr"]
-        assert calls["n"] == 2  # both attempts ran
-        # per-attempt deadline bounded INSIDE the alarm window: never
-        # more than half the remaining budget minus the records reserve
-        for t in calls["timeouts"]:
+        # both probe attempts ran, then the doctor's FIRST stage (its
+        # subprocess hits the same mock, times out, and the ladder
+        # stops at the first failing stage)
+        assert calls["n"] == 3
+        diagnosis = skip["probe_diagnosis"]
+        assert diagnosis["status"] == "sick"
+        assert diagnosis["verdict"]["stage"] == "import_jax"
+        # per-attempt probe deadline bounded INSIDE the alarm window:
+        # never more than half the remaining budget minus the records
+        # reserve (the trailing doctor-stage timeout has its own rule)
+        for t in calls["timeouts"][:2]:
             assert t <= 480 / 2 - 45 + 1
 
     def test_attempt_budget_shrinks_with_alarm(self, monkeypatch):

@@ -1,0 +1,379 @@
+"""Chaos sweep: every registered fault site fires once through REAL
+code, and nothing wedges.
+
+The fault registry (``horovod_tpu/faults.py``) documents its sites in
+a docstring table; each PR that adds a site also adds the code path
+that honors it — but nothing before this sweep guaranteed the whole
+table stays *live*.  This module pins that: the site list is parsed
+from the table itself (plus the ``remesh.<phase>`` expansion), every
+site has a scenario that arms a plan and drives the real code path to
+it, and a site without a scenario FAILS the coverage test — a new
+fault site cannot land without its chaos scenario.
+
+Each scenario asserts the three sweep invariants:
+
+* the armed fault actually fired (``faults.injected.<site>.<kind>``);
+* the run completed — degraded, aborted cleanly, or retried through,
+  but never wedged (every scenario returns within its own timeout);
+* the degradation surface fired (fallback/retry/abort counters or the
+  exception the abort contract names).
+
+The multi-process version of the same sweep — a two-tenant 4-process
+train loop under a fault plan — is ``tools/tier1_slo_smoke.sh``; this
+in-process half runs in the default tier so the registry cannot rot
+between smoke runs.
+"""
+
+import re
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import horovod_tpu.faults as faults_mod
+from horovod_tpu import faults, metrics
+from horovod_tpu.exceptions import FaultInjected
+from horovod_tpu.utils.retry import RetryPolicy
+
+pytestmark = [pytest.mark.slo, pytest.mark.faults]
+
+
+@pytest.fixture(autouse=True)
+def _sweep_isolation():
+    faults.set_plan(None)
+    metrics.reset_counters("faults.")
+    metrics.reset_counters("svc.")
+    metrics.reset_counters("slo.")
+    yield
+    faults.set_plan(None)
+
+
+def registered_sites():
+    """Ground truth: the docstring table rows (every site is dotted),
+    with ``remesh.<phase>`` expanded to the real phase list."""
+    from horovod_tpu.elastic import remesh
+
+    rows = re.findall(r"^``([a-z_]+\.[a-z_.<>]+)``",
+                      faults_mod.__doc__, re.M)
+    sites = set()
+    for site in rows:
+        if site.startswith("faults."):
+            continue  # the counter-name row, not a site
+        if site == "remesh.<phase>":
+            sites.update(f"remesh.{p}" for p in remesh.PHASES)
+        else:
+            sites.add(site)
+    return sorted(sites)
+
+
+def _fired(site, kind):
+    n = metrics.get_counter(f"faults.injected.{site}.{kind}")
+    assert n >= 1, f"armed fault at {site} never fired ({kind})"
+
+
+# ------------------------------------------------------- scenarios
+
+def _noop_sleep(_s):
+    return None
+
+
+def scenario_discovery_script(tmp_path):
+    faults.set_plan("discovery.script:error:nth=1")
+    from horovod_tpu.elastic.discovery import HostDiscoveryScript
+
+    disc = HostDiscoveryScript(
+        "echo hostA:2",
+        retry=RetryPolicy(max_attempts=2, base_delay_s=0.0,
+                          sleep=_noop_sleep, name="discovery"),
+    )
+    assert disc.find_available_hosts_and_slots() == {"hostA": 2}
+    _fired("discovery.script", "error")
+    assert metrics.get_counter("retry.discovery.retries") >= 1
+
+
+def scenario_discovery_resize(tmp_path):
+    faults.set_plan("discovery.resize:resize_to:np=3,nth=1")
+    from horovod_tpu.elastic.discovery import FixedHosts, HostManager
+
+    mgr = HostManager(FixedHosts({"a": 2, "b": 2}))
+    mgr.update_available_hosts()
+    assert mgr.available_slots() == 3
+    _fired("discovery.resize", "resize_to")
+
+
+def scenario_driver_spawn(tmp_path):
+    # One real (degenerate) round: the first spawn attempt faults, the
+    # spawn RetryPolicy absorbs it, the worker runs and exits 0.
+    faults.set_plan("driver.spawn:error:nth=1")
+    from horovod_tpu.elastic.discovery import FixedHosts, HostManager
+    from horovod_tpu.runner.elastic_driver import ElasticDriver
+
+    driver = ElasticDriver(
+        HostManager(FixedHosts({"localhost": 1})), min_np=1,
+        cooldown_s=0.05,
+        spawn_retry=RetryPolicy(max_attempts=2, base_delay_s=0.0,
+                                sleep=_noop_sleep,
+                                name="elastic.spawn"),
+    )
+    driver.start_discovery()
+    try:
+        rc = driver.run_rounds([sys.executable, "-c", "pass"])
+    finally:
+        driver.stop()
+    assert rc == 0
+    _fired("driver.spawn", "error")
+    assert metrics.get_counter("retry.elastic.spawn.retries") >= 1
+
+
+def _worker_manager(monkeypatch, plan):
+    from horovod_tpu.runner import controller_py as cp
+    from horovod_tpu.runner.elastic_worker import (
+        WorkerNotificationManager,
+    )
+
+    srv = cp.PyControllerServer(secret="s3cret", world=1)
+    monkeypatch.setenv("HVD_TPU_RENDEZVOUS_ADDR", "127.0.0.1")
+    monkeypatch.setenv("HVD_TPU_RENDEZVOUS_PORT", str(srv.port))
+    monkeypatch.setenv("HVD_TPU_SECRET", "s3cret")
+    faults.set_plan(plan)
+    return srv, WorkerNotificationManager()
+
+
+def scenario_worker_connect(tmp_path, monkeypatch):
+    srv, mgr = _worker_manager(
+        monkeypatch, "worker.connect:error:nth=1"
+    )
+    try:
+        mgr.init()  # first dial faults, the connect retry absorbs it
+        assert mgr._client is not None
+    finally:
+        mgr.close()
+        srv.stop()
+    _fired("worker.connect", "error")
+    assert metrics.get_counter("retry.worker.connect.retries") >= 1
+
+
+def scenario_worker_heartbeat(tmp_path, monkeypatch):
+    # A slow fault inside the heartbeat tick: the beat delays but the
+    # thread survives and keeps beating (the straggler stand-in).
+    srv, mgr = _worker_manager(
+        monkeypatch, "worker.heartbeat:slow:secs=0.01"
+    )
+    try:
+        mgr.init()
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            if metrics.get_counter(
+                    "faults.injected.worker.heartbeat.slow"):
+                break
+            time.sleep(0.05)
+    finally:
+        mgr.close()
+        srv.stop()
+    _fired("worker.heartbeat", "slow")
+
+
+def scenario_worker_commit(tmp_path):
+    faults.set_plan("worker.commit:error:nth=1")
+    from horovod_tpu.elastic.state import ObjectState
+
+    state = ObjectState(epoch=0)
+    with pytest.raises(FaultInjected):
+        state.commit()
+    state.commit()  # the run continues past the injected boundary
+    _fired("worker.commit", "error")
+
+
+def scenario_checkpoint_write(tmp_path):
+    import horovod_tpu as hvd
+
+    path = str(tmp_path / "ckpt")
+    hvd.save_checkpoint(path, {"epoch": 1}, step=1, use_orbax=False)
+    faults.set_plan("checkpoint.write:corrupt:nth=1")
+    hvd.save_checkpoint(path, {"epoch": 2}, step=2, use_orbax=False)
+    faults.set_plan(None)
+    # degraded, not wedged: restore falls back to the last good step
+    assert hvd.latest_good_step(path) == 1
+    state, step = hvd.restore_or_init(path, {"epoch": 0})
+    assert (state["epoch"], step) == (1, 1)
+    _fired("checkpoint.write", "corrupt")
+    assert metrics.get_counter("checkpoint.corrupt_detected") >= 1
+
+
+def _scenario_remesh_phase(phase):
+    def run(tmp_path):
+        faults.set_plan(f"remesh.{phase}:error:nth=1")
+        from horovod_tpu.elastic import remesh
+
+        # the abort contract: a faulted phase raises out of the
+        # instrumented block (the driver catches and falls back to the
+        # respawn path) — and the next pass through is clean
+        with pytest.raises(FaultInjected):
+            with remesh.remesh_phase(phase, remesh_id="chaos"):
+                pass
+        with remesh.remesh_phase(phase, remesh_id="chaos"):
+            pass
+        _fired(f"remesh.{phase}", "error")
+        assert metrics.get_counter(f"remesh.phase.{phase}") >= 1
+    return run
+
+
+def _svc_submit_one():
+    import jax.numpy as jnp
+
+    from horovod_tpu import svc, xir
+    from horovod_tpu.runtime import WORLD_AXIS
+
+    prog = xir.program("test", [
+        xir.all_reduce(WORLD_AXIS, reduce="mean", bucket=0, nbytes=32,
+                       dtype="float32"),
+    ])
+    s = svc.get_service()
+    x = jnp.ones((8, 1), jnp.float32)
+    out = s.submit(prog, [x], producer="chaos").result(timeout=60)[0]
+    np.testing.assert_allclose(np.asarray(out), 1.0)
+    return s
+
+
+def scenario_svc_submit(tmp_path, hvd_module):
+    faults.set_plan("svc.submit:error:nth=1")
+    s = _svc_submit_one()
+    assert s.dead
+    _fired("svc.submit", "error")
+    assert metrics.get_counter("svc.fallback_sync") >= 1
+
+
+def scenario_svc_admit(tmp_path, hvd_module):
+    faults.set_plan("svc.admit:error:nth=1")
+    s = _svc_submit_one()
+    assert s.dead
+    _fired("svc.admit", "error")
+    assert metrics.get_counter("svc.fallback_sync") >= 1
+
+
+def scenario_svc_loop(tmp_path, hvd_module):
+    faults.set_plan("svc.loop:error:nth=1")
+    s = _svc_submit_one()
+    assert s.dead
+    _fired("svc.loop", "error")
+
+
+def scenario_svc_drain(tmp_path, hvd_module):
+    faults.set_plan("svc.drain:error:nth=1")
+    from horovod_tpu import svc
+
+    s = svc.get_service()
+    assert s.drain(timeout_s=5) is False
+    assert s.dead
+    faults.set_plan(None)
+    s2 = _svc_submit_one()  # post-death submissions resolve inline
+    assert s2.dead
+    _fired("svc.drain", "error")
+
+
+def scenario_topo_dcn_phase(tmp_path, hvd_module):
+    import jax.numpy as jnp
+
+    from horovod_tpu.topo import hierarchical
+
+    faults.set_plan("topo.dcn_phase:slow:secs=0.01")
+    with hierarchical._dcn_trace("rs_dcn", jnp.ones(8), "dense"):
+        pass
+    _fired("topo.dcn_phase", "slow")
+
+
+def _remediator(store=None):
+    from horovod_tpu.elastic.remediate import Remediator
+
+    calls = store if store is not None else []
+    return Remediator(
+        placement={"jobA": 1, "jobB": 3},
+        actuators={
+            "handoff": lambda o, n, b: calls.append("handoff"),
+            "rollback": lambda o, n, b: calls.append("rollback"),
+        },
+        cooldown_s_=0.0, retry_attempts=2, retry_timeout_s=5.0,
+        sleep=_noop_sleep,
+    )
+
+
+def scenario_remediate_plan(tmp_path):
+    faults.set_plan("remediate.plan:error:nth=1")
+    r = _remediator()
+    rec = r.remediate({"tenant": "jobA", "kind": "step"}, "handoff")
+    assert rec["outcome"] == "abort" and rec["stable"] is True
+    assert r.placement() == {"jobA": 1, "jobB": 3}  # nothing changed
+    _fired("remediate.plan", "error")
+    assert metrics.get_counter("slo.remediation_abort") == 1
+
+
+def scenario_remediate_handoff(tmp_path):
+    faults.set_plan("remediate.handoff:error:times=0")
+    calls = []
+    r = _remediator(calls)
+    rec = r.remediate({"tenant": "jobA", "kind": "step"}, "handoff")
+    assert rec["outcome"] == "abort" and rec["stable"] is True
+    assert r.placement() == {"jobA": 1, "jobB": 3}  # rolled back
+    assert "rollback" in calls
+    _fired("remediate.handoff", "error")
+    assert metrics.get_counter("slo.rollbacks") == 1
+
+
+def scenario_remediate_rollback(tmp_path):
+    faults.set_plan(
+        "remediate.handoff:error:times=0;"
+        "remediate.rollback:error:times=0"
+    )
+    r = _remediator()
+    rec = r.remediate({"tenant": "jobA", "kind": "step"}, "handoff")
+    assert rec["outcome"] == "abort" and rec["stable"] is False
+    _fired("remediate.rollback", "error")
+    assert metrics.get_counter("slo.remediation_unstable") == 1
+
+
+SCENARIOS = {
+    "discovery.script": scenario_discovery_script,
+    "discovery.resize": scenario_discovery_resize,
+    "driver.spawn": scenario_driver_spawn,
+    "worker.connect": scenario_worker_connect,
+    "worker.heartbeat": scenario_worker_heartbeat,
+    "worker.commit": scenario_worker_commit,
+    "checkpoint.write": scenario_checkpoint_write,
+    "svc.submit": scenario_svc_submit,
+    "svc.admit": scenario_svc_admit,
+    "svc.drain": scenario_svc_drain,
+    "svc.loop": scenario_svc_loop,
+    "topo.dcn_phase": scenario_topo_dcn_phase,
+    "remediate.plan": scenario_remediate_plan,
+    "remediate.handoff": scenario_remediate_handoff,
+    "remediate.rollback": scenario_remediate_rollback,
+}
+SCENARIOS.update({
+    f"remesh.{p}": _scenario_remesh_phase(p)
+    for p in ("pause", "snapshot", "publish", "barrier", "reinit",
+              "fetch", "rebuild")
+})
+
+
+def test_every_registered_site_has_a_scenario():
+    """A fault site without a chaos scenario cannot land: the docstring
+    table and this sweep move together."""
+    assert set(SCENARIOS) == set(registered_sites())
+
+
+@pytest.mark.parametrize("site", sorted(SCENARIOS))
+def test_site_fires_and_nothing_wedges(site, tmp_path, monkeypatch,
+                                       request):
+    scenario = SCENARIOS[site]
+    kwargs = {}
+    code = scenario.__code__
+    if "monkeypatch" in code.co_varnames[:code.co_argcount]:
+        kwargs["monkeypatch"] = monkeypatch
+    if "hvd_module" in code.co_varnames[:code.co_argcount]:
+        kwargs["hvd_module"] = request.getfixturevalue("hvd_module")
+        from horovod_tpu import svc
+
+        svc.reset_service()
+        request.addfinalizer(svc.reset_service)
+    scenario(tmp_path, **kwargs)

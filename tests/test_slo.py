@@ -1,0 +1,734 @@
+"""SLO self-healing control plane (``runner/slo.py`` +
+``elastic/remediate.py``).
+
+Contracts under test:
+
+* **Specs** — ``HVD_TPU_SLO_SPEC`` parsing: per-tenant step/p99
+  targets, malformed entries skipped (never a dead driver).
+* **Watchdog** — breach detection folds the tenant phase histograms,
+  the ``/tenants`` wait aggregation, and the straggler verdicts;
+  N-consecutive-window hysteresis gates confirmation, recovery re-arms.
+* **Ladder** — a confirmed breach escalates preempt -> degrade ->
+  handoff one rung per cooldown; every rung runs under its
+  RetryPolicy; the handoff moves REAL shard buffers through
+  :func:`~horovod_tpu.elastic.remesh.reshard_shards` bitwise.
+* **Abort contract** — a fault at ``remediate.plan`` aborts before
+  anything changed; at ``remediate.handoff`` the placement rolls back
+  to the pre-handoff state and the shards continue bitwise; at
+  ``remediate.rollback`` the abort record says ``stable=False``.
+* **Surfaces** — ``GET /slo`` serves specs + status + remediation
+  history; the negotiator's stall escalation abandons a dead
+  producer's negotiation after ``HVD_TPU_STALL_ABANDON`` stalled
+  checks and the service resolves its futures inline; the arbiter's
+  admission-timeout and preemption-expiry paths land in the event log.
+
+``tools/tier1_slo_smoke.sh`` drives the same marker end-to-end across
+4 worker processes.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from horovod_tpu import events, faults, metrics
+from horovod_tpu.elastic import remediate, remesh
+from horovod_tpu.elastic.remediate import (
+    RemediationError,
+    Remediator,
+    pick_donor,
+    plan_handoff,
+)
+from horovod_tpu.runner import slo
+from horovod_tpu.runner.telemetry_http import TelemetryServer
+
+pytestmark = pytest.mark.slo
+
+
+@pytest.fixture(autouse=True)
+def _slo_isolation():
+    metrics.reset_counters("slo.")
+    metrics.reset_counters("trace.")
+    metrics.reset_counters("svc.")
+    metrics.reset_counters("faults.")
+    metrics.reset_counters("retry.")
+    yield
+    faults.set_plan(None)
+    events.set_event_log(None)
+    metrics.reset_counters("slo.")
+    metrics.reset_counters("trace.")
+
+
+@pytest.fixture()
+def event_log(tmp_path):
+    path = str(tmp_path / "events.jsonl")
+    events.set_event_log(events.EventLog(path))
+    yield path
+    events.set_event_log(None)
+
+
+def _named(path, name):
+    return [e for e in events.read_events(path) if e["event"] == name]
+
+
+# --------------------------------------------------------- snapshots
+
+def rank_snapshot(tenant_ms=None, wait_ms=None, phase_ms=None, n=8):
+    """One synthetic worker metrics snapshot: per-tenant phase
+    histograms (``trace.tenant_seconds.<t>.dcn``), optional arbiter
+    wait histograms, optional untagged phase histograms — built
+    through the real registry so the bucket shapes are authentic."""
+    metrics.reset_counters("trace.")
+    metrics.reset_counters("svc.tenant.wait_seconds")
+    for _ in range(n):
+        for t, ms in (tenant_ms or {}).items():
+            metrics.observe(f"trace.tenant_seconds.{t}.dcn", ms / 1e3)
+        for t, ms in (wait_ms or {}).items():
+            metrics.observe(f"svc.tenant.wait_seconds.{t}", ms / 1e3)
+        if phase_ms is not None:
+            metrics.observe("trace.phase_seconds.dcn", phase_ms / 1e3)
+    snap = metrics.snapshot()
+    metrics.reset_counters("trace.")
+    metrics.reset_counters("svc.tenant.wait_seconds")
+    return snap
+
+
+# ------------------------------------------------------------- specs
+
+class TestSpecParsing:
+    def test_full_syntax(self):
+        specs = slo.parse_slo_spec(
+            "jobA:step=0.5,p99=0.05;jobB:p99=0.1"
+        )
+        assert specs["jobA"].step_s == 0.5
+        assert specs["jobA"].p99_s == 0.05
+        assert specs["jobB"].step_s is None
+        assert specs["jobB"].p99_s == 0.1
+        assert specs["jobA"].targets() == [("step", 0.5),
+                                           ("p99", 0.05)]
+
+    @pytest.mark.parametrize("raw", [
+        "", ";;", "noseparator", "t:", "t:step", "t:step=abc",
+        "t:step=-1", "t:latency=0.5",
+    ])
+    def test_malformed_entries_skipped(self, raw):
+        assert slo.parse_slo_spec(raw) == {}
+
+    def test_bad_entry_does_not_kill_good_ones(self):
+        specs = slo.parse_slo_spec("bad:wat=1;good:step=0.2")
+        assert list(specs) == ["good"]
+
+    def test_specs_from_env(self, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_SLO_SPEC", "j:step=0.25")
+        assert slo.specs_from_env()["j"].step_s == 0.25
+        monkeypatch.delenv("HVD_TPU_SLO_SPEC")
+        assert slo.specs_from_env() == {}
+
+
+# ---------------------------------------------------------- observed
+
+class TestObserveTenants:
+    def test_step_is_worst_rank_phase_p50_sum(self):
+        fast = rank_snapshot(tenant_ms={"a": 1.0})
+        slow = rank_snapshot(tenant_ms={"a": 50.0})
+        obs = slo.observe_tenants({0: fast, 1: slow})
+        assert obs["a"]["step_s"] == pytest.approx(0.05, rel=0.5)
+        assert obs["a"]["step_s"] > 0.02  # the slow rank, not the fast
+
+    def test_p99_prefers_arbiter_wait_histogram(self):
+        snap = rank_snapshot(tenant_ms={"a": 1.0},
+                             wait_ms={"a": 200.0})
+        obs = slo.observe_tenants({0: snap})
+        assert obs["a"]["p99_s"] > 0.05  # the wait hist, not the 1ms phase
+
+    def test_p99_falls_back_to_phase_p99(self):
+        snap = rank_snapshot(tenant_ms={"a": 30.0})
+        obs = slo.observe_tenants({0: snap})
+        assert obs["a"]["p99_s"] is not None
+        assert obs["a"]["p99_s"] > 0.01
+
+    def test_straggler_verdicts_attach_to_tenant(self):
+        fast = rank_snapshot(tenant_ms={"a": 1.0}, phase_ms=1.0)
+        slow = rank_snapshot(tenant_ms={"a": 40.0}, phase_ms=40.0)
+        obs = slo.observe_tenants({0: fast, 1: slow})
+        assert any(s["rank"] == 1 for s in obs["a"]["stragglers"])
+
+
+# ---------------------------------------------------------- watchdog
+
+class TestWatchdogHysteresis:
+    def _breaching(self):
+        return {0: rank_snapshot(tenant_ms={"jobA": 50.0})}
+
+    def _green(self):
+        return {0: rank_snapshot(tenant_ms={"jobA": 0.5})}
+
+    def test_confirm_only_after_n_consecutive_windows(self, event_log):
+        wd = slo.SLOWatchdog(slo.parse_slo_spec("jobA:step=0.01"),
+                             windows=3)
+        assert wd.evaluate(self._breaching())["breaches"] == []
+        assert wd.evaluate(self._breaching())["breaches"] == []
+        status = wd.evaluate(self._breaching())
+        assert [b["tenant"] for b in status["breaches"]] == ["jobA"]
+        assert status["breaches"][0]["kind"] == "step"
+        assert status["breaches"][0]["windows"] == 3
+        assert metrics.get_counter("slo.breaches") == 1
+        assert metrics.get_counter("slo.breaches.jobA.step") == 1
+        assert len(_named(event_log, events.SLO_BREACH)) == 1
+        assert metrics.get_gauge(
+            "slo.breached", {"tenant": "jobA", "kind": "step"}) == 1.0
+
+    def test_green_window_resets_the_streak(self):
+        wd = slo.SLOWatchdog(slo.parse_slo_spec("jobA:step=0.01"),
+                             windows=3)
+        wd.evaluate(self._breaching())
+        wd.evaluate(self._breaching())
+        wd.evaluate(self._green())  # streak broken at 2
+        wd.evaluate(self._breaching())
+        wd.evaluate(self._breaching())
+        assert wd.evaluate(self._breaching())["breaches"], \
+            "streak should re-confirm after 3 fresh windows"
+        assert metrics.get_counter("slo.breaches") == 1
+
+    def test_recovery_emits_event_and_counter(self, event_log):
+        wd = slo.SLOWatchdog(slo.parse_slo_spec("jobA:step=0.01"),
+                             windows=1)
+        assert wd.evaluate(self._breaching())["breaches"]
+        assert wd.evaluate(self._green())["breaches"] == []
+        assert metrics.get_counter("slo.recoveries") == 1
+        assert len(_named(event_log, events.SLO_RECOVERED)) == 1
+        assert metrics.get_gauge(
+            "slo.breached", {"tenant": "jobA", "kind": "step"}) == 0.0
+
+    def test_unobserved_tenant_never_breaches(self):
+        wd = slo.SLOWatchdog(slo.parse_slo_spec("ghost:step=0.01"),
+                             windows=1)
+        assert wd.evaluate(self._breaching())["breaches"] == []
+
+
+# ------------------------------------------------------------ ladder
+
+def _breach(tenant="jobA", kind="step"):
+    return {"tenant": tenant, "kind": kind, "observed": 0.9,
+            "target": 0.1}
+
+
+class TestEscalationLadder:
+    def test_rungs_escalate_and_cap_at_handoff(self):
+        calls = []
+        r = Remediator(
+            placement={"jobA": 1, "jobB": 3},
+            actuators={
+                "preempt": lambda t, b: calls.append("preempt"),
+                "degrade": lambda t, b: calls.append("degrade") or {},
+                "handoff": lambda o, n, b: calls.append("handoff"),
+            },
+            cooldown_s_=0.0, retry_attempts=1, retry_timeout_s=5.0,
+            sleep=lambda s: None,
+        )
+        for _ in range(4):
+            r.consider(_breach())
+        assert calls == ["preempt", "degrade", "handoff", "handoff"]
+        assert r.placement() == {"jobA": 3, "jobB": 1}
+        assert metrics.get_counter("slo.remediations.preempt") == 1
+        assert metrics.get_counter("slo.remediations.handoff") == 2
+
+    def test_cooldown_gates_reactions(self):
+        clock = {"t": 100.0}
+        calls = []
+        r = Remediator(
+            placement={"jobA": 1, "jobB": 2},
+            actuators={"preempt": lambda t, b: calls.append("p"),
+                       "degrade": lambda t, b: {}},
+            cooldown_s_=30.0, retry_attempts=1,
+            clock=lambda: clock["t"], sleep=lambda s: None,
+        )
+        assert r.consider(_breach()) is not None
+        assert r.consider(_breach()) is None  # inside cooldown
+        clock["t"] += 31.0
+        assert r.consider(_breach()) is not None  # escalated rung
+        assert calls == ["p"]
+
+    def test_reset_rearms_from_cheapest_rung(self):
+        calls = []
+        r = Remediator(
+            actuators={"preempt": lambda t, b: calls.append("p"),
+                       "degrade": lambda t, b: {}},
+            cooldown_s_=0.0, retry_attempts=1, sleep=lambda s: None,
+        )
+        r.consider(_breach())
+        r.reset("jobA")
+        r.consider(_breach())
+        assert calls == ["p", "p"]
+
+    def test_rung_retries_then_aborts(self, event_log):
+        attempts = []
+
+        def flaky(t, b):
+            attempts.append(1)
+            raise RuntimeError("actuator down")
+
+        r = Remediator(actuators={"preempt": flaky},
+                       cooldown_s_=0.0, retry_attempts=3,
+                       retry_timeout_s=5.0, sleep=lambda s: None)
+        rec = r.remediate(_breach(), "preempt")
+        assert rec["outcome"] == "abort"
+        assert rec["stable"] is True  # nothing moved
+        assert len(attempts) == 3
+        aborts = _named(event_log, events.REMEDIATE_ABORT)
+        assert aborts and aborts[0]["stable"] is True
+        assert metrics.get_counter("slo.remediation_abort") == 1
+
+    def test_degrade_records_knob_changes(self, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_SVC_STALENESS", "1")
+        monkeypatch.setenv("HVD_TPU_TOPO_LOWER", "hier")
+        r = Remediator(cooldown_s_=0.0, retry_attempts=1,
+                       sleep=lambda s: None)
+        rec = r.remediate(_breach(), "degrade")
+        assert rec["outcome"] == "ok"
+        assert rec["changes"]["HVD_TPU_SVC_STALENESS"] == "2"
+        assert rec["changes"]["HVD_TPU_TOPO_LOWER"] == "flat"
+        import os
+
+        assert os.environ["HVD_TPU_SVC_STALENESS"] == "2"
+        assert os.environ["HVD_TPU_TOPO_LOWER"] == "flat"
+
+    def test_plan_handoff_validates_before_mutation(self):
+        with pytest.raises(RemediationError):
+            plan_handoff({"a": 1, "b": 1}, "a", "b")  # starves donor
+        with pytest.raises(RemediationError):
+            plan_handoff({"a": 2}, "a", "a")
+        assert plan_handoff({"a": 3, "b": 1}, "a", "b", slices=2) == \
+            {"a": 1, "b": 3}
+
+    def test_pick_donor_most_slices_ties_by_name(self):
+        assert pick_donor({"a": 2, "b": 3, "c": 3}, "a") == "b"
+        assert pick_donor({"a": 1, "b": 1}, "a") is None
+
+
+# ----------------------------------------------- handoff via remesh
+
+def _split(buf, layout):
+    padded = np.zeros(layout.shards * layout.shard_len, buf.dtype)
+    padded[:buf.size] = buf
+    return [
+        padded[r * layout.shard_len:(r + 1) * layout.shard_len].copy()
+        for r in range(layout.shards)
+    ]
+
+
+class TestHandoffMovesRealState:
+    """The in-process slice handoff: donor shrink + recipient grow are
+    reshard_shards calls, so the exchanged state is a permutation —
+    training continues bitwise after both the handoff and a rollback.
+    """
+
+    def _actuators(self, store):
+        # store: tenant -> {"buf": flat valid array, "layout": ShardLayout,
+        # "shards": list}; the handoff re-lays each tenant's shards out
+        # over its NEW slice count.
+        def relayout(tenant, new_slices):
+            st = store[tenant]
+            old = st["layout"]
+            new = remesh.ShardLayout(
+                old.n, new_slices,
+                -(-old.n // new_slices),  # ceil
+            )
+            st["shards"] = remesh.reshard_shards(st["shards"], old, new)
+            st["layout"] = new
+
+        def handoff(old_p, new_p, breach):
+            for tenant in sorted(set(old_p) | set(new_p)):
+                if old_p.get(tenant) != new_p.get(tenant):
+                    relayout(tenant, new_p[tenant])
+
+        def rollback(old_p, new_p, breach):
+            for tenant in sorted(set(old_p) | set(new_p)):
+                if store[tenant]["layout"].shards != old_p[tenant]:
+                    relayout(tenant, old_p[tenant])
+
+        return {"handoff": handoff, "rollback": rollback,
+                "preempt": lambda t, b: None,
+                "degrade": lambda t, b: {}}
+
+    def _store(self):
+        store = {}
+        rng = np.random.RandomState(0)
+        for tenant, slices in (("jobA", 1), ("jobB", 3)):
+            buf = rng.rand(23).astype(np.float32)
+            layout = remesh.ShardLayout(23, slices, -(-23 // slices))
+            store[tenant] = {"buf": buf, "layout": layout,
+                             "shards": _split(buf, layout)}
+        return store
+
+    def _valid(self, st):
+        flat = np.concatenate([np.asarray(s).reshape(-1)
+                               for s in st["shards"]])
+        return flat[:st["layout"].n]
+
+    def test_handoff_is_bitwise_and_measured(self, event_log):
+        store = self._store()
+        before = {t: self._valid(st).copy() for t, st in store.items()}
+        r = Remediator(placement={"jobA": 1, "jobB": 3},
+                       actuators=self._actuators(store),
+                       cooldown_s_=0.0, retry_attempts=1,
+                       sleep=lambda s: None)
+        rec = r.remediate(_breach("jobA"), "handoff")
+        assert rec["outcome"] == "ok"
+        assert rec["donor"] == "jobB"
+        assert r.placement() == {"jobA": 2, "jobB": 2}
+        assert store["jobA"]["layout"].shards == 2
+        assert store["jobB"]["layout"].shards == 2
+        for tenant in store:
+            np.testing.assert_array_equal(
+                self._valid(store[tenant]), before[tenant]
+            ), f"handoff permuted {tenant} state"
+        # measured: per-phase wall clocks in the record + histogram
+        assert [p["phase"] for p in rec["phases"]] == \
+            ["plan", "handoff"]
+        assert all(p["seconds"] >= 0 for p in rec["phases"])
+        assert metrics.get_counter("slo.handoffs") == 1
+        oks = _named(event_log, events.REMEDIATE_OK)
+        assert oks and oks[0]["rung"] == "handoff"
+
+    def test_handoff_fault_rolls_back_bitwise(self, event_log):
+        store = self._store()
+        before = {t: self._valid(st).copy() for t, st in store.items()}
+        faults.set_plan("remediate.handoff:error:times=0")
+        r = Remediator(placement={"jobA": 1, "jobB": 3},
+                       actuators=self._actuators(store),
+                       cooldown_s_=0.0, retry_attempts=2,
+                       retry_timeout_s=5.0, sleep=lambda s: None)
+        rec = r.remediate(_breach("jobA"), "handoff")
+        assert rec["outcome"] == "abort"
+        assert rec["stable"] is True
+        # placement restored, state untouched bitwise
+        assert r.placement() == {"jobA": 1, "jobB": 3}
+        for tenant in store:
+            assert store[tenant]["layout"].shards == \
+                {"jobA": 1, "jobB": 3}[tenant]
+            np.testing.assert_array_equal(
+                self._valid(store[tenant]), before[tenant]
+            )
+        assert metrics.get_counter("slo.rollbacks") == 1
+        assert metrics.get_counter(
+            "faults.injected.remediate.handoff.error") == 2
+        aborts = _named(event_log, events.REMEDIATE_ABORT)
+        assert aborts and aborts[0]["stable"] is True
+
+    def test_plan_fault_aborts_before_any_mutation(self):
+        store = self._store()
+        faults.set_plan("remediate.plan:error:nth=1")
+        r = Remediator(placement={"jobA": 1, "jobB": 3},
+                       actuators=self._actuators(store),
+                       cooldown_s_=0.0, retry_attempts=1,
+                       sleep=lambda s: None)
+        rec = r.remediate(_breach("jobA"), "handoff")
+        assert rec["outcome"] == "abort"
+        assert rec["stable"] is True
+        assert r.placement() == {"jobA": 1, "jobB": 3}
+        assert store["jobB"]["layout"].shards == 3  # nothing moved
+        assert metrics.get_counter("slo.rollbacks") == 0  # no rollback needed
+
+    def test_rollback_fault_marks_unstable(self, event_log):
+        store = self._store()
+        faults.set_plan(
+            "remediate.handoff:error:times=0;"
+            "remediate.rollback:error:times=0"
+        )
+        r = Remediator(placement={"jobA": 1, "jobB": 3},
+                       actuators=self._actuators(store),
+                       cooldown_s_=0.0, retry_attempts=1,
+                       retry_timeout_s=5.0, sleep=lambda s: None)
+        rec = r.remediate(_breach("jobA"), "handoff")
+        assert rec["outcome"] == "abort"
+        assert rec["stable"] is False
+        assert rec["rollback_error"]
+        aborts = _named(event_log, events.REMEDIATE_ABORT)
+        assert aborts and aborts[0]["stable"] is False
+        assert metrics.get_counter("slo.remediation_unstable") == 1
+
+
+# -------------------------------------------------- controller + /slo
+
+class TestController:
+    def test_from_env_none_without_spec(self, monkeypatch):
+        monkeypatch.delenv("HVD_TPU_SLO_SPEC", raising=False)
+        assert slo.SLOController.from_env() is None
+
+    def test_tick_rate_limit_and_remediation(self, monkeypatch):
+        acted = []
+
+        class FakeRemediator:
+            def consider(self, breach):
+                acted.append(breach["tenant"])
+
+            def history(self):
+                return []
+
+            def placement(self):
+                return {}
+
+        wd = slo.SLOWatchdog(slo.parse_slo_spec("jobA:step=0.01"),
+                             windows=1)
+        c = slo.SLOController(wd, remediator=FakeRemediator(),
+                              check_interval_s_=10.0)
+        snaps = {0: rank_snapshot(tenant_ms={"jobA": 50.0})}
+        assert c.maybe_tick(lambda: snaps, now=100.0) is not None
+        assert c.maybe_tick(lambda: snaps, now=105.0) is None
+        assert c.maybe_tick(lambda: snaps, now=111.0) is not None
+        assert acted == ["jobA", "jobA"]
+        assert metrics.get_counter("slo.windows") == 2
+
+    def test_tick_never_raises(self):
+        wd = slo.SLOWatchdog(slo.parse_slo_spec("j:step=0.1"))
+        c = slo.SLOController(wd, check_interval_s_=0.0)
+
+        def explode():
+            raise RuntimeError("kv down")
+
+        assert c.maybe_tick(explode) is None
+
+    def test_slo_endpoint_serves_status_and_history(self):
+        r = Remediator(placement={"jobA": 1, "jobB": 3},
+                       actuators={"preempt": lambda t, b: None},
+                       cooldown_s_=0.0, retry_attempts=1,
+                       sleep=lambda s: None)
+        r.remediate(_breach("jobA"), "preempt")
+        wd = slo.SLOWatchdog(slo.parse_slo_spec("jobA:step=0.01"),
+                             windows=1)
+        c = slo.SLOController(wd, remediator=r,
+                              check_interval_s_=0.0)
+        c.maybe_tick(lambda: {0: rank_snapshot(
+            tenant_ms={"jobA": 50.0})})
+        server = TelemetryServer(port=0, bind_host="127.0.0.1",
+                                 slo_fn=c.payload)
+        try:
+            body = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/slo", timeout=10
+            ).read())
+            assert body["specs"]["jobA"]["step_s"] == 0.01
+            assert body["tenants"]["jobA"]["windows"]["step"] == 1
+            assert [b["tenant"] for b in body["breaches"]] == ["jobA"]
+            assert body["placement"] == {"jobA": 1, "jobB": 3}
+            # one direct remediate() + one the tick's breach triggered
+            assert [h["rung"] for h in body["remediations"]] == \
+                ["preempt", "preempt"]
+        finally:
+            server.stop()
+
+    def test_slo_endpoint_404_without_watchdog(self):
+        server = TelemetryServer(port=0, bind_host="127.0.0.1")
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/slo", timeout=10
+                )
+            assert e.value.code == 404
+        finally:
+            server.stop()
+
+
+# ------------------------------------------- two-tenant acceptance
+
+class TestTwoTenantSelfHealing:
+    """The PR's acceptance scenario, in process: two tenants under a
+    fault plan; a load spike on jobA confirms a breach, the ladder
+    walks to a measured slice handoff, both tenants' SLOs go green
+    after, and zero worker processes were restarted (everything moved
+    through reshard_shards in this very process)."""
+
+    def test_load_spike_to_handoff_to_green(self, monkeypatch,
+                                            event_log):
+        monkeypatch.setenv("HVD_TPU_SLO_SPEC",
+                           "jobA:step=0.02;jobB:step=10.0")
+        helper = TestHandoffMovesRealState()
+        store = helper._store()
+        before = {t: helper._valid(st).copy()
+                  for t, st in store.items()}
+        r = Remediator(placement={"jobA": 1, "jobB": 3},
+                       actuators=helper._actuators(store),
+                       cooldown_s_=0.0, retry_attempts=1,
+                       sleep=lambda s: None)
+        c = slo.SLOController(
+            slo.SLOWatchdog(slo.specs_from_env(), windows=2),
+            remediator=r, check_interval_s_=0.0,
+        )
+        spike = {0: rank_snapshot(tenant_ms={"jobA": 60.0,
+                                             "jobB": 1.0})}
+        green = {0: rank_snapshot(tenant_ms={"jobA": 1.0,
+                                             "jobB": 1.0})}
+        # window 1: breaching but unconfirmed; 2..4: confirmed, the
+        # ladder walks preempt -> degrade -> handoff.
+        for i in range(4):
+            c.maybe_tick(lambda: spike, now=float(i))
+        rungs = [h["rung"] for h in r.history()]
+        assert rungs == ["preempt", "degrade", "handoff"]
+        assert all(h["outcome"] == "ok" for h in r.history())
+        assert r.placement() == {"jobA": 2, "jobB": 2}
+        # the spike resolved: both tenants green, recovery emitted
+        status = c.maybe_tick(lambda: green, now=10.0)
+        assert status["breaches"] == []
+        assert _named(event_log, events.SLO_RECOVERED)
+        # zero restarts: state moved bitwise inside this process
+        for tenant in store:
+            np.testing.assert_array_equal(
+                helper._valid(store[tenant]), before[tenant]
+            )
+
+    def test_injected_handoff_fault_bitwise_rollback(self, monkeypatch,
+                                                     event_log):
+        monkeypatch.setenv("HVD_TPU_SLO_SPEC", "jobA:step=0.02")
+        faults.set_plan("remediate.handoff:error:times=0")
+        helper = TestHandoffMovesRealState()
+        store = helper._store()
+        before = {t: helper._valid(st).copy()
+                  for t, st in store.items()}
+        r = Remediator(placement={"jobA": 1, "jobB": 3},
+                       actuators=helper._actuators(store),
+                       cooldown_s_=0.0, retry_attempts=2,
+                       retry_timeout_s=5.0, sleep=lambda s: None)
+        rec = r.remediate(_breach("jobA"), "handoff")
+        assert rec["outcome"] == "abort" and rec["stable"] is True
+        assert r.placement() == {"jobA": 1, "jobB": 3}
+        # training bitwise-continues on the pre-handoff placement
+        for tenant in store:
+            np.testing.assert_array_equal(
+                helper._valid(store[tenant]), before[tenant]
+            )
+
+
+# ------------------------------------------- stall-abandon escalation
+
+class TestStallAbandon:
+    def _pending_sub(self, neg):
+        from horovod_tpu import xir
+        from horovod_tpu.runtime import WORLD_AXIS
+        from horovod_tpu.svc.queue import (
+            Submission,
+            SvcFuture,
+            TensorQueue,
+        )
+
+        q = TensorQueue()
+        prog = xir.program("test", [
+            xir.all_reduce(WORLD_AXIS, reduce="mean", bucket=0,
+                           nbytes=16, dtype="float32"),
+        ])
+        sub = Submission(
+            seq=q.next_seq(), producer="alive", program=prog,
+            args=[], future=SvcFuture(),
+            participants=("alive", "ghost"),
+        )
+        assert neg.post(sub) == []
+        return sub
+
+    def test_default_off_warns_forever(self, monkeypatch):
+        from horovod_tpu.svc.negotiate import Negotiator
+
+        monkeypatch.delenv("HVD_TPU_STALL_ABANDON", raising=False)
+        neg = Negotiator()
+        self._pending_sub(neg)
+        for _ in range(5):
+            reports = neg.check_stalls(timeout_s=0.0)
+            assert reports and "abandoned" not in reports[0]
+        assert neg.take_abandoned() == []
+        assert neg.pending_count() == 1
+        assert metrics.get_counter("svc.stall_abandoned") == 0
+
+    def test_abandons_after_n_stalled_checks(self, monkeypatch,
+                                             event_log):
+        from horovod_tpu.svc.negotiate import Negotiator
+
+        monkeypatch.setenv("HVD_TPU_STALL_ABANDON", "3")
+        neg = Negotiator()
+        sub = self._pending_sub(neg)
+        assert "abandoned" not in neg.check_stalls(timeout_s=0.0)[0]
+        assert "abandoned" not in neg.check_stalls(timeout_s=0.0)[0]
+        report = neg.check_stalls(timeout_s=0.0)[0]
+        assert report["abandoned"] is True
+        assert report["checks"] == 3
+        assert report["missing"] == ["ghost"]
+        assert neg.pending_count() == 0
+        assert neg.take_abandoned() == [sub]
+        assert neg.take_abandoned() == []  # drained exactly once
+        assert metrics.get_counter("svc.stall_abandoned") == 1
+        assert metrics.get_gauge("svc.stalled_negotiations") == 0
+        evs = _named(event_log, events.SVC_STALL_ABANDON)
+        assert evs and evs[0]["missing"] == ["ghost"]
+
+    def test_completion_resets_the_check_clock(self, monkeypatch):
+        from horovod_tpu.svc.negotiate import Negotiator
+
+        monkeypatch.setenv("HVD_TPU_STALL_ABANDON", "2")
+        neg = Negotiator()
+        sub = self._pending_sub(neg)
+        neg.check_stalls(timeout_s=0.0)  # 1 stalled check
+        # the ghost shows up after all: negotiation completes
+        import dataclasses
+
+        ghost = dataclasses.replace(
+            sub, producer="ghost",
+            future=type(sub.future)(),
+        )
+        assert len(neg.post(ghost)) == 2
+        assert neg.take_abandoned() == []
+
+    def test_service_resolves_abandoned_futures_inline(
+            self, monkeypatch):
+        from horovod_tpu.svc.negotiate import Negotiator
+
+        monkeypatch.setenv("HVD_TPU_STALL_ABANDON", "1")
+        neg = Negotiator()
+        sub = self._pending_sub(neg)
+        neg.check_stalls(timeout_s=0.0)
+        # the abandon() drain path (service death before the loop's
+        # take_abandoned ran) must still surface the orphans
+        assert neg.abandon() == [sub]
+
+
+# ---------------------------------------------- arbiter event entries
+
+class TestArbiterAdmissionEvents:
+    def test_admission_timeout_lands_in_event_log(self, event_log):
+        from horovod_tpu.svc import arbiter as arbiter_mod
+
+        arb = arbiter_mod.Arbiter()
+        arbiter_mod.set_inflight_override(1)
+        try:
+            arb.admit("jobA")
+            assert not arb.admit("jobA", timeout_s=0.2)
+        finally:
+            arbiter_mod.set_inflight_override(None)
+        evs = _named(event_log, events.SVC_ADMIT_TIMEOUT)
+        assert len(evs) == 1
+        assert evs[0]["tenant"] == "jobA"
+        assert evs[0]["waited_s"] >= 0.15
+        assert evs[0]["cap"] == 1
+
+    def test_preempt_expiry_lands_in_event_log(self, event_log):
+        from horovod_tpu.svc import arbiter as arbiter_mod
+
+        arb = arbiter_mod.Arbiter()
+        arb.admit("hi")  # keep the high lane non-drained
+        arb.request_preempt("hi", cycles=2)
+        arb.on_cycle(1)  # inside the window: no event
+        arb.on_cycle(5)  # past expiry
+        evs = _named(event_log, events.SVC_PREEMPT_EXPIRED)
+        assert len(evs) == 1
+        assert evs[0]["tenant"] == "hi"
+        assert evs[0]["reason"] == "expired"
+        assert evs[0]["cycle"] == 5
+
+    def test_preempt_drain_lands_in_event_log(self, event_log):
+        from horovod_tpu.svc import arbiter as arbiter_mod
+
+        arb = arbiter_mod.Arbiter()
+        arb.request_preempt("hi", cycles=100)
+        arb.on_cycle(1)  # hi's lane is empty: gate lifts as drained
+        evs = _named(event_log, events.SVC_PREEMPT_EXPIRED)
+        assert len(evs) == 1
+        assert evs[0]["reason"] == "drained"

@@ -1,0 +1,163 @@
+"""Device-probe doctor: WHERE does a dead probe die?
+
+``bench.py``'s ``run_device_probe`` proves the device runtime boots
+before the bench pays compiles in-process — but its skip record only
+says *that* the one-liner probe died (rc / timeout + a stderr tail),
+not *which layer* died.  A wedged TPU tunnel, a libtpu version clash,
+and a broken Python env all produce the same "probe exhausted retries"
+line, and each one pages a different owner.
+
+This doctor reruns the probe as three separable stages, each its own
+subprocess with its own timeout, per-stage wall clock, and stderr
+capture:
+
+``import_jax``
+    ``import jax`` alone — a failure here is an install/env problem
+    (missing wheel, broken libtpu import), no device involved;
+``backend_init``
+    ``jax.devices()`` — the first runtime/backend handshake; this is
+    where a wedged device tunnel hangs (the BENCH_r03..r05 mode);
+``compute``
+    ``jnp.ones(8).sum()`` — first real compile + execute; a failure
+    here with a live backend points at XLA/compilation, not transport.
+
+The verdict is the FIRST failing stage — everything after it is
+skipped (it would fail for the same reason and double the wait).  The
+record is JSON-stable::
+
+    {"status": "ok"|"sick", "verdict": {"stage", "cause", "detail"},
+     "stages": [{"stage", "status", "seconds", "returncode",
+                 "stderr_tail", "timeout_s"}, ...],
+     "platform": {...}}
+
+Run standalone (``python tools/probe_doctor.py [--timeout-s N]
+[--platform cpu]``) or let ``bench.py`` call :func:`diagnose` when its
+probe exhausts retries — the diagnosis rides the structured skip
+record as ``probe_diagnosis``, so the round log names the sick layer.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional
+
+DEFAULT_STAGE_TIMEOUT_S = 60.0
+STDERR_TAIL_CHARS = 800
+
+# (stage, one-liner, cause when it fails) — ordered cheapest first;
+# the first failure is the verdict and later stages are skipped.
+STAGES = (
+    ("import_jax",
+     "import jax; print(jax.__version__)",
+     "python environment: jax failed to import"),
+    ("backend_init",
+     "import jax; print(len(jax.devices()))",
+     "device runtime: backend handshake failed or hung"),
+    ("compute",
+     "import jax, jax.numpy as jnp; print(float(jnp.ones(8).sum()))",
+     "compile/execute: backend alive but first computation failed"),
+)
+
+
+def _tail(err: Any) -> str:
+    if err is None:
+        return ""
+    if isinstance(err, bytes):
+        err = err.decode("utf-8", "replace")
+    return str(err)[-STDERR_TAIL_CHARS:]
+
+
+def run_stage(stage: str, code: str, timeout_s: float,
+              env: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    """One stage in its own interpreter: status ok|error|timeout, wall
+    seconds, rc, and the stderr tail — everything the verdict needs."""
+    t0 = time.monotonic()
+    out: Dict[str, Any] = {
+        "stage": stage, "status": "ok", "returncode": 0,
+        "stderr_tail": "", "timeout_s": timeout_s,
+    }
+    try:
+        proc = subprocess.run(
+            [sys.executable, "-c", code],
+            capture_output=True, text=True, timeout=timeout_s,
+            env=dict(env if env is not None else os.environ),
+        )
+        out["returncode"] = proc.returncode
+        if proc.returncode != 0:
+            out["status"] = "error"
+            out["stderr_tail"] = _tail(proc.stderr)
+    except subprocess.TimeoutExpired as e:
+        out["status"] = "timeout"
+        out["returncode"] = None
+        out["stderr_tail"] = _tail(getattr(e, "stderr", None))
+    except OSError as e:  # interpreter itself unlaunchable
+        out["status"] = "error"
+        out["returncode"] = None
+        out["stderr_tail"] = f"{type(e).__name__}: {e}"
+    out["seconds"] = round(time.monotonic() - t0, 3)
+    return out
+
+
+def diagnose(timeout_s: float = DEFAULT_STAGE_TIMEOUT_S,
+             env: Optional[Dict[str, str]] = None) -> Dict[str, Any]:
+    """Run the stage ladder; return the structured root-cause record.
+    Never raises — a doctor that crashes mid-diagnosis is worse than
+    no doctor (bench.py attaches this best-effort)."""
+    stages: List[Dict[str, Any]] = []
+    verdict: Optional[Dict[str, Any]] = None
+    try:
+        for stage, code, cause in STAGES:
+            rec = run_stage(stage, code, timeout_s, env=env)
+            stages.append(rec)
+            if rec["status"] != "ok":
+                verdict = {
+                    "stage": stage,
+                    "cause": cause,
+                    "detail": (
+                        f"{rec['status']} after {rec['seconds']}s"
+                        + (f" (rc={rec['returncode']})"
+                           if rec["returncode"] is not None else "")
+                    ),
+                }
+                break
+    except Exception as e:  # pragma: no cover - defensive
+        verdict = {"stage": "doctor", "cause": "doctor itself failed",
+                   "detail": f"{type(e).__name__}: {e}"}
+    return {
+        "status": "ok" if verdict is None else "sick",
+        "verdict": verdict,
+        "stages": stages,
+        "platform": {
+            "python": sys.version.split()[0],
+            "jax_platforms": (env or os.environ).get(
+                "JAX_PLATFORMS", os.environ.get("JAX_PLATFORMS", "")),
+        },
+    }
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Diagnose which layer of the device probe is sick."
+    )
+    ap.add_argument("--timeout-s", type=float,
+                    default=DEFAULT_STAGE_TIMEOUT_S,
+                    help="per-stage subprocess timeout (default 60)")
+    ap.add_argument("--platform", default=None,
+                    help="force JAX_PLATFORMS for the probes "
+                         "(e.g. cpu)")
+    ns = ap.parse_args(argv)
+    env = dict(os.environ)
+    if ns.platform:
+        env["JAX_PLATFORMS"] = ns.platform
+    report = diagnose(timeout_s=ns.timeout_s, env=env)
+    print(json.dumps(report, indent=2))
+    return 0 if report["status"] == "ok" else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
