@@ -41,68 +41,15 @@ _PARTIAL = None
 # the device probe + init can eat minutes before main() runs.
 _ALARM_ARMED_AT = None
 
-# Peak dense bf16 TFLOP/s per chip by device_kind substring (public
-# cloud.google.com/tpu/docs system-architecture figures).
-_PEAK_BF16_TFLOPS = [
-    ("v6", 918.0),       # Trillium / v6e
-    ("v5p", 459.0),
-    ("v5 lite", 197.0),  # v5e reports device_kind "TPU v5 lite"
-    ("v5e", 197.0),
-    ("v4", 275.0),
-    ("v3", 123.0),
-    ("v2", 45.0),
-]
-
-# ResNet-50 v1.5 @224: ~4.1 GFLOPs forward per image; training
-# (fwd + bwd) ~3x forward.
-RESNET50_TRAIN_GFLOPS_PER_IMAGE = 4.1 * 3
-
-
-def _chip_peak_tflops(device) -> float | None:
-    kind = (device.device_kind or "").lower()
-    for key, peak in _PEAK_BF16_TFLOPS:
-        if key in kind:
-            return peak
-    return None
-
-
-_MEASURED_PEAK = None
-
-
-def _measured_peak_tflops() -> float:
-    """Peak fallback for device kinds missing from the public table
-    (CPU smoke runs, unreleased TPU generations): the achieved TFLOP/s
-    of a compiled square bf16 matmul — the closest measurable stand-in
-    for the matrix-unit roofline.  MFU against a measured peak is a
-    utilization-of-achievable number rather than of-datasheet, but it
-    is non-null and comparable across rounds on the same host."""
-    global _MEASURED_PEAK
-    if _MEASURED_PEAK is not None:
-        return _MEASURED_PEAK
-    import jax
-    import jax.numpy as jnp
-
-    n, iters = 1024, 8
-    a = jnp.full((n, n), 0.5, jnp.bfloat16)
-    f = jax.jit(lambda x: jnp.tanh(x @ x))  # tanh keeps values bounded
-    float(jnp.sum(f(a).astype(jnp.float32)))  # compile + warm
-    out = a
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = f(out)
-    float(jnp.sum(out.astype(jnp.float32)))
-    dt = time.perf_counter() - t0
-    _MEASURED_PEAK = max(2.0 * n ** 3 * iters / dt / 1e12, 1e-6)
-    return _MEASURED_PEAK
-
-
-def _peak_tflops(device) -> tuple:
-    """(peak TFLOP/s, source): datasheet when the chip is known,
-    measured-matmul fallback otherwise — MFU is always computable."""
-    peak = _chip_peak_tflops(device)
-    if peak is not None:
-        return peak, "table"
-    return _measured_peak_tflops(), "measured"
+# Device peak model: shared with the online MFU gauge and the ResNet
+# sweep (one table, added-to once) — see horovod_tpu/prof/peak.py.
+from horovod_tpu.prof.peak import (  # noqa: E402
+    PEAK_BF16_TFLOPS as _PEAK_BF16_TFLOPS,
+    RESNET50_TRAIN_GFLOPS_PER_IMAGE,
+    chip_peak_tflops as _chip_peak_tflops,
+    measured_peak_tflops as _measured_peak_tflops,
+    peak_tflops as _peak_tflops,
+)
 
 
 def _phase_profile(hvd, jnp, model, params, batch_stats, data, target,

@@ -92,6 +92,10 @@ REMEDIATE_START = "remediate_start"
 REMEDIATE_PHASE = "remediate_phase"
 REMEDIATE_OK = "remediate_ok"
 REMEDIATE_ABORT = "remediate_abort"
+# Perf-regression sentinel (prof/baseline.py): observed step p50 or
+# MFU degraded past HVD_TPU_PROF_REGRESS_FACTOR against the persisted
+# baseline for this (workload signature, topology, knob fingerprint).
+PROF_REGRESSION = "prof_regression"
 
 
 class EventLog:

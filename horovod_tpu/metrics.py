@@ -215,6 +215,26 @@ def histograms_by_prefix(
         }
 
 
+def gauges_by_prefix(
+    prefix: str, snap: Optional[Dict[str, Any]] = None
+) -> List[Dict[str, Any]]:
+    """All gauges whose name starts with ``prefix``, as
+    ``[{name, labels, value}]`` rows (from a snapshot dict, or this
+    process's live registry) — the extraction ``GET /prof`` folds
+    per-rank ``prof.*`` gauges through."""
+    if snap is not None:
+        return [
+            g for g in snap.get("gauges", [])
+            if str(g.get("name", "")).startswith(prefix)
+        ]
+    with _counter_lock:
+        return [
+            {"name": k[0], "labels": dict(k[1]), "value": v}
+            for k, v in sorted(_gauges.items())
+            if k[0].startswith(prefix)
+        ]
+
+
 def quantile(name: str, q: float) -> Optional[float]:
     """Interpolated quantile of the named histogram (p50: ``q=0.5``,
     p99: ``q=0.99``); None when the histogram is absent or empty.  The

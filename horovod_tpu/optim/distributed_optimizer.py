@@ -928,7 +928,7 @@ class TrainStep:
         # instead of copying the full parameter set in HBM every step.
         # ``donate=False`` (the numerics-parity test hook) keeps the
         # inputs alive and must produce bitwise-identical losses.
-        return jax.jit(
+        fn = jax.jit(
             jax.shard_map(
                 self._step_body,
                 mesh=self.mesh,
@@ -937,6 +937,17 @@ class TrainStep:
                 check_vma=False,
             ),
             donate_argnums=(0, 1, 2) if self._donate else (),
+        )
+        from .. import prof
+
+        # Profiling plane: AOT-compile through the wrapper so XLA
+        # cost/memory analysis feeds prof.flops / prof.mfu — an
+        # AOT-compiled call runs the same HLO as the jit call, so
+        # losses stay bitwise identical; HVD_TPU_PROF=off returns fn
+        # untouched.
+        return prof.wrap_executor(
+            fn, key=f"train_step_{len(self._step_cache)}",
+            kind="step", workload="train_step",
         )
 
     def __call__(self, params, *args):

@@ -1,0 +1,237 @@
+"""Perf-regression sentinel: did this run get slower than its past?
+
+A tuned system that quietly loses its tuning is worse than an untuned
+one — nobody is looking anymore.  This module persists a per-workload
+perf baseline and compares every run against it:
+
+* :class:`PerfBaselineStore` rides the PR 7 ``ScheduleStore`` machinery
+  (same atomic JSON file, keep-best concurrent merge, fleet ``merge``)
+  with a different record shape: ``{step_p50_s, mfu, rail_busy,
+  score}`` keyed by ``make_key(workload signature, kind=
+  "prof_baseline")`` — so the key already folds in topology, jax
+  version, and the knob fingerprint, and a knob change or resize never
+  compares apples to oranges.  ``score = 1 / step_p50_s``: keep-best
+  keeps the *fastest* run as the baseline.
+* :class:`Sentinel` observes the host-gap profiler's rolling step p50,
+  the online MFU, and the measured rail-busy gauges; every
+  ``HVD_TPU_PROF_CHECK_EVERY`` steps (or an explicit ``check()``) it
+  compares against the stored baseline.  Degradation past
+  ``HVD_TPU_PROF_REGRESS_FACTOR`` emits an ``events.PROF_REGRESSION``
+  record, sets the ``prof.regression`` gauge, and opens a
+  ``jax.profiler`` capture window (``prof/capture.py``) so the
+  evidence for the postmortem is collected *while the regression is
+  happening*.
+
+No DB configured (``HVD_TPU_PROF_DB`` unset) = observe-only: verdicts
+are ``no_baseline`` and nothing persists — bit-identical to no sentinel
+at all.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from .. import events, metrics
+from ..sched import store as store_mod
+from ..utils import env
+from . import hostgap, introspect, mfu
+from .config import enabled, regress_factor
+
+
+class PerfBaselineStore(store_mod.ScheduleStore):
+    """``ScheduleStore`` subclass holding perf baselines instead of
+    schedule configs; the load/merge/atomic-write machinery is
+    inherited, only the entry shape and the record API differ.
+    Baseline entries carry no ``pred_cost_s``, so the schedule staleness
+    check never fires on them (``stale_factor=0`` pins it off anyway)."""
+
+    REQUIRED_KEYS = ("step_p50_s",)
+
+    def __init__(self, path: Optional[str]):
+        super().__init__(path, stale_factor=0.0)
+
+    @classmethod
+    def from_env(cls) -> Optional["PerfBaselineStore"]:
+        path = env.get_env(env.PROF_DB)
+        if not path:
+            return None
+        return cls(path)
+
+    def record_perf(self, key: str, *, step_p50_s: float,
+                    mfu_v: Optional[float] = None,
+                    rail_busy: Optional[Dict[str, float]] = None,
+                    meta: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+        """Insert/update the baseline for ``key`` (keep-best: the
+        fastest observed run wins) and persist."""
+        entry: Dict[str, Any] = {
+            "step_p50_s": float(step_p50_s),
+            "mfu": None if mfu_v is None else float(mfu_v),
+            "rail_busy": dict(rail_busy or {}),
+            "score": 1.0 / max(float(step_p50_s), 1e-9),
+            "topo": store_mod.topology_spec(),
+            "jax": store_mod.jax_version(),
+            "updated": time.time(),
+            "hits": 0,
+        }
+        if meta:
+            entry["meta"] = meta
+        with self._lock:
+            prev = self._entries.get(key)
+            if prev is not None and (
+                    prev.get("score", 0.0) > entry["score"]):
+                entry = prev
+            self._entries[key] = entry
+        self._save()
+        metrics.inc_counter("prof.baseline_store")
+        return entry
+
+
+def _rail_busy() -> Dict[str, float]:
+    out = {}
+    for rail in ("ici", "dcn"):
+        v = metrics.get_gauge("topo.rail_busy_frac", {"rail": rail})
+        if v is not None:
+            out[rail] = v
+    return out
+
+
+class Sentinel:
+    """The comparator: observed stats vs the persisted baseline."""
+
+    def __init__(self, store: Optional[PerfBaselineStore] = None):
+        self.store = store
+        self._lock = threading.Lock()
+        self._last: Optional[Dict[str, Any]] = None
+
+    def _signature(self) -> Any:
+        """Default workload signature: the sorted workload names the
+        introspection registry has seen — stable across runs of the
+        same job, insensitive to shape-variant recompiles."""
+        return tuple(sorted({
+            r.get("workload") or r.get("kind") or "unknown"
+            for r in introspect.ranked()
+        })) or ("untraced",)
+
+    def check(self, signature: Any = None) -> Dict[str, Any]:
+        """One stored-vs-observed comparison.  Returns (and caches for
+        ``/prof``) the verdict record; never raises."""
+        try:
+            return self._check(signature)
+        except Exception as e:  # pragma: no cover - defensive
+            verdict = {"verdict": "error", "error": str(e)}
+            with self._lock:
+                self._last = verdict
+            return verdict
+
+    def _check(self, signature: Any = None) -> Dict[str, Any]:
+        observed_p50 = hostgap.step_p50()
+        observed_mfu = mfu.observed()
+        result: Dict[str, Any] = {
+            "observed": {
+                "step_p50_s": observed_p50,
+                "mfu": observed_mfu,
+                "rail_busy": _rail_busy(),
+                "steps": hostgap.summary()["steps"],
+            },
+            "factor": regress_factor(),
+            "db": self.store.path if self.store is not None else None,
+            "checked_at": time.time(),
+        }
+        if observed_p50 is None:
+            result["verdict"] = "no_data"
+            with self._lock:
+                self._last = result
+            return result
+        key = store_mod.make_key(
+            signature if signature is not None else self._signature(),
+            kind="prof_baseline",
+        )
+        result["key"] = key
+        if self.store is None:
+            result["verdict"] = "no_baseline"
+            with self._lock:
+                self._last = result
+            return result
+        base = self.store.lookup(key)
+        if base is None:
+            self.store.record_perf(
+                key, step_p50_s=observed_p50, mfu_v=observed_mfu,
+                rail_busy=_rail_busy(),
+            )
+            result["verdict"] = "baseline_created"
+            with self._lock:
+                self._last = result
+            return result
+        factor = regress_factor()
+        base_p50 = float(base.get("step_p50_s", 0.0))
+        base_mfu = base.get("mfu")
+        slow = base_p50 > 0 and observed_p50 > base_p50 * factor
+        dull = (observed_mfu is not None and base_mfu
+                and observed_mfu < float(base_mfu) / factor)
+        result["baseline"] = {
+            "step_p50_s": base_p50, "mfu": base_mfu,
+            "rail_busy": base.get("rail_busy"),
+            "updated": base.get("updated"),
+        }
+        if slow or dull:
+            result["verdict"] = "regression"
+            result["slow"] = bool(slow)
+            result["mfu_drop"] = bool(dull)
+            metrics.set_gauge("prof.regression", 1.0)
+            metrics.inc_counter("prof.regressions")
+            events.emit(
+                events.PROF_REGRESSION,
+                key=key, observed_p50_s=observed_p50,
+                baseline_p50_s=base_p50, observed_mfu=observed_mfu,
+                baseline_mfu=base_mfu, factor=factor,
+            )
+            from . import capture
+
+            capture.maybe_capture("prof_regression")
+        else:
+            result["verdict"] = "ok"
+            metrics.set_gauge("prof.regression", 0.0)
+            # keep-best: a run at least as fast as the baseline
+            # tightens it; a merely-ok run leaves it alone.
+            self.store.record_perf(
+                key, step_p50_s=observed_p50, mfu_v=observed_mfu,
+                rail_busy=_rail_busy(),
+            )
+        with self._lock:
+            self._last = result
+        return result
+
+    def last(self) -> Optional[Dict[str, Any]]:
+        """The most recent verdict record (the ``/prof`` baseline
+        block), or None before any check."""
+        with self._lock:
+            return dict(self._last) if self._last is not None else None
+
+
+_sentinel: Optional[Sentinel] = None
+_sentinel_lock = threading.Lock()
+
+
+def get_sentinel() -> Sentinel:
+    """The process-wide sentinel, store resolved from ``HVD_TPU_PROF_DB``
+    on first use."""
+    global _sentinel
+    with _sentinel_lock:
+        if _sentinel is None:
+            store = PerfBaselineStore.from_env() if enabled() else None
+            _sentinel = Sentinel(store)
+        return _sentinel
+
+
+def set_sentinel(sentinel: Optional[Sentinel]) -> None:
+    """Install (or with None, forget) the process sentinel — tests pin
+    a store-backed one through this."""
+    global _sentinel
+    with _sentinel_lock:
+        _sentinel = sentinel
+
+
+def reset() -> None:
+    set_sentinel(None)

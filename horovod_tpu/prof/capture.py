@@ -1,0 +1,92 @@
+"""Bounded ``jax.profiler`` capture windows, opened on bad news.
+
+A device-level profiler trace is the evidence a perf postmortem needs,
+but it is far too heavy to run always-on.  This module opens a capture
+window exactly when something already decided the run is in trouble —
+the perf-regression sentinel's confirmed regression, or the SLO
+watchdog's confirmed breach — and bounds the damage:
+
+* inert unless ``HVD_TPU_PROF_CAPTURE_DIR`` is set;
+* one window at a time, ``HVD_TPU_PROF_CAPTURE_SECS`` long (a daemon
+  timer stops it — no step-path work);
+* at most ``HVD_TPU_PROF_CAPTURE_MAX`` windows per process, so a
+  flapping sentinel can never fill the disk;
+* never raises — a broken profiler must not take down the step it was
+  meant to explain.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from .. import metrics
+from ..utils.logging import get_logger
+from .config import capture_dir, capture_max, capture_secs, enabled
+
+_lock = threading.Lock()
+_active = False
+_captures = 0
+
+
+def maybe_capture(reason: str) -> bool:
+    """Open a capture window if configured and within bounds; returns
+    whether one was started."""
+    global _active, _captures
+    if not enabled():
+        return False
+    target = capture_dir()
+    if not target:
+        return False
+    with _lock:
+        if _active or _captures >= capture_max():
+            return False
+        _active = True
+        _captures += 1
+    try:
+        import jax.profiler
+
+        jax.profiler.start_trace(target)
+    except Exception as e:
+        with _lock:
+            _active = False
+            _captures -= 1
+        get_logger().warning("prof capture (%s) failed to start: %s",
+                             reason, e)
+        return False
+    metrics.inc_counter("prof.captures")
+    metrics.set_gauge("prof.capture_active", 1.0)
+    get_logger().warning(
+        "prof: started %.1fs jax.profiler capture window into %s "
+        "(reason=%s)", capture_secs(), target, reason,
+    )
+    timer = threading.Timer(capture_secs(), _stop)
+    timer.daemon = True
+    timer.start()
+    return True
+
+
+def _stop() -> None:
+    global _active
+    try:
+        import jax.profiler
+
+        jax.profiler.stop_trace()
+    except Exception as e:  # pragma: no cover - defensive
+        get_logger().warning("prof capture stop failed: %s", e)
+    with _lock:
+        _active = False
+    metrics.set_gauge("prof.capture_active", 0.0)
+
+
+def stats() -> Dict[str, Any]:
+    with _lock:
+        return {"active": _active, "captures": _captures,
+                "dir": capture_dir(), "max": capture_max()}
+
+
+def reset() -> None:
+    global _active, _captures
+    with _lock:
+        _active = False
+        _captures = 0

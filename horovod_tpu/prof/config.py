@@ -1,0 +1,57 @@
+"""Profiling-plane knobs (``HVD_TPU_PROF*``), in one place.
+
+Every prof module gates on :func:`enabled`; tests pin it with
+:func:`set_enabled_override` instead of mutating the environment.  The
+contract mirrors the tracer's: profiling is host-side only — it wraps
+compiled executors and reads span trees but inserts no ops into any
+compiled program — so ``on`` vs ``off`` losses are bitwise identical,
+and ``off`` returns every executor unwrapped (the pre-PR 17 code path
+exactly).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from ..utils import env
+
+_enabled_override: Optional[bool] = None
+
+
+def enabled() -> bool:
+    """Is the profiling plane on?  ``HVD_TPU_PROF`` (default on)."""
+    if _enabled_override is not None:
+        return _enabled_override
+    return env.get_bool(env.PROF, True)
+
+
+def set_enabled_override(value: Optional[bool]) -> None:
+    """Pin profiling on/off for tests; None restores the env knob."""
+    global _enabled_override
+    _enabled_override = value
+
+
+def regress_factor() -> float:
+    """Sentinel degradation threshold (``HVD_TPU_PROF_REGRESS_FACTOR``,
+    default 1.5): regression when observed p50 > baseline x factor or
+    observed MFU < baseline / factor."""
+    return max(1.0, env.get_float(env.PROF_REGRESS_FACTOR, 1.5))
+
+
+def check_every() -> int:
+    """Sentinel auto-check cadence in steps (default 20; 0 = manual
+    ``check()`` only)."""
+    return max(0, env.get_int(env.PROF_CHECK_EVERY, 20))
+
+
+def capture_dir() -> Optional[str]:
+    """Directory for jax.profiler capture windows; None = hooks inert."""
+    return env.get_env(env.PROF_CAPTURE_DIR)
+
+
+def capture_secs() -> float:
+    return max(0.1, env.get_float(env.PROF_CAPTURE_SECS, 5.0))
+
+
+def capture_max() -> int:
+    return max(0, env.get_int(env.PROF_CAPTURE_MAX, 2))

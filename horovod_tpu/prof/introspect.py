@@ -1,0 +1,255 @@
+"""Compiled-step introspection: what did XLA actually build?
+
+Every executor the stack compiles — the svc executor cache's per-program
+and fused executors, the optimizer's train step, the stale-gradient step
+fn — is wrapped in a :class:`ProfiledExecutor`.  The wrapper compiles
+ahead-of-time (``fn.lower(*args).compile()``) instead of letting the
+first call trigger tracing implicitly; an AOT-compiled call runs the
+same HLO as the jit call it replaces, so results are bitwise identical
+— the wrapper only *observes* the compile.  Per program signature it
+records into the metrics registry:
+
+* ``prof.flops`` / ``prof.bytes_accessed`` gauges — XLA
+  ``cost_analysis`` (the measured replacement for ROADMAP item 3's
+  bench-guess FLOPs), labeled ``{key, kind}``;
+* ``prof.peak_hbm_bytes`` gauge — ``memory_analysis`` argument +
+  output + temp footprint;
+* ``prof.compile_seconds`` histogram + ``prof.compiles`` counter —
+  wall compile time (satellite 3's re-lowering cost signal rides the
+  same clock through the svc cache's ``on_compile`` callback).
+
+Graceful degradation is the hard requirement: any backend that lacks
+``cost_analysis``/``memory_analysis``, or any program AOT refuses to
+lower, permanently falls back to calling the raw fn for that argument
+signature — one attempt, no retry storm, never an exception out of the
+wrapper.  ``HVD_TPU_PROF=off`` never constructs a wrapper at all.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .. import metrics
+from .config import enabled
+
+# Per-signature compile map sentinel: AOT was tried for this argument
+# signature and failed; call the raw fn forever after.
+_FALLBACK = object()
+
+# Registry of every program the plane has introspected:
+# key -> {kind, workload, flops, bytes_accessed, peak_hbm_bytes,
+#         compile_seconds, compiles, calls, fallback}
+_programs: Dict[str, Dict[str, Any]] = {}
+_lock = threading.Lock()
+
+
+def program_key(program: Any) -> str:
+    """Stable short digest of an XIR program's signature (or any
+    object's repr) — the ``key`` label every ``prof.*`` series and the
+    ``/prof`` program table are keyed by."""
+    try:
+        payload = repr(program.signature())
+    except Exception:
+        payload = repr(program)
+    return hashlib.sha256(payload.encode()).hexdigest()[:12]
+
+
+def _cost_scalar(cost: Any, name: str) -> Optional[float]:
+    try:
+        if isinstance(cost, (list, tuple)):
+            cost = cost[0] if cost else {}
+        v = cost.get(name)
+        return None if v is None else float(v)
+    except Exception:
+        return None
+
+
+def _peak_hbm_bytes(compiled: Any) -> Optional[float]:
+    """Argument + output + temp footprint from ``memory_analysis`` —
+    donated (aliased) bytes are counted once, not twice."""
+    try:
+        mem = compiled.memory_analysis()
+    except Exception:
+        return None
+    if mem is None:
+        return None
+    total, seen = 0.0, False
+    for attr in ("argument_size_in_bytes", "output_size_in_bytes",
+                 "temp_size_in_bytes"):
+        v = getattr(mem, attr, None)
+        if isinstance(v, (int, float)):
+            total += float(v)
+            seen = True
+    alias = getattr(mem, "alias_size_in_bytes", None)
+    if isinstance(alias, (int, float)):
+        total -= float(alias)
+    return max(total, 0.0) if seen else None
+
+
+def _args_signature(args: Tuple[Any, ...]) -> Any:
+    import jax
+
+    leaves, treedef = jax.tree.flatten(args)
+    return treedef, tuple(
+        (getattr(l, "shape", ()), str(getattr(l, "dtype", type(l).__name__)))
+        for l in leaves
+    )
+
+
+class ProfiledExecutor:
+    """AOT-compiling wrapper around one jitted executor.
+
+    Calls are routed through a per-argument-signature compiled cache
+    (jit keeps its own equivalent cache internally, so call counts and
+    recompiles match the unwrapped path); the first sighting of a
+    signature pays the same compile the jit call would have, but
+    through ``lower()``/``compile()`` so cost/memory analysis and the
+    compile wall-clock are observable."""
+
+    __slots__ = ("_fn", "key", "kind", "workload", "_on_compile",
+                 "_compiled", "_lock", "__weakref__")
+
+    def __init__(self, fn: Callable, key: str, kind: str,
+                 workload: Optional[str] = None,
+                 on_compile: Optional[Callable[[float], None]] = None):
+        self._fn = fn
+        self.key = key
+        self.kind = kind
+        self.workload = workload or kind
+        self._on_compile = on_compile
+        self._compiled: Dict[Any, Any] = {}
+        self._lock = threading.Lock()
+        with _lock:
+            _programs.setdefault(key, {
+                "kind": kind, "workload": self.workload,
+                "flops": None, "bytes_accessed": None,
+                "peak_hbm_bytes": None, "compile_seconds": 0.0,
+                "compiles": 0, "calls": 0, "fallback": False,
+            })
+
+    # ----------------------------------------------------------- call
+    def __call__(self, *args: Any) -> Any:
+        if not enabled():
+            return self._fn(*args)
+        try:
+            sig = _args_signature(args)
+        except Exception:
+            return self._fn(*args)
+        with self._lock:
+            compiled = self._compiled.get(sig)
+        if compiled is None:
+            compiled = self._compile(sig, args)
+        with _lock:
+            rec = _programs.get(self.key)
+            if rec is not None:
+                rec["calls"] += 1
+        if compiled is _FALLBACK:
+            return self._fn(*args)
+        from .. import trace
+
+        with trace.span(f"exec.{self.workload}", "exec", program=self.key):
+            return compiled(*args)
+
+    # ----------------------------------------------------- delegation
+    def __getattr__(self, name: str) -> Any:
+        # Anything not on the wrapper (``lower``, ``trace``, jit
+        # internals) resolves against the wrapped executor, so code
+        # that introspects the jit fn — HLO dumps, the bucket
+        # profiler — sees the same surface it would unwrapped.
+        return getattr(object.__getattribute__(self, "_fn"), name)
+
+    # -------------------------------------------------------- compile
+    def _compile(self, sig: Any, args: Tuple[Any, ...]) -> Any:
+        try:
+            t0 = time.monotonic()
+            compiled = self._fn.lower(*args).compile()
+            dt = time.monotonic() - t0
+        except Exception:
+            compiled = _FALLBACK
+            dt = None
+        with self._lock:
+            self._compiled[sig] = compiled
+        if compiled is _FALLBACK:
+            with _lock:
+                rec = _programs.get(self.key)
+                if rec is not None:
+                    rec["fallback"] = True
+            metrics.inc_counter("prof.fallbacks")
+            return compiled
+        self._record(compiled, dt)
+        if self._on_compile is not None:
+            try:
+                self._on_compile(dt)
+            except Exception:
+                pass
+        return compiled
+
+    def _record(self, compiled: Any, dt: float) -> None:
+        try:
+            cost = compiled.cost_analysis()
+        except Exception:
+            cost = None
+        flops = _cost_scalar(cost, "flops")
+        nbytes = _cost_scalar(cost, "bytes accessed")
+        hbm = _peak_hbm_bytes(compiled)
+        labels = {"key": self.key, "kind": self.kind}
+        if flops is not None:
+            metrics.set_gauge("prof.flops", flops, labels)
+        if nbytes is not None:
+            metrics.set_gauge("prof.bytes_accessed", nbytes, labels)
+        if hbm is not None:
+            metrics.set_gauge("prof.peak_hbm_bytes", hbm, labels)
+        metrics.inc_counter("prof.compiles")
+        metrics.observe("prof.compile_seconds", dt)
+        with _lock:
+            rec = _programs.get(self.key)
+            if rec is not None:
+                rec["compiles"] += 1
+                rec["compile_seconds"] += dt
+                # keep the largest variant's numbers (re-lowers for a
+                # new shape overwrite only upward)
+                for field, v in (("flops", flops),
+                                 ("bytes_accessed", nbytes),
+                                 ("peak_hbm_bytes", hbm)):
+                    if v is not None and (rec[field] is None
+                                          or v > rec[field]):
+                        rec[field] = v
+
+
+def wrap(fn: Callable, key: str, kind: str,
+         workload: Optional[str] = None,
+         on_compile: Optional[Callable[[float], None]] = None) -> Callable:
+    """Wrap a jitted executor for introspection — or return it
+    untouched when profiling is off (the bitwise-off contract's
+    structural half: off means the wrapper never exists)."""
+    if not enabled():
+        return fn
+    return ProfiledExecutor(fn, key, kind,
+                            workload=workload, on_compile=on_compile)
+
+
+def get(key: Optional[str]) -> Optional[Dict[str, Any]]:
+    """The registry record for one program key (a copy), or None."""
+    if key is None:
+        return None
+    with _lock:
+        rec = _programs.get(key)
+        return dict(rec) if rec is not None else None
+
+
+def ranked() -> List[Dict[str, Any]]:
+    """Every introspected program, most expensive re-lowering first —
+    the ``/prof`` program table."""
+    with _lock:
+        rows = [dict(r, key=k) for k, r in _programs.items()]
+    rows.sort(key=lambda r: r.get("compile_seconds") or 0.0, reverse=True)
+    return rows
+
+
+def reset() -> None:
+    """Clear the program registry (test isolation)."""
+    with _lock:
+        _programs.clear()

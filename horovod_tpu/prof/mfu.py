@@ -1,0 +1,121 @@
+"""Online MFU: cost-analysis FLOPs over measured step time.
+
+ROADMAP item 3's ResNet MFU >= 0.30 target was argued from bench
+guesses (analytic FLOPs/image x images/sec); this module computes the
+same ratio online from what XLA says the step actually does.  Per
+finalized step span:
+
+* the ``exec`` spans in the tree name the introspected programs that
+  ran (``prof/introspect.py`` stamps each executor call with its
+  program key);
+* each program's cost-analysis FLOPs divided by the step wall-clock,
+  against the device peak from :mod:`prof.peak` (the shared
+  bench-table/measured-matmul model), becomes
+  ``prof.mfu{workload=...}``;
+* total step FLOPs split across tenants proportionally to each
+  tenant's device-busy seconds (the host-gap attribution's
+  ``tenant_busy_s``) becomes ``prof.mfu{tenant=...}`` — device-time
+  accounting through the same trace tenant slot the arbiter's
+  fairness story uses.
+
+Backends whose ``cost_analysis`` is unavailable simply never register
+FLOPs, so every gauge here silently stays absent — same graceful
+degradation as the introspection layer.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, Optional
+
+from .. import metrics
+from . import introspect, peak
+from .config import enabled
+
+_lock = threading.Lock()
+# Last computed per-workload MFU (the sentinel's observed MFU reads
+# the max over workloads — "the" workload in a single-model process).
+_last_mfu: Dict[str, float] = {}
+
+
+def on_step(span: Any, stats: Dict[str, Any]) -> None:
+    """Price one finalized step; called by ``hostgap.on_step``.  Never
+    raises past its own guard — MFU is observability, not a step
+    dependency."""
+    if not enabled():
+        return
+    wall = stats.get("wall_s") or 0.0
+    if wall <= 0:
+        return
+    per_workload: Dict[str, float] = {}
+    total_flops = 0.0
+    for s in span.walk():
+        if s.phase != "exec":
+            continue
+        rec = introspect.get(s.attrs.get("program") if s.attrs else None)
+        if not rec or not rec.get("flops"):
+            continue
+        w = rec.get("workload") or rec.get("kind") or "unknown"
+        per_workload[w] = per_workload.get(w, 0.0) + rec["flops"]
+        total_flops += rec["flops"]
+    if total_flops <= 0:
+        return
+    try:
+        peak_tflops, _source = peak.default_peak_tflops()
+    except Exception:
+        return
+    if peak_tflops <= 0:
+        return
+    denom = wall * peak_tflops * 1e12
+    with _lock:
+        for w, fl in per_workload.items():
+            v = min(fl / denom, 1.0)
+            metrics.set_gauge("prof.mfu", v, {"workload": w})
+            _last_mfu[w] = v
+    metrics.set_gauge("prof.flops_per_step", total_flops)
+    tenant_busy = stats.get("tenant_busy_s") or {}
+    busy_total = sum(tenant_busy.values())
+    if busy_total > 0:
+        for tenant, busy in tenant_busy.items():
+            share = busy / busy_total
+            metrics.set_gauge(
+                "prof.mfu", min(total_flops * share / denom, 1.0),
+                {"tenant": tenant},
+            )
+
+
+def publish(workload: str, achieved_tflops: float,
+            peak_tflops: Optional[float] = None) -> Optional[float]:
+    """Direct MFU publication for bench-style offline measurements
+    (``tools/resnet_cpu_bench.py`` records its sweep winner through
+    this so the ResNet CPU-sim MFU shows up on ``/prof`` like any
+    online workload)."""
+    if peak_tflops is None:
+        try:
+            peak_tflops, _ = peak.default_peak_tflops()
+        except Exception:
+            return None
+    if peak_tflops <= 0:
+        return None
+    v = min(achieved_tflops / peak_tflops, 1.0)
+    metrics.set_gauge("prof.mfu", v, {"workload": workload})
+    with _lock:
+        _last_mfu[workload] = v
+    return v
+
+
+def last() -> Dict[str, float]:
+    """Last computed per-workload MFU values (a copy)."""
+    with _lock:
+        return dict(_last_mfu)
+
+
+def observed() -> Optional[float]:
+    """The sentinel's scalar: max MFU over workloads, or None."""
+    with _lock:
+        return max(_last_mfu.values()) if _last_mfu else None
+
+
+def reset() -> None:
+    with _lock:
+        _last_mfu.clear()

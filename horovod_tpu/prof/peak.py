@@ -1,0 +1,137 @@
+"""Device peak-FLOPs model shared by the benches and the online MFU
+gauge.
+
+One peak table, three consumers: ``bench.py`` (full-workload MFU
+records), ``tools/resnet_cpu_bench.py`` (stem/batch sweep), and
+``prof/mfu.py`` (the per-step online gauge).  Before PR 17 the first
+two each carried their own copy; the table lives here now and both
+import it, so a new device generation is added exactly once.
+
+Datasheet peaks are keyed by ``device_kind`` substring; unknown kinds
+(CPU smoke runs, unreleased generations) fall back to the achieved
+TFLOP/s of a compiled square bf16 matmul — a utilization-of-achievable
+denominator rather than of-datasheet, but non-null and comparable
+across rounds on the same host.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional, Tuple
+
+# Peak dense bf16 TFLOP/s per chip by device_kind substring (public
+# cloud.google.com/tpu/docs system-architecture figures).
+PEAK_BF16_TFLOPS = [
+    ("v6", 918.0),       # Trillium / v6e
+    ("v5p", 459.0),
+    ("v5 lite", 197.0),  # v5e reports device_kind "TPU v5 lite"
+    ("v5e", 197.0),
+    ("v4", 275.0),
+    ("v3", 123.0),
+    ("v2", 45.0),
+]
+
+# ResNet-50 v1.5 @224: ~4.1 GFLOPs forward per image; training
+# (fwd + bwd) ~3x forward.
+RESNET50_TRAIN_GFLOPS_PER_IMAGE = 4.1 * 3
+
+_lock = threading.Lock()
+_MEASURED_PEAK: Optional[float] = None
+_DEFAULT_PEAK: Optional[Tuple[float, str]] = None
+_override: Optional[float] = None
+
+
+def chip_peak_tflops(device) -> Optional[float]:
+    """Datasheet peak for a jax device, or None when its kind is not
+    in the public table."""
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    for key, peak in PEAK_BF16_TFLOPS:
+        if key in kind:
+            return peak
+    return None
+
+
+def measured_peak_tflops() -> float:
+    """Peak fallback for device kinds missing from the public table:
+    the achieved TFLOP/s of a compiled square bf16 matmul — the closest
+    measurable stand-in for the matrix-unit roofline.  Measured once
+    per process and cached."""
+    global _MEASURED_PEAK
+    with _lock:
+        if _MEASURED_PEAK is not None:
+            return _MEASURED_PEAK
+    import jax
+    import jax.numpy as jnp
+
+    n, iters = 1024, 8
+    a = jnp.full((n, n), 0.5, jnp.bfloat16)
+    f = jax.jit(lambda x: jnp.tanh(x @ x))  # tanh keeps values bounded
+    float(jnp.sum(f(a).astype(jnp.float32)))  # compile + warm
+    out = a
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = f(out)
+    float(jnp.sum(out.astype(jnp.float32)))
+    dt = time.perf_counter() - t0
+    measured = max(2.0 * n ** 3 * iters / dt / 1e12, 1e-6)
+    with _lock:
+        if _MEASURED_PEAK is None:
+            _MEASURED_PEAK = measured
+        return _MEASURED_PEAK
+
+
+def peak_tflops(device) -> Tuple[float, str]:
+    """(peak TFLOP/s, source): datasheet when the chip is known,
+    measured-matmul fallback otherwise — MFU is always computable."""
+    if _override is not None:
+        return _override, "override"
+    peak = chip_peak_tflops(device)
+    if peak is not None:
+        return peak, "table"
+    return measured_peak_tflops(), "measured"
+
+
+def default_peak_tflops() -> Tuple[float, str]:
+    """(peak, source) for this process's first jax device, computed at
+    most once — the denominator ``prof/mfu.py`` prices every step
+    against."""
+    global _DEFAULT_PEAK
+    if _override is not None:
+        return _override, "override"
+    with _lock:
+        if _DEFAULT_PEAK is not None:
+            return _DEFAULT_PEAK
+    import jax
+
+    result = peak_tflops(jax.devices()[0])
+    with _lock:
+        if _DEFAULT_PEAK is None:
+            _DEFAULT_PEAK = result
+        return _DEFAULT_PEAK
+
+
+def cached_peak() -> Optional[Tuple[float, str]]:
+    """The already-computed default peak, or None — what a telemetry
+    scrape reads, so ``GET /prof`` never triggers the measurement
+    matmul itself."""
+    if _override is not None:
+        return _override, "override"
+    with _lock:
+        return _DEFAULT_PEAK
+
+
+def set_peak_override(value: Optional[float]) -> None:
+    """Pin the peak (tests assert exact MFU values through this); None
+    restores table/measured resolution."""
+    global _override
+    _override = None if value is None else float(value)
+
+
+def reset() -> None:
+    """Forget cached measurements and any override (test isolation)."""
+    global _MEASURED_PEAK, _DEFAULT_PEAK, _override
+    with _lock:
+        _MEASURED_PEAK = None
+        _DEFAULT_PEAK = None
+    _override = None

@@ -530,6 +530,19 @@ class ElasticDriver:
             payload["workers"] = len(self._last_assignments)
             return payload
 
+        def prof_fn():
+            # GET /prof: the device-time profiling plane (prof/,
+            # docs/observability.md) — per-rank host-gap / MFU /
+            # regression digests from the same KV pushes, with round
+            # context like /trace and /tenants.
+            from .. import prof
+
+            per_rank = {rank: snap for rank, snap in workers_fn()}
+            payload = prof.prof_payload(per_rank)
+            payload["round"] = self.rounds
+            payload["workers"] = len(self._last_assignments)
+            return payload
+
         self._slo = self._build_slo(control)
         self._slo_workers_fn = workers_fn
         slo_fn = None
@@ -544,10 +557,13 @@ class ElasticDriver:
                 payload["workers"] = len(self._last_assignments)
                 return payload
 
+        from .telemetry_http import probe_payload
+
         return TelemetryServer(
             port=self.telemetry_port, health_fn=health_fn,
             workers_fn=workers_fn, schedule_store=self.schedule_store(),
             trace_fn=trace_fn, tenants_fn=tenants_fn, slo_fn=slo_fn,
+            prof_fn=prof_fn, probe_fn=probe_payload,
         )
 
     def _build_slo(self, control):

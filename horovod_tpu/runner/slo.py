@@ -317,6 +317,18 @@ class SLOController:
             self._last_tick = now
         try:
             status = self.watchdog.evaluate(per_rank_fn())
+            if status["breaches"]:
+                # Profiling-plane capture hook: a confirmed breach is
+                # exactly the moment a device-level profiler trace is
+                # worth its cost (prof/capture.py bounds how many).
+                from .. import prof
+
+                prof.maybe_capture(
+                    "slo_breach:" + ",".join(sorted(
+                        str(b.get("tenant", "?"))
+                        for b in status["breaches"]
+                    ))
+                )
             if self.remediator is not None:
                 for breach in status["breaches"]:
                     self.remediator.consider(breach)

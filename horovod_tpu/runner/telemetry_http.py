@@ -32,6 +32,16 @@ gives the driver process a scrapeable surface:
   the remediation history the self-healing ladder
   (``elastic/remediate.py``) has taken — which rung, which phases,
   outcome, and current slice placement (docs/fault_tolerance.md).
+* ``GET /prof`` — the device-time profiling plane (``prof/``):
+  compiled-program introspection (XLA cost/memory analysis + compile
+  cost per signature), per-step host-gap and dispatches-per-step, MFU
+  per workload/tenant, capture-window state, and the perf-regression
+  sentinel's last stored-vs-observed verdict — aggregated per rank
+  from the same worker KV pushes ``/metrics`` renders
+  (docs/observability.md).  ``/health`` additionally carries the
+  staged device-probe doctor's verdict (``tools/probe_doctor.py``)
+  under a ``probe`` field, so a dead device layer is visible from the
+  driver without grepping bench records.
 * ``GET/POST /schedules`` — the persistent autotuning database
   (``sched/store.py``): GET returns every stored (bucket_bytes, wire,
   lowering) winner (``?key=<hex>`` filters to one), POST merges a
@@ -109,11 +119,15 @@ class _Handler(BaseHTTPRequestHandler):
                     payload if payload is not None
                     else {"error": "no SLO watchdog"}
                 ).encode(), "application/json")
+            elif route == "/prof":
+                self._send(200, json.dumps(
+                    srv.render_prof(), default=str
+                ).encode(), "application/json")
             else:
                 self._send(
                     404,
                     b"not found: try /metrics, /health, /schedules, "
-                    b"/trace, /tenants or /slo\n",
+                    b"/trace, /tenants, /slo or /prof\n",
                     "text/plain")
         except Exception as e:  # a scrape must never kill the server
             self._send(500, f"telemetry error: {e}\n".encode(),
@@ -188,6 +202,8 @@ class TelemetryServer:
         trace_fn: Optional[Callable[[], Dict[str, Any]]] = None,
         tenants_fn: Optional[Callable[[], Dict[str, Any]]] = None,
         slo_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+        prof_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+        probe_fn: Optional[Callable[[], Dict[str, Any]]] = None,
     ):
         self.health_fn = health_fn
         self.workers_fn = workers_fn
@@ -195,6 +211,8 @@ class TelemetryServer:
         self.trace_fn = trace_fn
         self.tenants_fn = tenants_fn
         self.slo_fn = slo_fn
+        self.prof_fn = prof_fn
+        self.probe_fn = probe_fn
         self._server = _QuietHTTPServer((bind_host, port), _Handler)
         self._server.telemetry = self  # type: ignore[attr-defined]
         self.port = self._server.server_address[1]
@@ -223,9 +241,21 @@ class TelemetryServer:
         return "".join(parts)
 
     def render_health(self) -> Dict[str, Any]:
-        if self.health_fn is None:
-            return {"status": "ok"}
-        return self.health_fn()
+        payload = (
+            {"status": "ok"} if self.health_fn is None
+            else self.health_fn()
+        )
+        # Device-probe doctor verdict (satellite: the staged probe used
+        # to live only inside bench skip records).  Additive field —
+        # a sick probe does not flip the health status: the driver
+        # process itself is fine, its device layer is what's sick.
+        if self.probe_fn is not None:
+            try:
+                payload = dict(payload)
+                payload["probe"] = self.probe_fn()
+            except Exception as e:  # pragma: no cover - defensive
+                payload["probe"] = {"status": "error", "error": str(e)}
+        return payload
 
     def render_trace(self) -> Optional[Dict[str, Any]]:
         """``GET /trace`` payload: an explicit ``trace_fn`` (the
@@ -259,6 +289,22 @@ class TelemetryServer:
                 return tenants_payload(per_rank)
         return tenants_payload({0: metrics.snapshot()})
 
+    def render_prof(self) -> Dict[str, Any]:
+        """``GET /prof`` payload: an explicit ``prof_fn`` (the elastic
+        driver installs one with round context), else the local
+        profiling-plane payload — with the per-rank digest folded in
+        when worker snapshots are reachable.  Always a dict: an empty
+        profiling plane still answers 200 with its (empty) structure."""
+        if self.prof_fn is not None:
+            return self.prof_fn()
+        from .. import prof
+
+        if self.workers_fn is not None:
+            per_rank = {rank: snap for rank, snap in self.workers_fn()}
+            if per_rank:
+                return prof.prof_payload(per_rank)
+        return prof.prof_payload()
+
     def render_slo(self) -> Optional[Dict[str, Any]]:
         """``GET /slo`` payload: whatever ``slo_fn`` renders (the
         elastic driver installs the SLO controller's ``payload()``).
@@ -285,3 +331,94 @@ class TelemetryServer:
     def stop(self) -> None:
         self._server.shutdown()
         self._server.server_close()
+
+
+# ------------------------------------------------------- probe doctor
+
+_probe_lock = threading.Lock()
+_probe_result: Optional[Dict[str, Any]] = None
+_probe_thread: Optional[threading.Thread] = None
+
+
+def _load_probe_doctor():
+    """Import ``tools/probe_doctor.py`` — as a module when ``tools`` is
+    importable (repo-root runs), else by file path relative to the
+    package root.  None when neither works (an installed wheel without
+    the tools tree)."""
+    try:
+        from tools import probe_doctor  # type: ignore[import-not-found]
+
+        return probe_doctor
+    except Exception:
+        pass
+    try:
+        import importlib.util
+        import os
+
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        path = os.path.join(root, "tools", "probe_doctor.py")
+        spec = importlib.util.spec_from_file_location(
+            "hvd_tpu_probe_doctor", path)
+        if spec is None or spec.loader is None:
+            return None
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    except Exception:
+        return None
+
+
+def _run_probe() -> None:
+    global _probe_result
+    doctor = _load_probe_doctor()
+    if doctor is None:
+        result: Dict[str, Any] = {"status": "unavailable", "verdict": None}
+    else:
+        try:
+            d = doctor.diagnose()
+            failing = next(
+                (s for s in d.get("stages", [])
+                 if s.get("status") != "ok"), None,
+            )
+            result = {
+                "status": d.get("status"),
+                "verdict": d.get("verdict"),
+                "failing_stage": failing.get("stage") if failing else None,
+                "stderr_tail": (
+                    failing.get("stderr_tail") if failing else None
+                ),
+            }
+        except Exception as e:
+            result = {"status": "error",
+                      "verdict": {"stage": "doctor", "cause": str(e)}}
+    with _probe_lock:
+        _probe_result = result
+
+
+def probe_payload() -> Dict[str, Any]:
+    """The ``probe`` field of ``GET /health``: the staged device-probe
+    doctor's verdict (import -> backend init -> first compute).  The
+    probe runs worker subprocesses with their own timeouts, so the
+    first scrape kicks it off on a background daemon thread and answers
+    ``pending`` until the verdict lands (then it's cached — the probe
+    diagnoses a boot-time condition, not a live signal)."""
+    global _probe_thread
+    with _probe_lock:
+        if _probe_result is not None:
+            return dict(_probe_result)
+        if _probe_thread is None or not _probe_thread.is_alive():
+            _probe_thread = threading.Thread(
+                target=_run_probe, daemon=True,
+                name="hvd_tpu_probe_doctor",
+            )
+            _probe_thread.start()
+    return {"status": "pending", "verdict": None}
+
+
+def reset_probe_cache() -> None:
+    """Forget the cached probe verdict (test isolation)."""
+    global _probe_result, _probe_thread
+    with _probe_lock:
+        _probe_result = None
+        _probe_thread = None

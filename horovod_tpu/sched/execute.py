@@ -479,6 +479,12 @@ def exchange(
         metrics.inc_counter("xir.programs")
         metrics.inc_counter(f"xir.programs.{kind}")
         metrics.inc_counter("xir.ops", len(program.ops))
+        # Emission accounting for the profiling plane (trace-time, like
+        # the counters above): how many collective programs — and ops —
+        # one step's schedule emits, per source.
+        from .. import prof
+
+        prof.note_emission(f"sched.{kind}", len(program.ops))
     axis_size = None
     if isinstance(axis, str):
         try:

@@ -168,6 +168,16 @@ class ScheduleStore:
     sharing one DB converge on the best-scored entry instead of
     clobbering each other."""
 
+    # Minimum entry shape accepted from disk / peer merges; subclasses
+    # storing a different record kind (prof/baseline.py's
+    # PerfBaselineStore) override this instead of re-implementing the
+    # load/merge machinery.
+    REQUIRED_KEYS = ("bucket_bytes", "wire", "lowering")
+
+    @classmethod
+    def _valid_entry(cls, e: Any) -> bool:
+        return isinstance(e, dict) and all(k in e for k in cls.REQUIRED_KEYS)
+
     def __init__(self, path: Optional[str],
                  stale_factor: Optional[float] = None):
         self.path = path
@@ -200,8 +210,7 @@ class ScheduleStore:
             # shape-check each entry; drop garbage rather than crash
             good = {}
             for k, e in entries.items():
-                if (isinstance(e, dict) and "bucket_bytes" in e
-                        and "wire" in e and "lowering" in e):
+                if self._valid_entry(e):
                     good[str(k)] = e
             return good
         except FileNotFoundError:
@@ -339,8 +348,7 @@ class ScheduleStore:
         changed = 0
         with self._lock:
             for k, e in entries.items():
-                if not (isinstance(e, dict) and "bucket_bytes" in e
-                        and "wire" in e and "lowering" in e):
+                if not self._valid_entry(e):
                     continue
                 mine = self._entries.get(k)
                 if mine is None or (

@@ -46,12 +46,15 @@ def capacity() -> int:
 
 @dataclasses.dataclass
 class CachedResponse:
-    """One cached signature's resolution: the lowered program and
-    (lazily) its compiled host-path executor."""
+    """One cached signature's resolution: the lowered program, (lazily)
+    its compiled host-path executor, and the compile cost the entry has
+    paid so far — an eviction that later re-lowers pays it again, and
+    ``GET /prof`` ranks entries by exactly that bill."""
 
     program: Any  # lowered xir.ir.ExchangeProgram
     executor: Any = None
     hits: int = 0
+    compile_seconds: float = 0.0
 
 
 class ResponseCache:
@@ -107,6 +110,25 @@ class ResponseCache:
         if evicted:
             metrics.inc_counter("svc.cache_evict", evicted)
         return entry
+
+    def top_by_compile_cost(self, n: int = 10) -> list:
+        """The ``n`` most expensive entries by accumulated lowering +
+        executor-compile seconds — the ``/prof`` table naming which
+        signatures a capacity bump (or a warmer tune DB) would save
+        re-lowering."""
+        with self._lock:
+            rows = [
+                {
+                    "kind": getattr(e.program, "kind", None),
+                    "signature": repr(k[0])[:120],
+                    "axis_size": k[1],
+                    "compile_seconds": e.compile_seconds,
+                    "hits": e.hits,
+                }
+                for k, e in self._entries.items()
+            ]
+        rows.sort(key=lambda r: r["compile_seconds"], reverse=True)
+        return rows[:n]
 
     def __len__(self) -> int:
         with self._lock:

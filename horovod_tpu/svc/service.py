@@ -302,16 +302,25 @@ class ExchangeService:
         entry = self.cache.lookup(key)
         if entry is not None:
             return entry
+        lower_seconds = 0.0
         if program.lowered:
             lowered = program
         else:
+            t0 = time.monotonic()
             lowered = lower_mod.lower(program, axis_size, store=store)
+            lower_seconds = time.monotonic() - t0
             metrics.inc_counter("svc.lowerings")
+            # Compile-cost accounting: a miss silently pays this
+            # re-lowering; the histogram plus the per-entry carry lets
+            # /prof rank the most expensive signatures.
+            metrics.observe("svc.compile_seconds", lower_seconds)
         # Cache entries are shared across submissions: store the shape,
         # not the first submitter's trace identity.
         if lowered.trace is not None:
             lowered = lowered.with_trace(None)
-        return self.cache.insert(key, CachedResponse(program=lowered))
+        return self.cache.insert(key, CachedResponse(
+            program=lowered, compile_seconds=lower_seconds,
+        ))
 
     def _build_executor(self, program, axis_size: Optional[int],
                         process_set=None):
@@ -408,8 +417,9 @@ class ExchangeService:
             ):
                 entry = self._resolve_program(fused_prog, fb.axis_size)
                 if entry.executor is None:
-                    entry.executor = self._build_fused_executor(
-                        fb, entry.program
+                    entry.executor = self._wrap_executor(
+                        self._build_fused_executor(fb, entry.program),
+                        entry,
                     )
                 args = tuple(
                     x for m in fb.members for x in m.sub.args
@@ -505,8 +515,11 @@ class ExchangeService:
             ):
                 entry = self._resolve_program(sub.program, sub.axis_size)
                 if entry.executor is None:
-                    entry.executor = self._build_executor(
-                        entry.program, sub.axis_size, sub.process_set
+                    entry.executor = self._wrap_executor(
+                        self._build_executor(
+                            entry.program, sub.axis_size, sub.process_set
+                        ),
+                        entry,
                     )
                 with self._inflight_guard():
                     outs = entry.executor(tuple(sub.args))
@@ -520,6 +533,34 @@ class ExchangeService:
             sub.future.set_exception(e)
         finally:
             self.arbiter.release(sub)
+
+    def _wrap_executor(self, fn, entry):
+        """Profiling-plane wrap of a freshly built executor
+        (``prof/introspect.py``): XLA cost/memory analysis and the
+        executor-compile wall time land in ``prof.*`` keyed by the
+        program signature, and the compile cost is carried on the cache
+        entry (satellite: rank the most expensive re-lowerings on
+        ``/prof``).  At ``HVD_TPU_PROF=off`` — or on any wrap failure —
+        the raw executor is used unchanged."""
+        try:
+            from .. import prof
+
+            if not prof.enabled():
+                return fn
+
+            def on_compile(dt: float, _entry=entry) -> None:
+                _entry.compile_seconds += dt
+                metrics.observe("svc.compile_seconds", dt)
+
+            program = entry.program
+            return prof.wrap_executor(
+                fn, key=prof.program_key(program),
+                kind=getattr(program, "kind", "svc"),
+                workload=f"svc.{getattr(program, 'kind', 'program')}",
+                on_compile=on_compile,
+            )
+        except Exception:  # pragma: no cover - defensive
+            return fn
 
     def _inflight_guard(self):
         svc = self

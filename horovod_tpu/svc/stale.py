@@ -157,13 +157,19 @@ class StaleTrainStep:
         # every step — the donation TrainStep._build_step already
         # performs for the synchronous path.  The correction and batch
         # (args 2/3) are read-only and never donated.
-        self._step_fn = jax.jit(
-            jax.shard_map(
-                step_body, mesh=self.mesh,
-                in_specs=(spec, spec, spec, P(axis)),
-                out_specs=(spec, spec, P(), spec), check_vma=False,
+        from .. import prof
+
+        self._step_fn = prof.wrap_executor(
+            jax.jit(
+                jax.shard_map(
+                    step_body, mesh=self.mesh,
+                    in_specs=(spec, spec, spec, P(axis)),
+                    out_specs=(spec, spec, P(), spec), check_vma=False,
+                ),
+                donate_argnums=(0, 1) if donate else (),
             ),
-            donate_argnums=(0, 1) if donate else (),
+            key=f"stale_step_k{self.k}", kind="step",
+            workload="stale_step",
         )
 
     # ------------------------------------------------------------ API

@@ -59,6 +59,14 @@ def trace_dir() -> Optional[str]:
     return env.get_env(env.TRACE_DIR) or None
 
 
+DEFAULT_DUMP_KEEP = 64
+
+
+def dump_keep() -> int:
+    """On-disk retention: newest N dumps kept per rank (0 = unbounded)."""
+    return max(0, env.get_int(env.TRACE_DUMP_KEEP, DEFAULT_DUMP_KEEP))
+
+
 class FlightRecorder:
     """Per-process ring of recent step span trees + anomaly dumps."""
 
@@ -160,6 +168,7 @@ class FlightRecorder:
                 with open(tmp, "w") as fh:
                     json.dump(payload, fh, default=str)
                 os.replace(tmp, path)
+                self._prune_dumps(d, payload["rank"])
             except OSError as e:
                 from ..utils.logging import get_logger
 
@@ -173,6 +182,44 @@ class FlightRecorder:
                if isinstance(v, (int, float, str))},
         )
         return path
+
+    @staticmethod
+    def _prune_dumps(d: str, rank: Any) -> None:
+        """Oldest-first retention on this rank's on-disk dumps: a
+        long-running chaos-heavy job must not grow ``HVD_TPU_TRACE_DIR``
+        without bound.  Keeps the newest ``HVD_TPU_TRACE_DUMP_KEEP``
+        (0 = unbounded); pruned files count into
+        ``trace.dumps_pruned``.  Never raises."""
+        keep = dump_keep()
+        if keep <= 0:
+            return
+        import re
+
+        prefix = f"flight_rank{rank}_"
+        found: List[tuple] = []
+        try:
+            for name in os.listdir(d):
+                if not (name.startswith(prefix) and name.endswith(".json")):
+                    continue
+                m = re.match(re.escape(prefix) + r"(\d+)\.json$", name)
+                if m:
+                    found.append((int(m.group(1)), name))
+        except OSError:
+            return
+        if len(found) <= keep:
+            return
+        found.sort()
+        pruned = 0
+        for _, name in found[:-keep]:
+            try:
+                os.remove(os.path.join(d, name))
+                pruned += 1
+            except OSError:
+                pass
+        if pruned:
+            from .. import metrics
+
+            metrics.inc_counter("trace.dumps_pruned", pruned)
 
     # ------------------------------------------------------ inspection
 

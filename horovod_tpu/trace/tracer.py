@@ -323,6 +323,12 @@ class Tracer:
         metrics.inc_counter("trace.spans", n)
         if step:
             self._publish_rail_utilization(span)
+            # Device-time profiling plane (prof/): host-gap + MFU +
+            # sentinel all derive from the finalized step tree.  The
+            # hook never raises and is a no-op at HVD_TPU_PROF=off.
+            from .. import prof
+
+            prof.on_step_span(span)
         from . import recorder
 
         rec = recorder.get_recorder()

@@ -243,6 +243,11 @@ TRACE_ANOMALY_Z = "TRACE_ANOMALY_Z"
 # p50 exceeds z x the median rank's p50 is flagged in the /trace
 # summary and the trace.straggler{rank=,phase=} gauges (default 2.0).
 TRACE_STRAGGLER_Z = "TRACE_STRAGGLER_Z"
+# On-disk flight-dump retention: keep only the newest N
+# flight_rank<r>_*.json anomaly dumps per rank under HVD_TPU_TRACE_DIR,
+# deleting oldest-first after each dump (default 64; 0 = unbounded).
+# Pruned files bump the trace.dumps_pruned counter.
+TRACE_DUMP_KEEP = "TRACE_DUMP_KEEP"
 # Async-service negotiation stall timeout (seconds, default 60): a
 # submission stuck in negotiation past this emits a svc.stall warning
 # naming the missing participants (the PR 2 stall inspector extended to
@@ -277,6 +282,37 @@ SLO_COOLDOWN = "SLO_COOLDOWN"
 # (default 2) for the RetryPolicy every escalation rung runs under.
 REMEDIATE_TIMEOUT = "REMEDIATE_TIMEOUT"
 REMEDIATE_RETRIES = "REMEDIATE_RETRIES"
+# Device-time profiling plane (prof/): compiled-step introspection (XLA
+# cost/memory analysis per program signature), the per-step host-gap
+# profiler, online MFU gauges, and the perf-regression sentinel.
+#   on  = (default) everything above; host-side only — profiling
+#         inserts no ops into any compiled program, so losses are
+#         bitwise identical on vs off.
+#   off = every prof call is a no-op; executors are returned unwrapped
+#         (exactly the pre-profiling code path).
+PROF = "PROF"
+# Persistent perf-baseline database (prof/baseline.py): JSON file
+# (ScheduleStore machinery, entry kind "prof_baseline") recording
+# step-time p50 / MFU / rail-busy per (workload signature, topology,
+# knob fingerprint).  Unset = sentinel observes but never persists or
+# compares ("no_baseline" verdicts).
+PROF_DB = "PROF_DB"
+# Regression threshold factor (default 1.5): the sentinel flags a
+# regression when observed step p50 exceeds baseline x factor, or
+# observed MFU falls below baseline / factor.
+PROF_REGRESS_FACTOR = "PROF_REGRESS_FACTOR"
+# Sentinel check cadence in steps (default 20); 0 = never auto-check
+# (explicit Sentinel.check() only, e.g. from tests or the smoke).
+PROF_CHECK_EVERY = "PROF_CHECK_EVERY"
+# Directory for jax.profiler capture windows triggered by a confirmed
+# perf regression or SLO breach.  Unset (default) = capture hooks are
+# inert — no profiler trace is ever started.
+PROF_CAPTURE_DIR = "PROF_CAPTURE_DIR"
+# Capture-window length in seconds (default 5) and the maximum number
+# of capture windows per process (default 2) — a flapping sentinel can
+# never fill the disk with profiler traces.
+PROF_CAPTURE_SECS = "PROF_CAPTURE_SECS"
+PROF_CAPTURE_MAX = "PROF_CAPTURE_MAX"
 
 # Launcher-provided rendezvous env (analog of reference gloo_run.py:65-103).
 RANK = "RANK"

@@ -540,6 +540,12 @@ def execute(program: ir.ExchangeProgram,
     elif store:
         program = lower_mod._store_sync(program)
     account(program, axis_size)
+    # Emission accounting for the profiling plane: programs/ops emitted
+    # through the interpreter, per kind — published at trace time like
+    # account() above.
+    from .. import prof
+
+    prof.note_emission(f"xir.{program.kind}", len(program.ops))
     outs = []
     with trace.span(
         f"exchange.{program.kind}", "exchange", ctx=program.trace,

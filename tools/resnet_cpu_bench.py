@@ -44,22 +44,12 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 
 
 def _measured_peak_tflops() -> float:
-    """Achieved TFLOP/s of a compiled square bf16 matmul — the same
-    measured-peak stand-in ``bench.py`` uses for unknown chips."""
-    import jax
-    import jax.numpy as jnp
+    """Achieved TFLOP/s of a compiled square bf16 matmul — the shared
+    measured-peak stand-in (``horovod_tpu/prof/peak.py``) ``bench.py``
+    and the online MFU gauge use for unknown chips."""
+    from horovod_tpu.prof import peak as peak_mod
 
-    n, iters = 512, 8
-    a = jnp.full((n, n), 0.5, jnp.bfloat16)
-    f = jax.jit(lambda x: jnp.tanh(x @ x))
-    float(jnp.sum(f(a).astype(jnp.float32)))
-    out = a
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        out = f(out)
-    float(jnp.sum(out.astype(jnp.float32)))
-    dt = time.perf_counter() - t0
-    return max(2.0 * n ** 3 * iters / dt / 1e12, 1e-9)
+    return peak_mod.measured_peak_tflops()
 
 
 def _phase_profile(model, params, stats, data, target,
@@ -233,6 +223,19 @@ def main() -> dict:
     }
     out.update(best)
     out["bottleneck"] = _bottleneck(best.get("phase_profile", {}))
+    # Publish the winner onto the profiling plane: the ResNet CPU-sim
+    # MFU shows up on GET /prof (prof.mfu{workload=resnet_cpu_sim})
+    # like any online workload.
+    try:
+        from horovod_tpu.prof import mfu as mfu_mod
+
+        mfu_mod.publish(
+            "resnet_cpu_sim",
+            best["mfu"] * peak,  # achieved TFLOP/s back from the ratio
+            peak_tflops=peak,
+        )
+    except Exception:
+        pass
     out["mfu_sweep"] = {
         "best": {k: best[k] for k in ("stem", "batch_per_chip", "mfu")},
         "configs": [
