@@ -36,7 +36,12 @@ pre-handoff state and dumps the flight recorder; only a fault in the
 caller's signal to fall back to the respawn path.  A tenant's ladder
 escalates only while its breach persists past ``HVD_TPU_SLO_COOLDOWN``
 seconds per rung, and re-arms from the cheapest rung on
-:meth:`Remediator.reset`.
+:meth:`Remediator.reset` — which the SLO controller calls on the
+breach→recovered transition, and which also *reverts degraded mode*:
+every knob the tenant's degrade rung(s) flipped is restored to its
+pre-degrade value (a breach/recover cycle is a round trip, not a
+ratchet), locally and — through the optional ``undegrade`` actuator —
+on every worker.
 
 See docs/fault_tolerance.md (remediation ladder) and
 docs/multitenant.md (SLO specs + ``/slo``).
@@ -46,6 +51,7 @@ from __future__ import annotations
 
 import collections
 import contextlib
+import os
 import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
@@ -194,6 +200,10 @@ class Remediator:
         self._lock = threading.Lock()
         self._rung_idx: Dict[str, int] = {}
         self._last_action: Dict[str, float] = {}
+        # tenant -> {env name -> pre-degrade value (None = was unset)}:
+        # what reset() restores. First degrade wins per knob, so
+        # repeated degrades still revert to the ORIGINAL values.
+        self._degrade_undo: Dict[str, Dict[str, Optional[str]]] = {}
         self._history: collections.deque = collections.deque(
             maxlen=max(1, history_cap)
         )
@@ -214,14 +224,54 @@ class Remediator:
 
     def reset(self, tenant: Optional[str] = None) -> None:
         """Re-arm the ladder from the cheapest rung (SLO recovered, or
-        test isolation); ``None`` resets every tenant."""
+        test isolation) and revert degraded mode: every env knob the
+        tenant's degrade rung(s) flipped is restored to its pre-degrade
+        value — locally here, and on every worker when an ``undegrade``
+        actuator is wired (the elastic driver publishes the restore on
+        ``__slo__/degrade``).  ``None`` resets every tenant."""
         with self._lock:
             if tenant is None:
                 self._rung_idx.clear()
                 self._last_action.clear()
+                undos = self._degrade_undo
+                self._degrade_undo = {}
             else:
                 self._rung_idx.pop(tenant, None)
                 self._last_action.pop(tenant, None)
+                undos = {}
+                undo = self._degrade_undo.pop(tenant, None)
+                if undo:
+                    undos[tenant] = undo
+        for t, undo in undos.items():
+            self._revert_degrade(t, undo)
+
+    def _revert_degrade(self, tenant: str,
+                        undo: Dict[str, Optional[str]]) -> None:
+        """Restore the pre-degrade knob values (None = unset) and tell
+        the workers through the ``undegrade`` actuator.  Never raises —
+        reset runs on the recovery path, which must stay green."""
+        for name, prior in undo.items():
+            if prior is None:
+                os.environ.pop(name, None)
+            else:
+                os.environ[name] = prior
+        metrics.inc_counter("slo.degrade_reverts")
+        events.emit(events.REMEDIATE_REVERT, tenant=tenant,
+                    restored=dict(undo))
+        get_logger().info(
+            "SLO recovered: degraded mode reverted for tenant %s (%s)",
+            tenant, undo,
+        )
+        act = self._actuators.get("undegrade")
+        if act is not None:
+            try:
+                act(tenant, dict(undo))
+            except Exception as e:
+                get_logger().warning(
+                    "undegrade publication failed for tenant %s: %s "
+                    "(local knobs restored; workers keep degraded "
+                    "values until the next publication)", tenant, e,
+                )
 
     def _retry(self, name: str) -> RetryPolicy:
         kw: Dict[str, Any] = dict(
@@ -335,10 +385,18 @@ class Remediator:
                     self._retry("preempt").call(act, tenant, breach)
             elif rung == "degrade":
                 act = self._actuators.get("degrade", _default_degrade)
+                env_before = dict(os.environ)
                 with self._phase(record, "degrade", tenant=tenant):
                     record["changes"] = self._retry("degrade").call(
                         act, tenant, breach
                     ) or {}
+                with self._lock:
+                    # remember what each flipped knob held BEFORE the
+                    # first degrade, so reset() can undo the whole
+                    # ladder of bumps in one restore.
+                    undo = self._degrade_undo.setdefault(tenant, {})
+                    for name in record["changes"]:
+                        undo.setdefault(name, env_before.get(name))
             else:  # handoff
                 act = self._actuators.get("handoff")
                 with self._phase(record, "handoff",

@@ -29,6 +29,7 @@ class State:
         self._remesh_request = None
         self._sharded: Dict[str, Any] = {}
         self._commit_count = 0
+        self._tenant_placement: Optional[Dict[str, int]] = None
 
     def register_reset_callbacks(self, callbacks) -> None:
         self._reset_callbacks.extend(callbacks)
@@ -58,6 +59,14 @@ class State:
         boundary raises :class:`RemeshInterrupt` instead of the plain
         restart interrupt (``runner/elastic_worker.py`` poller)."""
         self._remesh_request = request
+
+    def on_placement_updated(self, placement) -> None:
+        """An SLO slice handoff changed the tenant→slice placement
+        (``runner/slo_consumer.py``).  The arbiter-weight half is
+        already enacted by the consumer; the default here just records
+        the placement — a state that shards per tenant overrides this
+        to reshard at its next commit boundary."""
+        self._tenant_placement = dict(placement)
 
     def commit(self) -> None:
         """Snapshot + check for host changes (reference ``elastic.py:60``).

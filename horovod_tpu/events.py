@@ -92,6 +92,9 @@ REMEDIATE_START = "remediate_start"
 REMEDIATE_PHASE = "remediate_phase"
 REMEDIATE_OK = "remediate_ok"
 REMEDIATE_ABORT = "remediate_abort"
+# SLO recovery re-armed a tenant's ladder and restored the env knobs
+# its degrade rung(s) had flipped (Remediator.reset).
+REMEDIATE_REVERT = "remediate_revert"
 # Perf-regression sentinel (prof/baseline.py): observed step p50 or
 # MFU degraded past HVD_TPU_PROF_REGRESS_FACTOR against the persisted
 # baseline for this (workload signature, topology, knob fingerprint).

@@ -159,4 +159,4 @@ def reset() -> None:
     mfu.reset()
     baseline.reset()
     capture.reset()
-    peak.set_peak_override(None)
+    peak.reset()

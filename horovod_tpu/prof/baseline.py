@@ -29,6 +29,7 @@ at all.
 
 from __future__ import annotations
 
+import atexit
 import threading
 import time
 from typing import Any, Dict, Optional
@@ -213,6 +214,45 @@ class Sentinel:
 _sentinel: Optional[Sentinel] = None
 _sentinel_lock = threading.Lock()
 
+# Single-flight background check (the step-finalize path must never
+# pay the baseline-store disk roundtrip or a capture start itself).
+_async_lock = threading.Lock()
+_async_thread: Optional[threading.Thread] = None
+
+
+def check_async() -> bool:
+    """Run the sentinel check on a background thread — the cadence
+    hook ``hostgap.on_step`` uses so the disk read/merge/atomic-write
+    (and a possible ``jax.profiler`` capture start) never stall the
+    step that crossed the check boundary.  Single-flight: a check
+    already in flight absorbs the new request (the next cadence
+    boundary re-arms).  Returns False when the request was absorbed."""
+    global _async_thread
+    with _async_lock:
+        if _async_thread is not None and _async_thread.is_alive():
+            return False
+        thread = threading.Thread(
+            target=lambda: get_sentinel().check(),
+            name="hvd-tpu-prof-sentinel", daemon=True,
+        )
+        _async_thread = thread
+    thread.start()
+    return True
+
+
+def drain_async(timeout_s: float = 10.0) -> None:
+    """Block until an in-flight background check finishes (tests, and
+    orderly shutdown paths that want the last verdict persisted).
+    Registered atexit so a check mid-flight at interpreter teardown
+    cannot abort the process."""
+    with _async_lock:
+        thread = _async_thread
+    if thread is not None:
+        thread.join(timeout_s)
+
+
+atexit.register(drain_async)
+
 
 def get_sentinel() -> Sentinel:
     """The process-wide sentinel, store resolved from ``HVD_TPU_PROF_DB``
@@ -234,4 +274,5 @@ def set_sentinel(sentinel: Optional[Sentinel]) -> None:
 
 
 def reset() -> None:
+    drain_async()
     set_sentinel(None)

@@ -146,9 +146,12 @@ def on_step(span: Any) -> Optional[Dict[str, Any]]:
     mfu.on_step(span, stats)
     cadence = check_every()
     if cadence and steps % cadence == 0:
+        # Off the step path: the sentinel's baseline-store disk
+        # roundtrip (and a possible capture start) runs on a
+        # single-flight background thread, never in step-finalize.
         from . import baseline
 
-        baseline.get_sentinel().check()
+        baseline.check_async()
     return stats
 
 

@@ -22,7 +22,16 @@ Graceful degradation is the hard requirement: any backend that lacks
 ``cost_analysis``/``memory_analysis``, or any program AOT refuses to
 lower, permanently falls back to calling the raw fn for that argument
 signature — one attempt, no retry storm, never an exception out of the
-wrapper.  ``HVD_TPU_PROF=off`` never constructs a wrapper at all.
+wrapper that plain ``jit`` would not also raise.  The contract has two
+halves: the cache key folds in each leaf's *sharding* alongside shape
+and dtype (so same-shape inputs arriving with a new sharding after an
+elastic resize compile their own variant instead of hitting a stale
+``Compiled``), and any exception the cached ``Compiled`` raises at
+call time — layout/committedness mismatches the key cannot see —
+permanently demotes that signature to the raw fn, whose own call then
+either succeeds (jit would have resharded/recompiled) or raises the
+genuine error.  ``HVD_TPU_PROF=off`` never constructs a wrapper at
+all.
 """
 
 from __future__ import annotations
@@ -93,8 +102,13 @@ def _args_signature(args: Tuple[Any, ...]) -> Any:
     import jax
 
     leaves, treedef = jax.tree.flatten(args)
+    # Sharding is part of the key: jax shardings are hashable and
+    # equality-comparable, so the object itself participates in the
+    # dict lookup.  Hosts-side leaves (numpy, scalars) have none.
     return treedef, tuple(
-        (getattr(l, "shape", ()), str(getattr(l, "dtype", type(l).__name__)))
+        (getattr(l, "shape", ()),
+         str(getattr(l, "dtype", type(l).__name__)),
+         getattr(l, "sharding", None))
         for l in leaves
     )
 
@@ -136,10 +150,10 @@ class ProfiledExecutor:
             return self._fn(*args)
         try:
             sig = _args_signature(args)
-        except Exception:
+            with self._lock:
+                compiled = self._compiled.get(sig)
+        except Exception:  # unflattenable args or an unhashable leaf
             return self._fn(*args)
-        with self._lock:
-            compiled = self._compiled.get(sig)
         if compiled is None:
             compiled = self._compile(sig, args)
         with _lock:
@@ -150,8 +164,28 @@ class ProfiledExecutor:
             return self._fn(*args)
         from .. import trace
 
-        with trace.span(f"exec.{self.workload}", "exec", program=self.key):
-            return compiled(*args)
+        try:
+            with trace.span(f"exec.{self.workload}", "exec",
+                            program=self.key):
+                return compiled(*args)
+        except Exception:
+            # A call-time aval/layout/committedness mismatch the
+            # signature cannot see (e.g. same-shape inputs whose
+            # placement changed after an elastic resize): plain jit
+            # would transparently recompile, the cached Compiled raises
+            # instead.  Demote the signature to the raw fn forever; a
+            # genuine execution error re-raises from the raw call.
+            self._mark_fallback(sig)
+        return self._fn(*args)
+
+    def _mark_fallback(self, sig: Any) -> None:
+        with self._lock:
+            self._compiled[sig] = _FALLBACK
+        with _lock:
+            rec = _programs.get(self.key)
+            if rec is not None:
+                rec["fallback"] = True
+        metrics.inc_counter("prof.fallbacks")
 
     # ----------------------------------------------------- delegation
     def __getattr__(self, name: str) -> Any:
@@ -168,17 +202,10 @@ class ProfiledExecutor:
             compiled = self._fn.lower(*args).compile()
             dt = time.monotonic() - t0
         except Exception:
-            compiled = _FALLBACK
-            dt = None
+            self._mark_fallback(sig)
+            return _FALLBACK
         with self._lock:
             self._compiled[sig] = compiled
-        if compiled is _FALLBACK:
-            with _lock:
-                rec = _programs.get(self.key)
-                if rec is not None:
-                    rec["fallback"] = True
-            metrics.inc_counter("prof.fallbacks")
-            return compiled
         self._record(compiled, dt)
         if self._on_compile is not None:
             try:

@@ -60,10 +60,15 @@ def on_step(span: Any, stats: Dict[str, Any]) -> None:
         total_flops += rec["flops"]
     if total_flops <= 0:
         return
-    try:
-        peak_tflops, _source = peak.default_peak_tflops()
-    except Exception:
+    # Step path: only the cached peak is acceptable here — resolving it
+    # can mean an 8-iteration benchmark matmul on unknown device kinds,
+    # which runs on a background thread instead (MFU stays absent for
+    # the first steps until the denominator lands).
+    cached = peak.cached_peak()
+    if cached is None:
+        peak.ensure_default_peak_async()
         return
+    peak_tflops, _source = cached
     if peak_tflops <= 0:
         return
     denom = wall * peak_tflops * 1e12

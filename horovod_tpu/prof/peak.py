@@ -16,6 +16,7 @@ across rounds on the same host.
 
 from __future__ import annotations
 
+import atexit
 import threading
 import time
 from typing import Optional, Tuple
@@ -113,12 +114,57 @@ def default_peak_tflops() -> Tuple[float, str]:
 
 def cached_peak() -> Optional[Tuple[float, str]]:
     """The already-computed default peak, or None — what a telemetry
-    scrape reads, so ``GET /prof`` never triggers the measurement
-    matmul itself."""
+    scrape (and the per-step MFU hook) reads, so neither ever triggers
+    the measurement matmul itself."""
     if _override is not None:
         return _override, "override"
     with _lock:
         return _DEFAULT_PEAK
+
+
+_measure_thread: Optional[threading.Thread] = None
+
+
+def ensure_default_peak_async() -> None:
+    """Kick the default-peak resolution on a background thread when it
+    is not cached yet.  For a device kind missing from the datasheet
+    table this runs the 8-iteration measured-matmul benchmark —
+    seconds of work that must never run inside step-finalize
+    (``mfu.on_step`` skips MFU until the cache fills).  Single-flight;
+    returns immediately."""
+    global _measure_thread
+    if cached_peak() is not None:
+        return
+    with _lock:
+        if _measure_thread is not None and _measure_thread.is_alive():
+            return
+        thread = threading.Thread(
+            target=_measure_quietly, name="hvd-tpu-prof-peak",
+            daemon=True,
+        )
+        _measure_thread = thread
+    thread.start()
+
+
+def _measure_quietly() -> None:
+    try:
+        default_peak_tflops()
+    except Exception:
+        pass  # no denominator -> MFU simply stays absent
+
+
+def drain_async(timeout_s: float = 30.0) -> None:
+    """Join an in-flight background measurement.  Registered atexit: a
+    daemon thread still inside XLA while the interpreter tears down
+    aborts the whole process, so exit waits for the measurement (or
+    the timeout) first."""
+    with _lock:
+        thread = _measure_thread
+    if thread is not None:
+        thread.join(timeout_s)
+
+
+atexit.register(drain_async)
 
 
 def set_peak_override(value: Optional[float]) -> None:
@@ -129,8 +175,11 @@ def set_peak_override(value: Optional[float]) -> None:
 
 
 def reset() -> None:
-    """Forget cached measurements and any override (test isolation)."""
+    """Forget cached measurements and any override (test isolation).
+    Joins an in-flight background measurement first so a late writer
+    cannot repopulate the cache after the reset."""
     global _MEASURED_PEAK, _DEFAULT_PEAK, _override
+    drain_async()
     with _lock:
         _MEASURED_PEAK = None
         _DEFAULT_PEAK = None

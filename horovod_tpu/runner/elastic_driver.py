@@ -179,6 +179,7 @@ class ElasticDriver:
         # any tenant, ticked from the round watch loop, served as /slo.
         self._slo = None
         self._slo_workers_fn = None
+        self._slo_enactment_fn = None
 
     def schedule_store(self):
         """The driver-side schedule store (lazy: first use reads
@@ -551,10 +552,18 @@ class ElasticDriver:
 
             def slo_fn():
                 # GET /slo: the watchdog's last window + remediation
-                # history, with round context like /trace and /tenants.
+                # history, with round context like /trace and /tenants
+                # — plus per-action worker ack counts, so a handoff
+                # that no worker enacted is visible as such.
                 payload = controller.payload()
                 payload["round"] = self.rounds
                 payload["workers"] = len(self._last_assignments)
+                enact = getattr(self, "_slo_enactment_fn", None)
+                if enact is not None:
+                    try:
+                        payload["enactment"] = enact()
+                    except Exception:  # pragma: no cover - defensive
+                        pass
                 return payload
 
         from .telemetry_http import probe_payload
@@ -570,25 +579,39 @@ class ElasticDriver:
         """Build the SLO controller (watchdog + remediation ladder)
         when ``HVD_TPU_SLO_SPEC`` names any tenant; None otherwise.
 
-        The driver's actuators act through the channels it already
-        owns: rung (a) preempts the in-process arbiter when an
-        exchange service lives in this process and always publishes
-        the request on the KV store (``__slo__/preempt``) so every
-        worker's service can honor it; rung (b) is the default
-        degraded-mode knob flip plus a KV advisory; rung (c) publishes
-        the NEW placement (``__slo__/placement``) — workers pick it up
-        at their next commit boundary and reshard through the remesh
-        pipeline, no restarts — and rollback republishes the old one.
+        The driver's actuators publish every rung on the KV store
+        (``__slo__/preempt|degrade|placement``, seq-stamped) and the
+        workers' heartbeat threads consume and enact them in-process
+        (``runner/slo_consumer.py``): preempt gates the worker's
+        arbiter lanes, degrade applies the knob flip there, and a
+        placement handoff shifts the arbiter's tenant weights (rail
+        shares follow slices at the next scheduling cycle) and reaches
+        registered states at their next commit boundary through
+        ``on_placement_updated`` — no restarts.  Rollback republishes
+        the old placement, and the degrade revert published by
+        :meth:`~horovod_tpu.elastic.remediate.Remediator.reset` on SLO
+        recovery rides the same degrade channel.  Each worker acks
+        what it enacted; ``GET /slo`` folds the ack counts in
+        (``enactment``), so the history reports what workers DID, not
+        just what the driver said.
         """
+        import itertools
         import json as _json
 
         from ..elastic import remediate
         from . import slo as slo_mod
+        from .slo_consumer import ack_key
+
+        seq_counter = itertools.count(1)
+        published: Dict[str, int] = {}
 
         def publish(key: str, payload: Dict) -> None:
             # Advisory channel: a KV hiccup must fail the RUNG (so its
             # RetryPolicy retries), not the driver loop — hence raise.
+            seq = next(seq_counter)
+            payload = dict(payload, seq=seq)
             control.put("__slo__", key, _json.dumps(payload).encode())
+            published[key] = seq
 
         def preempt(tenant, breach):
             from ..svc import service as service_mod
@@ -604,6 +627,13 @@ class ElasticDriver:
             publish("degrade", {"tenant": tenant, "changes": changes})
             return changes
 
+        def undegrade(tenant, restored):
+            # Remediator.reset() reverting degraded mode after SLO
+            # recovery: workers un-apply through the same channel
+            # (null value = unset the knob).
+            publish("degrade", {"tenant": tenant, "changes": restored,
+                                "revert": True})
+
         def handoff(old_placement, new_placement, breach):
             publish("placement", {
                 "placement": new_placement,
@@ -618,8 +648,33 @@ class ElasticDriver:
                 "rollback": True,
             })
 
+        def enactment() -> Dict:
+            # Which ranks acked the latest publication of each action —
+            # the /slo proof that a remediation was enacted, not merely
+            # announced.  Non-blocking KV reads, scrape-time only.
+            out: Dict[str, Dict] = {}
+            for key, seq in published.items():
+                acked = []
+                for slot in list(self._last_assignments):
+                    try:
+                        if control.get(
+                            "__slo__", ack_key(key, seq, slot.rank),
+                            timeout_ms=0,
+                        ) is not None:
+                            acked.append(slot.rank)
+                    except Exception:
+                        pass
+                out[key] = {
+                    "seq": seq,
+                    "acked_ranks": sorted(acked),
+                    "workers": len(self._last_assignments),
+                }
+            return out
+
+        self._slo_enactment_fn = enactment
         remediator = remediate.Remediator(actuators={
             "preempt": preempt, "degrade": degrade,
+            "undegrade": undegrade,
             "handoff": handoff, "rollback": rollback,
         })
         return slo_mod.SLOController.from_env(remediator)

@@ -53,6 +53,27 @@ class WorkerNotificationManager:
         self._stop = threading.Event()
         self.round = int(os.environ.get("HVD_TPU_ELASTIC_ROUND", "0"))
         self.rank = int(os.environ.get("HVD_TPU_CROSS_RANK", "0"))
+        # SLO remediation consumer (runner/slo_consumer.py): the
+        # heartbeat polls __slo__ so the driver's preempt/degrade/
+        # placement actions are enacted in THIS process, not just
+        # published.
+        from . import slo_consumer
+
+        self._slo_consumer = slo_consumer.SLOActionConsumer(
+            rank_fn=lambda: self.rank,
+            on_placement=self._notify_placement,
+        )
+
+    def _notify_placement(self, placement) -> None:
+        """Fan a newly enacted tenant→slice placement out to registered
+        states: a state that shards per tenant reacts at its next
+        commit boundary (``State.on_placement_updated``)."""
+        with self._lock:
+            listeners = list(self._listeners)
+        for state in listeners:
+            notify = getattr(state, "on_placement_updated", None)
+            if notify is not None:
+                notify(placement)
 
     def init(self) -> None:
         if self._client is not None:
@@ -161,6 +182,9 @@ class WorkerNotificationManager:
                     metrics.render_json().encode(),
                 )
                 self._push_schedules(client)
+                # Enact any newly published SLO remediation action
+                # (poll() never raises — see slo_consumer.py).
+                self._slo_consumer.poll(client)
             except Exception:
                 pass  # KV blips must never kill the worker
             # a 'hang' fault here freezes the heartbeat AFTER it

@@ -176,6 +176,12 @@ class SLOWatchdog:
     A confirmed breach whose metric goes green emits
     :data:`~horovod_tpu.events.SLO_RECOVERED` and re-arms the counter
     — never one noisy sample in either direction beyond the first.
+
+    A *missing* observation is not green: a tenant whose ranks stop
+    reporting (workers died, histograms gone) HOLDS its streak and its
+    confirmed state — the window that cannot see the tenant must never
+    declare it recovered.  ``no_data`` kinds are flagged per tenant in
+    the status body and as the ``slo.no_data`` gauge.
     """
 
     def __init__(self, specs: Dict[str, SLOSpec],
@@ -198,6 +204,7 @@ class SLOWatchdog:
         metrics.inc_counter("slo.windows")
         observed = observe_tenants(per_rank)
         breaches: List[Dict[str, Any]] = []
+        recovered: List[Dict[str, Any]] = []
         tenants_out: Dict[str, Any] = {}
         for tenant, spec in sorted(self.specs.items()):
             obs = observed.get(tenant, {})
@@ -205,30 +212,41 @@ class SLOWatchdog:
                 "observed": {k: obs.get(k) for k in
                              ("step_s", "p99_s", "share", "usage")},
                 "stragglers": obs.get("stragglers", []),
-                "targets": {}, "windows": {},
+                "targets": {}, "windows": {}, "no_data": [],
             }
             for kind, target in spec.targets():
                 value = obs.get(f"{kind}_s")
-                breaching = value is not None and value > target
+                no_data = value is None
+                breaching = (not no_data) and value > target
                 key = (tenant, kind)
                 with self._lock:
-                    if breaching:
+                    if no_data:
+                        # hold the streak: no observation is neither a
+                        # breach nor a recovery.
+                        consec = self._consec.get(key, 0)
+                    elif breaching:
                         self._consec[key] = self._consec.get(key, 0) + 1
+                        consec = self._consec[key]
                     else:
-                        self._consec[key] = 0
-                    consec = self._consec[key]
+                        self._consec[key] = consec = 0
                     was_confirmed = key in self._confirmed
                     now_confirmed = consec >= self.windows
                     if now_confirmed:
                         self._confirmed.add(key)
-                    elif was_confirmed and not breaching:
+                    elif was_confirmed and not breaching and not no_data:
                         self._confirmed.discard(key)
                 entry["targets"][kind] = target
                 entry["windows"][kind] = consec
+                if no_data:
+                    entry["no_data"].append(kind)
                 if breaching:
                     metrics.inc_counter("slo.breach_windows")
                 metrics.set_gauge(
                     "slo.breached", 1.0 if now_confirmed else 0.0,
+                    {"tenant": tenant, "kind": kind},
+                )
+                metrics.set_gauge(
+                    "slo.no_data", 1.0 if no_data else 0.0,
                     {"tenant": tenant, "kind": kind},
                 )
                 if now_confirmed and not was_confirmed:
@@ -243,18 +261,24 @@ class SLOWatchdog:
                         "target %.4fs for %d consecutive windows",
                         tenant, kind, value, target, consec,
                     )
-                elif was_confirmed and not breaching:
+                elif was_confirmed and not breaching and not no_data:
                     metrics.inc_counter("slo.recoveries")
                     events.emit(
                         events.SLO_RECOVERED, tenant=tenant, kind=kind,
                         observed=value, target=target,
                     )
+                    recovered.append({"tenant": tenant, "kind": kind,
+                                      "observed": value,
+                                      "target": target})
                 if now_confirmed:
                     breaches.append({
                         "tenant": tenant, "kind": kind,
                         "observed": value, "target": target,
-                        "ratio": (value / target) if target else None,
+                        "ratio": ((value / target)
+                                  if target and value is not None
+                                  else None),
                         "windows": consec,
+                        "no_data": no_data,
                         "share": obs.get("share"),
                         "usage": obs.get("usage"),
                         "stragglers": obs.get("stragglers", []),
@@ -268,6 +292,7 @@ class SLOWatchdog:
             "hysteresis_windows": self.windows,
             "tenants": tenants_out,
             "breaches": breaches,
+            "recovered": recovered,
         }
 
 
@@ -332,6 +357,18 @@ class SLOController:
             if self.remediator is not None:
                 for breach in status["breaches"]:
                     self.remediator.consider(breach)
+                # Recovery re-arms the ladder: once EVERY kind for a
+                # tenant is green again, reset() walks it back to the
+                # cheapest rung and reverts degraded mode (the knob
+                # flips are a round trip, not a ratchet).  A tenant
+                # with another kind still confirmed keeps its rung.
+                recovered_tenants = {
+                    r["tenant"] for r in status.get("recovered", [])
+                }
+                if recovered_tenants:
+                    still = {t for t, _kind in self.watchdog.confirmed()}
+                    for tenant in sorted(recovered_tenants - still):
+                        self.remediator.reset(tenant)
             with self._lock:
                 self._last_status = status
             return status

@@ -1,0 +1,179 @@
+"""Worker-side consumer of the driver's ``__slo__`` remediation scope.
+
+The elastic driver's remediation actuators (``elastic_driver._build_slo``)
+publish every rung's action on the rendezvous KV store — ``preempt``,
+``degrade``, ``placement`` — but a published action heals nothing until
+a worker enacts it.  This module is that enactment: the worker's
+heartbeat thread (``elastic_worker.WorkerNotificationManager``) polls
+the scope once per beat and applies each new action in-process:
+
+``preempt``
+    gate lower-priority lanes on this worker's in-process exchange
+    service (:meth:`~horovod_tpu.svc.arbiter.Arbiter.request_preempt`)
+    — the same call the driver makes against its own service, now on
+    every rank that actually dispatches exchanges;
+``degrade``
+    apply the published knob changes (``HVD_TPU_SVC_STALENESS`` bump,
+    ``HVD_TPU_TOPO_LOWER=flat``) to this process's environment — the
+    staleness/lowering knobs are read live per window/emission, so the
+    flip takes effect at the next exchange.  A revert (published by
+    :meth:`~horovod_tpu.elastic.remediate.Remediator.reset` on SLO
+    recovery) rides the same channel with the restored values; ``null``
+    means unset;
+``placement``
+    enact the new tenant→slice placement through the arbiter's live
+    weight knob (``HVD_TPU_SVC_TENANT_WEIGHTS`` — DRR deficits refill
+    by ``quantum × weight``, so rail shares shift to the new placement
+    at the next scheduling cycle) and hand the placement to the
+    notification manager's registered states
+    (``on_placement_updated``), so a state that shards per tenant can
+    reshard at its next commit boundary.
+
+Every applied action is acknowledged back on the KV store
+(``__slo__/ack_<action>_<seq>_rank_<rank>``); the driver folds the ack
+counts into ``GET /slo`` so the remediation history reports what
+workers *enacted*, not just what the driver published.  Actions are
+deduplicated on payload bytes — a heartbeat re-reading the same
+publication is a no-op — and a failure applying one action never
+reaches the heartbeat loop.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, Optional
+
+from .. import metrics
+from ..utils.logging import get_logger
+
+SCOPE = "__slo__"
+ACTIONS = ("preempt", "degrade", "placement")
+
+
+def ack_key(action: str, seq: Any, rank: int) -> str:
+    """The KV key one rank acknowledges one published action under."""
+    return f"ack_{action}_{seq}_rank_{rank}"
+
+
+def weights_spec(placement: Dict[str, Any]) -> str:
+    """Render a tenant→slice placement as the
+    ``HVD_TPU_SVC_TENANT_WEIGHTS`` syntax (slice counts are the DRR
+    weights: a tenant's rail share is its slice share)."""
+    return ",".join(
+        f"{t}:{int(n)}" for t, n in sorted(placement.items())
+        if isinstance(n, (int, float)) and n > 0
+    )
+
+
+def apply_env_changes(changes: Dict[str, Optional[str]]) -> None:
+    """Apply a published knob-change dict to this process: full env
+    names mapped to their new value, ``None`` = unset (the revert
+    path's way of restoring a knob that was never set)."""
+    for name, value in changes.items():
+        if value is None:
+            os.environ.pop(name, None)
+        else:
+            os.environ[name] = str(value)
+
+
+class SLOActionConsumer:
+    """Polls ``__slo__`` and enacts each new action in this process.
+
+    ``rank_fn`` returns the worker's *current* rank (it changes across
+    an in-process remesh); ``on_placement`` receives every newly
+    published placement dict (the notification manager fans it out to
+    registered states)."""
+
+    def __init__(self, rank_fn: Callable[[], int],
+                 on_placement: Optional[Callable[[Dict[str, int]], None]]
+                 = None):
+        self._rank_fn = rank_fn
+        self._on_placement = on_placement
+        self._seen: Dict[str, bytes] = {}
+
+    # ------------------------------------------------------------ poll
+    def poll(self, client: Any) -> int:
+        """One pass over the scope; returns how many new actions were
+        applied.  Never raises — heartbeats must survive any KV or
+        enactment failure."""
+        applied = 0
+        for action in ACTIONS:
+            try:
+                raw = client.get(SCOPE, action, timeout_ms=0)
+            except Exception:
+                continue
+            if raw is None or self._seen.get(action) == raw:
+                continue
+            try:
+                payload = json.loads(raw.decode())
+            except Exception:
+                self._seen[action] = raw  # malformed: never retry it
+                continue
+            ok = False
+            try:
+                self._apply(action, payload)
+                ok = True
+                applied += 1
+                metrics.inc_counter(f"slo.worker.{action}")
+            except Exception as e:
+                get_logger().warning(
+                    "SLO action %s failed to apply on rank %s: %s",
+                    action, self._rank_fn(), e,
+                )
+            # consumed either way: a failing action must not be
+            # re-attempted every heartbeat (the driver's retry policy
+            # owns republication), but only a *successful* apply acks.
+            self._seen[action] = raw
+            if ok:
+                self._ack(client, action, payload)
+        return applied
+
+    # ----------------------------------------------------------- apply
+    def _apply(self, action: str, payload: Dict[str, Any]) -> None:
+        if action == "preempt":
+            self._apply_preempt(payload)
+        elif action == "degrade":
+            apply_env_changes(payload.get("changes") or {})
+            get_logger().info(
+                "SLO degrade %s applied on rank %s: %s",
+                "revert" if payload.get("revert") else "action",
+                self._rank_fn(), payload.get("changes"),
+            )
+        elif action == "placement":
+            self._apply_placement(payload)
+
+    def _apply_preempt(self, payload: Dict[str, Any]) -> None:
+        from ..svc import service as service_mod
+
+        tenant = payload.get("tenant")
+        if not tenant:
+            return
+        svc = service_mod.get_service_or_none()
+        if svc is not None:
+            svc.arbiter.request_preempt(tenant)
+
+    def _apply_placement(self, payload: Dict[str, Any]) -> None:
+        placement = payload.get("placement") or {}
+        spec = weights_spec(placement)
+        if spec:
+            os.environ["HVD_TPU_SVC_TENANT_WEIGHTS"] = spec
+        if self._on_placement is not None:
+            self._on_placement(dict(placement))
+        get_logger().info(
+            "SLO placement %s enacted on rank %s: %s",
+            "rollback" if payload.get("rollback") else "handoff",
+            self._rank_fn(), placement,
+        )
+
+    # ------------------------------------------------------------- ack
+    def _ack(self, client: Any, action: str,
+             payload: Dict[str, Any]) -> None:
+        seq = payload.get("seq")
+        if seq is None:
+            return
+        try:
+            client.put(SCOPE, ack_key(action, seq, self._rank_fn()),
+                       b"1")
+        except Exception:
+            pass  # the ack is telemetry; losing one is not a failure

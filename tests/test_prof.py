@@ -134,6 +134,39 @@ class TestIntrospection:
         assert metrics.get_counter("prof.fallbacks") >= 1
         assert metrics.get_counter("prof.compiles") == 0
 
+    def test_calltime_failure_falls_back(self):
+        # A Compiled whose lowering succeeded but whose *call* blows up
+        # (the AOT-vs-jit gap: layout/sharding drift the signature key
+        # cannot see) must demote to the raw fn, not raise.
+        calls = []
+
+        class Boom:
+            def cost_analysis(self):
+                return [{"flops": 1.0}]
+
+            def memory_analysis(self):
+                return None
+
+            def __call__(self, *args):
+                raise RuntimeError("layout mismatch")
+
+        class FakeJit:
+            def lower(self, *args):
+                return self
+
+            def compile(self):
+                return Boom()
+
+            def __call__(self, x):
+                calls.append(x)
+                return x + 1
+
+        ex = introspect.wrap(FakeJit(), key="intro_e", kind="step")
+        assert ex(1) == 2 and ex(2) == 3  # results survive the fallback
+        assert calls == [1, 2]  # raw fn served both calls
+        assert introspect.get("intro_e")["fallback"] is True
+        assert metrics.get_counter("prof.fallbacks") >= 1
+
     def test_off_returns_fn_unwrapped(self):
         prof.set_enabled_override(False)
         f = jax.jit(lambda x: x)
@@ -235,6 +268,30 @@ class TestMFU:
         mfu.on_step(root, hostgap.attribute(root))
         assert metrics.get_gauge("prof.mfu", {"workload": "mfu_c"}) == 1.0
 
+    def test_peak_resolves_off_step_path(self, monkeypatch):
+        # No cached peak yet: the step hook must skip MFU and kick the
+        # (potentially benchmark-running) resolution onto a background
+        # thread, then price normally once the denominator lands.
+        monkeypatch.setattr(peak, "measured_peak_tflops", lambda: 1.0)
+        monkeypatch.setattr(
+            peak, "chip_peak_tflops", lambda device: None)
+        peak.reset()
+        flops = self._introspected("mfu_async")
+        assert flops and flops > 0
+        root = _step_span(2.0, [
+            _span("e", "exec", 0.0, 0.5, program="mfu_async")])
+        stats = hostgap.attribute(root)
+        mfu.on_step(root, stats)  # peak unknown: skipped, kicked async
+        assert metrics.get_gauge(
+            "prof.mfu", {"workload": "mfu_async"}) is None
+        thread = peak._measure_thread
+        assert thread is not None
+        thread.join(10)
+        assert peak.cached_peak() == (1.0, "measured")
+        mfu.on_step(root, stats)
+        assert metrics.get_gauge("prof.mfu", {"workload": "mfu_async"}) \
+            == pytest.approx(min(flops / (2.0 * 1e12), 1.0))
+
     def test_untraced_step_publishes_nothing(self):
         root = _step_span(0.5)  # no exec spans -> no FLOPs known
         mfu.on_step(root, hostgap.attribute(root))
@@ -320,8 +377,10 @@ class TestBaselineSentinel:
         sent = baseline.Sentinel(store)
         baseline.set_sentinel(sent)
         hostgap.on_step(_step_span(0.1))
+        baseline.drain_async()
         assert sent.last() is None  # step 1: below cadence
         hostgap.on_step(_step_span(0.1))
+        baseline.drain_async()  # check runs off the step path
         assert sent.last() is not None  # step 2: sentinel ran
         assert sent.last()["verdict"] == "baseline_created"
 

@@ -30,6 +30,7 @@ import json
 import time
 import urllib.error
 import urllib.request
+from types import SimpleNamespace
 
 import numpy as np
 import pytest
@@ -208,6 +209,43 @@ class TestWatchdogHysteresis:
                              windows=1)
         assert wd.evaluate(self._breaching())["breaches"] == []
 
+    def test_no_data_is_not_recovery(self, event_log):
+        wd = slo.SLOWatchdog(slo.parse_slo_spec("jobA:step=0.01"),
+                             windows=1)
+        assert wd.evaluate(self._breaching())["breaches"]
+        # ranks stop reporting (workers died, histograms gone): the
+        # window that cannot see the tenant must hold the breach, not
+        # declare it recovered with observed=None.
+        status = wd.evaluate({})
+        assert [b["tenant"] for b in status["breaches"]] == ["jobA"]
+        assert status["breaches"][0]["observed"] is None
+        assert status["breaches"][0]["no_data"] is True
+        assert status["recovered"] == []
+        assert status["tenants"]["jobA"]["no_data"] == ["step"]
+        assert metrics.get_counter("slo.recoveries") in (None, 0)
+        assert _named(event_log, events.SLO_RECOVERED) == []
+        assert metrics.get_gauge(
+            "slo.no_data", {"tenant": "jobA", "kind": "step"}) == 1.0
+        # data returns green: a genuine recovery, this time with a value
+        status = wd.evaluate(self._green())
+        assert status["breaches"] == []
+        assert [r["tenant"] for r in status["recovered"]] == ["jobA"]
+        recs = _named(event_log, events.SLO_RECOVERED)
+        assert recs and recs[0]["observed"] is not None
+        assert metrics.get_gauge(
+            "slo.no_data", {"tenant": "jobA", "kind": "step"}) == 0.0
+
+    def test_no_data_holds_streak_without_advancing(self):
+        wd = slo.SLOWatchdog(slo.parse_slo_spec("jobA:step=0.01"),
+                             windows=2)
+        assert wd.evaluate(self._breaching())["breaches"] == []
+        # a blind window neither breaks the streak nor advances it
+        assert wd.evaluate({})["breaches"] == []
+        assert wd.evaluate({})["breaches"] == []
+        status = wd.evaluate(self._breaching())
+        assert [b["tenant"] for b in status["breaches"]] == ["jobA"]
+        assert status["breaches"][0]["windows"] == 2
+
 
 # ------------------------------------------------------------ ladder
 
@@ -295,6 +333,34 @@ class TestEscalationLadder:
 
         assert os.environ["HVD_TPU_SVC_STALENESS"] == "2"
         assert os.environ["HVD_TPU_TOPO_LOWER"] == "flat"
+
+    def test_reset_reverts_degrade_knobs(self, monkeypatch, event_log):
+        import os
+
+        monkeypatch.setenv("HVD_TPU_SVC_STALENESS", "1")
+        monkeypatch.delenv("HVD_TPU_TOPO_LOWER", raising=False)
+        published = []
+        r = Remediator(
+            actuators={"undegrade":
+                       lambda t, restored: published.append((t, restored))},
+            cooldown_s_=0.0, retry_attempts=1, sleep=lambda s: None)
+        r.remediate(_breach(), "degrade")
+        r.remediate(_breach(), "degrade")  # second bump: 1 -> 2 -> 3
+        assert os.environ["HVD_TPU_SVC_STALENESS"] == "3"
+        assert os.environ["HVD_TPU_TOPO_LOWER"] == "flat"
+        r.reset("jobA")
+        # a breach/recover cycle is a round trip, not a ratchet: the
+        # ORIGINAL values return, not the first bump's.
+        assert os.environ["HVD_TPU_SVC_STALENESS"] == "1"
+        assert "HVD_TPU_TOPO_LOWER" not in os.environ
+        assert published == [("jobA", {"HVD_TPU_SVC_STALENESS": "1",
+                                       "HVD_TPU_TOPO_LOWER": None})]
+        assert metrics.get_counter("slo.degrade_reverts") == 1
+        reverts = _named(event_log, events.REMEDIATE_REVERT)
+        assert reverts and reverts[0]["tenant"] == "jobA"
+        # re-arming twice is idempotent: nothing left to revert
+        r.reset("jobA")
+        assert metrics.get_counter("slo.degrade_reverts") == 1
 
     def test_plan_handoff_validates_before_mutation(self):
         with pytest.raises(RemediationError):
@@ -483,6 +549,35 @@ class TestController:
         assert acted == ["jobA", "jobA"]
         assert metrics.get_counter("slo.windows") == 2
 
+    def test_recovery_rearms_the_ladder(self):
+        resets = []
+
+        class FakeRemediator:
+            def consider(self, breach):
+                pass
+
+            def reset(self, tenant):
+                resets.append(tenant)
+
+            def history(self):
+                return []
+
+            def placement(self):
+                return {}
+
+        wd = slo.SLOWatchdog(slo.parse_slo_spec("jobA:step=0.01"),
+                             windows=1)
+        c = slo.SLOController(wd, remediator=FakeRemediator(),
+                              check_interval_s_=0.0)
+        breaching = {0: rank_snapshot(tenant_ms={"jobA": 50.0})}
+        green = {0: rank_snapshot(tenant_ms={"jobA": 0.5})}
+        c.maybe_tick(lambda: breaching, now=0.0)
+        assert resets == []  # still breached: the rung sticks
+        c.maybe_tick(lambda: {}, now=1.0)
+        assert resets == []  # blind window: no phantom recovery
+        c.maybe_tick(lambda: green, now=2.0)
+        assert resets == ["jobA"]  # real green data re-arms
+
     def test_tick_never_raises(self):
         wd = slo.SLOWatchdog(slo.parse_slo_spec("j:step=0.1"))
         c = slo.SLOController(wd, check_interval_s_=0.0)
@@ -530,6 +625,111 @@ class TestController:
             assert e.value.code == 404
         finally:
             server.stop()
+
+
+# ------------------------------------------- worker-side enactment
+
+class FakeKV:
+    """Dict-backed stand-in for the rendezvous KV client (the scope/key
+    get-put surface the consumer and the driver's actuators share)."""
+
+    def __init__(self):
+        self.data = {}
+
+    def put(self, scope, key, blob):
+        self.data[(scope, key)] = blob
+
+    def get(self, scope, key, timeout_ms=0):
+        return self.data.get((scope, key))
+
+
+class TestWorkerSLOConsumer:
+    def _put(self, kv, action, payload):
+        kv.put("__slo__", action, json.dumps(payload).encode())
+
+    def test_degrade_and_placement_enacted_once_and_acked(self):
+        import os
+
+        from horovod_tpu.runner import slo_consumer
+
+        kv = FakeKV()
+        placements = []
+        consumer = slo_consumer.SLOActionConsumer(
+            rank_fn=lambda: 2, on_placement=placements.append)
+        saved = {k: os.environ.get(k) for k in
+                 ("HVD_TPU_SVC_STALENESS", "HVD_TPU_SVC_TENANT_WEIGHTS")}
+        try:
+            os.environ.pop("HVD_TPU_SVC_STALENESS", None)
+            self._put(kv, "degrade", {
+                "seq": 1, "tenant": "jobA",
+                "changes": {"HVD_TPU_SVC_STALENESS": "2"}})
+            self._put(kv, "placement", {
+                "seq": 2, "tenant": "jobA",
+                "placement": {"jobA": 2, "jobB": 2}})
+            assert consumer.poll(kv) == 2
+            assert os.environ["HVD_TPU_SVC_STALENESS"] == "2"
+            # slice counts became live DRR weights for the arbiter
+            assert os.environ["HVD_TPU_SVC_TENANT_WEIGHTS"] == \
+                "jobA:2,jobB:2"
+            assert placements == [{"jobA": 2, "jobB": 2}]
+            assert kv.get("__slo__", "ack_degrade_1_rank_2") == b"1"
+            assert kv.get("__slo__", "ack_placement_2_rank_2") == b"1"
+            assert metrics.get_counter("slo.worker.degrade") == 1
+            # a heartbeat re-reading the same publication is a no-op
+            assert consumer.poll(kv) == 0
+            # the revert rides the same channel: null unsets the knob
+            self._put(kv, "degrade", {
+                "seq": 3, "tenant": "jobA", "revert": True,
+                "changes": {"HVD_TPU_SVC_STALENESS": None}})
+            assert consumer.poll(kv) == 1
+            assert "HVD_TPU_SVC_STALENESS" not in os.environ
+            assert kv.get("__slo__", "ack_degrade_3_rank_2") == b"1"
+        finally:
+            for k, v in saved.items():
+                if v is None:
+                    os.environ.pop(k, None)
+                else:
+                    os.environ[k] = v
+
+    def test_preempt_reaches_inprocess_arbiter(self, monkeypatch):
+        from horovod_tpu.runner import slo_consumer
+        from horovod_tpu.svc import service as service_mod
+
+        preempted = []
+        stub = SimpleNamespace(arbiter=SimpleNamespace(
+            request_preempt=preempted.append))
+        monkeypatch.setattr(service_mod, "get_service_or_none",
+                            lambda: stub)
+        kv = FakeKV()
+        consumer = slo_consumer.SLOActionConsumer(rank_fn=lambda: 0)
+        self._put(kv, "preempt", {"seq": 5, "tenant": "jobA"})
+        assert consumer.poll(kv) == 1
+        assert preempted == ["jobA"]
+        assert kv.get("__slo__", "ack_preempt_5_rank_0") == b"1"
+
+    def test_malformed_and_failing_actions_never_loop(self, monkeypatch):
+        from horovod_tpu.runner import slo_consumer
+
+        kv = FakeKV()
+        consumer = slo_consumer.SLOActionConsumer(rank_fn=lambda: 0)
+        kv.put("__slo__", "degrade", b"not json")
+        assert consumer.poll(kv) == 0
+        assert consumer.poll(kv) == 0  # malformed: consumed, not retried
+        # an action that fails to apply is consumed but never acked
+        monkeypatch.setattr(
+            consumer, "_apply",
+            lambda action, payload: (_ for _ in ()).throw(
+                RuntimeError("boom")))
+        self._put(kv, "placement", {"seq": 7, "placement": {"a": 1}})
+        assert consumer.poll(kv) == 0
+        assert kv.get("__slo__", "ack_placement_7_rank_0") is None
+        assert consumer.poll(kv) == 0  # consumed despite the failure
+
+    def test_weights_spec_drops_nonpositive(self):
+        from horovod_tpu.runner import slo_consumer
+
+        assert slo_consumer.weights_spec(
+            {"b": 1, "a": 2, "gone": 0}) == "a:2,b:1"
 
 
 # ------------------------------------------- two-tenant acceptance
