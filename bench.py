@@ -445,6 +445,7 @@ def _device_free_records(result: dict, deadline_s: float,
     _maybe_railpipe(result, deadline_s, t_start)
     _maybe_svc_fusion(result, deadline_s, t_start)
     _maybe_tenant(result, deadline_s, t_start)
+    _maybe_serve(result, deadline_s, t_start)
 
 
 def _maybe_svc_fusion(result: dict, deadline_s: float,
@@ -520,6 +521,45 @@ def _maybe_tenant(result: dict, deadline_s: float,
         )
     except Exception as e:
         result["svc_tenant_interference"] = {
+            "error": f"{type(e).__name__}: {e}"
+        }
+
+
+def _maybe_serve(result: dict, deadline_s: float,
+                 t_start: float) -> None:
+    """Append the ``serve_plane`` record (HVD_BENCH_SERVE=0 skips):
+    the inference serving plane's two measured claims via
+    ``tools/topo_bench.py --serve`` in a scrubbed 8-device CPU
+    subprocess (docs/serving.md).  (A) continuous batching vs
+    sequential serving of the same 16-request synthetic trace —
+    bitwise-identical tokens, continuous tokens/sec must win; (B)
+    decode-tenant exchange p99 under prefill-tenant DCN bulk, FIFO vs
+    arbiter — arbiter p99 must hold at or under 0.6x FIFO."""
+    if os.environ.get("HVD_BENCH_SERVE", "1") == "0":
+        return
+    if deadline_s - (time.monotonic() - t_start) < 75:
+        result["serve_plane"] = {
+            "error": "skipped: deadline too close"
+        }
+        return
+    try:
+        import subprocess as sp
+
+        repo = os.path.dirname(os.path.abspath(__file__))
+        env = _scrubbed_cpu_env()
+        env.setdefault("HVD_TPU_TOPO", "2x4")
+        out = sp.run(
+            [sys.executable, os.path.join(repo, "tools", "topo_bench.py"),
+             "--serve"],
+            capture_output=True, text=True, timeout=300, env=env, cwd=repo,
+        )
+        line = (out.stdout or "").strip().splitlines()
+        result["serve_plane"] = (
+            json.loads(line[-1]) if out.returncode == 0 and line
+            else {"error": f"rc={out.returncode}: {(out.stderr or '')[-300:]}"}
+        )
+    except Exception as e:
+        result["serve_plane"] = {
             "error": f"{type(e).__name__}: {e}"
         }
 

@@ -59,6 +59,7 @@ if not hasattr(_jax.lax, "pcast"):
 from . import runtime as _runtime
 from .exceptions import (  # noqa: F401
     CheckpointCorruptionError,
+    CheckpointMissingKeysError,
     FaultInjected,
     HorovodInternalError,
     HorovodTpuError,
@@ -297,7 +298,9 @@ from . import checkpoint  # noqa: F401,E402
 from .checkpoint import (  # noqa: F401,E402
     latest_good_step,
     load_checkpoint,
+    load_params,
     restore_or_init,
     save_checkpoint,
     verify_checkpoint,
 )
+from . import serve  # noqa: F401,E402

@@ -59,11 +59,23 @@ class _LoadError:
     """Picklable error sentinel broadcast to all ranks so load failures
     raise everywhere instead of deadlocking non-root ranks."""
 
-    def __init__(self, message: str, corrupt: bool = False):
+    def __init__(self, message: str, corrupt: bool = False,
+                 missing: Optional[List[str]] = None,
+                 available: Optional[List[str]] = None,
+                 path: str = ""):
         self.message = message
         self.corrupt = corrupt
+        self.missing = missing
+        self.available = available
+        self.path = path
 
     def raise_(self) -> None:
+        if self.missing is not None:
+            from .exceptions import CheckpointMissingKeysError
+
+            raise CheckpointMissingKeysError(
+                self.missing, self.available or (), self.path
+            )
         if self.corrupt:
             raise CheckpointCorruptionError(self.message)
         raise RuntimeError(self.message)
@@ -237,13 +249,19 @@ def load_checkpoint(
     path: str,
     step: Optional[int] = None,
     broadcast: bool = True,
+    _select=None,
 ) -> Optional[Dict[str, Any]]:
     """Load a checkpoint; returns None if absent.  With ``broadcast``
     (default), only rank 0 touches the filesystem and its bytes are
     broadcast, so all ranks restore identically even when local files
     are divergent, partially written, or missing on non-root ranks.
     Raises :class:`CheckpointCorruptionError` (on every rank) when the
-    checkpoint exists but fails integrity verification."""
+    checkpoint exists but fails integrity verification.
+
+    ``_select`` (internal, see :func:`load_params`) post-processes the
+    state on the reading rank *before* the broadcast — either a reduced
+    state dict or a :class:`_LoadError` — so non-root ranks only ever
+    receive (and materialize) the selected subset."""
     import time
 
     t0 = time.perf_counter()
@@ -280,6 +298,9 @@ def load_checkpoint(
                 state = ocp.PyTreeCheckpointer().restore(orbax_dir)
         elif os.path.exists(pkl):
             state = _read_pickle_verified(target)
+        if _select is not None and state is not None \
+                and not isinstance(state, _LoadError):
+            state = _select(state)
     if broadcast and multi:
         state = functions.broadcast_object(state, root_rank=0)
     if isinstance(state, _LoadError):
@@ -291,6 +312,67 @@ def load_checkpoint(
             "checkpoint.restore_seconds", time.perf_counter() - t0
         )
     return state
+
+
+PARAMS_KEY = "params"
+
+
+def load_params(
+    path: str,
+    step: Optional[int] = None,
+    broadcast: bool = True,
+    keys: tuple = (PARAMS_KEY,),
+) -> Optional[Dict[str, Any]]:
+    """Params-only restore for serving replicas (``serve/replica.py``).
+
+    A training checkpoint holds the full resumable state — params plus
+    optimizer moments, which for Adam-family optimizers are 2x the
+    model again.  An inference replica must never materialize that
+    optimizer state: the requested ``keys`` (default ``("params",)``)
+    are selected on the *reading* rank before the restore broadcast, so
+    the dropped entries neither cross the wire nor land on any other
+    rank, and the returned dict holds exactly ``keys``.
+
+    Returns None when no checkpoint exists.  A checkpoint that exists
+    but lacks a requested key raises
+    :class:`~horovod_tpu.exceptions.CheckpointMissingKeysError` on
+    every rank — a structured error naming the absent keys (and what
+    the checkpoint does hold) instead of a raw ``KeyError``."""
+    from . import metrics
+
+    if step is None and _all_steps(path):
+        # A training run's root directory: serve from the newest step
+        # that passes verification (corrupted newer steps are skipped,
+        # same policy as restore_or_init).
+        step = latest_good_step(path)
+    target = path if step is None else os.path.join(path, f"step_{step}")
+    want = tuple(keys)
+
+    def select(state):
+        if not isinstance(state, dict):
+            return _LoadError(
+                f"checkpoint at {target} holds a "
+                f"{type(state).__name__}, not a state dict",
+            )
+        missing = [k for k in want if k not in state]
+        if missing:
+            return _LoadError(
+                "missing keys", missing=sorted(missing),
+                available=sorted(map(str, state)), path=target,
+            )
+        dropped = sorted(k for k in state if k not in want)
+        if dropped:
+            log.info(
+                "params-only restore from %s: dropped %s before "
+                "broadcast", target, dropped,
+            )
+        return {k: state[k] for k in want}
+
+    out = load_checkpoint(path, step=step, broadcast=broadcast,
+                          _select=select)
+    if out is not None:
+        metrics.inc_counter("checkpoint.params_only_restore")
+    return out
 
 
 def _all_steps(path: str) -> List[int]:

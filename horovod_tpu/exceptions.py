@@ -91,6 +91,25 @@ class CheckpointCorruptionError(HorovodTpuError):
     this and falls back to the previous good step."""
 
 
+class CheckpointMissingKeysError(HorovodTpuError):
+    """A params-only restore (``checkpoint.load_params``) asked for
+    state keys the checkpoint does not hold.  Carries the structured
+    identity of the failure instead of a raw ``KeyError``: ``missing``
+    names every absent key and ``available`` what the checkpoint
+    actually stores, so a serving replica pointed at the wrong
+    checkpoint says *which* keys are wrong, on every rank."""
+
+    def __init__(self, missing, available, path: str = ""):
+        self.missing = tuple(sorted(missing))
+        self.available = tuple(sorted(available))
+        self.path = path
+        super().__init__(
+            f"checkpoint{f' at {path}' if path else ''} is missing "
+            f"key(s) {list(self.missing)}; it holds "
+            f"{list(self.available)}"
+        )
+
+
 class QuantizedWireError(HorovodTpuError, ValueError):
     """The int8 quantized-wire path cannot serve this reduction
     (unsupported op, non-global process set, or IndexedSlices

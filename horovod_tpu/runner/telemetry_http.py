@@ -42,6 +42,11 @@ gives the driver process a scrapeable surface:
   staged device-probe doctor's verdict (``tools/probe_doctor.py``)
   under a ``probe`` field, so a dead device layer is visible from the
   driver without grepping bench records.
+* ``GET /serve`` — the inference serving plane (``serve/``):
+  requests/sec and tokens/sec per replica, queue depth, prefill /
+  decode / TTFT p50/p99, KV-pool occupancy, per-replica MFU, and the
+  latest serve bench record — aggregated from the same worker KV
+  pushes (docs/serving.md).
 * ``GET/POST /schedules`` — the persistent autotuning database
   (``sched/store.py``): GET returns every stored (bucket_bytes, wire,
   lowering) winner (``?key=<hex>`` filters to one), POST merges a
@@ -123,11 +128,15 @@ class _Handler(BaseHTTPRequestHandler):
                 self._send(200, json.dumps(
                     srv.render_prof(), default=str
                 ).encode(), "application/json")
+            elif route == "/serve":
+                self._send(200, json.dumps(
+                    srv.render_serve(), default=str
+                ).encode(), "application/json")
             else:
                 self._send(
                     404,
                     b"not found: try /metrics, /health, /schedules, "
-                    b"/trace, /tenants, /slo or /prof\n",
+                    b"/trace, /tenants, /slo, /prof or /serve\n",
                     "text/plain")
         except Exception as e:  # a scrape must never kill the server
             self._send(500, f"telemetry error: {e}\n".encode(),
@@ -204,6 +213,7 @@ class TelemetryServer:
         slo_fn: Optional[Callable[[], Dict[str, Any]]] = None,
         prof_fn: Optional[Callable[[], Dict[str, Any]]] = None,
         probe_fn: Optional[Callable[[], Dict[str, Any]]] = None,
+        serve_fn: Optional[Callable[[], Dict[str, Any]]] = None,
     ):
         self.health_fn = health_fn
         self.workers_fn = workers_fn
@@ -213,6 +223,7 @@ class TelemetryServer:
         self.slo_fn = slo_fn
         self.prof_fn = prof_fn
         self.probe_fn = probe_fn
+        self.serve_fn = serve_fn
         self._server = _QuietHTTPServer((bind_host, port), _Handler)
         self._server.telemetry = self  # type: ignore[attr-defined]
         self.port = self._server.server_address[1]
@@ -304,6 +315,23 @@ class TelemetryServer:
             if per_rank:
                 return prof.prof_payload(per_rank)
         return prof.prof_payload()
+
+    def render_serve(self) -> Dict[str, Any]:
+        """``GET /serve`` payload: an explicit ``serve_fn`` (a serving
+        deployment installs one with its own context), else the
+        serving-plane aggregation (``serve/frontend.serve_payload``) —
+        over worker snapshots when reachable, the local registry
+        otherwise.  Always a dict: a pod with no serving replicas
+        still answers 200 with (empty) structure."""
+        if self.serve_fn is not None:
+            return self.serve_fn()
+        from ..serve.frontend import serve_payload
+
+        if self.workers_fn is not None:
+            per_rank = {rank: snap for rank, snap in self.workers_fn()}
+            if per_rank:
+                return serve_payload(per_rank)
+        return serve_payload()
 
     def render_slo(self) -> Optional[Dict[str, Any]]:
         """``GET /slo`` payload: whatever ``slo_fn`` renders (the

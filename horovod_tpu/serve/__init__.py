@@ -1,0 +1,40 @@
+"""Elastic inference serving plane: checkpoint → replica → traffic.
+
+Horovod (arXiv:1802.05799) is a training system; its coordinator,
+fusion buffer, and background loop all exist to overlap *gradient*
+exchange with backprop.  This package points the same substrate at the
+other half of the model lifecycle — serving — without adding a second
+exchange stack:
+
+* :mod:`serve.replica` — load a params-only checkpoint
+  (``checkpoint.load_params``), shard it tensor-parallel, and route
+  every TP collective through the XIR exchange service, so lowering,
+  the quantized wire, fusion, and the tune DB apply to inference hops
+  unchanged.  Replica N warm-starts from replica 1's tune-DB entry,
+  keyed by model signature.
+* :mod:`serve.kvcache` — a paged KV-style context pool whose fused
+  TP payloads reuse the ``svc/fuse`` packing classes (same alignment,
+  same quantization-block rules as training's fusion buffers).
+* :mod:`serve.batcher` — continuous batching.  Prefill and decode run
+  as two *tenants* of the exchange arbiter
+  (``serve:<replica>:<phase>`` tags riding the TraceContext tenant
+  slot), so decode's small ICI-local exchanges are DRR-isolated from
+  prefill's DCN bulk exactly like two training jobs; request
+  admission reuses :meth:`svc.arbiter.Arbiter.admit` backpressure
+  with its own ``HVD_TPU_SERVE_INFLIGHT`` cap.
+* :mod:`serve.frontend` — HTTP ingest plus the ``GET /serve`` stats
+  payload (requests/sec, tokens/sec, queue depth, prefill/decode
+  p50/p99, per-replica MFU) served by ``runner/telemetry_http.py``.
+* :mod:`serve.loadgen` — a synthetic heavy-traffic generator so the
+  interference and throughput claims are measured, not argued
+  (``tools/topo_bench.py --serve`` + ``tools/tier1_serve_smoke.sh``).
+
+See docs/serving.md.
+"""
+
+from . import batcher, frontend, kvcache, loadgen, replica  # noqa: F401
+from .batcher import ContinuousBatcher, Request  # noqa: F401
+from .frontend import ServeFrontend, serve_payload  # noqa: F401
+from .kvcache import KVCachePool  # noqa: F401
+from .loadgen import LoadGenerator  # noqa: F401
+from .replica import Replica  # noqa: F401

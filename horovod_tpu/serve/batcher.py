@@ -1,0 +1,363 @@
+"""Continuous batching over the exchange arbiter.
+
+The serving loop's defining property is that requests join and leave
+the decode batch *every step* — no epoch barrier, no fixed batch.  The
+scheduling problem that creates (new requests' prefill bulk competing
+with in-flight requests' latency-critical decode) is exactly the
+multi-tenant interference problem the exchange arbiter already solves
+for training jobs, so this batcher doesn't build a scheduler — it
+*tags*:
+
+* Prefill exchanges carry the ``serve:<replica>:prefill`` tenant,
+  decode exchanges ``serve:<replica>:decode`` (minted by
+  :func:`~horovod_tpu.svc.arbiter.serve_tenant`, stamped through the
+  TraceContext tenant slot by :meth:`~horovod_tpu.serve.replica.
+  Replica.exchange`).  The DRR lanes do the isolation; FIFO-vs-arbiter
+  decode p99 is measured by ``tools/topo_bench.py --serve``.
+* Request admission reuses :meth:`~horovod_tpu.svc.arbiter.Arbiter.
+  admit` backpressure verbatim on a private arbiter instance — the
+  ``serve:<replica>:request`` lane bounded by
+  ``HVD_TPU_SERVE_INFLIGHT`` — so a traffic burst *blocks* the
+  frontend instead of growing an unbounded queue, with the same
+  timeout-releases-anyway safety valve the training lanes have.
+
+One background thread runs admit → prefill → decode-step → retire.
+Decode math is per-request (``replica.partial_logits``), so a request
+decoded in a batch of 8 yields bitwise the tokens it would alone —
+:func:`serve_sequential` replays the identical code path one request
+at a time, which is both the throughput baseline and the parity
+oracle.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import threading
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from .. import metrics
+from ..exceptions import HorovodTpuError
+from ..utils import env
+from ..utils.logging import get_logger
+from .kvcache import KVCachePool
+from .replica import Replica
+
+log = get_logger()
+
+DEFAULT_MAX_BATCH = 8
+DEFAULT_INFLIGHT = 64
+
+_rid = itertools.count(1)
+
+
+def max_batch() -> int:
+    """``HVD_TPU_SERVE_BATCH``: decode-batch width cap."""
+    return max(1, env.get_int(env.SERVE_BATCH, DEFAULT_MAX_BATCH))
+
+
+def inflight_cap() -> int:
+    """``HVD_TPU_SERVE_INFLIGHT``: request-level admission cap
+    (0 = unbounded) — the serving twin of
+    ``HVD_TPU_SVC_TENANT_INFLIGHT``."""
+    return max(0, env.get_int(env.SERVE_INFLIGHT, DEFAULT_INFLIGHT))
+
+
+@dataclasses.dataclass
+class Request:
+    """One in-flight generation request.  Carries the three admission
+    fields (``tenant`` / ``admitted`` / ``lane_released``) the arbiter's
+    :meth:`~horovod_tpu.svc.arbiter.Arbiter.release` contract expects,
+    so a request occupies an arbiter lane slot exactly like a
+    submission does."""
+
+    rid: int
+    prompt: List[int]
+    max_new_tokens: int
+    tenant: str = ""
+    admitted: bool = False
+    lane_released: bool = False
+    output: List[int] = dataclasses.field(default_factory=list)
+    error: str = ""
+    arrival: float = 0.0
+    prefilled_at: float = 0.0
+    first_token_at: float = 0.0
+    finished_at: float = 0.0
+    _done: threading.Event = dataclasses.field(
+        default_factory=threading.Event, repr=False, compare=False
+    )
+
+    @property
+    def seq(self) -> int:
+        return self.rid
+
+    def done(self) -> bool:
+        return self._done.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until generation finishes; raises on a request-level
+        error (KV exhaustion), returns the generated token ids."""
+        if not self._done.wait(timeout):
+            raise HorovodTpuError(
+                f"serve request {self.rid} timed out after {timeout}s"
+            )
+        if self.error:
+            raise HorovodTpuError(
+                f"serve request {self.rid} failed: {self.error}"
+            )
+        return list(self.output)
+
+
+class ContinuousBatcher:
+    """Admission-bounded continuous batching for one replica."""
+
+    def __init__(self, replica: Replica, kv: Optional[KVCachePool] = None,
+                 *, batch: Optional[int] = None,
+                 inflight: Optional[int] = None,
+                 start: bool = True):
+        from ..svc import arbiter
+
+        self.replica = replica
+        self.kv = kv or KVCachePool(replica.d_model, wire=replica.wire)
+        self.batch = max_batch() if batch is None else max(1, int(batch))
+        self.inflight = inflight_cap() if inflight is None \
+            else max(0, int(inflight))
+        self.admission = arbiter.Arbiter()
+        self._admit_tenant = arbiter.serve_tenant(replica.name, "request")
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._waiting: List[Request] = []
+        self._active: List[Request] = []
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._started_at = time.monotonic()
+        self._completions: List[tuple] = []  # (t, n_tokens) window
+        self._last_mfu = (time.monotonic(), 0)
+        if start:
+            self.start()
+
+    # ------------------------------------------------------ lifecycle
+
+    def start(self) -> None:
+        if self._thread is not None and self._thread.is_alive():
+            return
+        self._stop.clear()
+        self._thread = threading.Thread(
+            target=self._loop, name=f"serve-batcher-{self.replica.name}",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        self.admission.wake_all(abort=True)
+        with self._cond:
+            self._cond.notify_all()
+        t = self._thread
+        if t is not None and t.is_alive():
+            t.join(timeout)
+        self._thread = None
+
+    # ------------------------------------------------------ admission
+
+    def submit(self, prompt: Sequence[int], max_new_tokens: int = 8,
+               admit_timeout_s: Optional[float] = None) -> Request:
+        """Admit one request.  Blocks while the replica's request lane
+        is at its ``HVD_TPU_SERVE_INFLIGHT`` cap — arbiter backpressure
+        as request-level admission control; an expired wait admits
+        anyway (``svc.tenant.admission_timeouts``), never drops."""
+        req = Request(
+            rid=next(_rid), prompt=[int(t) for t in prompt],
+            max_new_tokens=max(1, int(max_new_tokens)),
+            tenant=self._admit_tenant,
+        )
+        metrics.inc_counter("serve.requests_submitted")
+        self.admission.admit(self._admit_tenant,
+                             timeout_s=admit_timeout_s,
+                             cap=self.inflight)
+        req.admitted = True
+        req.arrival = time.monotonic()
+        with self._cond:
+            self._waiting.append(req)
+            self._cond.notify_all()
+        self._publish_depth()
+        return req
+
+    # ----------------------------------------------------------- loop
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            with self._cond:
+                if not self._waiting and not self._active:
+                    self._cond.wait(0.05)
+                room = self.batch - len(self._active)
+                incoming = [self._waiting.pop(0)
+                            for _ in range(min(room, len(self._waiting)))]
+            if incoming:
+                admitted = self._prefill(incoming)
+                with self._cond:
+                    self._active.extend(admitted)
+            step: List[Request] = list(self._active)
+            if step:
+                self._decode_step(step)
+                self._retire()
+            self._publish_depth()
+            self._publish_rates()
+
+    # -------------------------------------------------------- prefill
+
+    def _prefill(self, batch: List[Request]) -> List[Request]:
+        """Embed each prompt into the KV pool, then ship ONE fused
+        cross-replica sync (``svc/fuse`` packing, DCN bulk, prefill
+        tenant) whose exchanged output — not the local copy — is what
+        decode reads.  A pool-full request goes back to the queue head:
+        backpressure, not failure."""
+        ready: List[Request] = []
+        requeue: List[Request] = []
+        for req in batch:
+            t0 = time.monotonic()
+            embs = self.replica.embed(req.prompt or [0])
+            if not self.kv.extend(req.seq, embs):
+                requeue.append(req)
+                continue
+            metrics.observe("serve.queue_wait_seconds",
+                            max(0.0, t0 - req.arrival))
+            ready.append(req)
+        if requeue:
+            with self._cond:
+                self._waiting[:0] = requeue
+        if ready:
+            t0 = time.monotonic()
+            ids = [r.seq for r in ready]
+            buf, layout = self.kv.fused_payload(ids)
+            out = self.replica.prefill_sync(buf)
+            self.kv.write_back(ids, out, layout)
+            dt = time.monotonic() - t0
+            now = time.monotonic()
+            for req in ready:
+                req.prefilled_at = now
+                metrics.observe("serve.prefill_seconds",
+                                max(0.0, now - req.arrival))
+            metrics.observe("serve.prefill_batch_seconds", dt)
+            metrics.inc_counter("serve.prefills", len(ready))
+        return ready
+
+    # --------------------------------------------------------- decode
+
+    def _decode_step(self, step: List[Request]) -> None:
+        """One continuous-batching decode step: every active request
+        contributes its pooled context, ONE grouped TP all_reduce
+        (decode tenant, ICI lane) completes all their logits, greedy
+        tokens append back into the pool."""
+        t0 = time.monotonic()
+        ctxs = np.stack([self.kv.context(r.seq) for r in step])
+        logits = self.replica.decode_logits(ctxs)
+        toks = np.argmax(logits, axis=-1)
+        now = time.monotonic()
+        for req, tok in zip(step, toks):
+            tok = int(tok)
+            req.output.append(tok)
+            if not req.first_token_at:
+                req.first_token_at = now
+                metrics.observe("serve.ttft_seconds",
+                                max(0.0, now - req.arrival))
+            if len(req.output) < req.max_new_tokens:
+                if not self.kv.append(req.seq,
+                                      self.replica.embed([tok])[0]):
+                    req.error = "kv pool exhausted mid-decode"
+        metrics.observe("serve.decode_seconds", now - t0)
+        metrics.inc_counter("serve.decode_steps")
+        metrics.inc_counter("serve.tokens_generated", len(step))
+
+    def _retire(self) -> None:
+        done = [r for r in self._active
+                if r.error or len(r.output) >= r.max_new_tokens]
+        if not done:
+            return
+        with self._cond:
+            self._active = [r for r in self._active if r not in done]
+        now = time.monotonic()
+        for req in done:
+            req.finished_at = now
+            self.kv.mark_finished(req.seq)
+            self.admission.release(req)
+            metrics.observe("serve.request_seconds",
+                            max(0.0, now - req.arrival))
+            metrics.inc_counter(
+                "serve.requests_failed" if req.error
+                else "serve.requests_completed"
+            )
+            self._completions.append((now, len(req.output)))
+            req._done.set()
+
+    # ------------------------------------------------------- gauges
+
+    def _publish_depth(self) -> None:
+        with self._lock:
+            q, a = len(self._waiting), len(self._active)
+        labels = {"replica": self.replica.name}
+        metrics.set_gauge("serve.queue_depth", float(q), labels)
+        metrics.set_gauge("serve.active_requests", float(a), labels)
+
+    def _publish_rates(self, window_s: float = 5.0) -> None:
+        now = time.monotonic()
+        self._completions = [
+            c for c in self._completions if now - c[0] <= window_s
+        ]
+        span = min(window_s, max(now - self._started_at, 1e-3))
+        labels = {"replica": self.replica.name}
+        metrics.set_gauge("serve.requests_per_s",
+                          len(self._completions) / span, labels)
+        metrics.set_gauge("serve.tokens_per_s",
+                          sum(c[1] for c in self._completions) / span,
+                          labels)
+        # Per-replica MFU: host-FLOP odometer over wall time, through
+        # the prof plane so /serve and /prof agree on the number.
+        t_last, f_last = self._last_mfu
+        if now - t_last >= 1.0:
+            try:
+                from ..prof import mfu
+
+                dflops = self.replica.flops - f_last
+                mfu.publish(f"serve:{self.replica.name}",
+                            dflops / max(now - t_last, 1e-6) / 1e12)
+            except Exception:
+                pass
+            self._last_mfu = (now, self.replica.flops)
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            return {
+                "replica": self.replica.name,
+                "queue_depth": len(self._waiting),
+                "active_requests": len(self._active),
+                "batch": self.batch,
+                "inflight_cap": self.inflight,
+                "kv": self.kv.stats(),
+            }
+
+
+def serve_sequential(replica: Replica, prompts: Sequence[Sequence[int]],
+                     max_new_tokens: int = 8,
+                     kv: Optional[KVCachePool] = None) -> List[List[int]]:
+    """The throughput baseline: each request runs prefill → full decode
+    alone, end-to-end, before the next starts — same code path as the
+    continuous loop (so outputs are bitwise identical), none of the
+    batching.  ``tools/topo_bench.py --serve`` races this against
+    :class:`ContinuousBatcher` for the tokens/sec claim."""
+    b = ContinuousBatcher(replica, kv=kv, batch=1, start=False)
+    outs: List[List[int]] = []
+    for prompt in prompts:
+        req = b.submit(list(prompt), max_new_tokens=max_new_tokens)
+        with b._cond:
+            b._waiting.remove(req)
+        ready = b._prefill([req])
+        while ready and not req.done():
+            b._active = list(ready)
+            b._decode_step(ready)
+            b._retire()
+        outs.append(list(req.output))
+    return outs

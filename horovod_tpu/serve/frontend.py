@@ -1,0 +1,257 @@
+"""HTTP ingest + the ``GET /serve`` stats surface.
+
+Two halves:
+
+* :func:`serve_payload` — the ``GET /serve`` body, rendered from
+  metrics snapshots exactly like ``svc/arbiter.tenants_payload``
+  renders ``/tenants``: requests/sec and tokens/sec per replica, queue
+  depth, prefill/decode/TTFT p50/p99, KV-pool occupancy, per-replica
+  MFU (the ``serve:<replica>`` workloads the batcher publishes through
+  ``prof/mfu``), and the latest serve bench record
+  (:func:`note_bench`) so the measured continuous-vs-sequential and
+  FIFO-vs-arbiter numbers are *served*, not buried in a JSON file.
+  ``runner/telemetry_http.py`` routes ``/serve`` here — driver
+  aggregation when worker snapshots are reachable, the local registry
+  otherwise.
+* :class:`ServeFrontend` — a minimal stdlib HTTP ingest for one
+  batcher: ``POST /generate`` admits a request (arbiter backpressure
+  and all) and returns its tokens; ``GET /serve`` returns the local
+  stats payload.  ``serve/loadgen.py`` drives either this or the
+  batcher directly.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+
+from .. import metrics
+from ..utils.logging import get_logger
+
+log = get_logger()
+
+# Histogram families surfaced as p50/p99 on /serve.
+_HIST_KEYS = (
+    ("prefill", "serve.prefill_seconds"),
+    ("decode", "serve.decode_seconds"),
+    ("ttft", "serve.ttft_seconds"),
+    ("request", "serve.request_seconds"),
+    ("queue_wait", "serve.queue_wait_seconds"),
+    ("decode_exchange", "serve.exchange_seconds.decode"),
+    ("prefill_exchange", "serve.exchange_seconds.prefill"),
+)
+_COUNTER_KEYS = (
+    "serve.requests_submitted", "serve.requests_completed",
+    "serve.requests_failed", "serve.tokens_generated",
+    "serve.prefills", "serve.decode_steps",
+    "serve.tune.db_hit", "serve.tune.db_miss",
+)
+_REPLICA_GAUGES = ("serve.queue_depth", "serve.active_requests",
+                   "serve.requests_per_s", "serve.tokens_per_s")
+
+# Latest bench record (tools/topo_bench.py --serve stores its result
+# here before exiting; the smoke test scrapes it back off /serve).
+_bench_lock = threading.Lock()
+_last_bench: Optional[Dict[str, Any]] = None
+
+
+def note_bench(record: Dict[str, Any]) -> None:
+    """Remember the latest serve bench record for ``GET /serve``."""
+    global _last_bench
+    with _bench_lock:
+        _last_bench = dict(record)
+
+
+def last_bench() -> Optional[Dict[str, Any]]:
+    with _bench_lock:
+        return dict(_last_bench) if _last_bench else None
+
+
+def _rank_view(snap: Dict[str, Any]) -> Dict[str, Any]:
+    """One rank's serve-plane slice of a metrics snapshot."""
+    counters = {
+        k: int(v) for k, v in (snap.get("counters") or {}).items()
+        if k in _COUNTER_KEYS
+    }
+    replicas: Dict[str, Dict[str, float]] = {}
+    kv: Dict[str, float] = {}
+    mfu: Dict[str, float] = {}
+    for g in snap.get("gauges") or ():
+        name = g.get("name")
+        labels = g.get("labels") or {}
+        val = float(g.get("value") or 0.0)
+        if name in _REPLICA_GAUGES and labels.get("replica"):
+            short = name[len("serve."):]
+            replicas.setdefault(labels["replica"], {})[short] = val
+        elif name in ("serve.kv.used_tokens", "serve.kv.capacity"):
+            kv[name[len("serve.kv."):]] = val
+        elif name == "serve.tune.warm_start" and labels.get("replica"):
+            replicas.setdefault(
+                labels["replica"], {})["tune_warm_start"] = val
+        elif name == "prof.mfu" and str(
+                labels.get("workload", "")).startswith("serve:"):
+            mfu[labels["workload"][len("serve:"):]] = val
+    for replica, v in mfu.items():
+        replicas.setdefault(replica, {})["mfu"] = v
+    latency: Dict[str, Dict[str, Any]] = {}
+    hists = snap.get("histograms") or {}
+    for short, name in _HIST_KEYS:
+        h = hists.get(name)
+        if not h or not int(h.get("count", 0)):
+            continue
+        latency[short] = {
+            "p50_s": metrics.hist_quantile(h, 0.5),
+            "p99_s": metrics.hist_quantile(h, 0.99),
+            "count": int(h["count"]),
+        }
+    view: Dict[str, Any] = {}
+    if counters:
+        view["counters"] = counters
+    if replicas:
+        view["replicas"] = replicas
+    if kv:
+        view["kv"] = kv
+    if latency:
+        view["latency"] = latency
+    return view
+
+
+def serve_payload(
+    per_rank: Optional[Dict[int, Dict[str, Any]]] = None,
+) -> Dict[str, Any]:
+    """The ``GET /serve`` body.  ``per_rank`` maps rank → pushed
+    metrics snapshot (the driver's KV collection); None renders the
+    local registry.  Counters and rates sum across ranks, latency
+    quantiles take the worst rank (the serving SLO is a max, not a
+    mean), per-rank views ride underneath."""
+    if per_rank is None:
+        per_rank = {0: metrics.snapshot()}
+    totals: Dict[str, int] = {}
+    replicas: Dict[str, Dict[str, float]] = {}
+    latency: Dict[str, Dict[str, Any]] = {}
+    kv: Dict[str, float] = {}
+    ranks: Dict[str, Any] = {}
+    for rank, snap in sorted(per_rank.items()):
+        view = _rank_view(snap)
+        if view:
+            ranks[str(rank)] = view
+        for k, v in (view.get("counters") or {}).items():
+            totals[k] = totals.get(k, 0) + v
+        for name, vals in (view.get("replicas") or {}).items():
+            agg = replicas.setdefault(name, {})
+            for k, v in vals.items():
+                if k in ("queue_depth", "active_requests",
+                         "requests_per_s", "tokens_per_s"):
+                    agg[k] = agg.get(k, 0.0) + v
+                else:
+                    agg[k] = max(agg.get(k, 0.0), v)
+        for k, v in (view.get("kv") or {}).items():
+            kv[k] = kv.get(k, 0.0) + v
+        for short, q in (view.get("latency") or {}).items():
+            worst = latency.setdefault(short, dict(q))
+            if (q.get("p99_s") or 0.0) >= (worst.get("p99_s") or 0.0):
+                worst.update(q)
+    payload: Dict[str, Any] = {
+        "replicas": replicas,
+        "counters": totals,
+        "latency": latency,
+        "kv": kv,
+        "ranks": ranks,
+    }
+    bench = last_bench()
+    if bench is not None:
+        payload["bench"] = bench
+    return payload
+
+
+# ------------------------------------------------------- HTTP ingest
+
+class _FrontendHandler(BaseHTTPRequestHandler):
+    server_version = "hvd-tpu-serve/1.0"
+
+    def log_message(self, fmt, *args):
+        log.debug("serve http: " + fmt, *args)
+
+    def _send(self, code: int, payload: Dict[str, Any]) -> None:
+        body = json.dumps(payload).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def do_GET(self):  # noqa: N802 (http.server API)
+        fe: "ServeFrontend" = self.server.frontend  # type: ignore[attr-defined]
+        try:
+            route = self.path.split("?")[0]
+            if route == "/serve":
+                self._send(200, serve_payload())
+            elif route == "/health":
+                self._send(200, {"status": "ok",
+                                 **fe.batcher.stats()})
+            else:
+                self._send(404, {"error":
+                                 "not found: try /serve or /health"})
+        except Exception as e:  # a scrape must never kill the server
+            self._send(500, {"error": str(e)})
+
+    def do_POST(self):  # noqa: N802 (http.server API)
+        fe: "ServeFrontend" = self.server.frontend  # type: ignore[attr-defined]
+        try:
+            if self.path.split("?")[0] != "/generate":
+                self._send(404, {"error": "not found: POST /generate"})
+                return
+            length = int(self.headers.get("Content-Length") or 0)
+            if not 0 < length <= 1 << 20:
+                self._send(400, {"error": "bad Content-Length"})
+                return
+            try:
+                body = json.loads(self.rfile.read(length))
+                prompt = [int(t) for t in body.get("prompt") or [0]]
+                max_new = int(body.get("max_new_tokens", 8))
+            except (ValueError, TypeError) as e:
+                self._send(400, {"error": f"bad generate payload: {e}"})
+                return
+            req = fe.batcher.submit(prompt, max_new_tokens=max_new)
+            tokens = req.result(timeout=fe.request_timeout_s)
+            self._send(200, {"rid": req.rid, "tokens": tokens})
+        except Exception as e:  # an ingest must never kill the server
+            self._send(500, {"error": str(e)})
+
+
+class _QuietServer(ThreadingHTTPServer):
+    daemon_threads = True
+
+    def handle_error(self, request, client_address):
+        import sys
+
+        log.debug("serve http client error from %s: %s",
+                  client_address, sys.exc_info()[1])
+
+
+class ServeFrontend:
+    """HTTP ingest for one continuous batcher: ``POST /generate``
+    (admit → generate → respond; admission backpressure blocks right
+    here, which is the point), ``GET /serve`` (local stats payload),
+    ``GET /health``."""
+
+    def __init__(self, batcher, port: int = 0,
+                 bind_host: str = "127.0.0.1",
+                 request_timeout_s: float = 120.0):
+        self.batcher = batcher
+        self.request_timeout_s = request_timeout_s
+        self._server = _QuietServer((bind_host, port), _FrontendHandler)
+        self._server.frontend = self  # type: ignore[attr-defined]
+        self.port = self._server.server_address[1]
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True,
+            name=f"serve-frontend-{batcher.replica.name}",
+        )
+        self._thread.start()
+        log.info("serve frontend on :%d (/generate, /serve)", self.port)
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()

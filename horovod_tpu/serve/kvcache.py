@@ -1,0 +1,235 @@
+"""Paged KV-style context pool for the serving plane.
+
+A continuous batcher admits new sequences while old ones are mid-
+decode, so per-sequence context state must live in a shared pool with
+explicit admission/eviction — the serving twin of the training fusion
+buffer.  This pool stores one f32 row per cached token (the toy
+model's "KV" is its token embedding) in a fixed ``[capacity, width]``
+arena with a per-sequence page table:
+
+* **Append/extend** take free slots (O(1) stack pop); a full pool
+  first evicts finished sequences LRU, then reports backpressure to
+  the batcher (the request stays queued — admission control, not an
+  error).
+* **Fused TP payloads** reuse the ``svc/fuse`` packing classes
+  verbatim: :func:`~horovod_tpu.svc.fuse.align_elems` fixes the
+  member alignment (the quantization block when the serve wire is
+  int8/fp8 — cached contexts quantize exactly as training payloads
+  do), and :func:`~horovod_tpu.svc.fuse.pack_group` /
+  :func:`~horovod_tpu.svc.fuse.unpack_group` produce the one flat
+  buffer a prefill exchange ships.  One packer, train and serve.
+
+Metrics: ``serve.kv.used_tokens`` / ``serve.kv.capacity`` gauges,
+``serve.kv.appends`` / ``serve.kv.evictions`` / ``serve.kv.rejects``
+counters.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import metrics
+from ..exceptions import HorovodTpuError
+from ..utils import env
+
+DEFAULT_CAPACITY_TOKENS = 4096
+
+
+def capacity_tokens() -> int:
+    """``HVD_TPU_SERVE_KV_TOKENS``: pool capacity in cached tokens."""
+    return max(1, env.get_int(env.SERVE_KV_TOKENS,
+                              DEFAULT_CAPACITY_TOKENS))
+
+
+class _Seq:
+    __slots__ = ("slots", "finished", "stamp")
+
+    def __init__(self):
+        self.slots: List[int] = []
+        self.finished = False
+        self.stamp = 0
+
+
+class KVCachePool:
+    """Fixed-capacity token-context arena with per-sequence pages."""
+
+    def __init__(self, width: int, capacity: Optional[int] = None,
+                 wire: str = "off"):
+        from ..svc import fuse
+
+        self.width = int(width)
+        self.capacity = capacity_tokens() if capacity is None \
+            else max(1, int(capacity))
+        self.wire = wire or "off"
+        # svc/fuse alignment: quantized serve wires align members to
+        # the quantization block, dense to the fusion lane tile — the
+        # same rule training's fusion buffers pack under.
+        self.align = fuse.align_elems(self.wire, "float32")
+        self.pool = np.zeros((self.capacity, self.width), np.float32)
+        self._free: List[int] = list(range(self.capacity - 1, -1, -1))
+        self._seqs: Dict[int, _Seq] = {}
+        self._clock = 0
+        self._lock = threading.Lock()
+        metrics.set_gauge("serve.kv.capacity", float(self.capacity))
+
+    # ------------------------------------------------------ admission
+
+    def _touch(self, seq: _Seq) -> None:
+        self._clock += 1
+        seq.stamp = self._clock
+
+    def _take_slot_locked(self) -> Optional[int]:
+        if self._free:
+            return self._free.pop()
+        if self._evict_locked():
+            return self._free.pop() if self._free else None
+        return None
+
+    def _evict_locked(self) -> bool:
+        """Drop the least-recently-used *finished* sequence; an active
+        sequence is never evicted (its decode state would be lost)."""
+        victim = None
+        for sid, seq in self._seqs.items():
+            if not seq.finished:
+                continue
+            if victim is None or seq.stamp < self._seqs[victim].stamp:
+                victim = sid
+        if victim is None:
+            return False
+        self._release_locked(victim)
+        metrics.inc_counter("serve.kv.evictions")
+        return True
+
+    def extend(self, seq_id: int, rows: np.ndarray) -> bool:
+        """Append ``[t, width]`` context rows to ``seq_id`` (allocating
+        it on first touch).  False = pool exhausted even after evicting
+        finished sequences — the caller's backpressure signal; the
+        sequence is left unchanged (all-or-nothing)."""
+        rows = np.atleast_2d(np.asarray(rows, np.float32))
+        if rows.shape[1] != self.width:
+            raise HorovodTpuError(
+                f"KV row width {rows.shape[1]} != pool width {self.width}"
+            )
+        with self._lock:
+            seq = self._seqs.setdefault(seq_id, _Seq())
+            taken: List[int] = []
+            for _ in range(rows.shape[0]):
+                slot = self._take_slot_locked()
+                if slot is None:
+                    self._free.extend(reversed(taken))
+                    metrics.inc_counter("serve.kv.rejects")
+                    return False
+                taken.append(slot)
+            for slot, row in zip(taken, rows):
+                self.pool[slot] = row
+            seq.slots.extend(taken)
+            self._touch(seq)
+        metrics.inc_counter("serve.kv.appends", rows.shape[0])
+        self._publish()
+        return True
+
+    def append(self, seq_id: int, row: np.ndarray) -> bool:
+        return self.extend(seq_id, np.asarray(row, np.float32)[None, :])
+
+    # -------------------------------------------------------- reading
+
+    def tokens(self, seq_id: int) -> np.ndarray:
+        """The cached ``[t, width]`` context matrix, in append order."""
+        with self._lock:
+            seq = self._seqs.get(seq_id)
+            slots = list(seq.slots) if seq else []
+            if seq:
+                self._touch(seq)
+        return self.pool[slots] if slots else \
+            np.zeros((0, self.width), np.float32)
+
+    def length(self, seq_id: int) -> int:
+        with self._lock:
+            seq = self._seqs.get(seq_id)
+            return len(seq.slots) if seq else 0
+
+    def context(self, seq_id: int) -> np.ndarray:
+        """Mean-pooled context vector ``[width]`` (the toy attention
+        state the decode step consumes)."""
+        toks = self.tokens(seq_id)
+        if not len(toks):
+            return np.zeros((self.width,), np.float32)
+        return np.mean(toks, axis=0, dtype=np.float32)
+
+    # ---------------------------------------------------- fused hops
+
+    def fused_payload(self, seq_ids: Sequence[int]
+                      ) -> Tuple[np.ndarray, List[Tuple]]:
+        """One aligned flat buffer holding every listed sequence's
+        context matrix — ``svc/fuse.pack_group`` at this pool's wire
+        alignment, so a prefill TP hop ships N sequences as ONE
+        exchange whose members quantize exactly as they would alone."""
+        from ..svc import fuse
+
+        mats = [np.asarray(self.tokens(s)) for s in seq_ids]
+        buf, layout = fuse.pack_group(
+            [m if m.size else np.zeros((1, self.width), np.float32)
+             for m in mats],
+            self.align,
+        )
+        return np.asarray(buf, np.float32), layout
+
+    def write_back(self, seq_ids: Sequence[int], buf: np.ndarray,
+                   layout: Sequence[Tuple]) -> None:
+        """Land an exchanged fused buffer back into the pool (inverse
+        of :meth:`fused_payload`) — the exchange output, not the local
+        copy, is what decode reads."""
+        from ..svc import fuse
+
+        mats = fuse.unpack_group(np.asarray(buf, np.float32), layout)
+        for sid, mat in zip(seq_ids, mats):
+            mat = np.asarray(mat, np.float32)
+            with self._lock:
+                seq = self._seqs.get(sid)
+                if seq is None:
+                    continue
+                slots = list(seq.slots)
+            rows = min(len(slots), mat.shape[0])
+            for slot, row in zip(slots[:rows], mat[:rows]):
+                self.pool[slot] = row
+
+    # ------------------------------------------------------ lifecycle
+
+    def mark_finished(self, seq_id: int) -> None:
+        with self._lock:
+            seq = self._seqs.get(seq_id)
+            if seq is not None:
+                seq.finished = True
+        self._publish()
+
+    def free(self, seq_id: int) -> None:
+        with self._lock:
+            self._release_locked(seq_id)
+        self._publish()
+
+    def _release_locked(self, seq_id: int) -> None:
+        seq = self._seqs.pop(seq_id, None)
+        if seq is not None:
+            self._free.extend(reversed(seq.slots))
+
+    def used(self) -> int:
+        with self._lock:
+            return self.capacity - len(self._free)
+
+    def _publish(self) -> None:
+        metrics.set_gauge("serve.kv.used_tokens", float(self.used()))
+
+    def stats(self) -> Dict[str, Any]:
+        with self._lock:
+            active = sum(1 for s in self._seqs.values() if not s.finished)
+            return {
+                "capacity_tokens": self.capacity,
+                "used_tokens": self.capacity - len(self._free),
+                "sequences": len(self._seqs),
+                "active_sequences": active,
+                "align_elems": self.align,
+                "wire": self.wire,
+            }

@@ -1,0 +1,114 @@
+"""Synthetic heavy-traffic generator for the serving plane.
+
+The interference and throughput claims in docs/serving.md are
+*measured*: this open-loop generator submits deterministic synthetic
+requests at a target rate (seeded prompt lengths and token ids, so two
+runs — or two processes of one smoke test — offer identical traffic),
+collects every :class:`~horovod_tpu.serve.batcher.Request`, and
+reduces them to the summary the bench and the tier-1 smoke assert on
+(requests/sec, tokens/sec, TTFT and end-to-end quantiles, a digest of
+every generated token for cross-process parity checks).
+
+Open loop matters: a closed-loop driver slows down when the server
+does, hiding exactly the queue growth the admission-control story is
+about.  Submission happens from this thread and *blocks* when the
+request lane is at its ``HVD_TPU_SERVE_INFLIGHT`` cap — which the
+summary reports as achieved-vs-offered rate.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import time
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils.logging import get_logger
+
+log = get_logger()
+
+
+def synthetic_prompts(count: int, vocab: int = 32,
+                      min_len: int = 2, max_len: int = 8,
+                      seed: int = 7) -> List[List[int]]:
+    """Deterministic traffic: ``count`` prompts of seeded lengths and
+    token ids (every process of a smoke run generates the same list)."""
+    rng = np.random.RandomState(seed)
+    out = []
+    for _ in range(count):
+        n = int(rng.randint(min_len, max_len + 1))
+        out.append([int(t) for t in rng.randint(0, vocab, size=n)])
+    return out
+
+
+def output_digest(outputs: Sequence[Sequence[int]]) -> str:
+    """Order-sensitive sha256 over generated tokens — the
+    cross-process / cross-mode parity check."""
+    h = hashlib.sha256()
+    for toks in outputs:
+        h.update((",".join(str(t) for t in toks) + ";").encode())
+    return h.hexdigest()[:16]
+
+
+def _quantiles(xs: List[float]) -> Dict[str, float]:
+    if not xs:
+        return {}
+    xs = sorted(xs)
+
+    def q(frac: float) -> float:
+        return round(xs[int(frac * (len(xs) - 1))] * 1e3, 3)
+
+    return {"p50_ms": q(0.5), "p99_ms": q(0.99)}
+
+
+class LoadGenerator:
+    """Drive one batcher with open-loop synthetic traffic."""
+
+    def __init__(self, batcher, *, rate_rps: float = 50.0,
+                 count: int = 32, max_new_tokens: int = 8,
+                 vocab: Optional[int] = None, seed: int = 7):
+        self.batcher = batcher
+        self.rate_rps = max(0.1, float(rate_rps))
+        self.count = max(1, int(count))
+        self.max_new_tokens = max(1, int(max_new_tokens))
+        self.vocab = vocab or batcher.replica.vocab
+        self.seed = seed
+        self.requests: List[Any] = []
+
+    def run(self, timeout_s: float = 300.0) -> Dict[str, Any]:
+        """Submit the whole schedule, wait for every request, return
+        the measured summary."""
+        prompts = synthetic_prompts(self.count, vocab=self.vocab,
+                                    seed=self.seed)
+        interval = 1.0 / self.rate_rps
+        t0 = time.monotonic()
+        for i, prompt in enumerate(prompts):
+            target = t0 + i * interval
+            delay = target - time.monotonic()
+            if delay > 0:
+                time.sleep(delay)
+            self.requests.append(self.batcher.submit(
+                prompt, max_new_tokens=self.max_new_tokens
+            ))
+        submitted_in = time.monotonic() - t0
+        outputs = [r.result(timeout=timeout_s) for r in self.requests]
+        elapsed = time.monotonic() - t0
+        tokens = sum(len(o) for o in outputs)
+        ttft = [r.first_token_at - r.arrival for r in self.requests
+                if r.first_token_at]
+        e2e = [r.finished_at - r.arrival for r in self.requests
+               if r.finished_at]
+        return {
+            "requests": len(outputs),
+            "tokens": tokens,
+            "elapsed_s": round(elapsed, 4),
+            "offered_rps": round(self.rate_rps, 3),
+            "achieved_rps": round(len(outputs) / max(elapsed, 1e-9), 3),
+            "submit_window_s": round(submitted_in, 4),
+            "tokens_per_s": round(tokens / max(elapsed, 1e-9), 3),
+            "ttft": _quantiles(ttft),
+            "e2e": _quantiles(e2e),
+            "digest": output_digest(outputs),
+            "outputs": outputs,
+        }

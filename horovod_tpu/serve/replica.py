@@ -1,0 +1,426 @@
+"""Serving replica: params-only checkpoint → TP-sharded forward pass.
+
+The replica owns the checkpoint-to-traffic half of the lifecycle:
+
+* **Restore** (:meth:`Replica.from_checkpoint`): loads through
+  ``checkpoint.load_params`` — the params-only path that never
+  materializes optimizer state and names missing keys in a structured
+  :class:`~horovod_tpu.exceptions.CheckpointMissingKeysError`.
+* **Tensor parallelism**: ``w1`` column-sharded / ``w2`` row-sharded
+  across a slice-local rank group (``tanh`` is elementwise, so the
+  split is value-exact); each rank's partial logits meet in ONE
+  all_reduce.  That collective is an ordinary XIR program submitted
+  through the exchange service, so lowering, the quantized wire
+  (``HVD_TPU_SERVE_WIRE``), fusion, the arbiter, and the tune DB all
+  apply to inference hops with zero new exchange machinery.
+* **Phase tenancy**: every exchange is stamped with a
+  ``serve:<replica>:<phase>`` tenant
+  (:func:`~horovod_tpu.svc.arbiter.serve_tenant`) through the
+  TraceContext tenant slot — decode rides its own arbiter lane,
+  isolated from prefill bulk.
+* **Warm start**: replica N reads replica 1's tune-DB entry, keyed by
+  the *model signature* (param names/shapes/dtypes + TP layout), and
+  pins the stored (cycle time, fusion threshold) pair before serving
+  its first request (``serve.tune.db_hit``).
+
+The built-in model is deliberately tiny — ``logits =
+tanh(ctx @ w1) @ w2`` over a mean-pooled token-embedding context — the
+smallest forward pass that still has a real TP reduction; the exchange
+topology (small grouped ICI decode reduce, bulk ungrouped DCN prefill
+sync) is the part the paper's serving story is about.
+
+Decode math runs per request in float32 host numpy, so a batch of one
+and a batch of eight produce bitwise-identical logits — the property
+the train→checkpoint→serve parity tests pin.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .. import metrics
+from ..exceptions import HorovodTpuError
+from ..utils import env
+from ..utils.logging import get_logger
+
+log = get_logger()
+
+DEFAULT_VOCAB = 32
+DEFAULT_D_MODEL = 16
+DEFAULT_HIDDEN = 32
+
+PARAM_KEYS = ("emb", "w1", "w2")
+
+
+def toy_lm_params(vocab: int = DEFAULT_VOCAB,
+                  d_model: int = DEFAULT_D_MODEL,
+                  hidden: int = DEFAULT_HIDDEN,
+                  seed: int = 0) -> Dict[str, np.ndarray]:
+    """Deterministic toy-LM parameters (the shape every serve test and
+    bench shares): ``emb [V,D]``, ``w1 [D,H]``, ``w2 [H,V]``."""
+    rng = np.random.RandomState(seed)
+    return {
+        "emb": rng.randn(vocab, d_model).astype(np.float32) * 0.5,
+        "w1": rng.randn(d_model, hidden).astype(np.float32) * 0.3,
+        "w2": rng.randn(hidden, vocab).astype(np.float32) * 0.3,
+    }
+
+
+def serve_wire() -> str:
+    """``HVD_TPU_SERVE_WIRE``: wire format for the decode TP reduce
+    (default ``off`` = f32 — the bitwise-parity configuration; int8/fp8
+    quantize the hop through the PR 9 fused wire)."""
+    return (env.get_env(env.SERVE_WIRE, "off") or "off").strip() or "off"
+
+
+def _world() -> Tuple[int, int]:
+    """(world size, this rank) — (1, 0) when the runtime is down (the
+    inline single-process mode unit tests use)."""
+    from ..runtime import get_runtime_or_none
+
+    rt = get_runtime_or_none()
+    if rt is None:
+        return 1, 0
+    return rt.size, rt.rank
+
+
+def default_tp_groups(n: int) -> Tuple[Tuple[int, ...], ...]:
+    """Slice-local TP groups for an ``n``-rank world: one group per
+    slice when the topo model tiles ``n``, else one group of all ranks.
+    Keeping the TP reduce inside a slice is the point — decode's
+    latency-critical hop stays on ICI."""
+    try:
+        from ..topo import model as topo_model
+
+        topo = topo_model.current()
+        ns, ss = int(topo.num_slices), int(topo.slice_size)
+        if ns > 1 and ns * ss == n:
+            return tuple(
+                tuple(range(s * ss, (s + 1) * ss)) for s in range(ns)
+            )
+    except Exception:
+        pass
+    return (tuple(range(n)),)
+
+
+class Replica:
+    """One serving replica: sharded params + the exchange plumbing.
+
+    ``tp_groups`` (default: slice-local) gives every rank group a full
+    copy of the model, each rank holding one column/row shard; rank
+    ``g[i]`` of group ``g`` computes partial logits from shard ``i``
+    and the group's all_reduce completes them.  ``process_set``
+    restricts serving to a rank subgroup instead (the masked eager
+    path: non-members pass through) — the "serve on half the pod while
+    the other half trains" arrangement.
+    """
+
+    def __init__(self, params: Dict[str, Any], *, name: str = "r0",
+                 tp_groups: Optional[Sequence[Sequence[int]]] = None,
+                 process_set: Any = None, wire: Optional[str] = None,
+                 warm_start: bool = True):
+        for k in PARAM_KEYS:
+            if k not in params:
+                raise HorovodTpuError(
+                    f"serve replica needs params {list(PARAM_KEYS)}; "
+                    f"got {sorted(map(str, params))}"
+                )
+        self.name = name or "r0"
+        self.process_set = process_set
+        self.wire = serve_wire() if wire is None else (wire or "off")
+        self.emb = np.asarray(params["emb"], dtype=np.float32)
+        self.w1 = np.asarray(params["w1"], dtype=np.float32)
+        self.w2 = np.asarray(params["w2"], dtype=np.float32)
+        self.vocab = int(self.emb.shape[0])
+        self.d_model = int(self.emb.shape[1])
+        self.hidden = int(self.w1.shape[1])
+        self.n, self.rank = _world()
+        if process_set is not None:
+            members: Tuple[int, ...] = tuple(process_set.ranks)
+            self.tp_groups: Tuple[Tuple[int, ...], ...] = (members,)
+        elif tp_groups is not None:
+            self.tp_groups = tuple(tuple(int(r) for r in g)
+                                   for g in tp_groups)
+        else:
+            self.tp_groups = default_tp_groups(self.n)
+        self.tp = len(self.tp_groups[0])
+        if any(len(g) != self.tp for g in self.tp_groups):
+            raise HorovodTpuError(
+                f"TP groups must be equal-size, got {self.tp_groups}"
+            )
+        if self.hidden % self.tp:
+            raise HorovodTpuError(
+                f"hidden dim {self.hidden} does not shard over tp="
+                f"{self.tp}"
+            )
+        self._shard()
+        self.flops = 0  # host-side FLOP odometer (per-replica MFU)
+        self._store = None
+        self._store_key: Optional[str] = None
+        if warm_start:
+            self._warm_start()
+
+    # ------------------------------------------------------- sharding
+
+    def _shard(self) -> None:
+        """Stacked one-row-per-rank shard tensors: row ``r`` holds the
+        column/row shard of ``r``'s position within its TP group (zeros
+        for ranks outside a ``process_set`` — the masked path carries
+        their rows through untouched, and zero partials keep the
+        payload well-defined)."""
+        hs = self.hidden // self.tp
+        self.shard_hidden = hs
+        w1s = np.zeros((self.n, self.d_model, hs), np.float32)
+        w2s = np.zeros((self.n, hs, self.vocab), np.float32)
+        for g in self.tp_groups:
+            for i, r in enumerate(g):
+                if 0 <= r < self.n:
+                    w1s[r] = self.w1[:, i * hs:(i + 1) * hs]
+                    w2s[r] = self.w2[i * hs:(i + 1) * hs, :]
+        self.w1_shards = w1s
+        self.w2_shards = w2s
+
+    # ------------------------------------------------------ tune DB
+
+    def signature(self) -> Tuple:
+        """Model identity for tune-DB keying: parameter layout + TP
+        arrangement.  Two replicas of the same trained model share the
+        signature (replica N warm-starts from replica 1's entry); a
+        different model, shard count, or wire never collides."""
+        return (
+            "serve_replica",
+            tuple((k, tuple(np.asarray(getattr(self, k)).shape), "float32")
+                  for k in PARAM_KEYS),
+            ("tp", self.tp, len(self.tp_groups)),
+            ("wire", self.wire),
+        )
+
+    def store_key(self) -> str:
+        from ..sched.store import knob_fingerprint, make_key
+
+        # include_svc=False for the same reason svc/params excludes it:
+        # the entry's payload IS the (cycle, threshold) pair, so the
+        # key must survive pinning the winner into those knobs.
+        return make_key(self.signature(),
+                        knobs=knob_fingerprint(include_svc=False),
+                        kind="serve_replica")
+
+    def _warm_start(self) -> None:
+        from ..sched.store import ScheduleStore
+
+        self._store = ScheduleStore.from_env()
+        if self._store is None:
+            return
+        self._store_key = self.store_key()
+        entry = self._store.lookup(self._store_key)
+        if entry is None:
+            metrics.inc_counter("serve.tune.db_miss")
+            return
+        meta = entry.get("meta") or {}
+        cycle = meta.get("cycle_time_ms")
+        if cycle is not None:
+            env.set_env("SVC_CYCLE_TIME", repr(float(cycle)))
+        env.set_env("SVC_FUSION_THRESHOLD",
+                    str(int(entry["bucket_bytes"])))
+        metrics.inc_counter("serve.tune.db_hit")
+        metrics.set_gauge("serve.tune.warm_start", 1.0,
+                          {"replica": self.name})
+        log.info(
+            "serve replica %s warm start from tune DB: cycle_time=%s "
+            "fusion_threshold=%d", self.name, cycle,
+            int(entry["bucket_bytes"]),
+        )
+
+    def record_tuned(self, score: float = 1.0) -> None:
+        """Publish this replica's serving knobs as the model's tune-DB
+        entry (replica 1 records; replicas 2..N warm-start from it)."""
+        if self._store is None or self._store_key is None:
+            return
+        from ..svc import fuse
+        from ..svc.params import cycle_time_ms
+
+        self._store.record(
+            self._store_key,
+            bucket_bytes=fuse.fusion_threshold(),
+            wire=self.wire,
+            lowering="flat",
+            score=float(score),
+            meta={
+                "serve": self.name,
+                "cycle_time_ms": cycle_time_ms(),
+                "tp": self.tp,
+            },
+        )
+        metrics.inc_counter("serve.tune.db_store")
+
+    # ------------------------------------------------------ programs
+
+    def decode_program(self, batch: int):
+        """The decode-phase TP reduce: one small grouped all_reduce of
+        partial logits — slice-local groups = ICI-only occupancy, the
+        latency-critical lane the arbiter protects."""
+        from ..runtime import WORLD_AXIS
+        from ..xir import ir
+
+        groups = None if self.process_set is not None else self.tp_groups
+        return ir.program("serve_decode", [ir.all_reduce(
+            WORLD_AXIS, reduce="sum", lowering="flat", groups=groups,
+            wire=self.wire, nbytes=batch * self.vocab * 4,
+            dtype="float32",
+        )])
+
+    def prefill_program(self, elems: int):
+        """The prefill-phase bulk exchange: one ungrouped (cross-slice
+        ⇒ DCN-priced) all_reduce of the packed context buffer.  ``max``
+        of identical replicas is the identity *bitwise* regardless of
+        reduction order — the sync confirms co-replica coherence
+        without perturbing values."""
+        from ..runtime import WORLD_AXIS
+        from ..xir import ir
+
+        return ir.program("serve_prefill", [ir.all_reduce(
+            WORLD_AXIS, reduce="max", lowering="flat", groups=None,
+            nbytes=elems * 4, dtype="float32",
+        )])
+
+    # ------------------------------------------------------ exchange
+
+    def exchange(self, phase: str, program, payload: np.ndarray,
+                 timeout: float = 120.0) -> np.ndarray:
+        """Submit one stacked host-path payload through the exchange
+        service under this replica's ``serve:<name>:<phase>`` tenant;
+        returns the reduced stacked result.  Runtime down ⇒ host-side
+        inline reduce (unit-test mode), same values."""
+        from .. import svc, trace
+        from ..svc import arbiter
+
+        tenant = arbiter.serve_tenant(self.name, phase)
+        t0 = time.monotonic()
+        if self.n <= 1 or _world()[0] <= 1:
+            out = self._inline_reduce(program, payload)
+        else:
+            ctx = trace.new_context(f"serve.{self.name}.{phase}",
+                                    tenant=tenant)
+            fut = svc.get_service().submit(
+                program.with_trace(ctx), [payload],
+                producer=f"serve.{self.name}", tenant=tenant,
+                process_set=self.process_set,
+            )
+            out = np.asarray(fut.result(timeout=timeout)[0])
+            done = getattr(fut, "resolved_at", 0.0) or time.monotonic()
+            metrics.observe(f"serve.exchange_seconds.{phase}",
+                            max(0.0, done - t0))
+        metrics.inc_counter(f"serve.exchanges.{phase}")
+        return out
+
+    def _inline_reduce(self, program, payload: np.ndarray) -> np.ndarray:
+        op = program.ops[0]
+        reduce = op.attr("reduce") or "sum"
+        groups = op.groups or (tuple(range(payload.shape[0])),)
+        out = np.array(payload, dtype=payload.dtype, copy=True)
+        for g in groups:
+            rows = [r for r in g if 0 <= r < payload.shape[0]]
+            if not rows:
+                continue
+            if reduce == "max":
+                red = payload[rows].max(axis=0)
+            else:
+                red = payload[rows].sum(axis=0)
+            for r in rows:
+                out[r] = red
+        return out
+
+    # ------------------------------------------------------- forward
+
+    def embed(self, tokens: Sequence[int]) -> np.ndarray:
+        """Token embeddings ``[t, D]`` (f32 host numpy)."""
+        idx = np.asarray(list(tokens), dtype=np.int64) % self.vocab
+        return self.emb[idx]
+
+    @staticmethod
+    def context_of(embs: np.ndarray) -> np.ndarray:
+        """Mean-pooled context vector ``[D]`` of a token-embedding
+        matrix — the toy stand-in for attention state."""
+        return np.mean(np.asarray(embs, np.float32), axis=0,
+                       dtype=np.float32)
+
+    def partial_logits(self, ctx: np.ndarray) -> np.ndarray:
+        """Stacked per-rank partial logits ``[n, V]`` for one context:
+        row ``r`` is ``tanh(ctx @ w1_shard_r) @ w2_shard_r``.  Per-rank
+        (not batched) matmuls so the result is independent of how many
+        requests share the decode step."""
+        out = np.zeros((self.n, self.vocab), np.float32)
+        for r in range(self.n):
+            h = np.tanh(ctx @ self.w1_shards[r])
+            out[r] = h @ self.w2_shards[r]
+        self.flops += self.n * 2 * self.shard_hidden * (
+            self.d_model + self.vocab
+        )
+        return out
+
+    def _read_row(self) -> int:
+        """The stacked row holding complete logits after the TP reduce:
+        the first rank of the first group (every group computes the
+        same full logits — shards are replicated group-to-group)."""
+        return self.tp_groups[0][0]
+
+    def decode_logits(self, ctxs: np.ndarray,
+                      timeout: float = 120.0) -> np.ndarray:
+        """Full logits ``[B, V]`` for a batch of contexts: per-request
+        partials stacked into one ``[n, B, V]`` payload, completed by a
+        single grouped decode all_reduce through the service."""
+        ctxs = np.atleast_2d(np.asarray(ctxs, np.float32))
+        b = ctxs.shape[0]
+        payload = np.stack(
+            [self.partial_logits(c) for c in ctxs], axis=1
+        )  # [n, B, V]
+        out = self.exchange("decode", self.decode_program(b), payload,
+                            timeout=timeout)
+        return np.asarray(out)[self._read_row()]
+
+    def prefill_sync(self, flat: np.ndarray,
+                     timeout: float = 120.0) -> np.ndarray:
+        """Cross-replica context sync for a packed prefill buffer
+        ``[L]``: every rank contributes the identical buffer, the bulk
+        ungrouped all_reduce (max ⇒ bitwise identity) crosses DCN, and
+        the exchanged copy is what lands in the KV pool — prefill's
+        rail pressure is real, its values untouched."""
+        flat = np.asarray(flat, np.float32).reshape(-1)
+        payload = np.broadcast_to(
+            flat, (max(self.n, 1), flat.shape[0])
+        ).copy()
+        out = self.exchange("prefill",
+                            self.prefill_program(flat.shape[0]),
+                            payload, timeout=timeout)
+        return np.asarray(out)[self._read_row()]
+
+    def forward(self, tokens: Sequence[int],
+                timeout: float = 120.0) -> np.ndarray:
+        """One-shot forward pass (the parity-test entry): logits for
+        the next token after ``tokens``, through the full TP-sharded
+        exchange path."""
+        ctx = self.context_of(self.embed(tokens))
+        return self.decode_logits(ctx[None, :], timeout=timeout)[0]
+
+    # ----------------------------------------------------- lifecycle
+
+    @classmethod
+    def from_checkpoint(cls, path: str, step: Optional[int] = None,
+                        **kw) -> "Replica":
+        """Build a replica from a saved training checkpoint via the
+        params-only restore (optimizer state is dropped on the reader
+        rank, never broadcast, never materialized here)."""
+        from .. import checkpoint
+
+        state = checkpoint.load_params(path, step=step)
+        if state is None:
+            raise HorovodTpuError(
+                f"no checkpoint found at {path!r} to serve from"
+            )
+        params = state[checkpoint.PARAMS_KEY]
+        replica = cls(params, **kw)
+        metrics.inc_counter("serve.replicas_started")
+        return replica

@@ -149,6 +149,38 @@ def current_tenant() -> str:
     return (env.get_env(env.SVC_TENANT, "") or "").strip()
 
 
+SERVE_TENANT_PREFIX = "serve"
+
+
+def serve_tenant(replica: str, phase: str) -> str:
+    """Mint a serving-plane tenant tag: ``serve:<replica>:<phase>``.
+
+    The inference serving plane (``horovod_tpu/serve/``) runs each
+    replica's prefill and decode as two *tenants* of this arbiter —
+    decode's small latency-critical ICI exchanges in one lane,
+    prefill's bulk in another — so the DRR schedule isolates them
+    exactly like two training jobs.  The tag rides the existing
+    TraceContext tenant slot (``trace/context.py``), so ``/tenants``,
+    ``trace.tenant_seconds`` histograms, and per-tenant SLO specs all
+    distinguish the phases with zero further arbiter changes.  ``:``
+    inside either component is folded to ``_`` to keep the tag
+    parseable."""
+    r = (replica or "r0").replace(":", "_")
+    p = (phase or "decode").replace(":", "_")
+    return f"{SERVE_TENANT_PREFIX}:{r}:{p}"
+
+
+def parse_serve_tenant(tenant: Any) -> Optional[Tuple[str, str]]:
+    """``(replica, phase)`` when ``tenant`` is a serving-plane tag
+    minted by :func:`serve_tenant`, else None (training tenants pass
+    through unannotated)."""
+    parts = str(tenant or "").split(":")
+    if len(parts) == 3 and parts[0] == SERVE_TENANT_PREFIX \
+            and parts[1] and parts[2]:
+        return parts[1], parts[2]
+    return None
+
+
 def tenant_of(producer: str = "default", process_set: Any = None,
               ctx: Any = None) -> str:
     """Resolve a submission's tenant: the attached TraceContext's
@@ -229,16 +261,20 @@ class Arbiter:
 
     # -------------------------------------------------------- admission
 
-    def admit(self, tenant: str, timeout_s: Optional[float] = None) -> bool:
+    def admit(self, tenant: str, timeout_s: Optional[float] = None,
+              cap: Optional[int] = None) -> bool:
         """Admit one submission into ``tenant``'s lane, blocking while
         the lane is at its in-flight cap or preempt-gated.  Returns
         True when admitted cleanly; an expired wait admits anyway
         (``svc.tenant.admission_timeouts``) and a dead/aborted service
         admits immediately — backpressure must never wedge a producer.
         The ``svc.admit`` fault site fires here (fault-plan tests gate
-        a tenant's admission deterministically)."""
+        a tenant's admission deterministically).  ``cap`` overrides the
+        env in-flight bound for this lane (the serving plane's
+        request-level admission control re-uses these lanes with its
+        own ``HVD_TPU_SERVE_INFLIGHT`` cap)."""
         faults.inject("svc.admit", tenant=tenant)
-        cap = tenant_inflight_cap()
+        cap = tenant_inflight_cap() if cap is None else max(0, int(cap))
         timeout_s = admit_timeout_s() if timeout_s is None else timeout_s
         deadline = time.monotonic() + timeout_s
         waited = False
@@ -641,6 +677,12 @@ def tenants_payload(per_rank: Dict[int, Dict[str, Any]]) -> Dict[str, Any]:
                 "dcn_bytes": 0.0, "ici_bytes": 0.0,
                 "share": 0.0, "usage": 0.0, "ranks": 0,
             })
+            sv = parse_serve_tenant(tenant)
+            if sv is not None:
+                # Serving-plane tag family: name the (replica, phase)
+                # pair so /tenants consumers (SLO specs, dashboards)
+                # can split prefill from decode without re-parsing.
+                agg["serve"] = {"replica": sv[0], "phase": sv[1]}
             agg["ranks"] += 1
             for k in ("queue_depth", "inflight", "dcn_bytes",
                       "ici_bytes"):

@@ -204,6 +204,24 @@ SVC_ARBITER_QUANTUM_US = "SVC_ARBITER_QUANTUM_US"
 SVC_PREEMPT_CYCLES = "SVC_PREEMPT_CYCLES"
 # Seconds per service-tuner scoring window (default 0.25).
 SVC_TUNE_WINDOW = "SVC_TUNE_WINDOW"
+# --- elastic inference serving plane (horovod_tpu/serve/) ----------
+# Request-level admission cap: how many accepted-but-unfinished
+# requests one replica's batcher may hold before submit() blocks
+# (admission backpressure through the arbiter lanes, the request-level
+# twin of SVC_TENANT_INFLIGHT).  Default 64; 0 = unbounded.
+SERVE_INFLIGHT = "SERVE_INFLIGHT"
+# Maximum decode batch: how many active sequences one continuous-
+# batching decode step advances together (default 8).
+SERVE_BATCH = "SERVE_BATCH"
+# KV-cache pool capacity in tokens per replica (default 4096); a full
+# pool evicts finished sequences LRU-first and otherwise backpressures
+# prefill admission.
+SERVE_KV_TOKENS = "SERVE_KV_TOKENS"
+# Wire format for the serving plane's tensor-parallel hops
+# ("off" | "bf16" | "int8" | "fp8", default off).  EF-free quantized
+# wires are exactly right here: inference TP exchanges carry no
+# optimizer state to drift.
+SERVE_WIRE = "SERVE_WIRE"
 # ResponseCache capacity (entries).  Shares the reference's
 # HOROVOD_CACHE_CAPACITY knob (common.h:118, response_cache.cc);
 # 0 disables the cache (every submission renegotiates + re-lowers).

@@ -137,7 +137,7 @@ class TestSkipPathStillRecords:
         for rec in ("_maybe_scaling", "_maybe_topo",
                     "_maybe_quant_backend", "_maybe_adasum",
                     "_maybe_railpipe", "_maybe_svc_fusion",
-                    "_maybe_tenant"):
+                    "_maybe_tenant", "_maybe_serve"):
             monkeypatch.setattr(bench, rec, fake_record(rec))
 
         result = {
@@ -153,7 +153,7 @@ class TestSkipPathStillRecords:
         assert ran == ["cpu_fallback", "_maybe_scaling", "_maybe_topo",
                        "_maybe_quant_backend", "_maybe_adasum",
                        "_maybe_railpipe", "_maybe_svc_fusion",
-                       "_maybe_tenant"]
+                       "_maybe_tenant", "_maybe_serve"]
         assert result["reason"]
         assert result["cpu_fallback"]["value"] == 1.0
 
@@ -172,7 +172,7 @@ class TestSkipPathStillRecords:
         for rec in ("_maybe_scaling", "_maybe_topo",
                     "_maybe_quant_backend", "_maybe_adasum",
                     "_maybe_railpipe", "_maybe_svc_fusion",
-                    "_maybe_tenant"):
+                    "_maybe_tenant", "_maybe_serve"):
             monkeypatch.setattr(bench, rec, noop)
         bench._device_free_records(
             {"value": 123.0}, 480, time.monotonic()

@@ -1,0 +1,508 @@
+"""Inference serving plane (horovod_tpu/serve/): checkpoint-to-replica
+pipeline, TP-sharded forward through the exchange service, continuous
+batching over the arbiter, KV pool, HTTP surfaces.
+
+Contracts under test:
+
+* **Params-only restore** — ``checkpoint.load_params`` returns only
+  the requested keys (optimizer state never materializes past the
+  reader), resolves the newest good step of a run directory, and names
+  missing keys in a structured ``CheckpointMissingKeysError`` instead
+  of a raw ``KeyError``.
+* **Parity** — a replica restored from a checkpoint produces logits
+  bitwise identical (f32, wire off) to a replica built from the
+  trained params directly, through the full TP-sharded service path;
+  the same holds when serving rides a process-set subgroup; and
+  continuous batching yields bitwise the tokens sequential serving
+  does (decode math is batch-size invariant).
+* **Tenancy** — every serve exchange carries the
+  ``serve:<replica>:<phase>`` tenant; request admission is arbiter
+  backpressure on the ``serve:<replica>:request`` lane
+  (``HVD_TPU_SERVE_INFLIGHT``), blocking not dropping.
+* **KV pool** — all-or-nothing extend, LRU eviction of *finished*
+  sequences only, backpressure on exhaustion, and svc/fuse
+  pack/unpack round-trips (one packer, train and serve).
+* **Warm start** — replica N pins replica 1's tune-DB (cycle,
+  threshold) entry, keyed by model signature.
+* **Surfaces** — ``GET /serve`` payload aggregation (sum counters,
+  worst-rank p99), the bench-record pass-through, the standalone
+  frontend's ``POST /generate``, and the ``_maybe_serve`` bench
+  record's structured-skip contract.
+"""
+
+import json
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import metrics, svc
+from horovod_tpu.exceptions import HorovodTpuError
+from horovod_tpu.serve import frontend as frontend_mod
+from horovod_tpu.serve import loadgen
+from horovod_tpu.serve.batcher import ContinuousBatcher, serve_sequential
+from horovod_tpu.serve.frontend import ServeFrontend, serve_payload
+from horovod_tpu.serve.kvcache import KVCachePool
+from horovod_tpu.serve.replica import Replica, toy_lm_params
+from horovod_tpu.svc import arbiter
+
+pytestmark = pytest.mark.serve
+
+TP24 = ((0, 1, 2, 3), (4, 5, 6, 7))
+
+
+@pytest.fixture(autouse=True)
+def _serve_isolation(monkeypatch):
+    metrics.reset_counters("serve.")
+    metrics.reset_counters("checkpoint.")
+    metrics.reset_counters("svc.")
+    for knob in ("HVD_TPU_SERVE_WIRE", "HVD_TPU_SERVE_BATCH",
+                 "HVD_TPU_SERVE_INFLIGHT", "HVD_TPU_SERVE_KV_TOKENS",
+                 "HVD_TPU_TUNE_DB", "HVD_TPU_SVC_CYCLE_TIME",
+                 "HVD_TPU_SVC_FUSION_THRESHOLD"):
+        monkeypatch.delenv(knob, raising=False)
+    frontend_mod._last_bench = None
+    yield
+    arbiter.set_enabled_override(None)
+    svc.set_threshold_override(None)
+    svc.reset_service()
+    # warm start pins knobs into the process env on purpose; tests
+    # must not leak them forward
+    import os
+
+    os.environ.pop("HVD_TPU_SVC_CYCLE_TIME", None)
+    os.environ.pop("HVD_TPU_SVC_FUSION_THRESHOLD", None)
+
+
+# ---------------------------------------------------------------------
+# satellite 1: params-only restore
+
+
+@pytest.mark.usefixtures("hvd_module")
+class TestParamsOnlyRestore:
+    def test_restore_drops_optimizer_state(self, tmp_path):
+        params = toy_lm_params()
+        state = {"params": params,
+                 "opt_state": {"m": np.ones((512,), np.float32)},
+                 "step": 7}
+        hvd.save_checkpoint(str(tmp_path), state, step=7)
+        out = hvd.load_params(str(tmp_path), step=7)
+        assert set(out) == {"params"}, "optimizer state leaked through"
+        for k in params:
+            assert np.array_equal(np.asarray(out["params"][k]),
+                                  params[k])
+        assert metrics.get_counter("checkpoint.params_only_restore") >= 1
+
+    def test_missing_key_is_structured(self, tmp_path):
+        hvd.save_checkpoint(str(tmp_path),
+                            {"weights": np.ones((2,), np.float32)},
+                            step=1)
+        with pytest.raises(hvd.CheckpointMissingKeysError) as ei:
+            hvd.load_params(str(tmp_path), step=1)
+        err = ei.value
+        assert not isinstance(err, KeyError)
+        assert "params" in tuple(err.missing)
+        assert "weights" in tuple(err.available)
+        assert "params" in str(err) and "weights" in str(err)
+
+    def test_run_dir_resolves_latest_step(self, tmp_path):
+        for step, seed in ((1, 1), (3, 3)):
+            hvd.save_checkpoint(
+                str(tmp_path), {"params": toy_lm_params(seed=seed)},
+                step=step,
+            )
+        out = hvd.load_params(str(tmp_path))
+        want = toy_lm_params(seed=3)
+        assert np.array_equal(np.asarray(out["params"]["emb"]),
+                              want["emb"])
+
+
+# ---------------------------------------------------------------------
+# replica: TP-sharded forward, checkpoint parity, process sets
+
+
+@pytest.mark.usefixtures("hvd_module")
+class TestReplicaParity:
+    def test_checkpoint_to_serve_bitwise(self, tmp_path):
+        """train -> checkpoint -> serve: the restored TP-sharded
+        replica's logits are bitwise the direct replica's (f32, wire
+        off), through the real exchange service."""
+        svc.reset_service()
+        params = toy_lm_params(seed=5)
+        hvd.save_checkpoint(
+            str(tmp_path),
+            {"params": params,
+             "opt_state": {"v": np.zeros((64,), np.float32)}},
+            step=2,
+        )
+        direct = Replica(params, name="rA", tp_groups=TP24,
+                         warm_start=False)
+        restored = Replica.from_checkpoint(
+            str(tmp_path), name="rB", tp_groups=TP24, warm_start=False,
+        )
+        toks = [3, 1, 4, 1, 5]
+        a = direct.forward(toks)
+        b = restored.forward(toks)
+        assert a.dtype == np.float32
+        assert np.array_equal(a, b), "restored replica diverged"
+        # determinism of the service path itself
+        assert np.array_equal(a, direct.forward(toks))
+        assert metrics.get_counter("serve.replicas_started") == 1
+        assert metrics.get_counter("serve.exchanges.decode") >= 3
+
+    def test_process_set_subgroup_bitwise(self, monkeypatch):
+        """Serving on a rank subgroup (the "serve on half the pod"
+        arrangement) matches the grouped direct path bitwise."""
+        monkeypatch.setenv("HVD_TPU_DYNAMIC_PROCESS_SETS", "1")
+        svc.reset_service()
+        params = toy_lm_params(seed=9)
+        ps = hvd.add_process_set([0, 1, 2, 3])
+        toks = [7, 2, 9]
+        sub = Replica(params, name="sub", process_set=ps,
+                      warm_start=False)
+        # the full-cover grouped replica's first group reduces the same
+        # four rows in the same order -> its read row must match bitwise
+        grouped = Replica(params, name="grp", tp_groups=TP24,
+                          warm_start=False)
+        assert np.array_equal(sub.forward(toks), grouped.forward(toks))
+
+    def test_rejects_incomplete_params(self):
+        with pytest.raises(HorovodTpuError):
+            Replica({"emb": np.zeros((4, 4), np.float32)},
+                    warm_start=False)
+
+    def test_serve_tenant_stamping(self):
+        assert arbiter.serve_tenant("r0", "decode") == "serve:r0:decode"
+        assert arbiter.parse_serve_tenant("serve:r0:decode") == \
+            ("r0", "decode")
+        assert arbiter.parse_serve_tenant("trainer") is None
+        prog = Replica(toy_lm_params(), tp_groups=TP24,
+                       warm_start=False).decode_program(2)
+        assert prog.kind == "serve_decode"
+        assert prog.ops[0].groups == TP24
+
+
+class TestWarmStart:
+    def test_replica_n_warm_starts_from_replica_1(self, tmp_path,
+                                                  monkeypatch):
+        monkeypatch.setenv("HVD_TPU_TUNE_DB",
+                           str(tmp_path / "tune.json"))
+        params = toy_lm_params()
+        r1 = Replica(params, name="r1")
+        assert metrics.get_counter("serve.tune.db_miss") == 1
+        monkeypatch.setenv("HVD_TPU_SVC_FUSION_THRESHOLD", "12345")
+        r1.record_tuned(score=2.0)
+        r2 = Replica(params, name="r2")
+        assert metrics.get_counter("serve.tune.db_hit") == 1
+        assert r2.store_key() == r1.store_key()
+        import os
+
+        assert os.environ["HVD_TPU_SVC_FUSION_THRESHOLD"] == "12345"
+        assert metrics.get_gauge("serve.tune.warm_start",
+                                 {"replica": "r2"}) == 1.0
+
+    def test_signature_separates_models(self):
+        a = Replica(toy_lm_params(), warm_start=False)
+        b = Replica(toy_lm_params(vocab=16), warm_start=False)
+        c = Replica(toy_lm_params(), wire="int8", warm_start=False)
+        assert a.signature() != b.signature()
+        assert a.signature() != c.signature()
+        assert a.signature() == \
+            Replica(toy_lm_params(), warm_start=False).signature()
+
+    def test_wire_knob(self, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_SERVE_WIRE", "int8")
+        assert Replica(toy_lm_params(), warm_start=False).wire == "int8"
+
+
+# ---------------------------------------------------------------------
+# KV pool
+
+
+class TestKVCachePool:
+    def test_extend_context_roundtrip(self):
+        kv = KVCachePool(4, capacity=8)
+        rows = np.arange(12, dtype=np.float32).reshape(3, 4)
+        assert kv.extend(1, rows)
+        assert np.array_equal(kv.tokens(1), rows)
+        assert np.array_equal(kv.context(1),
+                              rows.mean(axis=0, dtype=np.float32))
+        assert kv.append(1, np.full((4,), 9.0, np.float32))
+        assert kv.length(1) == 4
+        assert kv.used() == 4
+        kv.free(1)
+        assert kv.used() == 0
+
+    def test_backpressure_and_lru_eviction(self):
+        kv = KVCachePool(2, capacity=4)
+        assert kv.extend(1, np.ones((3, 2), np.float32))
+        kv.mark_finished(1)
+        # evicting the finished seq makes room for the next one
+        assert kv.extend(2, np.ones((3, 2), np.float32))
+        assert metrics.get_counter("serve.kv.evictions") == 1
+        assert kv.length(1) == 0
+        # seq 2 is active: a pool-filling extend must fail all-or-
+        # nothing, leaving both the new seq and the free list untouched
+        used_before = kv.used()
+        assert not kv.extend(3, np.ones((2, 2), np.float32))
+        assert metrics.get_counter("serve.kv.rejects") == 1
+        assert kv.length(3) == 0 and kv.used() == used_before
+
+    def test_fused_payload_write_back(self):
+        kv = KVCachePool(4, capacity=16)
+        r1 = np.arange(8, dtype=np.float32).reshape(2, 4)
+        r2 = np.arange(8, 20, dtype=np.float32).reshape(3, 4)
+        kv.extend(1, r1)
+        kv.extend(2, r2)
+        buf, layout = kv.fused_payload([1, 2])
+        assert buf.ndim == 1 and buf.size % kv.align == 0
+        kv.write_back([1, 2], buf * 2.0, layout)
+        assert np.array_equal(kv.tokens(1), r1 * 2.0)
+        assert np.array_equal(kv.tokens(2), r2 * 2.0)
+
+
+# ---------------------------------------------------------------------
+# continuous batching
+
+
+@pytest.mark.usefixtures("hvd_module")
+class TestContinuousBatching:
+    def test_continuous_equals_sequential_bitwise(self):
+        """The headline parity: a request decoded in a shifting batch
+        yields bitwise the tokens it gets served alone."""
+        svc.reset_service()
+        params = toy_lm_params(seed=2)
+        prompts = loadgen.synthetic_prompts(6, seed=11)
+        seq_out = serve_sequential(
+            Replica(params, name="s", tp_groups=TP24, warm_start=False),
+            prompts, max_new_tokens=4,
+        )
+        bat = ContinuousBatcher(
+            Replica(params, name="c", tp_groups=TP24, warm_start=False),
+            batch=4,
+        )
+        try:
+            reqs = [bat.submit(p, max_new_tokens=4) for p in prompts]
+            cont_out = [r.result(timeout=120) for r in reqs]
+        finally:
+            bat.stop()
+        assert cont_out == seq_out
+        assert loadgen.output_digest(cont_out) == \
+            loadgen.output_digest(seq_out)
+        assert metrics.get_counter("serve.requests_completed") >= 6
+        assert metrics.get_counter("serve.tokens_generated") >= 24
+
+    def test_request_lifecycle_timestamps(self):
+        svc.reset_service()
+        bat = ContinuousBatcher(
+            Replica(toy_lm_params(), name="t", tp_groups=TP24,
+                    warm_start=False),
+            batch=2,
+        )
+        try:
+            req = bat.submit([1, 2], max_new_tokens=3)
+            out = req.result(timeout=120)
+        finally:
+            bat.stop()
+        assert len(out) == 3
+        assert req.arrival <= req.prefilled_at <= req.first_token_at \
+            <= req.finished_at
+        assert req.tenant == "serve:t:request"
+        assert req.lane_released, "retire must release the lane slot"
+
+
+class TestAdmissionControl:
+    def test_inflight_cap_blocks_then_admits(self):
+        """HVD_TPU_SERVE_INFLIGHT backpressure: the lane at cap blocks
+        submit; an expired wait admits anyway (never a drop)."""
+        bat = ContinuousBatcher(
+            Replica(toy_lm_params(), name="adm", warm_start=False),
+            inflight=1, start=False,
+        )
+        bat.submit([1], max_new_tokens=1)
+        t0 = time.monotonic()
+        req2 = bat.submit([2], max_new_tokens=1, admit_timeout_s=0.2)
+        waited = time.monotonic() - t0
+        assert waited >= 0.15, "second submit did not block at the cap"
+        assert req2.admitted
+        assert metrics.get_counter("svc.tenant.admission_timeouts") >= 1
+
+    def test_result_timeout_raises(self):
+        bat = ContinuousBatcher(
+            Replica(toy_lm_params(), name="to", warm_start=False),
+            start=False,
+        )
+        req = bat.submit([1], max_new_tokens=1)
+        with pytest.raises(HorovodTpuError, match="timed out"):
+            req.result(timeout=0.05)
+
+
+# ---------------------------------------------------------------------
+# surfaces: /serve payload, frontend HTTP, loadgen, bench record
+
+
+@pytest.mark.usefixtures("hvd_module")
+class TestServeSurfaces:
+    def test_serve_payload_local(self):
+        svc.reset_service()
+        bat = ContinuousBatcher(
+            Replica(toy_lm_params(), name="pay", tp_groups=TP24,
+                    warm_start=False),
+            batch=2,
+        )
+        try:
+            reqs = [bat.submit([i, i + 1], max_new_tokens=2)
+                    for i in range(3)]
+            for r in reqs:
+                r.result(timeout=120)
+        finally:
+            bat.stop()
+        payload = serve_payload()
+        assert payload["counters"]["serve.requests_completed"] >= 3
+        assert "pay" in payload["replicas"]
+        assert payload["latency"]["request"]["count"] >= 3
+        assert payload["latency"]["decode"]["p99_s"] is not None
+        assert payload["kv"].get("capacity", 0) > 0
+
+    def test_serve_payload_aggregates_ranks(self):
+        """Driver-side view: counters sum across ranks, latency takes
+        the worst rank's p99."""
+        def snap(completed, bound):
+            return {
+                "counters": {"serve.requests_completed": completed},
+                "gauges": [
+                    {"name": "serve.tokens_per_s",
+                     "labels": {"replica": "r"}, "value": 10.0},
+                ],
+                "histograms": {"serve.decode_seconds": {
+                    "count": 4, "sum": 4 * bound,
+                    "buckets": [bound], "counts": [4, 0],
+                }},
+            }
+
+        slow = snap(3, 0.050)
+        payload = serve_payload({0: snap(2, 0.010), 1: slow})
+        assert payload["counters"]["serve.requests_completed"] == 5
+        assert payload["replicas"]["r"]["tokens_per_s"] == 20.0
+        assert payload["latency"]["decode"]["p99_s"] == \
+            metrics.hist_quantile(
+                slow["histograms"]["serve.decode_seconds"], 0.99)
+        assert set(payload["ranks"]) == {"0", "1"}
+
+    def test_bench_record_rides_serve_payload(self):
+        frontend_mod.note_bench({"metric": "serve_plane", "value": 2.0})
+        assert serve_payload()["bench"]["metric"] == "serve_plane"
+        assert frontend_mod.last_bench()["value"] == 2.0
+
+    def test_frontend_http_generate_and_stats(self):
+        svc.reset_service()
+        params = toy_lm_params(seed=4)
+        bat = ContinuousBatcher(
+            Replica(params, name="web", tp_groups=TP24,
+                    warm_start=False),
+            batch=2,
+        )
+        fe = ServeFrontend(bat, port=0)
+        try:
+            body = json.dumps(
+                {"prompt": [1, 2, 3], "max_new_tokens": 3}
+            ).encode()
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{fe.port}/generate", data=body,
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=60) as resp:
+                out = json.loads(resp.read())
+            assert len(out["tokens"]) == 3
+            # the HTTP path serves bitwise what the oracle generates
+            want = serve_sequential(
+                Replica(params, name="web2", tp_groups=TP24,
+                        warm_start=False),
+                [[1, 2, 3]], max_new_tokens=3,
+            )[0]
+            assert out["tokens"] == want
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{fe.port}/serve",
+                    timeout=10) as resp:
+                stats = json.loads(resp.read())
+            assert stats["counters"]["serve.requests_completed"] >= 1
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{fe.port}/health",
+                    timeout=10) as resp:
+                health = json.loads(resp.read())
+            assert health["status"] == "ok"
+            assert health["replica"] == "web"
+        finally:
+            fe.stop()
+            bat.stop()
+
+    def test_telemetry_server_serves_serve_route(self):
+        from horovod_tpu.runner.telemetry_http import TelemetryServer
+
+        frontend_mod.note_bench({"metric": "serve_plane", "value": 3.0})
+        ts = TelemetryServer(port=0, bind_host="127.0.0.1")
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{ts.port}/serve",
+                    timeout=10) as resp:
+                payload = json.loads(resp.read())
+            assert payload["bench"]["metric"] == "serve_plane"
+        finally:
+            ts.stop()
+
+    def test_loadgen_deterministic_summary(self):
+        assert loadgen.synthetic_prompts(5, seed=3) == \
+            loadgen.synthetic_prompts(5, seed=3)
+        assert loadgen.output_digest([[1, 2], [3]]) != \
+            loadgen.output_digest([[3], [1, 2]])
+        svc.reset_service()
+        bat = ContinuousBatcher(
+            Replica(toy_lm_params(), name="lg", tp_groups=TP24,
+                    warm_start=False),
+            batch=4,
+        )
+        try:
+            gen = loadgen.LoadGenerator(bat, rate_rps=200, count=5,
+                                        max_new_tokens=2)
+            summary = gen.run(timeout_s=120)
+        finally:
+            bat.stop()
+        assert summary["requests"] == 5
+        assert summary["tokens"] == 10
+        assert summary["digest"] == \
+            loadgen.output_digest(summary["outputs"])
+        assert summary["achieved_rps"] > 0
+        assert "p99_ms" in summary["ttft"]
+
+
+# ---------------------------------------------------------------------
+# bench record plumbing (the _maybe_tenant contract, serve edition)
+
+
+class TestMaybeServe:
+    def _bench(self):
+        import importlib.util
+        import os
+
+        path = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+        spec = importlib.util.spec_from_file_location("bench_mod", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+
+    def test_env_skip(self, monkeypatch):
+        bench = self._bench()
+        monkeypatch.setenv("HVD_BENCH_SERVE", "0")
+        result = {}
+        bench._maybe_serve(result, 480, time.monotonic())
+        assert "serve_plane" not in result
+
+    def test_deadline_structured_skip(self, monkeypatch):
+        bench = self._bench()
+        monkeypatch.delenv("HVD_BENCH_SERVE", raising=False)
+        result = {}
+        bench._maybe_serve(result, 10, time.monotonic())
+        assert result["serve_plane"]["error"] == \
+            "skipped: deadline too close"

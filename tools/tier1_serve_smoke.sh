@@ -1,0 +1,221 @@
+#!/usr/bin/env bash
+# Inference serving-plane smoke: a 4-process CPU run on a forced 2x4
+# topology must prove the serve/ acceptance properties end to end:
+#
+#   1. the checkpoint-to-replica pipeline serves real traffic: each
+#      process saves a training checkpoint, restores it params-only
+#      into a TP-sharded replica, and drives it with the synthetic
+#      load generator through the continuous batcher AND the HTTP
+#      frontend (POST /generate, GET /serve);
+#   2. parity: the generated-token digest is bitwise identical to the
+#      sequential-serving oracle per process AND across all 4
+#      processes (seeded traffic => one digest for the whole fleet);
+#   3. the isolation bound holds: decode-tenant exchange p99 under
+#      prefill-tenant DCN bulk is cut to <= 0.6x the FIFO baseline by
+#      the DRR lanes (the in-process version of the
+#      tools/topo_bench.py --serve record), and GET /serve reports
+#      live counters for the traffic it carried.
+#
+# Each of the 4 worker processes runs its own 8-virtual-device SPMD
+# world (this jax build's CPU backend rejects cross-process
+# computations, so the processes are independent replicas of the same
+# seeded loop): assertions cover per-process properties AND bitwise
+# agreement of the digests across all 4.
+set -euo pipefail
+
+export JAX_PLATFORMS=cpu
+export XLA_FLAGS="--xla_force_host_platform_device_count=8 ${XLA_FLAGS:-}"
+export HVD_TPU_TOPO=2x4
+# the worker file lives in /tmp: put the repo root on the path
+export PYTHONPATH="$(cd "$(dirname "$0")/.." && pwd)${PYTHONPATH:+:$PYTHONPATH}"
+
+WORKER="$(mktemp /tmp/hvd_tpu_serve_smoke.XXXXXX.py)"
+CKPT="$(mktemp -d /tmp/hvd_tpu_serve_smoke_ckpt.XXXXXX)"
+trap 'rm -rf "$WORKER" "$WORKER".out.* "$CKPT"' EXIT
+
+cat > "$WORKER" <<'EOF'
+import json
+import os
+import sys
+import time
+import urllib.request
+
+import jax
+import numpy as np
+
+import horovod_tpu as hvd
+from horovod_tpu import svc, trace
+from horovod_tpu.serve import loadgen
+from horovod_tpu.serve.batcher import ContinuousBatcher, serve_sequential
+from horovod_tpu.serve.frontend import ServeFrontend
+from horovod_tpu.serve.replica import Replica, toy_lm_params
+from horovod_tpu.svc import arbiter
+
+sys.setswitchinterval(0.001)
+rank_arg = int(sys.argv[1])
+ckpt = os.path.join(sys.argv[2], f"proc{rank_arg}")
+
+hvd.init()
+n = hvd.size()
+TP = tuple(tuple(range(s * 4, (s + 1) * 4)) for s in range(n // 4))
+
+# -- 1. train-side checkpoint -> params-only restore -----------------
+params = toy_lm_params(seed=13)
+hvd.save_checkpoint(ckpt, {
+    "params": params,
+    "opt_state": {"m": np.ones((256,), np.float32)},
+    "step": 3,
+}, step=3)
+rep = Replica.from_checkpoint(ckpt, name="smoke", tp_groups=TP,
+                              warm_start=False)
+
+# -- 2. loadgen through the batcher + HTTP frontend, vs the oracle ---
+svc.reset_service()
+COUNT, MAX_NEW = 12, 4
+bat = ContinuousBatcher(rep, batch=4)
+fe = ServeFrontend(bat, port=0)
+summary = loadgen.LoadGenerator(
+    bat, rate_rps=100, count=COUNT, max_new_tokens=MAX_NEW,
+).run(timeout_s=240)
+# one more request over real HTTP, then scrape /serve
+http_prompt = [5, 6, 7]
+body = json.dumps({"prompt": http_prompt,
+                   "max_new_tokens": MAX_NEW}).encode()
+req = urllib.request.Request(
+    f"http://127.0.0.1:{fe.port}/generate", data=body,
+    headers={"Content-Type": "application/json"},
+)
+with urllib.request.urlopen(req, timeout=120) as resp:
+    http_tokens = json.loads(resp.read())["tokens"]
+with urllib.request.urlopen(
+        f"http://127.0.0.1:{fe.port}/serve", timeout=30) as resp:
+    served = json.loads(resp.read())
+fe.stop()
+bat.stop()
+assert served["counters"]["serve.requests_completed"] >= COUNT + 1, \
+    f"/serve lost traffic: {served['counters']}"
+assert "decode" in served["latency"] and "prefill" in served["latency"]
+
+oracle_rep = Replica(params, name="oracle", tp_groups=TP,
+                     warm_start=False)
+prompts = loadgen.synthetic_prompts(COUNT, vocab=rep.vocab, seed=7)
+oracle = serve_sequential(oracle_rep, prompts, max_new_tokens=MAX_NEW)
+assert summary["digest"] == loadgen.output_digest(oracle), \
+    "continuous batching diverged from the sequential oracle"
+assert http_tokens == serve_sequential(
+    Replica(params, name="oh", tp_groups=TP, warm_start=False),
+    [http_prompt], max_new_tokens=MAX_NEW,
+)[0], "HTTP path diverged from the oracle"
+
+# -- 3. decode p99 under prefill bulk: FIFO vs arbiter ---------------
+os.environ["HVD_TPU_SVC_CYCLE_TIME"] = "4.0"
+BULK_ROWS = 1 << 19
+rng = np.random.RandomState(11)
+bulk = rng.randn(n, BULK_ROWS).astype(np.float32)
+
+
+def isolation(arbiter_on, steps=40, warm=4):
+    svc.reset_service()
+    svc.fuse.set_threshold_override(0)
+    arbiter.set_enabled_override(arbiter_on)
+    try:
+        r = Replica(params, name="smoke", tp_groups=TP,
+                    warm_start=False)
+        s = svc.get_service()
+        payload = np.stack(
+            [r.partial_logits(r.context_of(r.embed([1, 2, 3])))],
+            axis=1,
+        )
+        t_dec = arbiter.serve_tenant("smoke", "decode")
+        t_pre = arbiter.serve_tenant("smoke", "prefill")
+        lat = []
+        for it in range(steps + warm):
+            futs_b = [
+                s.submit(
+                    r.prefill_program(BULK_ROWS).with_trace(
+                        trace.new_context("serve.smoke.prefill",
+                                          tenant=t_pre)),
+                    [bulk], producer=f"pre{i}", tenant=t_pre,
+                )
+                for i in range(4)
+            ]
+            t0 = time.monotonic()
+            fut = s.submit(
+                r.decode_program(1).with_trace(
+                    trace.new_context("serve.smoke.decode",
+                                      tenant=t_dec)),
+                [payload], producer="dec", tenant=t_dec,
+            )
+            jax.block_until_ready(fut.result(timeout=120)[0])
+            served_s = fut.resolved_at - t0
+            for f in futs_b:
+                jax.block_until_ready(f.result(timeout=120))
+            if it >= warm:
+                lat.append(served_s)
+        lat.sort()
+        return lat[int(0.99 * (len(lat) - 1))]
+    finally:
+        arbiter.set_enabled_override(None)
+        svc.fuse.set_threshold_override(None)
+
+
+fifo_p99 = isolation(False)
+arb_p99 = isolation(True)
+print(json.dumps({
+    "rank": rank_arg,
+    "digest": summary["digest"],
+    "requests": summary["requests"],
+    "tokens_per_s": summary["tokens_per_s"],
+    "fifo_p99_ms": round(fifo_p99 * 1e3, 3),
+    "arbiter_p99_ms": round(arb_p99 * 1e3, 3),
+}))
+EOF
+
+echo "== serve smoke: 4 independent workers =="
+PIDS=()
+for r in 0 1 2 3; do
+  python "$WORKER" "$r" "$CKPT" > "$WORKER.out.$r" 2> "$WORKER.out.$r.err" &
+  PIDS+=($!)
+done
+FAIL=0
+for i in 0 1 2 3; do
+  if ! wait "${PIDS[$i]}"; then
+    echo "worker $i FAILED:"; tail -20 "$WORKER.out.$i.err"; FAIL=1
+  fi
+done
+[ "$FAIL" = 0 ] || exit 1
+
+python - "$WORKER" <<'EOF'
+import json
+import sys
+
+worker = sys.argv[1]
+rows = [
+    json.loads(open(f"{worker}.out.{r}").read().strip().splitlines()[-1])
+    for r in range(4)
+]
+# bitwise agreement of the generated-token digest across all 4
+# processes (same seeded traffic, same restored checkpoint => the
+# whole fleet serves identical tokens)
+digs = {row["digest"] for row in rows}
+assert len(digs) == 1, f"serve digests diverge across processes: {digs}"
+# the isolation bound: DRR lanes must hold decode p99 under prefill
+# bulk to <= 0.6x FIFO in EVERY process
+for row in rows:
+    ratio = row["arbiter_p99_ms"] / max(row["fifo_p99_ms"], 1e-9)
+    assert ratio <= 0.6, (
+        f"rank {row['rank']}: decode p99 {row['arbiter_p99_ms']}ms "
+        f"under arbiter not <= 0.6x FIFO {row['fifo_p99_ms']}ms"
+    )
+    assert row["requests"] == 12
+print("serve smoke OK:", json.dumps({
+    "digest": rows[0]["digest"],
+    "tokens_per_s": [r["tokens_per_s"] for r in rows],
+    "fifo_p99_ms": [r["fifo_p99_ms"] for r in rows],
+    "arbiter_p99_ms": [r["arbiter_p99_ms"] for r in rows],
+}))
+EOF
+
+echo "== serve marker tests =="
+python -m pytest tests/ -q -m serve -p no:cacheprovider
+echo "tier1_serve_smoke: OK"

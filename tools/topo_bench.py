@@ -58,10 +58,22 @@ headline value is the FIFO/arbiter p99 ratio; the record also reports
 whether the arbiter held A's p99 within the 10% interference bound
 the FIFO baseline measurably breaks.
 
+``--serve`` record — ``serve_plane``: the inference serving plane
+(``horovod_tpu/serve/``) on its two headline claims.  Throughput:
+one replica serves the same 16-request synthetic trace sequentially
+(each request prefills and fully decodes alone) and continuously
+(``ContinuousBatcher``, batch 8) — outputs bitwise equal, continuous
+tokens/sec must exceed sequential.  Isolation: decode's small grouped
+ICI exchange is latency-probed while prefill-tenant DCN bulk floods
+the service, FIFO vs the DRR arbiter (the ``--tenant`` methodology on
+the serve tenants); decode p99 under the arbiter must stay ≤ 0.6x
+FIFO.  The record is also what ``GET /serve`` reports under
+``"bench"`` (``serve/frontend.note_bench``).
+
 Run standalone or through ``bench.py`` (which embeds the lines under
 its ``"topo_hier_vs_flat"`` / ``"quant_fused_vs_phase"`` /
 ``"adasum_vs_sum"`` / ``"railpipe_overlap"`` /
-``"svc_tenant_interference"`` keys).
+``"svc_tenant_interference"`` / ``"serve_plane"`` keys).
 """
 
 import json
@@ -794,21 +806,191 @@ def main_tenant() -> dict:
     }
 
 
+def main_serve() -> dict:
+    """The ``serve_plane`` record: the serving plane's two measured
+    claims on the sim mesh.  (A) Throughput — the same synthetic trace
+    served sequentially vs continuously, bitwise-equal outputs,
+    continuous tokens/sec must win.  (B) Isolation — decode-tenant
+    exchange p99 while prefill-tenant DCN bulk floods the service,
+    FIFO vs arbiter (the ``main_tenant`` methodology on the
+    ``serve:<replica>:<phase>`` tag family); arbiter p99 must be
+    ≤ 0.6x FIFO."""
+    import jax
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu import svc, trace
+    from horovod_tpu.serve import batcher as batcher_mod
+    from horovod_tpu.serve import frontend as frontend_mod
+    from horovod_tpu.serve import loadgen
+    from horovod_tpu.serve import replica as replica_mod
+    from horovod_tpu.svc import arbiter
+
+    # Same harness hygiene as main_tenant: the measured latencies are
+    # millisecond-scale on a shared interpreter.
+    import sys as _sys
+
+    _sys.setswitchinterval(0.001)
+    hvd.init()
+    n = hvd.size()
+    params = replica_mod.toy_lm_params()
+    prompts = loadgen.synthetic_prompts(16, seed=7)
+    max_new = 8
+
+    # ---- (A) continuous batching vs sequential serving ------------
+    svc.reset_service()
+    rep = replica_mod.Replica(params, name="bench", warm_start=False)
+    t0 = time.monotonic()
+    seq_out = batcher_mod.serve_sequential(
+        rep, prompts, max_new_tokens=max_new
+    )
+    seq_dt = time.monotonic() - t0
+    bat = batcher_mod.ContinuousBatcher(rep, batch=8)
+    t0 = time.monotonic()
+    reqs = [bat.submit(p, max_new_tokens=max_new) for p in prompts]
+    cont_out = [r.result(timeout=300) for r in reqs]
+    cont_dt = time.monotonic() - t0
+    bat.stop()
+    assert cont_out == seq_out, (
+        "continuous batching changed generated tokens — decode must "
+        "be batch-size invariant"
+    )
+    tokens = sum(len(o) for o in cont_out)
+    seq_tps = tokens / max(seq_dt, 1e-9)
+    cont_tps = tokens / max(cont_dt, 1e-9)
+    assert cont_tps > seq_tps, (
+        f"continuous batching not faster: {cont_tps:.1f} vs "
+        f"{seq_tps:.1f} tokens/s"
+    )
+
+    # ---- (B) decode p99 under prefill bulk: FIFO vs arbiter --------
+    # 4 ms linger so one prefill burst lands in one cycle (the
+    # main_tenant calibration).
+    os.environ["HVD_TPU_SVC_CYCLE_TIME"] = "4.0"
+    rng = np.random.RandomState(11)
+    bulk_rows = 1 << 19  # 2 MiB/rank of ungrouped (DCN) prefill bulk
+    bulk = rng.randn(n, bulk_rows).astype(np.float32)
+    n_bulk = 4
+
+    def run(arbiter_on, bulk_on, steps=100, warmup=5):
+        svc.reset_service()
+        svc.fuse.set_threshold_override(0)
+        arbiter.set_enabled_override(bool(arbiter_on))
+        try:
+            r = replica_mod.Replica(params, name="bench",
+                                    warm_start=False)
+            s = svc.get_service()
+            ctxv = r.context_of(r.embed([1, 2, 3]))
+            payload = np.stack([r.partial_logits(ctxv)], axis=1)
+            t_dec = arbiter.serve_tenant("bench", "decode")
+            t_pre = arbiter.serve_tenant("bench", "prefill")
+            served = []
+            out = None
+            for it in range(warmup + steps):
+                futs_b = []
+                if bulk_on:
+                    futs_b = [
+                        s.submit(
+                            r.prefill_program(bulk_rows).with_trace(
+                                trace.new_context(
+                                    "serve.bench.prefill", tenant=t_pre
+                                )
+                            ),
+                            [bulk], producer=f"serve.bench.pre{i}",
+                            tenant=t_pre,
+                        )
+                        for i in range(n_bulk)
+                    ]
+                t_mono = time.monotonic()
+                fut = s.submit(
+                    r.decode_program(1).with_trace(trace.new_context(
+                        "serve.bench.decode", tenant=t_dec
+                    )),
+                    [payload], producer="serve.bench.dec",
+                    tenant=t_dec,
+                )
+                out = fut.result(timeout=120)[0]
+                jax.block_until_ready(out)
+                for f in futs_b:
+                    jax.block_until_ready(f.result(timeout=120))
+                if it >= warmup:
+                    served.append(fut.resolved_at - t_mono)
+            served.sort()
+
+            def q(frac):
+                return round(
+                    served[int(frac * (len(served) - 1))] * 1e3, 3
+                )
+
+            return {"p50_ms": q(0.5), "p99_ms": q(0.99),
+                    "out": np.asarray(out)}
+        finally:
+            arbiter.set_enabled_override(None)
+            svc.fuse.set_threshold_override(None)
+
+    baseline = run(arbiter_on=False, bulk_on=False)
+    fifo = run(arbiter_on=False, bulk_on=True)
+    fair = run(arbiter_on=True, bulk_on=True)
+    assert (baseline["out"] == fifo["out"]).all() and \
+        (baseline["out"] == fair["out"]).all(), (
+            "arbiter changed decode logits — ordering-only contract "
+            "broken"
+        )
+    ratio = fifo["p99_ms"] / max(fair["p99_ms"], 1e-9)
+    bound_met = fair["p99_ms"] <= 0.6 * fifo["p99_ms"]
+    assert bound_met, (
+        f"arbiter isolation bound broken: decode p99 {fair['p99_ms']}"
+        f"ms under arbiter vs {fifo['p99_ms']}ms FIFO (need <= 0.6x)"
+    )
+    record = {
+        "metric": "serve_plane",
+        "unit": "fifo_over_arbiter_decode_p99",
+        "value": round(ratio, 3),
+        "topo": os.environ.get("HVD_TPU_TOPO", ""),
+        "throughput": {
+            "requests": len(prompts),
+            "max_new_tokens": max_new,
+            "tokens": tokens,
+            "sequential_tokens_per_s": round(seq_tps, 2),
+            "continuous_tokens_per_s": round(cont_tps, 2),
+            "speedup": round(cont_tps / max(seq_tps, 1e-9), 3),
+            "outputs_bitwise_equal": True,
+            "digest": loadgen.output_digest(cont_out),
+        },
+        "decode_latency_ms": {
+            "baseline": {k: baseline[k] for k in ("p50_ms", "p99_ms")},
+            "fifo": {k: fifo[k] for k in ("p50_ms", "p99_ms")},
+            "arbiter": {k: fair[k] for k in ("p50_ms", "p99_ms")},
+        },
+        "prefill_bulk": {"program_bytes": bulk_rows * 4, "rail": "dcn",
+                         "per_step": n_bulk},
+        "arbiter_bound": 0.6,
+        "arbiter_bound_met": bool(bound_met),
+        "bitwise_across_modes": True,
+    }
+    # Serve the measurement: an in-process caller's GET /serve reports
+    # this record under "bench" (the tier-1 smoke scrapes it back).
+    frontend_mod.note_bench(record)
+    return record
+
+
 if __name__ == "__main__":
     args = sys.argv[1:]
     which = ("quant" if "--quant" in args
              else "adasum" if "--adasum" in args
              else "pipeline" if "--pipeline" in args
              else "fusion" if "--fusion" in args
+             else "serve" if "--serve" in args
              else "tenant" if "--tenant" in args else "topo")
     mains = {"quant": main_quant, "adasum": main_adasum, "topo": main,
              "pipeline": main_pipeline, "fusion": main_fusion,
-             "tenant": main_tenant}
+             "tenant": main_tenant, "serve": main_serve}
     names = {"quant": "quant_fused_vs_phase", "adasum": "adasum_vs_sum",
              "topo": "topo_hier_vs_flat",
              "pipeline": "railpipe_overlap",
              "fusion": "svc_fusion_amortization",
-             "tenant": "svc_tenant_interference"}
+             "tenant": "svc_tenant_interference",
+             "serve": "serve_plane"}
     try:
         print(json.dumps(mains[which]()))
     except Exception as e:  # degraded-run hardening: always emit a line
