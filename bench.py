@@ -443,6 +443,7 @@ def _device_free_records(result: dict, deadline_s: float,
     _maybe_quant_backend(result, deadline_s, t_start)
     _maybe_adasum(result, deadline_s, t_start)
     _maybe_railpipe(result, deadline_s, t_start)
+    _maybe_onestep(result, deadline_s, t_start)
     _maybe_svc_fusion(result, deadline_s, t_start)
     _maybe_tenant(result, deadline_s, t_start)
     _maybe_serve(result, deadline_s, t_start)
@@ -597,6 +598,41 @@ def _maybe_railpipe(result: dict, deadline_s: float,
         )
     except Exception as e:
         result["railpipe_overlap"] = {"error": f"{type(e).__name__}: {e}"}
+
+
+def _maybe_onestep(result: dict, deadline_s: float,
+                   t_start: float) -> None:
+    """Append the ``onestep_hostgap`` record (HVD_BENCH_ONESTEP=0
+    skips): the whole-step single-dispatch fold off vs on on the
+    N-small-buckets service burst via ``tools/topo_bench.py
+    --onestep`` in a scrubbed 8-device CPU subprocess
+    (docs/exchange_ir.md "Whole-step emission").  Structured-skip on
+    deadline pressure like the other device-free records."""
+    if os.environ.get("HVD_BENCH_ONESTEP", "1") == "0":
+        return
+    if deadline_s - (time.monotonic() - t_start) < 75:
+        result["onestep_hostgap"] = {
+            "error": "skipped: deadline too close"
+        }
+        return
+    try:
+        import subprocess as sp
+
+        repo = os.path.dirname(os.path.abspath(__file__))
+        env = _scrubbed_cpu_env()
+        env.setdefault("HVD_TPU_TOPO", "2x4")
+        out = sp.run(
+            [sys.executable, os.path.join(repo, "tools", "topo_bench.py"),
+             "--onestep"],
+            capture_output=True, text=True, timeout=300, env=env, cwd=repo,
+        )
+        line = (out.stdout or "").strip().splitlines()
+        result["onestep_hostgap"] = (
+            json.loads(line[-1]) if out.returncode == 0 and line
+            else {"error": f"rc={out.returncode}: {(out.stderr or '')[-300:]}"}
+        )
+    except Exception as e:
+        result["onestep_hostgap"] = {"error": f"{type(e).__name__}: {e}"}
 
 
 def _scrubbed_cpu_env() -> dict:

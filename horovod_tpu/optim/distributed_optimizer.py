@@ -28,7 +28,7 @@ transform in your own shard_map.
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple, Optional, Sequence
+from typing import Any, Callable, NamedTuple, Optional, Sequence
 
 import jax
 import jax.numpy as jnp
@@ -127,6 +127,7 @@ def _reduce_gradients(
     sparse_as_dense: bool = False,
     residuals: Any = None,
     lowering: Optional[str] = None,
+    update: Optional[Callable[[Any], Any]] = None,
 ) -> Any:
     """Bucket, compress, and allreduce a gradient pytree as few fused
     collectives (the FuseResponses + fusion-buffer path, compiled).
@@ -145,6 +146,18 @@ def _reduce_gradients(
     reduction (``None`` defers to ``HVD_TPU_TOPO_LOWER`` /
     ``SchedConfig.lowering``) — the Adasum optimizer preset passes
     ``"hier_adasum"``.
+
+    ``update`` (a closure over the *reduced* gradient tree) engages
+    whole-step emission (``HVD_TPU_ONESTEP``): on the scheduler path
+    the decompress+update epilogue is handed to
+    :func:`~horovod_tpu.sched.execute.exchange` and — when the fold is
+    engaged — stitched into the exchange emission itself, so XLA
+    compiles reduce + update as one program.  The call then returns
+    ``update(reduced_tree)`` (with residuals:
+    ``(update_result, new_residuals)``) instead of the reduced tree.
+    Paths the fold does not cover (legacy single-pass, sparse leaves,
+    ``HVD_TPU_ONESTEP=off``) apply ``update`` after the reduction —
+    value-identical, the fold is ordering-only.
     """
     from ..ops.sparse import IndexedSlices, densify, sparse_allreduce
 
@@ -200,7 +213,7 @@ def _reduce_gradients(
         )
     leaves, treedef = jax.tree.flatten(grads, is_leaf=is_sparse)
     if not leaves:
-        return grads
+        return update(grads) if update is not None else grads
     sparse_idx = [i for i, g in enumerate(leaves) if is_sparse(g)]
     if sparse_idx:
         if quantized:
@@ -273,7 +286,10 @@ def _reduce_gradients(
             out[i] = t
         for i, t in reduced_sparse.items():
             out[i] = t
-        return jax.tree.unflatten(treedef, out)
+        tree = jax.tree.unflatten(treedef, out)
+        # Sparse leaves never fold (allgather-of-slices has no fused
+        # emission); the update applies after, value-identical.
+        return update(tree) if update is not None else tree
 
     compressed = [compression.compress(g) for g in leaves]
     wire = [c[0] for c in compressed]
@@ -522,16 +538,44 @@ def _reduce_gradients(
             )
             if hier_ok else None
         )
-        reduced = _sched.exchange(
+        if update is None:
+            reduced = _sched.exchange(
+                wire, schedule, reduce_bucket_flat,
+                barriers=cfg.barriers, timeline=tl, axis=axis,
+                phases=phase_factory,
+            )
+            out = [
+                compression.decompress(t, c)
+                for t, c in zip(reduced, ctxs)
+            ]
+            tree = jax.tree.unflatten(treedef, out)
+            if residuals is not None:
+                return tree, jax.tree.unflatten(treedef, res_out)
+            return tree
+
+        # Whole-step emission (HVD_TPU_ONESTEP): hand the decompress +
+        # optimizer-update closure to the exchange so an engaged fold
+        # stitches it INTO the traced emission (one dispatch unit for
+        # reduce + update).  A None result means the fold did not
+        # engage — the epilogue then applies right here, on the exact
+        # jaxpr the epilogue-free path would have built.
+        def _epilogue(red_leaves):
+            out_ = [
+                compression.decompress(t, c)
+                for t, c in zip(red_leaves, ctxs)
+            ]
+            return update(jax.tree.unflatten(treedef, out_))
+
+        reduced, update_result = _sched.exchange(
             wire, schedule, reduce_bucket_flat,
             barriers=cfg.barriers, timeline=tl, axis=axis,
-            phases=phase_factory,
+            phases=phase_factory, epilogue=_epilogue,
         )
-        out = [compression.decompress(t, c) for t, c in zip(reduced, ctxs)]
-        tree = jax.tree.unflatten(treedef, out)
+        if update_result is None:
+            update_result = _epilogue(reduced)
         if residuals is not None:
-            return tree, jax.tree.unflatten(treedef, res_out)
-        return tree
+            return update_result, jax.tree.unflatten(treedef, res_out)
+        return update_result
 
     # Legacy single-pass path (HVD_TPU_SCHED=off): in-order buckets, no
     # sequencing barriers — one monolithic fused exchange per dtype run.
@@ -557,6 +601,10 @@ def _reduce_gradients(
 
     out = [compression.decompress(t, c) for t, c in zip(reduced, ctxs)]
     tree = jax.tree.unflatten(treedef, out)
+    if update is not None:
+        # Legacy single-pass engine: no fold (the path has no program
+        # emission to stitch into); the update applies after.
+        tree = update(tree)
     if residuals is not None:
         # Legacy engine: EF rides the scheduler; residuals pass through
         # untouched (zeros behave as plain quantization).
@@ -611,7 +659,7 @@ def DistributedOptimizer(
     if k < 1:
         raise ValueError("backward_passes_per_step must be >= 1")
 
-    def reduce_fn(grads, residuals=None):
+    def reduce_fn(grads, residuals=None, update=None):
         return _reduce_gradients(
             grads,
             axis=axis,
@@ -625,6 +673,7 @@ def DistributedOptimizer(
             sparse_as_dense=sparse_as_dense,
             residuals=residuals,
             lowering=lowering,
+            update=update,
         )
 
     def _ef_active() -> bool:
@@ -660,6 +709,29 @@ def DistributedOptimizer(
     def update_fn(grads, state: DistributedOptimizerState, params=None):
         residual = getattr(state, "residual", None)
         if k == 1:
+            from ..xir import interp as _xinterp
+
+            if _xinterp.onestep_mode() != "off":
+                # Whole-step emission (HVD_TPU_ONESTEP): the inner
+                # update rides into the reduction as a closure, so an
+                # engaged fold compiles exchange + update as ONE
+                # dispatch unit.  Identical math in identical order —
+                # the closure body is the exact two lines below.
+                def _apply(reduced_tree):
+                    return optimizer.update(
+                        reduced_tree, state.inner, params
+                    )
+
+                if residual is not None:
+                    (updates, inner), residual = reduce_fn(
+                        grads, residual, update=_apply
+                    )
+                else:
+                    updates, inner = reduce_fn(grads, update=_apply)
+                return updates, DistributedOptimizerState(
+                    counter=state.counter + 1, acc=None, inner=inner,
+                    residual=residual,
+                )
             if residual is not None:
                 reduced, residual = reduce_fn(grads, residual)
             else:
@@ -957,6 +1029,14 @@ class TrainStep:
             opt_state, batch = args
             model_state = None
         specs = self._state_specs(opt_state)
+        from ..xir import interp as _xinterp
+
+        # Whole-step emission mode is a trace-time constant (the update
+        # closure either folds into the exchange or runs after it), so
+        # each resolved mode is its own compiled variant — flipping
+        # HVD_TPU_ONESTEP mid-run retraces instead of silently running
+        # the stale shape.
+        onestep = _xinterp.onestep_mode()
         threshold = None
         hier = None
         quant = None
@@ -970,7 +1050,7 @@ class TrainStep:
                 frozen_key = (
                     jax.tree.structure(opt_state),
                     jax.tree.structure(model_state),
-                    threshold, hier, quant,
+                    threshold, hier, quant, onestep,
                 )
                 self._step_cache = {
                     k: v for k, v in self._step_cache.items()
@@ -979,7 +1059,7 @@ class TrainStep:
         key = (
             jax.tree.structure(opt_state),
             jax.tree.structure(model_state),
-            threshold, hier, quant,
+            threshold, hier, quant, onestep,
         )
         fn = self._step_cache.get(key)
         built_here = fn is None
@@ -1000,7 +1080,14 @@ class TrainStep:
         # the flight recorder's slow-step check and derives the
         # measured topo.rail_busy_frac gauges.  Host-side only — the
         # traced computation is untouched.
-        _step_span = _trace.step(compiled=not built_here)
+        # The onestep attr rides the step span so prof/hostgap.py
+        # counts the folded step as exactly one dispatch (the exec span
+        # covers exchange + update; without the attr a fallback-demoted
+        # wrapper would read 0 and the epilogue could double-count).
+        _step_span = _trace.step(
+            compiled=not built_here,
+            onestep=1 if onestep == "on" else 0,
+        )
         _step_span.__enter__()
         _t0 = _time.perf_counter()
         try:

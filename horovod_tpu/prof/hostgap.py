@@ -66,6 +66,27 @@ def _union_seconds(intervals: List[Tuple[float, float]]) -> float:
     return total + (cur1 - cur0)
 
 
+def _count_dispatches(span: Any, *, root: bool = True) -> int:
+    """Dispatch count of one span tree, aware of the single-dispatch
+    step shape (``HVD_TPU_ONESTEP``): a span carrying a truthy
+    ``onestep`` attr IS exactly one host round-trip — its one exec span
+    covers exchange + update, so the subtree neither undercounts to 0
+    (when the executor wrapper lost its exec span) nor double-counts
+    the stitched epilogue.  Trees without ``onestep`` marks count every
+    call-shaped (``exec``/``dispatch``) span, same as the flat walk
+    this replaces."""
+    attrs = span.attrs or {}
+    if attrs.get("onestep") and (root or span.phase in DISPATCH_PHASES):
+        # A marked step root or call-shaped span is one dispatch no
+        # matter what nests under it; marked emission spans (phase
+        # "exchange"/"bucket") are not round-trips and fall through.
+        return 1
+    n = 0 if root or span.phase not in DISPATCH_PHASES else 1
+    for child in span.children:
+        n += _count_dispatches(child, root=False)
+    return n
+
+
 def attribute(span: Any) -> Dict[str, Any]:
     """Pure device-busy/host-gap attribution of one step span tree.
 
@@ -76,14 +97,12 @@ def attribute(span: Any) -> Dict[str, Any]:
     wall = span.dur
     intervals: List[Tuple[float, float]] = []
     per_tenant: Dict[str, List[Tuple[float, float]]] = {}
-    dispatches = 0
+    dispatches = _count_dispatches(span)
     for s in span.walk():
         if s is span:
             continue
         phase = s.phase
         rail = s.attrs.get("rail") if s.attrs else None
-        if phase in DISPATCH_PHASES:
-            dispatches += 1
         if phase not in DEVICE_PHASES and rail not in ("ici", "dcn"):
             continue
         # only leaves of the device-work subtree count as intervals;

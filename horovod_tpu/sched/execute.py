@@ -399,7 +399,8 @@ def exchange(
     kind: str = "dense_grad",
     axis: Any = None,
     phases: Optional[Callable[[Bucket], Optional[_PhasedBucket]]] = None,
-) -> List[jax.Array]:
+    epilogue: Optional[Callable[[List[jax.Array]], Any]] = None,
+) -> Any:
     """Run ``schedule`` over the ``wire`` leaves: per bucket, flatten ->
     one collective per dtype (via ``reduce_flat(flat, bucket)``) ->
     slice back out.  Returns the reduced leaves in original flatten
@@ -423,6 +424,21 @@ def exchange(
     ``wire`` is quantized trades that identity for compressed wire
     bytes (the reducer routes it through ops/quantized.py).
 
+    ``epilogue`` opts the schedule into whole-step emission
+    (``HVD_TPU_ONESTEP``, docs/exchange_ir.md "Whole-step emission"):
+    when :func:`~horovod_tpu.xir.interp.onestep_engaged` folds, the
+    caller's post-exchange closure (decompress + optimizer update) is
+    stitched onto the reduced leaves *inside* this traced emission via
+    :func:`~horovod_tpu.xir.interp.emit_step`, so XLA compiles
+    exchange + update as ONE program instead of two dispatch units.
+    With ``epilogue`` the return value is ``(reduced, result)`` where
+    ``result`` is the closure's output when the fold engaged and
+    ``None`` when it did not — a ``None`` result means the caller must
+    apply the epilogue itself, which keeps the ``off`` path's jaxpr
+    construction literally identical to the epilogue-free call.  The
+    fold is ordering-only (optimization_barrier ties), so f32 dense
+    losses stay bitwise identical in every mode.
+
     ``phases`` (a :func:`hier_phase_factory`) opts the schedule into
     the rail pipeliner: when ``HVD_TPU_XIR_PIPELINE`` engages
     (``xir.pipeline.engaged``), decomposable hier buckets emit as
@@ -433,6 +449,7 @@ def exchange(
     in every mode.
     """
     from .. import trace, xir
+    from ..xir import interp as _xinterp
     from ..xir import pipeline as railpipe
 
     t0 = time.perf_counter()
@@ -503,10 +520,23 @@ def exchange(
         "sched.pipeline.engaged", 1.0 if pipelined else 0.0,
         {"mode": railpipe.mode()},
     )
+    # Whole-step fold (xir/interp.py onestep): the update closure
+    # counts as one more dispatch unit on top of the bucket chain, so
+    # auto engages whenever there is anything to stitch it to.
+    onestep_fold = bool(
+        epilogue is not None
+        and _xinterp.onestep_engaged(len(schedule) + 1)
+    )
+    metrics.set_gauge(
+        "sched.onestep.engaged", 1.0 if onestep_fold else 0.0,
+        {"mode": _xinterp.onestep_mode()},
+    )
+    epilogue_result = None
     with trace.span(
         f"exchange.{kind}", "exchange",
         ctx=program.trace if program is not None else None,
         kind=kind, buckets=len(schedule), pipelined=pipelined,
+        onestep=int(onestep_fold),
     ):
         if pipelined:
             reduced = _exchange_pipelined(
@@ -552,6 +582,14 @@ def exchange(
                     "sched.bytes_per_bucket", bucket.nbytes,
                     buckets=metrics.BYTES_BUCKETS,
                 )
+        if onestep_fold:
+            # Stitch the caller's decompress+update closure onto the
+            # reduced leaves INSIDE this emission: one traced region,
+            # one dispatch unit (the exec span prof/hostgap.py counts
+            # once under onestep).
+            epilogue_result = _xinterp.emit_step(
+                reduced, epilogue, src=f"sched.{kind}"
+            )
     metrics.inc_counter("sched.plans")
     metrics.inc_counter("sched.buckets", len(schedule))
     metrics.inc_counter("sched.exchange_bytes", schedule.total_bytes)
@@ -561,6 +599,8 @@ def exchange(
     # Emission cost of the exchange subgraph (trace-time under jit; the
     # device-side wire time is the profiler's/timeline's to attribute).
     metrics.observe("sched.exchange_seconds", time.perf_counter() - t0)
+    if epilogue is not None:
+        return reduced, epilogue_result
     return reduced
 
 

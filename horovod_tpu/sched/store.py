@@ -100,6 +100,16 @@ def knob_fingerprint(include_svc: bool = True) -> str:
         items.append(("HVD_TPU_XIR_PIPELINE(resolved)", _railpipe.mode()))
     except Exception:
         pass
+    try:
+        # Whole-step emission mode, resolved for the same reason:
+        # "off" entries (per-unit dispatch wall clocks) must never
+        # cross with "on"/"auto" ones (single-dispatch constants), and
+        # unset/"auto" must agree with an explicit "auto".
+        from ..xir import interp as _xinterp
+
+        items.append(("HVD_TPU_ONESTEP(resolved)", _xinterp.onestep_mode()))
+    except Exception:
+        pass
     if include_svc:
         try:
             from ..svc import fuse as _svc_fuse, params as _svc_params

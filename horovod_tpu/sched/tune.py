@@ -114,6 +114,8 @@ class ScheduleTuner:
                  backend_candidates=("phase", "fused"),
                  explore_pipeline: bool = False,
                  pipeline_candidates=("off", "on", "auto"),
+                 explore_onestep: bool = False,
+                 onestep_candidates=("off", "on", "auto"),
                  store="env",
                  store_key=None,
                  store_kind="dense_grad",
@@ -156,6 +158,21 @@ class ScheduleTuner:
             self._pipeline_frozen = None
         else:
             self._pipeline_frozen = "off"
+        # Whole-step-emission exploration (HVD_TPU_ONESTEP as a tuned
+        # dimension, xir/interp.py): each window runs one candidate —
+        # applied process-wide through the env knob, since the fold
+        # resolves at trace time — scored from the same registry
+        # deltas; the winner freezes, pins the knob, and persists in
+        # entry meta.onestep.  The fold is ordering-only (losses
+        # bitwise-identical across candidates), so the score ranks
+        # pure wall-clock: dispatch round-trips saved vs the larger
+        # compiled program.
+        self._explore_onestep = explore_onestep
+        self._onestep_candidates = tuple(onestep_candidates)
+        self._onestep_scores: Dict[str, float] = {}
+        self._onestep_frozen: Optional[str] = (
+            None if explore_onestep else "env"
+        )
         # Lowering exploration (the HVD_TPU_TOPO_LOWER knob as a tuned
         # dimension): each window runs one candidate — including
         # hier_adasum, the adaptive cross-slice combine the cost model
@@ -239,6 +256,13 @@ class ScheduleTuner:
                 env.set_env("XIR_PIPELINE", pipe)
         elif self._pipeline_frozen is None:
             self._pipeline_frozen = "env"
+        onestep = str((entry.get("meta") or {}).get("onestep", ""))
+        if onestep in self._onestep_candidates:
+            self._onestep_frozen = onestep
+            if self._explore_onestep:
+                env.set_env("ONESTEP", onestep)
+        elif self._onestep_frozen is None:
+            self._onestep_frozen = "env"
         self._best_score = float(entry.get("score", 0.0))
         self._db_written = True  # a re-write would only echo the entry
         metrics.inc_counter("sched.tune.db_hit")
@@ -264,7 +288,8 @@ class ScheduleTuner:
             lowering=self.lowering(),
             score=self._best_score,
             meta={"backend": self.backend(),
-                  "pipeline": self.pipeline()},
+                  "pipeline": self.pipeline(),
+                  "onestep": self.onestep()},
         )
 
     @staticmethod
@@ -327,6 +352,26 @@ class ScheduleTuner:
                 return p
         return "auto"
 
+    def onestep(self) -> str:
+        """Whole-step-emission mode suggestion for the next window
+        (``HVD_TPU_ONESTEP``): the next unscored candidate while
+        exploring, the frozen winner after, or the env knob's resolved
+        mode when the fold is not a tuned dimension.  Exploration
+        applies the suggestion through the env knob in
+        :meth:`begin_window` — the fold resolves at trace time, so the
+        caller rebuilds its step per window exactly as with pipeline
+        exploration."""
+        if self._onestep_frozen == "env":
+            from ..xir import interp as xir_interp
+
+            return xir_interp.onestep_mode()
+        if self._onestep_frozen is not None:
+            return self._onestep_frozen
+        for m in self._onestep_candidates:
+            if m not in self._onestep_scores:
+                return m
+        return "auto"
+
     def lowering(self) -> str:
         """Lowering suggestion for the next window
         (``build_schedule(..., lowering=...)``): the next unscored
@@ -350,6 +395,9 @@ class ScheduleTuner:
         if self._pipeline_frozen is None:
             # pipeline candidates apply process-wide (trace-time knob)
             env.set_env("XIR_PIPELINE", self.pipeline())
+        if self._onestep_frozen is None:
+            # onestep candidates apply process-wide (trace-time knob)
+            env.set_env("ONESTEP", self.onestep())
         self._baseline = registry_view()
 
     def end_window(self) -> float:
@@ -403,6 +451,24 @@ class ScheduleTuner:
                 metrics.set_gauge(
                     "sched.tune_pipeline_frozen", 1.0,
                     {"pipeline": self._pipeline_frozen},
+                )
+        elif self._onestep_frozen is None:
+            m = self.onestep()
+            self._onestep_scores[m] = max(
+                self._onestep_scores.get(m, 0.0), score
+            )
+            metrics.set_gauge(
+                "sched.tune_onestep_score", score, {"onestep": m}
+            )
+            if all(c in self._onestep_scores
+                   for c in self._onestep_candidates):
+                self._onestep_frozen = max(
+                    self._onestep_scores, key=self._onestep_scores.get
+                )
+                env.set_env("ONESTEP", self._onestep_frozen)
+                metrics.set_gauge(
+                    "sched.tune_onestep_frozen", 1.0,
+                    {"onestep": self._onestep_frozen},
                 )
         elif self._lowering_frozen is None:
             lo = self.lowering()
@@ -476,5 +542,6 @@ class ScheduleTuner:
             and self._lowering_frozen is not None
             and self._backend_frozen is not None
             and self._pipeline_frozen is not None
+            and self._onestep_frozen is not None
             and self.tuner.converged
         )

@@ -160,6 +160,9 @@ class Replica:
         self.flops = 0  # host-side FLOP odometer (per-replica MFU)
         self._store = None
         self._store_key: Optional[str] = None
+        # Whole-step decode executors (HVD_TPU_ONESTEP): one compiled
+        # reduce+epilogue program per decode signature.
+        self._onestep_decode: Dict[Tuple, Any] = {}
         if warm_start:
             self._warm_start()
 
@@ -367,17 +370,80 @@ class Replica:
         same full logits — shards are replicated group-to-group)."""
         return self.tp_groups[0][0]
 
+    def _decode_onestep(self, program,
+                        payload: np.ndarray) -> Optional[np.ndarray]:
+        """Single-program decode (``HVD_TPU_ONESTEP``): the grouped TP
+        all_reduce and the read-row logits epilogue compile as ONE
+        jitted program — decode's milliseconds-scale steps pay a
+        single dispatch instead of an exchange round-trip plus a host
+        epilogue.  Serves the single-row-group shape only (there the
+        reduce is the identity by contract, so the fold is trivially
+        bitwise-identical to the inline reduce; multi-rank decode
+        keeps the service path — cross-step arbitration is the
+        service's job).  Returns None when the fold cannot run
+        (runtime down) and the caller keeps the inline path."""
+        from ..runtime import WORLD_AXIS, get_runtime_or_none
+        from ..xir import interp
+
+        rt = get_runtime_or_none()
+        if rt is None:
+            return None
+        key = (program.signature(),)
+        fn = self._onestep_decode.get(key)
+        if fn is None:
+            import jax
+            from jax.sharding import PartitionSpec as P
+
+            from .. import prof
+
+            def body(a):
+                x = a[0]
+                out = interp.execute(
+                    program, [x], axis_size=1, store=False,
+                )[0]
+                # The epilogue (complete-logits row select) stitches
+                # onto the reduce via the onestep emission — one
+                # program, one exec span.
+                return interp.emit_step(
+                    [out], lambda ts: ts[0], src="serve.decode",
+                )
+
+            fn = prof.wrap_executor(
+                jax.jit(jax.shard_map(
+                    body, mesh=rt.mesh, in_specs=(P(WORLD_AXIS),),
+                    out_specs=P(), check_vma=False,
+                )),
+                key=f"serve_decode_onestep_{len(self._onestep_decode)}",
+                kind="step", workload="serve.decode_onestep",
+            )
+            self._onestep_decode[key] = fn
+        return np.asarray(fn(payload))
+
     def decode_logits(self, ctxs: np.ndarray,
                       timeout: float = 120.0) -> np.ndarray:
         """Full logits ``[B, V]`` for a batch of contexts: per-request
         partials stacked into one ``[n, B, V]`` payload, completed by a
-        single grouped decode all_reduce through the service."""
+        single grouped decode all_reduce through the service — or, on
+        the single-row-group shape under ``HVD_TPU_ONESTEP``, by one
+        compiled reduce+epilogue program (:meth:`_decode_onestep`)."""
+        from ..xir import interp as _xinterp
+
         ctxs = np.atleast_2d(np.asarray(ctxs, np.float32))
         b = ctxs.shape[0]
         payload = np.stack(
             [self.partial_logits(c) for c in ctxs], axis=1
         )  # [n, B, V]
-        out = self.exchange("decode", self.decode_program(b), payload,
+        program = self.decode_program(b)
+        if self.n <= 1 and _xinterp.onestep_engaged(2):
+            try:
+                folded = self._decode_onestep(program, payload)
+            except Exception:
+                folded = None  # fold is a lever, never a new failure
+            if folded is not None:
+                metrics.inc_counter("serve.exchanges.decode")
+                metrics.inc_counter("serve.onestep.decodes")
+                return folded
+        out = self.exchange("decode", program, payload,
                             timeout=timeout)
         return np.asarray(out)[self._read_row()]
 

@@ -57,6 +57,26 @@ class CachedResponse:
     compile_seconds: float = 0.0
 
 
+@dataclasses.dataclass(frozen=True)
+class CycleProgram:
+    """Stand-in program for a whole-step (``HVD_TPU_ONESTEP``) cycle
+    executor: the ResponseCache caches ONE executor per *fused-cycle
+    signature* — the ordered tuple of every member program's own
+    ``(signature, axis_size)`` — and this stub gives that entry the
+    ``kind``/``signature()`` surface the profiling wrap and the
+    ``/prof`` compile-cost table expect.  It carries no ops: per-unit
+    traffic accounting stays with the member programs."""
+
+    member_keys: Tuple
+    kind: str = "onestep"
+    ops: Tuple = ()
+    trace: Any = None
+    lowered: bool = True
+
+    def signature(self) -> Tuple:
+        return ("onestep", self.member_keys)
+
+
 class ResponseCache:
     """Signature -> :class:`CachedResponse`, LRU, fit-epoch aware."""
 
@@ -73,6 +93,26 @@ class ResponseCache:
         from ..topo import fit as topo_fit
 
         return (program.signature(), axis_size, topo_fit.fit_epoch())
+
+    @staticmethod
+    def cycle_key(members) -> Tuple:
+        """Cache identity of one whole-step cycle executor
+        (``HVD_TPU_ONESTEP``): the ordered per-unit ``(signature,
+        axis_size)`` tuples plus the topo-fit epoch.  Order matters —
+        the executor scatters outputs positionally — and a different
+        unit mix is a different compiled program, so the key never
+        aliases across cycle shapes (nor across modes: only the fold
+        path builds these keys at all)."""
+        from ..topo import fit as topo_fit
+
+        return (
+            "onestep_cycle",
+            tuple(
+                (program.signature(), axis_size)
+                for program, axis_size in members
+            ),
+            topo_fit.fit_epoch(),
+        )
 
     def lookup(self, key: Tuple) -> Optional[CachedResponse]:
         import time

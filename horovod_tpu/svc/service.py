@@ -54,7 +54,7 @@ from ..utils import env
 from ..utils.logging import get_logger
 from . import arbiter as arbiter_mod
 from . import fuse, params as svc_params
-from .cache import CachedResponse, ResponseCache
+from .cache import CachedResponse, CycleProgram, ResponseCache
 from .negotiate import Negotiator
 from .queue import Submission, SvcFuture, TensorQueue
 
@@ -391,6 +391,26 @@ class ExchangeService:
             passthrough + [s for s, p in resolved if p is None],
             key=lambda s: pos[id(s)],
         )
+        # Whole-step fold (HVD_TPU_ONESTEP, xir/interp.py): one jitted
+        # executor for the ENTIRE cycle — every fused buffer and every
+        # passthrough solo — instead of one dispatch per unit.  The
+        # per-unit bodies are re-emitted op for op in the same order,
+        # so outputs are bitwise identical; a failed fold falls back
+        # to the per-unit paths below (svc.onestep.fallback).
+        from ..xir import interp as xir_interp
+
+        units = len(buffers) + len(passthrough)
+        if units >= 1 and xir_interp.onestep_engaged(units):
+            if self._dispatch_onestep(buffers, passthrough):
+                return
+            for sub in passthrough:
+                if not sub.future.done():
+                    metrics.inc_counter("svc.fusion.buffers_out")
+                    self._dispatch(sub)
+            for fb in buffers:
+                if not all(m.sub.future.done() for m in fb.members):
+                    self._dispatch_fused(fb)
+            return
         for sub in passthrough:
             metrics.inc_counter("svc.fusion.buffers_out")
             self._dispatch(sub)
@@ -496,6 +516,200 @@ class ExchangeService:
             body, mesh=mesh,
             in_specs=tuple(spec for _ in range(n_in)),
             out_specs=tuple(spec for _ in range(n_in)),
+            check_vma=False,
+        ))
+
+    def _dispatch_onestep(self, buffers, passthrough) -> bool:
+        """Execute one cycle's fused buffers + passthrough solos as a
+        SINGLE compiled dispatch (the ``HVD_TPU_ONESTEP`` fold, ROADMAP
+        item 4): the ResponseCache holds one whole-step executor per
+        fused-cycle signature (:meth:`ResponseCache.cycle_key`), so a
+        steady-state cycle pays exactly one host round-trip however
+        many fusion classes it carries.  Returns True when every
+        member's future resolved through the fold; False hands the
+        cycle back to the per-unit paths (fusion stays a performance
+        lever, never a new way to wedge a producer).  The executor
+        re-emits each unit's body op for op in cycle order — outputs
+        are bitwise identical to the per-unit dispatches."""
+        from .. import trace
+
+        # Resolve every unit first, dispatching nothing: a resolution
+        # failure (e.g. an unlowerable program) must leave the whole
+        # cycle to the per-unit paths, where the failure is recorded on
+        # the right future.
+        try:
+            units = []  # ("solo", sub, program) | ("fused", fb, program)
+            for sub in passthrough:
+                with trace.use_context(sub.trace):
+                    entry = self._resolve_program(
+                        sub.program, sub.axis_size
+                    )
+                units.append(("solo", sub, entry.program))
+            for fb in buffers:
+                fused_prog = fuse.build_fused_program(fb)
+                entry = self._resolve_program(fused_prog, fb.axis_size)
+                units.append(("fused", fb, entry.program))
+        except BaseException:  # noqa: BLE001 - degrade, never wedge
+            metrics.inc_counter("svc.onestep.fallback")
+            return False
+        t0 = time.monotonic()
+        try:
+            key = ResponseCache.cycle_key([
+                (prog, obj.axis_size) for _kind, obj, prog in units
+            ])
+            entry = self.cache.lookup(key)
+            if entry is None:
+                entry = self.cache.insert(key, CachedResponse(
+                    program=CycleProgram(member_keys=key[1]),
+                ))
+            if entry.executor is None:
+                entry.executor = self._wrap_executor(
+                    self._build_onestep_executor(units), entry
+                )
+            args = []
+            for kind_, obj, _prog in units:
+                if kind_ == "solo":
+                    args.extend(obj.args)
+                else:
+                    args.extend(
+                        x for m in obj.members for x in m.sub.args
+                    )
+            n_members = len(passthrough) + sum(
+                len(fb.members) for fb in buffers
+            )
+            with trace.span(
+                "dispatch.onestep", "dispatch", onestep=1,
+                units=len(units), members=n_members,
+            ), self._inflight_guard():
+                outs = entry.executor(*args)
+            metrics.inc_counter("svc.dispatches")
+            metrics.inc_counter("svc.onestep.cycles")
+            metrics.inc_counter("svc.onestep.units", len(units))
+            pos = 0
+            for kind_, obj, prog in units:
+                self._record_timeline(prog)
+                if kind_ == "solo":
+                    sub = obj
+                    take = len(prog.ops)
+                    sub.future.set_result(list(outs[pos:pos + take]))
+                    pos += take
+                    metrics.inc_counter("svc.fusion.buffers_out")
+                    metrics.inc_counter(f"svc.programs.{prog.kind}")
+                    self.arbiter.charge_dispatch(sub, prog,
+                                                 sub.axis_size)
+                    self.arbiter.release(sub)
+                    trace.record_complete(
+                        f"dispatch.{prog.kind}", "dispatch", t0,
+                        ctx=sub.trace, producer=sub.producer,
+                        seq=sub.seq, kind=prog.kind, onestep=1,
+                    )
+                else:
+                    fb = obj
+                    metrics.inc_counter("svc.fusion.buffers_out")
+                    metrics.inc_counter(
+                        "svc.fusion.members", len(fb.members)
+                    )
+                    metrics.inc_counter(
+                        "svc.fusion.bytes", fb.payload_bytes
+                    )
+                    metrics.inc_counter(
+                        "svc.fusion.padding_bytes", fb.padding_bytes
+                    )
+                    for m in fb.members:
+                        take = len(m.segments)
+                        m.sub.future.set_result(
+                            list(outs[pos:pos + take])
+                        )
+                        pos += take
+                        self.arbiter.charge_dispatch(
+                            m.sub, m.program, m.sub.axis_size
+                        )
+                        self.arbiter.release(m.sub)
+                        metrics.inc_counter(
+                            "svc.dispatches.fused_members"
+                        )
+                        metrics.inc_counter(
+                            f"svc.programs.{m.program.kind}"
+                        )
+                        trace.record_complete(
+                            f"dispatch.{m.program.kind}", "dispatch",
+                            t0, ctx=m.sub.trace,
+                            producer=m.sub.producer, seq=m.sub.seq,
+                            kind=m.program.kind, fused=1, onestep=1,
+                        )
+            return True
+        except BaseException:  # noqa: BLE001 - degrade, never wedge
+            metrics.inc_counter("svc.onestep.fallback")
+            return False
+
+    def _build_onestep_executor(self, units):
+        """Jitted whole-cycle emission: ONE traced body re-runs every
+        unit in cycle order — a fused buffer packs/reduces/unpacks
+        exactly as ``_build_fused_executor``'s body, a solo peels rank
+        rows and runs the interpreter exactly as ``_build_executor``'s
+        — so the host pays one executor call per CYCLE and XLA is free
+        to overlap the independent collectives inside it."""
+        from ..runtime import WORLD_AXIS, get_runtime
+        from ..xir import interp
+
+        mesh = get_runtime().mesh
+        spec = P(WORLD_AXIS)
+
+        # (kind, program, n_payloads, axis_size, process_set, align)
+        plans = []
+        n_args = 0
+        for kind_, obj, prog in units:
+            if kind_ == "solo":
+                take = len(obj.args)
+                plans.append((
+                    "solo", prog, take, obj.axis_size,
+                    obj.process_set, None,
+                ))
+            else:
+                take = sum(len(m.segments) for m in obj.members)
+                fused_op = prog.ops[0]
+                align = fuse.align_elems(
+                    fused_op.wire, fused_op.attr("dtype")
+                )
+                plans.append((
+                    "fused", prog, take, obj.axis_size, None, align,
+                ))
+            n_args += take
+
+        def body(*args):
+            outs = []
+            pos = 0
+            for kind_, prog, take, axis_size, pset, align in plans:
+                chunk = args[pos:pos + take]
+                pos += take
+                if kind_ == "solo":
+                    ins = [
+                        jax.tree.map(lambda x: x[0], a) for a in chunk
+                    ]
+                    res = interp.execute(
+                        prog, ins, axis_size=axis_size,
+                        process_set=pset, store=False,
+                    )
+                    outs.extend(
+                        jax.tree.map(lambda y: y[None], o) for o in res
+                    )
+                else:
+                    ins = [a[0] for a in chunk]
+                    buf, pack_layout = fuse.pack_group(ins, align)
+                    out = interp.execute(
+                        prog, [buf], axis_size=axis_size, store=False,
+                    )[0]
+                    outs.extend(
+                        y[None] for y in fuse.unpack_group(
+                            out, pack_layout
+                        )
+                    )
+            return tuple(outs)
+
+        return jax.jit(jax.shard_map(
+            body, mesh=mesh,
+            in_specs=tuple(spec for _ in range(n_args)),
+            out_specs=tuple(spec for _ in range(n_args)),
             check_vma=False,
         ))
 

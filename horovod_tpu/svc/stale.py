@@ -123,6 +123,17 @@ class StaleTrainStep:
         spec = P(axis)
         groups = [list(g) for g in self._intra]
         gsize = self._group_size
+        from ..xir import interp as xir_interp
+
+        # Whole-step emission (HVD_TPU_ONESTEP): the step body below is
+        # already ONE jitted program — ICI leg, correction, and update
+        # compile together; only the DCN leg stays service-side (the
+        # cross-step work the staleness pipeline exists for).  Under
+        # the fold the update stitches through the onestep emission so
+        # the step shape is marked for prof/hostgap.py; resolved at
+        # construction, like the donation choice.
+        self._onestep = xir_interp.onestep_mode() != "off"
+        _onestep = self._onestep
 
         def init_body(params):
             stack = lambda t: jax.tree.map(lambda x: x[None], t)
@@ -139,7 +150,17 @@ class StaleTrainStep:
             # DCN leg, delayed: the correction computed from step
             # i-k's hop rides in as an input.
             used = jax.tree.map(lambda s, d: s + d, slice_mean, c)
-            updates, st = inner_optimizer.update(used, st, p)
+            if _onestep:
+                leaves, tdef = jax.tree.flatten(used)
+                updates, st = xir_interp.emit_step(
+                    leaves,
+                    lambda ts, _st=st, _p=p: inner_optimizer.update(
+                        jax.tree.unflatten(tdef, ts), _st, _p,
+                    ),
+                    src="stale",
+                )
+            else:
+                updates, st = inner_optimizer.update(used, st, p)
             import optax
 
             p = optax.apply_updates(p, updates)
@@ -186,7 +207,12 @@ class StaleTrainStep:
     def __call__(self, params, opt_state, batch):
         from .. import trace
 
-        with self._lock, trace.step(staleness=self.k):
+        from ..xir import interp as xir_interp
+
+        with self._lock, trace.step(
+            staleness=self.k,
+            onestep=1 if xir_interp.onestep_mode() == "on" else 0,
+        ):
             with trace.span("collect_correction", "dispatch"):
                 corr = self._collect_correction(params)
             params, opt_state, loss, slice_mean = self._step_fn(

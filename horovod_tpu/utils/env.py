@@ -126,6 +126,21 @@ XIR_WIRE = "XIR_WIRE"
 # are identity on values and reordering never changes summation
 # grouping within a bucket.  See docs/exchange_ir.md.
 XIR_PIPELINE = "XIR_PIPELINE"
+# Whole-step emission (xir/interp.py onestep): fold a step's entire
+# exchange schedule — fused buffers, rail-interleaved ordering, AND the
+# optimizer-update closure — into ONE compiled dispatch instead of one
+# jitted executor per fused buffer / per bucket chain.
+#   off  = per-unit dispatch, the PR 18 paths exactly;
+#   auto = (default) fold whenever a step has >= 2 dispatch units
+#          (like the rail pipeliner, engagement is a scheduling
+#          decision, never a numerics one);
+#   on   = always fold.
+# f32 dense losses are bitwise-identical in every mode: the stitch is
+# optimization_barrier ties (identity on values) and the folded units
+# emit the same ops in the same per-unit order.  Resolved mode folds
+# into the tune-DB knob_fingerprint.  See docs/exchange_ir.md
+# ("Whole-step emission").
+ONESTEP = "ONESTEP"
 # Async exchange service (svc/): the TPU-native BackgroundThreadLoop —
 # a persistent executor that accepts XIR programs from concurrent
 # producers through a TensorQueue submission API, negotiates readiness

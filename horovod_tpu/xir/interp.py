@@ -61,6 +61,153 @@ def enabled() -> bool:
     return env.get_bool("XIR", True)
 
 
+# ----------------------------------------------------- onestep knob
+#
+# Whole-step emission (HVD_TPU_ONESTEP, ROADMAP item 4): fold every
+# dispatch unit a step would launch separately — fused service
+# buffers, bucket chains, the optimizer-update closure — into ONE
+# compiled program, so the host pays a single dispatch round-trip per
+# step.  Same mode grammar and override pattern as the rail pipeliner
+# (xir/pipeline.py): off | on | auto (default).  Engagement is a
+# scheduling decision only; the stitched emission is bitwise-identical
+# to the per-unit one (optimization_barrier ties are identity on
+# values and per-unit op order never changes).
+
+ONESTEP_MODES = ("off", "on", "auto")
+
+_onestep_override: Optional[str] = None
+
+
+def set_onestep_override(mode: Optional[str]) -> None:
+    """Trace/test-time knob override (the sched config-override
+    pattern): pin the whole-step emission without touching the
+    environment."""
+    global _onestep_override
+    if mode is not None and mode not in ONESTEP_MODES:
+        raise HorovodTpuError(
+            f"onestep mode override must be one of {ONESTEP_MODES}, "
+            f"got {mode!r}"
+        )
+    _onestep_override = mode
+
+
+def onestep_mode() -> str:
+    """``HVD_TPU_ONESTEP`` policy: ``off`` | ``on`` | ``auto``
+    (default).  ``off`` keeps every per-unit dispatch path exactly as
+    it was; ``auto`` folds when a step has >= 2 dispatch units; ``on``
+    always folds."""
+    if _onestep_override is not None:
+        return _onestep_override
+    raw = (env.get_env(env.ONESTEP, "auto") or "auto").strip().lower()
+    if raw in ("0", "false", "no", "none", ""):
+        raw = "off"
+    if raw in ("1", "true", "yes"):
+        raw = "on"
+    if raw not in ONESTEP_MODES:
+        raise HorovodTpuError(
+            f"HVD_TPU_ONESTEP must be off|on|auto, got {raw!r}"
+        )
+    return raw
+
+
+def onestep_engaged(n_units: int) -> bool:
+    """Whether whole-step emission folds ``n_units`` dispatch units
+    (fused buffers, solo programs, the update closure) into one
+    program.  ``off`` never folds; ``on`` always does; ``auto`` folds
+    only when there are at least two units — with one unit the fold
+    would change nothing."""
+    m = onestep_mode()
+    if m == "off":
+        return False
+    if m == "on":
+        return n_units >= 1
+    return n_units >= 2
+
+
+def emit_step(reduced: Sequence[Any], update, *, src: str = "sched"):
+    """Stitch a caller's update closure onto freshly-reduced exchange
+    outputs INSIDE the same traced emission: the closure's inputs are
+    barrier-tied to the reduced tensors (identity on values), so XLA
+    sees one program with an explicit exchange→update edge instead of
+    two independently dispatched subgraphs.  Returns whatever the
+    closure returns.  Values are bitwise-identical to applying the
+    closure after the exchange returns — the tie adds ordering edges
+    only."""
+    from .. import prof, trace
+
+    leaves = list(reduced)
+    arrays = [i for i, t in enumerate(leaves)
+              if isinstance(t, jax.Array) or hasattr(t, "dtype")]
+    if arrays:
+        tied = lax.optimization_barrier(
+            tuple(leaves[i] for i in arrays)
+        )
+        for i, t in zip(arrays, tied):
+            leaves[i] = t
+    metrics.inc_counter("xir.onestep.steps")
+    prof.note_emission(f"onestep.{src}", 1)
+    with trace.span(
+        "onestep.update", "exchange", onestep=1, src=src,
+    ), jax.named_scope(f"hvd_onestep_update_{src}"):
+        return update(leaves)
+
+
+def execute_onestep(programs: Sequence[ir.ExchangeProgram],
+                    args_lists: Sequence[Sequence[Any]],
+                    *,
+                    axis_size: Optional[int] = None,
+                    process_set=None,
+                    store: bool = False,
+                    update=None) -> Any:
+    """Whole-step emission of a program list: every program's ops —
+    and optionally the caller's ``update`` closure over the full
+    output list — lower into ONE traced region under a single
+    ``onestep``-marked span, instead of one :func:`execute` call (= one
+    potential dispatch) per program.  Per-program op order is
+    preserved exactly, so outputs are bitwise-identical to N separate
+    :func:`execute` calls; the fold only removes dispatch boundaries.
+    Returns one output list per program (or, with ``update``, whatever
+    the closure returns when applied to that list-of-lists)."""
+    from .. import trace
+
+    programs = [
+        p if p.lowered else lower_mod.lower(p, axis_size, store=store)
+        for p in programs
+    ]
+    for p, args in zip(programs, args_lists):
+        if len(args) != len(p.ops):
+            raise HorovodTpuError(
+                f"program {p.kind!r} has {len(p.ops)} ops but "
+                f"{len(args)} payloads were passed"
+            )
+    metrics.inc_counter("xir.onestep.programs", len(programs))
+    outs: List[List[Any]] = []
+    with trace.span(
+        "exchange.onestep", "exchange", onestep=1,
+        programs=len(programs),
+        kind="+".join(p.kind for p in programs),
+    ):
+        for p, args in zip(programs, args_lists):
+            account(p, axis_size)
+            prog_outs = []
+            for op, x in zip(p.ops, args):
+                with jax.named_scope(
+                    f"hvd_onestep_{p.kind}_{op.op}{op.bucket}"
+                    f"_{op.wire}_{op.lowering}"
+                ):
+                    prog_outs.append(
+                        run_op(op, x, process_set=process_set)
+                    )
+            outs.append(prog_outs)
+        if update is not None:
+            flat = [t for prog in outs for t in prog]
+            tied = emit_step(flat, lambda ts: ts, src="execute")
+            it = iter(tied)
+            outs = [[next(it) for _ in prog] for prog in outs]
+            return update(outs)
+    return outs
+
+
 def wire_request() -> str:
     """The wire format non-gradient IR workloads request
     (``HVD_TPU_XIR_WIRE``, default ``off``).  Deliberately NOT

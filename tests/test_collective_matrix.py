@@ -1278,6 +1278,159 @@ class TestPipelineColumn:
         ) < 1e-12
 
 
+@pytest.mark.onestep
+class TestOnestepColumn:
+    """Whole-step emission column of the matrix (``HVD_TPU_ONESTEP``,
+    xir/interp.py): the single-dispatch fold — exchange schedule plus
+    optimizer update traced into one jitted program — against the
+    per-bucket dispatch chain.  Bitwise on the f32 dense wire in every
+    mode (the fold is function composition at trace time: same ops in
+    the same order, the barrier is value-identity), 1e-3 on int8+EF,
+    composed with the hier lowering and the rail-pipelined ordering,
+    plus donation parity for both step classes with ``donate=False``
+    as the numerics hook."""
+
+    @pytest.fixture(autouse=True)
+    def _forced_two_slice(self, monkeypatch):
+        from horovod_tpu import sched, topo
+        from horovod_tpu.xir import interp as xinterp
+        from horovod_tpu.xir import pipeline as railpipe
+
+        monkeypatch.setenv("HVD_TPU_TOPO", "2x4")
+        topo.reset()
+        yield
+        xinterp.set_onestep_override(None)
+        railpipe.set_mode_override(None)
+        sched.set_config_override(None)
+        topo.reset()
+
+    def _train(self, mode, wire="off", pipeline="off", iters=5,
+               lowering="hier", donate=True):
+        import optax
+
+        from horovod_tpu import metrics, sched
+        from horovod_tpu.xir import interp as xinterp
+        from horovod_tpu.xir import pipeline as railpipe
+
+        rng = np.random.RandomState(7)
+        X = rng.randn(32, 64).astype(np.float32)
+        Y = (X @ rng.randn(64, 8).astype(np.float32)).astype(np.float32)
+
+        def loss_fn(p, b):
+            x, y = b
+            h = jnp.tanh(x @ p["w1"] + p["b1"])
+            return jnp.mean((h @ p["w2"] - y) ** 2)
+
+        r = np.random.RandomState(3)
+        p = {
+            "w1": jnp.asarray(r.randn(64, 256).astype(np.float32) * 0.05),
+            "b1": jnp.zeros((256,)),
+            "w2": jnp.asarray(r.randn(256, 8).astype(np.float32) * 0.05),
+        }
+        xinterp.set_onestep_override(mode)
+        railpipe.set_mode_override(pipeline)
+        sched.set_config_override(sched.SchedConfig(
+            enabled=True, bucket_bytes=16 * 1024, lowering=lowering,
+            wire=wire,
+        ))
+        folds0 = metrics.get_counter("xir.onestep.steps")
+        try:
+            tx = hvd.DistributedOptimizer(optax.sgd(0.05))
+            step = hvd.distributed_train_step(loss_fn, tx, donate=donate)
+            st = step.init(p)
+            batch = (jnp.asarray(X), jnp.asarray(Y))
+            losses = []
+            for _ in range(iters):
+                p, st, loss = step(p, st, batch)
+                losses.append(float(loss))
+            folds = metrics.get_counter("xir.onestep.steps") - folds0
+            return losses, folds
+        finally:
+            from horovod_tpu import sched as _s
+
+            _s.set_config_override(None)
+            railpipe.set_mode_override(None)
+            xinterp.set_onestep_override(None)
+
+    def test_onestep_vs_off_bitwise_f32(self, hvd_module):
+        off, n_off = self._train("off")
+        on, n_on = self._train("on")
+        assert off == on  # bitwise: the fold is trace-time composition
+        assert n_off == 0
+        assert n_on > 0  # the whole-step emission actually engaged
+
+    def test_auto_mode_bitwise_and_engaged(self, hvd_module):
+        off, _ = self._train("off")
+        auto, n_auto = self._train("auto")
+        assert off == auto
+        assert n_auto > 0  # multi-unit schedule: auto folds
+
+    def test_int8_ef_within_tolerance(self, hvd_module):
+        """The quantize/dequantize phases fold along with everything
+        else, so onestep == off holds to the wire's own tolerance and
+        both stay close to dense."""
+        dense, _ = self._train("off")
+        off, _ = self._train("off", wire="int8")
+        on, n_on = self._train("on", wire="int8")
+        assert n_on > 0
+        np.testing.assert_allclose(off, on, rtol=1e-3, atol=1e-3)
+        np.testing.assert_allclose(dense, on, rtol=1e-3, atol=1e-3)
+
+    def test_composes_with_pipelined_ordering(self, hvd_module):
+        """The fold stitches the update onto whatever ordering the
+        rail pipeliner emitted: onestep+pipelined == both off,
+        bitwise (ordering and stitching are both value-identity)."""
+        base, _ = self._train("off", pipeline="off")
+        both, n_both = self._train("on", pipeline="on")
+        assert base == both
+        assert n_both > 0
+
+    def test_train_step_donation_parity_under_onestep(self, hvd_module):
+        """Donated whole-step program == undonated, bitwise —
+        ``donate=False`` is the numerics hook when in-place buffer
+        reuse is suspected."""
+        donated, _ = self._train("on", donate=True)
+        undonated, _ = self._train("on", donate=False)
+        assert donated == undonated
+
+    def test_stale_step_donation_parity_under_onestep(self, hvd_module):
+        import optax
+
+        from horovod_tpu import svc
+        from horovod_tpu.svc.stale import StaleTrainStep
+        from horovod_tpu.xir import interp as xinterp
+
+        svc.set_enabled_override(True)
+        svc.set_staleness_override(1)
+        xinterp.set_onestep_override("on")
+
+        def lf(p, b):
+            return jnp.sum((p["w"] - 3.0) ** 2) + 0.0 * jnp.sum(b)
+
+        def run(donate):
+            step = StaleTrainStep(lf, optax.sgd(0.2), k=1,
+                                  donate=donate)
+            sp, st = step.init({"w": jnp.zeros((4,), jnp.float32)})
+            batch = jnp.zeros((N, 1), jnp.float32)
+            losses = []
+            for _ in range(8):
+                sp, st, loss = step(sp, st, batch)
+                losses.append(float(loss))
+            step.drain()
+            return losses
+
+        try:
+            donated = run(True)
+            svc.reset_service()
+            undonated = run(False)
+            assert donated == undonated, \
+                "stale onestep donation changed numerics"
+        finally:
+            svc.set_enabled_override(None)
+            svc.set_staleness_override(None)
+            svc.reset_service()
+
+
 @pytest.mark.pallas
 @pytest.mark.quant
 class TestFusedQuantColumn:

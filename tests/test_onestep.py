@@ -1,0 +1,389 @@
+"""Whole-step single-dispatch emission (``HVD_TPU_ONESTEP``).
+
+The train-step parity column lives in
+tests/test_collective_matrix.py::TestOnestepColumn; this file covers
+the machinery (ROADMAP item 4's "fold the whole exchange schedule into
+one XLA program"): the knob and engagement rules, ``emit_step``'s
+value-identity stitch, the host-gap profiler's single-dispatch step
+shape (``prof.dispatches_per_step`` reads exactly 1, never 0 or 2),
+the service-side whole-cycle fold (bitwise parity with per-unit
+dispatch, exactly one ``svc.dispatches`` increment per cycle, fallback
+on a broken fold), the whole-cycle ResponseCache key, tuner
+exploration with tune-DB persistence, and the store fingerprint fold.
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import horovod_tpu as hvd
+from horovod_tpu import metrics, sched, svc, topo, xir
+from horovod_tpu.exceptions import HorovodTpuError
+from horovod_tpu.prof import hostgap
+from horovod_tpu.runtime import WORLD_AXIS
+from horovod_tpu.svc.cache import CycleProgram, ResponseCache
+from horovod_tpu.topo import model as topo_model
+from horovod_tpu.trace.tracer import Span
+from horovod_tpu.xir import interp as xinterp
+
+pytestmark = pytest.mark.onestep
+
+N = 8
+T24 = topo_model.Topology(num_slices=2, slice_size=4)
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    for knob in ("HVD_TPU_ONESTEP", "HOROVOD_ONESTEP"):
+        monkeypatch.delenv(knob, raising=False)
+    yield
+    xinterp.set_onestep_override(None)
+    sched.set_config_override(None)
+    svc.set_enabled_override(None)
+    svc.set_threshold_override(None)
+    svc.reset_service()
+    topo.set_topology_override(None)
+
+
+# ----------------------------------------------------------- the knob
+
+class TestKnob:
+    def test_default_is_auto(self):
+        assert xinterp.onestep_mode() == "auto"
+
+    @pytest.mark.parametrize("raw,want", [
+        ("off", "off"), ("0", "off"), ("false", "off"),
+        ("on", "on"), ("1", "on"), ("true", "on"),
+        ("auto", "auto"), ("AUTO", "auto"),
+    ])
+    def test_spellings(self, monkeypatch, raw, want):
+        monkeypatch.setenv("HVD_TPU_ONESTEP", raw)
+        assert xinterp.onestep_mode() == want
+
+    def test_bad_spelling_raises(self, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_ONESTEP", "sideways")
+        with pytest.raises(HorovodTpuError, match="ONESTEP"):
+            xinterp.onestep_mode()
+
+    def test_override_wins_and_validates(self, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_ONESTEP", "off")
+        xinterp.set_onestep_override("on")
+        assert xinterp.onestep_mode() == "on"
+        with pytest.raises(HorovodTpuError):
+            xinterp.set_onestep_override("diagonal")
+
+    def test_engagement_rules(self):
+        xinterp.set_onestep_override("off")
+        assert not xinterp.onestep_engaged(100)
+        xinterp.set_onestep_override("on")
+        assert xinterp.onestep_engaged(1)
+        # auto folds only when there is more than one dispatch unit to
+        # save: a single-unit cycle already pays one round-trip.
+        xinterp.set_onestep_override("auto")
+        assert not xinterp.onestep_engaged(1)
+        assert xinterp.onestep_engaged(2)
+
+
+# ---------------------------------------------------------- emit_step
+
+class TestEmitStep:
+    def test_stitch_is_value_identity(self, hvd_init):
+        """The barrier tie is ordering-only: a jitted body routed
+        through ``emit_step`` is bitwise identical to the plain
+        composition."""
+        x = jnp.arange(16, dtype=jnp.float32)
+
+        def update(leaves):
+            return leaves[0] * 2.0 + 1.0
+
+        plain = jax.jit(lambda t: update([t * 3.0]))(x)
+        folded = jax.jit(
+            lambda t: xinterp.emit_step([t * 3.0], update)
+        )(x)
+        np.testing.assert_array_equal(np.asarray(plain),
+                                      np.asarray(folded))
+
+    def test_counts_once_per_trace(self, hvd_init):
+        before = metrics.get_counter("xir.onestep.steps")
+        f = jax.jit(
+            lambda t: xinterp.emit_step([t], lambda ls: ls[0] + 1.0)
+        )
+        for _ in range(4):  # traced once, executed four times
+            f(jnp.ones((4,)))
+        assert metrics.get_counter("xir.onestep.steps") == before + 1
+
+    def test_passes_non_array_leaves_through(self, hvd_init):
+        out = xinterp.emit_step(
+            [jnp.ones((2,)), "not-an-array"],
+            lambda ls: (ls[0] + 1.0, ls[1]),
+        )
+        np.testing.assert_array_equal(np.asarray(out[0]), 2.0)
+        assert out[1] == "not-an-array"
+
+
+# ------------------------------------------- host-gap dispatch gauge
+
+def _span(name, phase, t0, t1, **attrs):
+    s = Span(name, phase, t0, attrs=attrs or None)
+    s.t1 = t1
+    return s
+
+
+def _step(wall, children=(), **attrs):
+    root = _span("step", "step", 0.0, wall, **attrs)
+    root.children.extend(children)
+    return root
+
+
+class TestDispatchGauge:
+    def test_unmarked_tree_counts_every_call_span(self):
+        root = _step(1.0, [
+            _span("e1", "exec", 0.0, 0.2),
+            _span("d", "dispatch", 0.2, 0.4),
+            _span("x", "exchange", 0.4, 0.6),  # emission, not a call
+        ])
+        assert hostgap.attribute(root)["dispatches"] == 2
+
+    def test_marked_root_is_exactly_one_dispatch(self):
+        """The single-dispatch step shape: however many exec/dispatch
+        spans nest under a marked root, the step IS one round-trip —
+        the gauge must read 1, not 0 and not the epilogue-inflated
+        count."""
+        root = _step(1.0, [
+            _span("e1", "exec", 0.0, 0.5),
+            _span("upd", "exchange", 0.5, 0.6, onestep=1),
+        ], onestep=1)
+        assert hostgap.attribute(root)["dispatches"] == 1
+
+    def test_marked_root_without_exec_children_still_counts_one(self):
+        # the executor wrapper losing its exec span must not read as 0
+        assert hostgap.attribute(_step(1.0, onestep=1))["dispatches"] \
+            == 1
+
+    def test_marked_exec_subtree_collapses_to_one(self):
+        root = _step(1.0, [
+            _span("folded", "exec", 0.0, 0.5, onestep=1),
+            _span("other", "exec", 0.5, 0.7),
+        ])
+        folded = root.children[0]
+        folded.children.append(_span("inner", "dispatch", 0.1, 0.2))
+        assert hostgap.attribute(root)["dispatches"] == 2
+
+    def test_marked_emission_span_does_not_count(self):
+        """``exchange.{kind}`` / ``onestep.update`` spans carry the
+        onestep attr for the trace UI but are emission, not
+        round-trips: they neither short-circuit nor count."""
+        root = _step(1.0, [
+            _span("x", "exchange", 0.0, 0.5, onestep=1),
+        ])
+        root.children[0].children.extend([
+            _span("e1", "exec", 0.0, 0.2),
+            _span("e2", "exec", 0.2, 0.4),
+        ])
+        assert hostgap.attribute(root)["dispatches"] == 2
+
+    def test_unmarked_zero_mode_attr_keeps_flat_count(self):
+        # trace.step(onestep=0) under mode off/auto must not trigger
+        # the short-circuit: 0 is falsy.
+        root = _step(1.0, [
+            _span("e1", "exec", 0.0, 0.2),
+            _span("e2", "exec", 0.2, 0.4),
+        ], onestep=0)
+        assert hostgap.attribute(root)["dispatches"] == 2
+
+
+# ------------------------------------------- service whole-cycle fold
+
+def _ar_program(nbytes=64, reduce="mean", kind="dense_grad"):
+    return xir.program(kind, [xir.ExchangeOp(
+        "all_reduce", WORLD_AXIS, wire="off", lowering="flat",
+        bucket=0,
+        attrs=(("dtype", "float32"), ("nbytes", nbytes),
+               ("reduce", reduce)),
+    )])
+
+
+@pytest.mark.svc
+@pytest.mark.usefixtures("hvd_module")
+class TestServiceCycleFold:
+    def _submit_mixed(self, s, count=6):
+        """Mixed fusion classes (mean + sum) so one cycle holds
+        MULTIPLE dispatch units even under a high fusion threshold —
+        the shape the fold exists for."""
+        rng = np.random.RandomState(3)
+        xs = [jnp.asarray(rng.randn(N, 16).astype(np.float32))
+              for _ in range(count)]
+        futs = [
+            s.submit(
+                _ar_program(64, reduce="mean" if i % 2 else "sum"),
+                [x], producer=f"p{i % 2}",
+            )
+            for i, x in enumerate(xs)
+        ]
+        return [np.asarray(f.result(timeout=60)[0]) for f in futs]
+
+    def test_fold_bitwise_equals_per_unit_and_single_dispatch(self):
+        svc.set_threshold_override(64 << 20)
+        xinterp.set_onestep_override("on")
+        d0 = metrics.get_counter("svc.dispatches")
+        c0 = metrics.get_counter("svc.onestep.cycles")
+        fb0 = metrics.get_counter("svc.onestep.fallback")
+        folded = self._submit_mixed(svc.get_service())
+        cycles = metrics.get_counter("svc.onestep.cycles") - c0
+        dispatches = metrics.get_counter("svc.dispatches") - d0
+        assert cycles >= 1
+        # ONE dispatch per cycle, however many units the cycle held
+        assert dispatches == cycles
+        assert metrics.get_counter("svc.onestep.fallback") == fb0
+        svc.reset_service()
+        xinterp.set_onestep_override("off")
+        serial = self._submit_mixed(svc.get_service())
+        for a, b in zip(folded, serial):
+            assert (a == b).all(), "fold diverged from per-unit"
+
+    def test_auto_engages_on_multi_unit_cycles(self):
+        svc.set_threshold_override(64 << 20)
+        xinterp.set_onestep_override("auto")
+        c0 = metrics.get_counter("svc.onestep.cycles")
+        self._submit_mixed(svc.get_service())
+        assert metrics.get_counter("svc.onestep.cycles") > c0
+
+    def test_broken_fold_falls_back_to_per_unit(self, monkeypatch):
+        """The fold is a performance lever, never a new way to wedge a
+        producer: a failing whole-cycle build must leave every future
+        resolved through the per-unit paths."""
+        svc.set_threshold_override(64 << 20)
+        xinterp.set_onestep_override("on")
+        s = svc.get_service()
+        monkeypatch.setattr(
+            type(s), "_build_onestep_executor",
+            lambda self, units: (_ for _ in ()).throw(
+                RuntimeError("injected fold failure")
+            ),
+        )
+        fb0 = metrics.get_counter("svc.onestep.fallback")
+        outs = self._submit_mixed(s)
+        assert metrics.get_counter("svc.onestep.fallback") > fb0
+        svc.reset_service()
+        xinterp.set_onestep_override("off")
+        serial = self._submit_mixed(svc.get_service())
+        for a, b in zip(outs, serial):
+            assert (a == b).all(), "fallback diverged from per-unit"
+
+    def test_repeat_cycle_hits_whole_cycle_cache(self):
+        svc.set_threshold_override(64 << 20)
+        xinterp.set_onestep_override("on")
+        s = svc.get_service()
+        self._submit_mixed(s)
+        hits0 = metrics.get_counter("svc.cache_hit")
+        self._submit_mixed(s)
+        assert metrics.get_counter("svc.cache_hit") > hits0
+
+
+class TestCycleCacheKey:
+    def test_key_shape_and_order_sensitivity(self):
+        a = _ar_program(64, reduce="mean")
+        b = _ar_program(64, reduce="sum")
+        k_ab = ResponseCache.cycle_key([(a, 8), (b, 8)])
+        k_ba = ResponseCache.cycle_key([(b, 8), (a, 8)])
+        assert k_ab[0] == "onestep_cycle"
+        assert k_ab == ResponseCache.cycle_key([(a, 8), (b, 8)])
+        # the scatter is positional: cycle order is part of the key
+        assert k_ab != k_ba
+        assert ResponseCache.cycle_key([(a, 8)]) != \
+            ResponseCache.cycle_key([(a, 4)])
+
+    def test_cycle_program_signature_surface(self):
+        key = ResponseCache.cycle_key([(_ar_program(64), 8)])
+        prog = CycleProgram(member_keys=key[1])
+        assert prog.kind == "onestep"
+        assert prog.signature()[0] == "onestep"
+        assert prog.lowered and prog.ops == ()
+
+
+# ------------------------------------------------- tuner + store key
+
+@pytest.fixture()
+def two_slice(monkeypatch):
+    monkeypatch.setenv("HVD_TPU_TOPO", "2x4")
+    topo.reset()
+    yield
+    topo.reset()
+
+
+class TestTunerOnestepKnob:
+    SIG = ("onestep-test-sig", 1)
+
+    def _drive(self, tuner, favored="on", windows=16):
+        for _ in range(windows):
+            if tuner.converged:
+                break
+            tuner.begin_window()
+            cand = tuner.onestep()
+            steps = 30 if cand == favored else 10
+            metrics.inc_counter("train.steps", steps)
+            metrics.observe("train.step_seconds", 0.5)
+            metrics.set_gauge("sched.bytes_per_step", 1000.0)
+            tuner.end_window()
+        return tuner
+
+    def test_explores_and_freezes_winner(self, two_slice, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_ONESTEP", "auto")
+        tuner = sched.ScheduleTuner(explore_onestep=True,
+                                    warmup_windows=2)
+        assert not tuner.converged
+        seen = set()
+        for _ in range(3):
+            tuner.begin_window()
+            seen.add(tuner.onestep())
+            metrics.inc_counter(
+                "train.steps", 30 if tuner.onestep() == "on" else 10
+            )
+            metrics.observe("train.step_seconds", 0.5)
+            metrics.set_gauge("sched.bytes_per_step", 1000.0)
+            tuner.end_window()
+        assert seen == {"off", "on", "auto"}  # every candidate ran
+        assert tuner._onestep_frozen == "on"
+        # the winner is pinned into the env knob
+        assert xinterp.onestep_mode() == "on"
+
+    def test_not_explored_reads_env(self, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_ONESTEP", "off")
+        tuner = sched.ScheduleTuner()
+        assert tuner.onestep() == "off"
+
+    def test_cold_db_converges_and_warm_starts(self, two_slice,
+                                               tmp_path, monkeypatch):
+        monkeypatch.setenv("HVD_TPU_ONESTEP", "auto")
+        db = tmp_path / "tune.json"
+        monkeypatch.setenv("HVD_TPU_TUNE_DB", str(db))
+        t1 = sched.ScheduleTuner(explore_onestep=True,
+                                 warmup_windows=2, store="env",
+                                 store_key=self.SIG)
+        self._drive(t1, favored="on")
+        assert t1.converged
+        assert t1.onestep() == "on"
+        entries = json.loads(db.read_text())["entries"]
+        assert any(
+            (e.get("meta") or {}).get("onestep") == "on"
+            for e in entries.values()
+        )
+        # warm start: converged at window 0, knob re-pinned
+        monkeypatch.setenv("HVD_TPU_ONESTEP", "auto")
+        t2 = sched.ScheduleTuner(explore_onestep=True, store="env",
+                                 store_key=self.SIG)
+        assert t2.converged
+        assert t2.onestep() == "on"
+        assert xinterp.onestep_mode() == "on"
+
+    def test_fingerprint_folds_resolved_mode(self, monkeypatch):
+        from horovod_tpu.sched.store import knob_fingerprint
+
+        unset = knob_fingerprint()
+        monkeypatch.setenv("HVD_TPU_ONESTEP", "auto")
+        assert knob_fingerprint() == unset  # unset ≡ explicit default
+        monkeypatch.setenv("HVD_TPU_ONESTEP", "on")
+        assert knob_fingerprint() != unset  # fold points differ

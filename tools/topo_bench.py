@@ -47,6 +47,19 @@ pipelined emission whose bucket split comes from the fitted per-rail
 bandwidths; the headline value is the serialized/pipelined step-time
 speedup.
 
+``--onestep`` record — ``onestep_hostgap``: the whole-step
+single-dispatch fold (``HVD_TPU_ONESTEP``, xir/interp.py +
+svc/service.py) on the workload ROADMAP item 4 names — a burst of
+small programs spread across SEVERAL fusion classes, so every cycle
+holds multiple dispatch units even under a high fusion threshold.
+Off: one jitted executor call per class per cycle.  On: the whole
+cycle compiles into one executor (``ResponseCache.cycle_key``).
+Outputs are asserted bitwise equal; the headline value is the
+off/on mean ``prof.host_gap_seconds`` ratio (target >= 1.15), with
+``svc.dispatches`` per cycle (N classes -> 1) and the
+``prof.dispatches_per_step`` gauge (exactly 1 under ``on``) riding
+along.
+
 ``--tenant`` record — ``svc_tenant_interference``: the multi-tenant
 arbiter (``svc/arbiter.py``) on the contention workload it exists for
 — tenant A submits one tiny ICI-local exchange per step while tenant
@@ -72,8 +85,8 @@ FIFO.  The record is also what ``GET /serve`` reports under
 
 Run standalone or through ``bench.py`` (which embeds the lines under
 its ``"topo_hier_vs_flat"`` / ``"quant_fused_vs_phase"`` /
-``"adasum_vs_sum"`` / ``"railpipe_overlap"`` /
-``"svc_tenant_interference"`` / ``"serve_plane"`` keys).
+``"adasum_vs_sum"`` / ``"railpipe_overlap"`` / ``"onestep_hostgap"``
+/ ``"svc_tenant_interference"`` / ``"serve_plane"`` keys).
 """
 
 import json
@@ -651,6 +664,139 @@ def main_fusion() -> dict:
     }
 
 
+def main_onestep() -> dict:
+    """The ``onestep_hostgap`` record: one "step" = submit 18 small
+    programs spread across 6 fusion classes (mean/sum x f32/bf16/f16)
+    to the exchange service and wait on every future.  The high
+    threshold coalesces each class into one fused buffer, so an
+    ``off`` cycle still pays 6 dispatches; ``on`` folds the entire
+    cycle — every buffer, one executor — into a single dispatch
+    (``svc/service.py::_dispatch_onestep``).  Results are asserted
+    BITWISE equal, the folded run must retire exactly one
+    ``svc.dispatches`` per cycle, and the headline value is the
+    off/on mean host-gap ratio read from the prof plane's own
+    ``prof.host_gap_seconds`` histogram (exact sum/count, not the
+    bucket-interpolated quantile: both modes land inside one latency
+    bucket)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu import metrics, svc, trace, xir
+    from horovod_tpu.runtime import WORLD_AXIS
+    from horovod_tpu.xir import interp as xinterp
+
+    jax.config.update("jax_platforms", "cpu")
+    os.environ["HVD_TPU_SVC_CYCLE_TIME"] = "2.0"
+    hvd.init()
+
+    rows = 64  # 256 B per rank per program: latency-dominated
+    per_class = 3
+    classes = [(red, dt) for red in ("mean", "sum")
+               for dt in ("float32", "bfloat16", "float16")]
+    rng = np.random.RandomState(7)
+    payloads, progs = [], []
+    for red, dt in classes:
+        for _ in range(per_class):
+            x = rng.randn(hvd.size(), rows).astype(np.float32)
+            payloads.append(jnp.asarray(x, dtype=dt))
+            progs.append(xir.program("dense_grad", [
+                xir.all_reduce(WORLD_AXIS, reduce=red,
+                               lowering="flat", nbytes=rows * 4,
+                               dtype=dt),
+            ]))
+
+    def run(mode, iters=30, warmup=4):
+        svc.reset_service()
+        svc.set_threshold_override(64 * 1024 * 1024)
+        xinterp.set_onestep_override(mode)
+        metrics.reset_counters("svc.onestep")
+        try:
+            s = svc.get_service()
+
+            def step():
+                # the step span is what prof/hostgap.py attributes:
+                # its svc-dispatch delta IS the per-step count
+                with trace.step():
+                    futs = [
+                        s.submit(p, [x], producer=f"p{i % 4}")
+                        for i, (p, x) in enumerate(zip(progs, payloads))
+                    ]
+                    return [f.result(timeout=120)[0] for f in futs]
+
+            for _ in range(warmup):
+                outs = step()
+            jax.block_until_ready(outs)
+            # gap stats cover only steady-state steps: the off run
+            # compiles 6 executors and the on run 1, so counting
+            # warmup would hand the fold a compile-time head start
+            metrics.reset_counters("prof.host_gap")
+            d0 = metrics.get_counter("svc.dispatches")
+            t0 = time.perf_counter()
+            for _ in range(iters):
+                outs = step()
+            jax.block_until_ready(outs)
+            dt = (time.perf_counter() - t0) / iters
+            gap = metrics.get_histogram("prof.host_gap_seconds") or {}
+            return {
+                "step_time_ms": round(dt * 1000.0, 3),
+                "gap_mean_s": gap.get("sum", 0.0)
+                / max(gap.get("count", 0), 1),
+                "dispatches_per_cycle": (
+                    metrics.get_counter("svc.dispatches") - d0
+                ) / iters,
+                "dispatches_per_step": metrics.get_gauge(
+                    "prof.dispatches_per_step"
+                ),
+                "fold_cycles": metrics.get_counter("svc.onestep.cycles"),
+                "fallbacks": metrics.get_counter("svc.onestep.fallback"),
+                "outs": [np.asarray(o, dtype=np.float32) for o in outs],
+            }
+        finally:
+            svc.set_threshold_override(None)
+            xinterp.set_onestep_override(None)
+
+    off = run("off")
+    on = run("on")
+    bitwise = all(
+        (a == b).all() for a, b in zip(off["outs"], on["outs"])
+    )
+    assert bitwise, "onestep fold diverged from per-unit — contract broken"
+    assert on["fold_cycles"] > 0, "fold never engaged"
+    assert on["fallbacks"] == 0, f"fold fell back {on['fallbacks']}x"
+    assert on["dispatches_per_cycle"] == 1.0, (
+        f"folded cycle paid {on['dispatches_per_cycle']} dispatches"
+    )
+    assert off["dispatches_per_cycle"] > 1.0, (
+        "off run coalesced to one dispatch — workload lost its classes"
+    )
+    ratio = off["gap_mean_s"] / max(on["gap_mean_s"], 1e-9)
+    return {
+        "metric": "onestep_hostgap",
+        "unit": "off_over_on_host_gap",
+        "value": round(ratio, 3),
+        "target": 1.15,
+        "topo": os.environ["HVD_TPU_TOPO"],
+        "n_programs": len(progs),
+        "n_classes": len(classes),
+        "program_bytes": rows * 4,
+        "step_time_ms": {
+            "off": off["step_time_ms"], "on": on["step_time_ms"],
+        },
+        "host_gap_ms": {
+            "off": round(off["gap_mean_s"] * 1000.0, 3),
+            "on": round(on["gap_mean_s"] * 1000.0, 3),
+        },
+        "dispatches_per_cycle": {
+            "off": off["dispatches_per_cycle"],
+            "on": on["dispatches_per_cycle"],
+        },
+        "dispatches_per_step_gauge": on["dispatches_per_step"],
+        "bitwise_off_vs_on": bitwise,
+    }
+
+
 def main_tenant() -> dict:
     """The ``svc_tenant_interference`` record: tenant A's small
     ICI-local exchange latency while tenant B's DCN-heavy buckets
@@ -980,15 +1126,18 @@ if __name__ == "__main__":
              else "adasum" if "--adasum" in args
              else "pipeline" if "--pipeline" in args
              else "fusion" if "--fusion" in args
+             else "onestep" if "--onestep" in args
              else "serve" if "--serve" in args
              else "tenant" if "--tenant" in args else "topo")
     mains = {"quant": main_quant, "adasum": main_adasum, "topo": main,
              "pipeline": main_pipeline, "fusion": main_fusion,
+             "onestep": main_onestep,
              "tenant": main_tenant, "serve": main_serve}
     names = {"quant": "quant_fused_vs_phase", "adasum": "adasum_vs_sum",
              "topo": "topo_hier_vs_flat",
              "pipeline": "railpipe_overlap",
              "fusion": "svc_fusion_amortization",
+             "onestep": "onestep_hostgap",
              "tenant": "svc_tenant_interference",
              "serve": "serve_plane"}
     try:
