@@ -923,6 +923,38 @@ def _probe_cache_key() -> str:
     return f"{sys.executable}:{jax_version}:{_knob_fingerprint()}"
 
 
+def _resolved_backend_record() -> dict:
+    """The requested/platform/family triple every structured skip
+    carries, so a reader can tell "no TPU on this host" from "GPU host
+    routed through the gpu backend family" without rerunning anything.
+    Hang-safe by construction: consults jax only when a backend is
+    ALREADY initialized in this process (a wedged device tunnel hangs
+    the first backend init forever — the exact failure these records
+    describe); otherwise the platform field reports the JAX_PLATFORMS
+    request."""
+    requested = (os.environ.get("HVD_TPU_BACKEND")
+                 or os.environ.get("HOROVOD_BACKEND") or "auto")
+    platform = None
+    try:
+        jax_mod = sys.modules.get("jax")
+        if jax_mod is not None:
+            bridge = getattr(getattr(jax_mod, "_src", None),
+                             "xla_bridge", None)
+            if bridge is not None and getattr(bridge, "_backends", None):
+                platform = str(jax_mod.default_backend())
+    except Exception:
+        platform = None
+    if platform is None:
+        platform = os.environ.get("JAX_PLATFORMS") or "uninitialized"
+    fam = requested.strip().lower()
+    fam = {"axon": "tpu", "cuda": "gpu", "rocm": "gpu",
+           "nvidia": "gpu"}.get(fam, fam)
+    if fam not in ("tpu", "gpu"):
+        head = platform.split(",")[0].strip().lower()
+        fam = "gpu" if head in ("gpu", "cuda", "rocm") else "tpu"
+    return {"requested": requested, "platform": platform, "family": fam}
+
+
 def emit_structured_abort(e: BaseException,
                           grace_s: Optional[int] = None) -> dict:
     """Last-resort primary record: structured skip, never a raw error
@@ -945,6 +977,7 @@ def emit_structured_abort(e: BaseException,
             f"bench aborted before a primary measurement: "
             f"{type(e).__name__}: {e}".strip()
         ),
+        "backend": _resolved_backend_record(),
     }
     if grace_s is None:
         grace_s = int(os.environ.get("HVD_BENCH_GRACE_S", "240"))
@@ -1046,6 +1079,7 @@ def run_device_probe(deadline_s: float, armed_at: float,
                 or "device probe exhausted retries"
             ),
             "probe_stderr": stderr_tail["text"],
+            "backend": _resolved_backend_record(),
         }
         diagnosis = _probe_diagnosis(deadline_s, armed_at)
         if diagnosis is not None:

@@ -1,0 +1,21 @@
+"""Multi-backend lowering plane (PR 20).
+
+One plan pipeline, two accelerator families: a :class:`registry.Backend`
+descriptor per family (rail names, peak table hook, kernel-lowering
+table, discovery fn), resolved by ``HVD_TPU_BACKEND=auto|tpu|gpu``.
+The gpu family lowers the fused quantized ring through
+``ops/mosaic_quant.py``, discovers NVLink/IB topologies through
+:mod:`gpu_topo`, and prices its rails through the same fitted cost
+model every TPU consumer already uses.  See docs/backends.md.
+"""
+
+from . import gpu_topo, registry  # noqa: F401
+from .registry import (  # noqa: F401
+    RAILS,
+    Backend,
+    family,
+    get,
+    kernel_module_name,
+    rail_labels,
+    reset,
+)

@@ -1,0 +1,104 @@
+"""GPU topology discovery: NVLink domains and IB links as the two rails.
+
+The topology subsystem models exactly two network classes — a fast
+intra-domain rail and a ~10x-slower inter-domain rail — because that is
+the shape of every scaled training fabric (arXiv:1810.11112's two-level
+regime).  On TPU the pair is ICI/DCN; on a GPU cluster it is the NVLink
+island inside a host (or NVSwitch pod) and the InfiniBand fabric
+between hosts.  This module maps the second onto the first:
+
+* one **NVLink domain** per host — devices sharing a ``process_index``
+  (multi-process) or the whole local world (single-process) form a
+  "slice"; NVLink prices as the ICI rail;
+* **IB** between domains prices as the DCN rail;
+* the result is a plain :class:`~horovod_tpu.topo.model.Topology`
+  (``source="gpu"``), so the fitted cost model, hier/flat/hier_adasum
+  resolution, the rail pipeliner, DRR pricing, fusion buffers, and the
+  serve plane all run unchanged — they only ever see the two canonical
+  rails.
+
+``HVD_TPU_TOPO`` (spec string or JSON) is honored *upstream* in
+``topo.model.discover`` before any backend discovery runs, so a forced
+shape behaves identically under either family.  The ``TOPO_*_GBPS`` /
+latency knobs override the GPU defaults below exactly as they override
+the TPU ones.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+from ..utils import env
+
+# Link-parameter defaults for the gpu family (datasheet-order figures:
+# NVLink4 ~450 GB/s/direction per GPU, 4x200Gbit HDR IB ~ 25 GB/s/GPU;
+# the fitted cost model replaces both with measured values after the
+# first HVD_TPU_TOPO_FIT window, so these only seed the first plans).
+DEFAULT_NVLINK_GBPS = 300.0
+DEFAULT_IB_GBPS = 25.0
+DEFAULT_NVLINK_LAT_S = 2e-6
+DEFAULT_IB_LAT_S = 10e-6
+
+
+def _link_params() -> dict:
+    """The topo link-parameter dict with gpu-family defaults; the same
+    ``TOPO_*`` env knobs override (a job that measured its own fabric
+    pins the figures exactly as on TPU)."""
+    from ..topo import model as topo_model
+
+    return dict(
+        ici_gbps=env.get_float(env.TOPO_ICI_GBPS, DEFAULT_NVLINK_GBPS),
+        dcn_gbps=env.get_float(env.TOPO_DCN_GBPS, DEFAULT_IB_GBPS),
+        ici_latency_s=env.get_float(
+            env.TOPO_ICI_LAT_US, DEFAULT_NVLINK_LAT_S * 1e6) * 1e-6,
+        dcn_latency_s=env.get_float(
+            env.TOPO_DCN_LAT_US, DEFAULT_IB_LAT_S * 1e6) * 1e-6,
+        phase_overhead_s=env.get_float(
+            env.TOPO_PHASE_OVERHEAD_US,
+            topo_model.DEFAULT_PHASE_OVERHEAD_S * 1e6) * 1e-6,
+    )
+
+
+def discover(devices: Sequence):
+    """Build a Topology from a GPU (or forced-gpu CPU test) device
+    list: one NVLink domain per ``process_index``, IB between domains.
+    Ragged domain sizes or non-domain-major device order collapse to
+    one domain — the flat degenerate, exactly like the TPU path's
+    ragged-slice fallback."""
+    from ..topo import model as topo_model
+    from ..utils.logging import get_logger
+
+    params = _link_params()
+    n = len(devices)
+    host_of = []
+    for d in devices:
+        idx = getattr(d, "process_index", None)
+        if idx is None:
+            idx = getattr(d, "host_id", None)
+        host_of.append(0 if idx is None else int(idx))
+    ids = sorted(set(host_of))
+    sizes = {i: host_of.count(i) for i in ids}
+    if len(ids) < 2 or len(set(sizes.values())) != 1:
+        if len(ids) >= 2:
+            get_logger().warning(
+                "backend.gpu: ragged NVLink domain sizes %s; treating "
+                "the world as one domain (flat lowering)", sizes,
+            )
+        return topo_model.Topology(
+            num_slices=1, slice_size=n, source="gpu", **params
+        )
+    # Contiguity contract (shared with the TPU path): device order must
+    # be domain-major for the slice-major group math to hold.
+    size = sizes[ids[0]]
+    blocks = [host_of[i * size:(i + 1) * size] for i in range(len(ids))]
+    if any(len(set(b)) != 1 for b in blocks):
+        get_logger().warning(
+            "backend.gpu: device order is not NVLink-domain-major; "
+            "treating the world as one domain (flat lowering)"
+        )
+        return topo_model.Topology(
+            num_slices=1, slice_size=n, source="gpu", **params
+        )
+    return topo_model.Topology(
+        num_slices=len(ids), slice_size=size, source="gpu", **params
+    )

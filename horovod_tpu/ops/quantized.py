@@ -83,11 +83,14 @@ WIRE_FORMATS = {
 
 # Wire backends (``HVD_TPU_QUANT_BACKEND``): "phase" is the stock-XLA
 # three-HLO pipeline below; "fused" lowers the same contract to the
-# Pallas transfer-loop kernels (ops/pallas_quant.py) — quantize /
+# transfer-loop ring kernels of the resolved backend family
+# (``fused_kernel_module``: ops/pallas_quant.py on tpu — quantize /
 # remote-DMA / fp32 dequant-accumulate in one kernel per ICI hop, with
-# lax.ppermute standing in for the DMA off-TPU.  Same numerics contract
-# either way (one quantization per contribution); see
-# docs/quantization.md#wire-backends.
+# lax.ppermute standing in for the DMA off-TPU — and
+# ops/mosaic_quant.py on gpu, Triton compute kernels over an NCCL
+# ppermute transport).  Same numerics contract either way (one
+# quantization per contribution); see docs/quantization.md#wire-backends
+# and docs/backends.md.
 BACKENDS = ("phase", "fused")
 
 
@@ -98,9 +101,43 @@ def quant_block() -> int:
 
 
 def quant_backend() -> str:
-    """The active wire backend (``HVD_TPU_QUANT_BACKEND``, default
-    ``phase``)."""
-    return _canon_backend(env.get_env("QUANT_BACKEND", "phase"))
+    """The active wire backend: ``HVD_TPU_QUANT_BACKEND`` when set,
+    else the resolved backend family's default
+    (``backend/registry.py``: ``phase`` on tpu — the pre-registry
+    behavior exactly — and ``fused`` on gpu, so a GPU mesh routes
+    quantized reduce ops through the mosaic ring without extra
+    knobs)."""
+    raw = env.get_env("QUANT_BACKEND")
+    if raw is None:
+        try:
+            from ..backend import registry
+
+            return _canon_backend(registry.get().default_quant_backend)
+        except Exception:
+            return "phase"
+    return _canon_backend(raw)
+
+
+def fused_kernel_module():
+    """The fused-ring kernel module for the resolved backend family —
+    the registry's kernel-lowering table (``quant_ring`` op class):
+    ``ops/pallas_quant.py`` on tpu, ``ops/mosaic_quant.py`` on gpu.
+    Falls back to pallas_quant when the registry is unavailable (import
+    cycles during teardown) so the fused path never dangles."""
+    name = "pallas_quant"
+    try:
+        from ..backend import registry
+
+        name = registry.kernel_module_name("quant_ring") or name
+    except Exception:
+        pass
+    if name == "mosaic_quant":
+        from . import mosaic_quant
+
+        return mosaic_quant
+    from . import pallas_quant
+
+    return pallas_quant
 
 
 def _canon_backend(backend: Optional[str]) -> str:
@@ -129,10 +166,8 @@ def _fused_mode(groups, n: int, c: int, block: int, wire: str,
         else _canon_backend(backend)
     if resolved != "fused":
         return None
-    from . import pallas_quant
-
     wire_nbytes = n * (c * wire_itemsize(wire) + 4 * (c // block))
-    mode = pallas_quant.dispatch_mode(groups, n, wire_nbytes)
+    mode = fused_kernel_module().dispatch_mode(groups, n, wire_nbytes)
     if mode is None:
         from .. import metrics
 
@@ -312,9 +347,7 @@ def quantized_reduce_scatter(
 
     mode = _fused_mode(groups, n, c, block, wire, backend)
     if mode is not None:
-        from . import pallas_quant
-
-        mine, deq = pallas_quant.fused_reduce_scatter(
+        mine, deq = fused_kernel_module().fused_reduce_scatter(
             chunks, axis, groups=groups, n=n, wire=wire, block=block,
             want_deq=ef, mode=mode,
         )
@@ -390,9 +423,7 @@ def quantized_all_gather(
         )
     mode = _fused_mode(groups, n, c, block, wire, backend)
     if mode is not None:
-        from . import pallas_quant
-
-        return pallas_quant.fused_all_gather(
+        return fused_kernel_module().fused_all_gather(
             flat, axis, groups=groups, n=n, wire=wire, block=block,
             mode=mode,
         )

@@ -77,6 +77,29 @@ def maybe_capture(reason: str) -> bool:
         return False
 
 
+def _rails_view() -> Dict[str, Any]:
+    """The ``/prof`` rail digest: the measured ``topo.rail_busy_frac``
+    gauges keyed by canonical rail tag AND the resolved backend
+    family's display label (gpu relabels ``ici``/``dcn`` to
+    ``nvlink``/``ib``; on tpu the two spellings coincide), plus the
+    label map itself so consumers never have to guess the family."""
+    from .. import metrics
+    from ..topo import model as topo_model
+
+    try:
+        labels = topo_model.rail_labels()
+    except Exception:  # pragma: no cover - defensive
+        labels = {"ici": "ici", "dcn": "dcn"}
+    busy: Dict[str, Any] = {}
+    for rail in ("ici", "dcn"):
+        v = metrics.get_gauge("topo.rail_busy_frac", {"rail": rail})
+        busy[rail] = v
+        label = labels.get(rail, rail)
+        if label != rail:
+            busy[label] = v
+    return {"labels": labels, "busy_frac": busy}
+
+
 def _rank_view(snap: Dict[str, Any]) -> Dict[str, Any]:
     """The per-rank ``/prof`` digest from one worker's metrics
     snapshot (the existing KV push payload — no new wire format)."""
@@ -131,6 +154,7 @@ def prof_payload(
             "peak_tflops": cached[0] if cached else None,
             "peak_source": cached[1] if cached else None,
         }
+        payload["rails"] = _rails_view()
         payload["capture"] = capture.stats()
         sentinel = baseline.get_sentinel()
         payload["baseline"] = {

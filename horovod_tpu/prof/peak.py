@@ -33,6 +33,22 @@ PEAK_BF16_TFLOPS = [
     ("v2", 45.0),
 ]
 
+# The gpu-family table (backend/registry.py peak-table hook): dense
+# bf16 tensor-core peaks from the public NVIDIA/AMD datasheets, keyed
+# by device_kind substring exactly like the TPU table.  Ordered
+# longest-match-first where one name contains another.
+PEAK_BF16_TFLOPS_GPU = [
+    ("h200", 989.0),
+    ("h100", 989.0),     # SXM; PCIe parts report the same kind string
+    ("a100", 312.0),
+    ("a10g", 70.0),
+    ("l40", 181.0),
+    ("l4", 121.0),
+    ("v100", 125.0),     # no bf16 — fp16 tensor-core figure
+    ("mi300", 1307.0),
+    ("mi250", 383.0),
+]
+
 # ResNet-50 v1.5 @224: ~4.1 GFLOPs forward per image; training
 # (fwd + bwd) ~3x forward.
 RESNET50_TRAIN_GFLOPS_PER_IMAGE = 4.1 * 3
@@ -45,9 +61,17 @@ _override: Optional[float] = None
 
 def chip_peak_tflops(device) -> Optional[float]:
     """Datasheet peak for a jax device, or None when its kind is not
-    in the public table."""
+    in the resolved backend family's table (the registry peak-table
+    hook picks TPU vs GPU figures; registry failure falls back to the
+    TPU table — the pre-registry behavior)."""
     kind = (getattr(device, "device_kind", "") or "").lower()
-    for key, peak in PEAK_BF16_TFLOPS:
+    try:
+        from ..backend import registry
+
+        table = registry.get().peak_table()
+    except Exception:
+        table = PEAK_BF16_TFLOPS
+    for key, peak in table:
         if key in kind:
             return peak
     return None

@@ -90,6 +90,20 @@ def knob_fingerprint(include_svc: bool = True) -> str:
     except Exception:
         pass
     try:
+        # The RESOLVED accelerator backend family folds in the same
+        # way, but only when it is not "tpu": every pre-registry DB
+        # entry was tuned on the tpu family, so unset ≡ tpu must keep
+        # the existing keys byte-identical, while "gpu" winners —
+        # priced over NVLink/IB constants and the mosaic ring — must
+        # never warm-start a TPU mesh (or vice versa).
+        from ..backend import registry as _backend_registry
+
+        fam = _backend_registry.family()
+        if fam != "tpu":
+            items.append(("HVD_TPU_BACKEND(resolved)", fam))
+    except Exception:
+        pass
+    try:
         # The rail-pipeliner knob joins in resolved form for the same
         # reason as the backend: an unset HVD_TPU_XIR_PIPELINE and an
         # explicit "auto" plan identical schedules and share entries,

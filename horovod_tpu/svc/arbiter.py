@@ -614,6 +614,29 @@ _WAIT_PREFIX = "svc.tenant.wait_seconds."
 _ADMIT_PREFIX = "svc.tenant.admission_wait_seconds."
 
 
+def _canon_rail(rail: Any) -> str:
+    """Canonical rail tag (``ici``/``dcn``) for any spelling — a gauge
+    labeled ``nvlink`` folds into the ``ici`` column; an unknown tag
+    passes through lowercased rather than raising."""
+    try:
+        from ..topo import model as topo_model
+
+        return topo_model.canon_rail(rail)
+    except Exception:
+        return str(rail or "").strip().lower()
+
+
+def _rail_labels() -> Dict[str, str]:
+    """Resolved backend family's display label per canonical rail
+    (``{"ici": "nvlink", "dcn": "ib"}`` on gpu; identity on tpu)."""
+    try:
+        from ..topo import model as topo_model
+
+        return topo_model.rail_labels()
+    except Exception:
+        return {"ici": "ici", "dcn": "dcn"}
+
+
 def _tenant_gauges(snapshot: Dict[str, Any]) -> Dict[str, Dict[str, float]]:
     out: Dict[str, Dict[str, float]] = {}
     for g in snapshot.get("gauges") or ():
@@ -630,9 +653,15 @@ def _tenant_gauges(snapshot: Dict[str, Any]) -> Dict[str, Dict[str, float]]:
         labels = g.get("labels") or {}
         tenant, rail = labels.get("tenant"), labels.get("rail")
         if tenant and rail:
-            out.setdefault(tenant, {})[f"rail_seconds_{rail}"] = float(
-                g.get("value") or 0.0
-            )
+            canon = _canon_rail(rail)
+            val = float(g.get("value") or 0.0)
+            entry = out.setdefault(tenant, {})
+            entry[f"rail_seconds_{canon}"] = val
+            label = _rail_labels().get(canon, canon)
+            if label != canon:
+                # Backend display spelling rides along (gpu: nvlink/ib)
+                # so dashboards keyed either way keep working.
+                entry[f"rail_seconds_{label}"] = val
     return out
 
 
@@ -660,7 +689,12 @@ def tenants_payload(per_rank: Dict[int, Dict[str, Any]]) -> Dict[str, Any]:
     each rank's pushed metrics snapshot — queue depth and rail bytes
     summed across ranks, wait quantiles per rank, share/usage from the
     max reporter (every rank's arbiter computes the same fractions).
-    Shape: ``{"tenants": {name: {...}}, "ranks": {rank: {tenants}}}``.
+    Shape: ``{"tenants": {name: {...}}, "ranks": {rank: {tenants}},
+    "rail_labels": {canon: label}}``.  Canonical ``ici_bytes``/
+    ``dcn_bytes`` keys are always present; when the resolved backend
+    family relabels a rail (gpu: nvlink/ib) the display spelling is
+    mirrored alongside, so existing consumers and backend-native
+    dashboards both resolve.
     """
     tenants: Dict[str, Dict[str, Any]] = {}
     ranks: Dict[str, Dict[str, Any]] = {}
@@ -696,4 +730,9 @@ def tenants_payload(per_rank: Dict[int, Dict[str, Any]]) -> Dict[str, Any]:
                 agg["wait_p99_s"] = max(worst, w.get("p99") or 0.0)
         if rank_view:
             ranks[str(rank)] = rank_view
-    return {"tenants": tenants, "ranks": ranks}
+    labels = _rail_labels()
+    for agg in tenants.values():
+        for canon, label in labels.items():
+            if label != canon and f"{canon}_bytes" in agg:
+                agg[f"{label}_bytes"] = agg[f"{canon}_bytes"]
+    return {"tenants": tenants, "ranks": ranks, "rail_labels": labels}

@@ -64,10 +64,14 @@ from .hierarchical import (  # noqa: F401
 )
 from .model import (  # noqa: F401
     LOWER_CHOICES,
+    RAILS,
     Topology,
+    canon_rail,
     current,
     discover,
     lower_mode,
+    rail_label,
+    rail_labels,
     reset,
     set_topology_override,
 )

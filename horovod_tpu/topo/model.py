@@ -58,6 +58,48 @@ DEFAULT_PHASE_OVERHEAD_S = 200e-6
 
 _COLLECTIVES = ("all_reduce", "reduce_scatter", "all_gather")
 
+# --------------------------------------------------------- rail naming
+#
+# Every pricing/pipelining consumer keys on the two CANONICAL rails —
+# "ici" (fast intra-domain) and "dcn" (slow inter-domain) — regardless
+# of backend family; the physical spellings (NVLink/IB on gpu) are a
+# display concern served by the backend registry.  canon_rail maps any
+# spelling back to canonical (identity for unknown tags, never a
+# KeyError) so a payload tagged "nvlink" aggregates with one tagged
+# "ici".
+
+RAILS = ("ici", "dcn")
+
+_RAIL_CANON = {
+    "ici": "ici", "nvlink": "ici", "nvswitch": "ici",
+    "dcn": "dcn", "ib": "dcn", "infiniband": "dcn", "roce": "dcn",
+}
+
+
+def canon_rail(tag) -> str:
+    """Canonical rail for any spelling; an unknown tag passes through
+    lowercased (callers must tolerate it, never KeyError)."""
+    t = str(tag or "").strip().lower()
+    return _RAIL_CANON.get(t, t)
+
+
+def rail_labels() -> dict:
+    """Canonical rail tag -> the resolved backend family's physical
+    label ({"ici": "nvlink", "dcn": "ib"} on gpu; identity on tpu or
+    whenever the registry is unavailable)."""
+    try:
+        from ..backend import registry
+
+        return registry.rail_labels()
+    except Exception:
+        return {r: r for r in RAILS}
+
+
+def rail_label(rail: str) -> str:
+    """Physical spelling of one rail tag under the resolved family."""
+    canon = canon_rail(rail)
+    return rail_labels().get(canon, canon)
+
 
 @dataclasses.dataclass(frozen=True)
 class Topology:
@@ -606,7 +648,10 @@ def _from_devices(devices) -> Topology:
 
 def discover(devices: Optional[Sequence] = None) -> Topology:
     """Build the topology: the ``HVD_TPU_TOPO`` override when set (CPU
-    tests, forced shapes), else discovery from ``jax.devices()``."""
+    tests, forced shapes — honored identically under every backend
+    family), else the resolved family's discovery fn
+    (``backend/registry.py``: slice_index/coords grouping on tpu,
+    NVLink-domain/IB grouping on gpu)."""
     spec = env.get_env(env.TOPO)
     if devices is None:
         import jax
@@ -617,7 +662,13 @@ def discover(devices: Optional[Sequence] = None) -> Topology:
         devices = rt.devices if rt is not None else jax.devices()
     if spec:
         return _from_spec(spec, len(devices))
-    return _from_devices(devices)
+    try:
+        from ..backend import registry
+
+        backend_discover = registry.get().discover
+    except Exception:
+        backend_discover = _from_devices
+    return backend_discover(devices)
 
 
 def current() -> Topology:
@@ -633,7 +684,15 @@ def current() -> Topology:
 
     rt = get_runtime_or_none()
     devices = rt.devices if rt is not None else jax.devices()
-    key = (spec, len(devices))
+    try:
+        from ..backend import registry
+
+        fam = registry.family()
+    except Exception:
+        fam = "tpu"
+    # The family joins the cache key: tests flip HVD_TPU_BACKEND and a
+    # gpu-discovered topology must never serve a tpu-family lookup.
+    key = (spec, fam, len(devices))
     with _lock:
         topo = _cache.get(key)
         if topo is None:

@@ -67,6 +67,15 @@ SCHED_WIRE = "SCHED_WIRE"
 SCHED_WIRE_EF = "SCHED_WIRE_EF"
 # Elements per quantization block (fp32 scale granularity), default 512.
 QUANT_BLOCK = "QUANT_BLOCK"
+# Accelerator backend family (backend/registry.py): "auto" (default;
+# resolved from jax.devices()[0].platform — gpu/cuda/rocm platforms
+# pick the gpu family, everything else the tpu family), "tpu", or
+# "gpu".  The override exists so CPU test meshes can force either
+# family's lowering tables (rail names, fused-ring kernel module, peak
+# table, topology discovery) without hardware.  The RESOLVED family
+# folds into the tune-DB knob fingerprint (unset ≡ tpu, so existing
+# entries keep their keys).  See docs/backends.md.
+BACKEND = "BACKEND"
 # Quantized-wire backend: "phase" (default; blockwise quantize ->
 # all_to_all of wire chunks + scales -> dequant-accumulate as separate
 # XLA HLOs) or "fused" (ops/pallas_quant.py Pallas ring kernels:

@@ -49,11 +49,16 @@ def tuner_key(program: ir.ExchangeProgram) -> str:
 
 
 def resolve_backend(op: ir.ExchangeOp) -> Optional[str]:
-    """Quantized-wire backend for one op (``HVD_TPU_QUANT_BACKEND``),
-    gated per op class: only the reduce-shaped ops have a fused Pallas
-    lowering (the ring kernels implement quantize/DMA/dequant-
+    """Quantized-wire backend for one op (``HVD_TPU_QUANT_BACKEND``,
+    defaulting through the accelerator backend family —
+    ``backend/registry.py``: phase on tpu, fused on gpu), gated per op
+    class: only the reduce-shaped ops have a fused ring lowering (the
+    pallas_quant/mosaic_quant kernels implement quantize/DMA/dequant-
     accumulate — a shuffle op has no accumulation to fuse), so anything
-    else pins ``"phase"``.  ``None`` for dense/bf16 wires — the backend
+    else pins ``"phase"``.  Ineligible groups under ``"fused"`` fall
+    back to the phase primitives at dispatch time
+    (``quantized._fused_mode`` → ``quant.fused_fallback``), never
+    silently to dense.  ``None`` for dense/bf16 wires — the backend
     attribute only exists where a quantizer runs."""
     if op.wire not in ("int8", "fp8"):
         return None

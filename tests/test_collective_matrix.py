@@ -1635,3 +1635,248 @@ class TestGroupFusionKnob:
         unfused = run()
         for a, b in zip(fused, unfused):
             np.testing.assert_allclose(a, b, rtol=1e-6)
+
+
+@pytest.mark.backend
+class TestBackendColumn:
+    """The gpu backend-family column of the matrix
+    (``HVD_TPU_BACKEND=gpu`` → backend/registry.py routes quantized
+    reduce ops through ops/mosaic_quant.py, interpret mode on the CPU
+    mesh): gpu-interpret vs phase vs dense parity, gpu-vs-tpu family
+    bitwise identity (the two families share the kernel math), the
+    forced 2-slice hierarchical lowering, process-set subgroups, the
+    hardware-ineligibility fallback, and the acceptance counters
+    (nonzero ``backend.gpu.*``, zero silent fallbacks)."""
+
+    @pytest.fixture(autouse=True)
+    def _fresh_backend(self, monkeypatch):
+        from horovod_tpu import topo
+        from horovod_tpu.backend import registry
+
+        monkeypatch.delenv("HVD_TPU_BACKEND", raising=False)
+        monkeypatch.delenv("HVD_TPU_QUANT_BACKEND", raising=False)
+        registry.reset()
+        topo.reset()
+        yield
+        registry.reset()
+        topo.reset()
+
+    def _force(self, monkeypatch, fam):
+        from horovod_tpu import topo
+        from horovod_tpu.backend import registry
+
+        monkeypatch.setenv("HVD_TPU_BACKEND", fam)
+        registry.reset()
+        topo.reset()
+
+    def _run(self, fn, *args, n_out=1):
+        import jax
+        from jax.sharding import PartitionSpec as P
+
+        from horovod_tpu.runtime import WORLD_AXIS, get_runtime
+
+        mesh = get_runtime().mesh
+        spec = P(WORLD_AXIS)
+        return jax.jit(jax.shard_map(
+            fn, mesh=mesh, in_specs=(spec,) * len(args),
+            out_specs=(spec,) * n_out if n_out > 1 else spec,
+            check_vma=False,
+        ))(*args)
+
+    @pytest.mark.parametrize("wire", ["int8", "fp8"])
+    def test_gpu_family_vs_phase_vs_dense(self, hvd_module, monkeypatch,
+                                          wire):
+        """Under the gpu family the UNSET quant knob routes through the
+        mosaic ring (family default ``fused``); it must agree with an
+        explicit phase backend at summation-order tolerance and with
+        the dense sum at quantization tolerance."""
+        from jax import lax
+
+        from horovod_tpu.ops.quantized import quantized_allreduce
+        from horovod_tpu.ops.traced import Sum
+        from horovod_tpu.runtime import WORLD_AXIS
+
+        x = _data(np.float32, shape=(N, 999), seed=50)
+        self._force(monkeypatch, "gpu")
+        gpu = np.asarray(self._run(
+            lambda a: quantized_allreduce(a[0], op=Sum, wire=wire)[None],
+            x,
+        ))
+        phase = np.asarray(self._run(
+            lambda a: quantized_allreduce(
+                a[0], op=Sum, wire=wire, backend="phase"
+            )[None], x,
+        ))
+        dense = np.asarray(self._run(
+            lambda a: lax.psum(a[0], WORLD_AXIS)[None], x,
+        ))
+        np.testing.assert_allclose(gpu, phase, rtol=1e-6, atol=1e-6)
+        # dense tolerance is the wire's quantization error summed over
+        # N contributions (fp8 e4m3 carries ~6% per-element error)
+        dense_tol = dict(rtol=1e-2, atol=1e-1) if wire == "int8" \
+            else dict(rtol=1e-1, atol=1.0)
+        np.testing.assert_allclose(gpu, dense, **dense_tol)
+
+    def test_bitwise_exact_grid_gpu_phase_dense(self, hvd_module,
+                                                monkeypatch):
+        """Payload crafted so BOTH quantization grids are exact: the
+        contribution hop sees amax 127 (scale 1) over integer values,
+        and the gathered-sum hop sees amax 1016 = 8 x 127 (scale 8)
+        over multiple-of-8 sums — so gpu == phase == dense bit for
+        bit."""
+        from jax import lax
+
+        from horovod_tpu.ops.quantized import quant_block, quantized_allreduce
+        from horovod_tpu.ops.traced import Sum
+        from horovod_tpu.runtime import WORLD_AXIS
+
+        block = quant_block()
+        rng = np.random.RandomState(51)
+        x = (8 * rng.randint(-15, 16, (N, 2 * block))).astype(np.float32)
+        # Pin the amax on every 8-aligned run — the ring path re-chunks
+        # rows before blocking, and whatever block the quantizer lands
+        # on must contain a 127 (and the reduced tensor a 1016).
+        x[:, ::8] = 127.0
+        self._force(monkeypatch, "gpu")
+        gpu = np.asarray(self._run(
+            lambda a: quantized_allreduce(a[0], op=Sum, wire="int8")[None],
+            x,
+        ))
+        phase = np.asarray(self._run(
+            lambda a: quantized_allreduce(
+                a[0], op=Sum, wire="int8", backend="phase"
+            )[None], x,
+        ))
+        dense = np.asarray(self._run(
+            lambda a: lax.psum(a[0], WORLD_AXIS)[None], x,
+        ))
+        np.testing.assert_array_equal(gpu, phase)
+        np.testing.assert_array_equal(gpu, dense)
+
+    def test_gpu_family_bitwise_equals_tpu_family(self, hvd_module,
+                                                  monkeypatch):
+        """mosaic_quant imports pallas_quant's kernels rather than
+        copying them, so the two families' fused interpret paths are
+        the same program — bitwise, for arbitrary payloads."""
+        from horovod_tpu.ops.quantized import quantized_allreduce
+        from horovod_tpu.ops.traced import Sum
+
+        x = _data(np.float32, shape=(N, 1234), seed=52)
+        monkeypatch.setenv("HVD_TPU_QUANT_BACKEND", "fused")
+
+        def f():
+            return np.asarray(self._run(
+                lambda a: quantized_allreduce(
+                    a[0], op=Sum, wire="int8"
+                )[None], x,
+            ))
+
+        self._force(monkeypatch, "gpu")
+        out_gpu = f()
+        self._force(monkeypatch, "tpu")
+        out_tpu = f()
+        np.testing.assert_array_equal(out_gpu, out_tpu)
+
+    def test_forced_two_slice_hier_gpu_family(self, hvd_module,
+                                              monkeypatch):
+        """Forced 2-slice topology + gpu family: the hierarchical
+        lowering's quantized hop dispatches through the mosaic module
+        on the same tiling groups the tpu family uses — identical hop
+        math, bitwise-equal result."""
+        from horovod_tpu import topo
+        from horovod_tpu.ops.traced import Sum
+        from horovod_tpu.runtime import WORLD_AXIS
+
+        monkeypatch.setenv("HVD_TPU_TOPO", "2x4")
+        monkeypatch.setenv("HVD_TPU_QUANT_BACKEND", "fused")
+        x = _data(np.float32, shape=(N, 1100), seed=53)
+
+        def f():
+            return np.asarray(self._run(
+                lambda a: topo.hierarchical_all_reduce(
+                    a, WORLD_AXIS, op=Sum, wire="int8"
+                ), x,
+            ))
+
+        self._force(monkeypatch, "gpu")
+        assert topo.current().num_slices == 2  # spec wins over family
+        out_gpu = f()
+        self._force(monkeypatch, "tpu")
+        out_tpu = f()
+        np.testing.assert_array_equal(out_gpu, out_tpu)
+
+    def test_process_set_subgroups_gpu_family(self, hvd_module,
+                                              monkeypatch):
+        monkeypatch.setenv("HVD_TPU_DYNAMIC_PROCESS_SETS", "1")
+        from horovod_tpu.ops.quantized import quantized_allreduce
+        from horovod_tpu.ops.traced import Sum
+        from horovod_tpu.runtime import WORLD_AXIS
+
+        self._force(monkeypatch, "gpu")
+        ps = hvd.add_process_set([0, 1, 2, 3])
+        try:
+            x = _data(np.float32, shape=(N, 1030), seed=54)
+            out = np.asarray(self._run(
+                lambda a: quantized_allreduce(
+                    a[0], WORLD_AXIS, op=Sum, process_set=ps
+                )[None], x,
+            ))
+            expect = np.asarray(x[:4], np.float64).sum(axis=0)
+            np.testing.assert_allclose(
+                np.asarray(out[0], np.float64), expect,
+                rtol=1e-2, atol=1e-1,
+            )
+        finally:
+            hvd.remove_process_set(ps)
+
+    def test_hardware_ineligibility_falls_back_to_phase(
+        self, hvd_module, monkeypatch
+    ):
+        """A 'real GPU' whose jax build lacks the Triton lowering:
+        dispatch_mode returns None, the collective falls back to the
+        phase backend with the ``quant.fused_fallback`` counter — and
+        the answer is still right."""
+        from horovod_tpu import metrics
+        from horovod_tpu.ops import mosaic_quant
+        from horovod_tpu.ops.quantized import quantized_allreduce
+        from horovod_tpu.ops.traced import Sum
+
+        self._force(monkeypatch, "gpu")
+        monkeypatch.setattr(mosaic_quant, "_on_gpu", lambda: True)
+        monkeypatch.setattr(mosaic_quant, "_HAS_PLGPU", False)
+        assert mosaic_quant.dispatch_mode(None, N) is None
+        metrics.reset_counters("quant.")
+        metrics.reset_counters("backend.")
+        x = _data(np.float32, shape=(N, 512), seed=55)
+        out = np.asarray(self._run(
+            lambda a: quantized_allreduce(a[0], op=Sum)[None], x,
+        ))
+        assert metrics.get_counter("quant.fused_fallback") > 0
+        assert metrics.get_counter("backend.gpu.quant_collectives") == 0
+        expect = np.asarray(x, np.float64).sum(axis=0)
+        np.testing.assert_allclose(
+            np.asarray(out[0], np.float64), expect,
+            rtol=1e-2, atol=1e-1,
+        )
+
+    def test_acceptance_counters_nonzero_no_silent_fallback(
+        self, hvd_module, monkeypatch
+    ):
+        """The PR's acceptance gauge: under ``HVD_TPU_BACKEND=gpu`` a
+        quantized reduce op routes through the mosaic lowering —
+        nonzero ``backend.gpu.*`` counters, zero fallbacks."""
+        from horovod_tpu import metrics
+        from horovod_tpu.ops.quantized import quantized_allreduce
+        from horovod_tpu.ops.traced import Sum
+
+        self._force(monkeypatch, "gpu")
+        metrics.reset_counters("quant.")
+        metrics.reset_counters("backend.")
+        x = _data(np.float32, shape=(N, 768), seed=56)
+        self._run(
+            lambda a: quantized_allreduce(a[0], op=Sum)[None], x,
+        )
+        assert metrics.get_counter("backend.gpu.quant_collectives") > 0
+        assert metrics.get_counter("backend.gpu.quant_bytes") > 0
+        assert metrics.get_counter("quant.fused_collectives") > 0
+        assert metrics.get_counter("quant.fused_fallback") == 0

@@ -25,10 +25,19 @@ The verdict is the FIRST failing stage — everything after it is
 skipped (it would fail for the same reason and double the wait).  The
 record is JSON-stable::
 
-    {"status": "ok"|"sick", "verdict": {"stage", "cause", "detail"},
+    {"status": "ok"|"sick", "verdict": {"stage", "cause", "detail",
+                                        "backend_family"},
      "stages": [{"stage", "status", "seconds", "returncode",
-                 "stderr_tail", "timeout_s"}, ...],
-     "platform": {...}}
+                 "stderr_tail", "stdout", "timeout_s"}, ...],
+     "platform": {...},
+     "backend": {"requested", "platform", "family"}}
+
+The ``backend`` record resolves the accelerator backend family the
+way ``horovod_tpu/backend/registry.py`` does (env override, else the
+probed platform) WITHOUT importing horovod_tpu — the doctor stays
+runnable in an env so broken that only stdlib imports work.  It is
+what lets a reader tell "no TPU on this host" from "GPU host, gpu
+family" straight from the verdict.
 
 Run standalone (``python tools/probe_doctor.py [--timeout-s N]
 [--platform cpu]``) or let ``bench.py`` call :func:`diagnose` when its
@@ -56,7 +65,7 @@ STAGES = (
      "import jax; print(jax.__version__)",
      "python environment: jax failed to import"),
     ("backend_init",
-     "import jax; print(len(jax.devices()))",
+     "import jax; print(jax.default_backend(), len(jax.devices()))",
      "device runtime: backend handshake failed or hung"),
     ("compute",
      "import jax, jax.numpy as jnp; print(float(jnp.ones(8).sum()))",
@@ -88,6 +97,7 @@ def run_stage(stage: str, code: str, timeout_s: float,
             env=dict(env if env is not None else os.environ),
         )
         out["returncode"] = proc.returncode
+        out["stdout"] = (proc.stdout or "").strip()[:200]
         if proc.returncode != 0:
             out["status"] = "error"
             out["stderr_tail"] = _tail(proc.stderr)
@@ -101,6 +111,35 @@ def run_stage(stage: str, code: str, timeout_s: float,
         out["stderr_tail"] = f"{type(e).__name__}: {e}"
     out["seconds"] = round(time.monotonic() - t0, 3)
     return out
+
+
+def _backend_record(env_map: Dict[str, str],
+                    stages: List[Dict[str, Any]]) -> Dict[str, Any]:
+    """Resolve requested/platform/family with stdlib only, mirroring
+    ``backend/registry.py``'s rules: env override first (with the
+    registry's aliases), else the platform the ``backend_init`` stage
+    actually printed, else the JAX_PLATFORMS request."""
+    requested = (env_map.get("HVD_TPU_BACKEND")
+                 or env_map.get("HOROVOD_BACKEND") or "auto")
+    platform = ""
+    for rec in stages:
+        if rec.get("stage") == "backend_init" and rec.get("stdout"):
+            platform = rec["stdout"].split()[0].lower()
+            break
+    if not platform:
+        platform = (env_map.get("JAX_PLATFORMS") or
+                    "uninitialized").split(",")[0].strip().lower()
+    fam = requested.strip().lower()
+    fam = {"axon": "tpu", "cuda": "gpu", "rocm": "gpu",
+           "nvidia": "gpu"}.get(fam, fam)
+    if fam not in ("tpu", "gpu"):
+        if platform in ("gpu", "cuda", "rocm"):
+            fam = "gpu"
+        elif platform in ("tpu", "axon", "cpu"):
+            fam = "tpu"  # registry: every non-gpu platform -> tpu
+        else:
+            fam = "unknown"
+    return {"requested": requested, "platform": platform, "family": fam}
 
 
 def diagnose(timeout_s: float = DEFAULT_STAGE_TIMEOUT_S,
@@ -128,6 +167,10 @@ def diagnose(timeout_s: float = DEFAULT_STAGE_TIMEOUT_S,
     except Exception as e:  # pragma: no cover - defensive
         verdict = {"stage": "doctor", "cause": "doctor itself failed",
                    "detail": f"{type(e).__name__}: {e}"}
+    backend = _backend_record(dict(env if env is not None
+                                   else os.environ), stages)
+    if verdict is not None:
+        verdict["backend_family"] = backend["family"]
     return {
         "status": "ok" if verdict is None else "sick",
         "verdict": verdict,
@@ -137,6 +180,7 @@ def diagnose(timeout_s: float = DEFAULT_STAGE_TIMEOUT_S,
             "jax_platforms": (env or os.environ).get(
                 "JAX_PLATFORMS", os.environ.get("JAX_PLATFORMS", "")),
         },
+        "backend": backend,
     }
 
 
